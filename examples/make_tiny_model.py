@@ -1,0 +1,67 @@
+"""Build a tiny random-weight local checkpoint (llama or opt) + word-level
+tokenizer for offline experimentation — no network access needed.
+
+Usage: python examples/make_tiny_model.py --arch llama --out /tmp/tiny-llama
+"""
+import argparse
+import sys
+
+sys.path.insert(0, "tests")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--arch", choices=["llama", "opt"], default="llama")
+    parser.add_argument("--out", type=str, required=True)
+    parser.add_argument("--hidden-size", type=int, default=64)
+    parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--heads", type=int, default=4)
+    parser.add_argument("--kv-heads", type=int, default=2)
+    parser.add_argument("--head-dim", type=int, default=None)
+    parser.add_argument("--max-len", type=int, default=128)
+    args = parser.parse_args()
+
+    import torch
+    from conftest import _build_word_tokenizer
+
+    _, vocab_size = _build_word_tokenizer(args.out)
+    torch.manual_seed(0)
+    if args.arch == "llama":
+        from transformers import LlamaConfig, LlamaForCausalLM
+        kwargs = {}
+        if args.head_dim:
+            kwargs["head_dim"] = args.head_dim
+        config = LlamaConfig(
+            vocab_size=vocab_size,
+            hidden_size=args.hidden_size,
+            intermediate_size=args.hidden_size * 2,
+            num_hidden_layers=args.layers,
+            num_attention_heads=args.heads,
+            num_key_value_heads=args.kv_heads,
+            max_position_embeddings=args.max_len,
+            pad_token_id=0, eos_token_id=1, bos_token_id=1,
+            tie_word_embeddings=False,
+            torch_dtype=torch.float32,
+            **kwargs,
+        )
+        model = LlamaForCausalLM(config)
+    else:
+        from transformers import OPTConfig, OPTForCausalLM
+        config = OPTConfig(
+            vocab_size=vocab_size,
+            hidden_size=args.hidden_size,
+            num_hidden_layers=args.layers,
+            num_attention_heads=args.heads,
+            ffn_dim=args.hidden_size * 2,
+            max_position_embeddings=args.max_len,
+            pad_token_id=0, eos_token_id=1, bos_token_id=1,
+            word_embed_proj_dim=args.hidden_size,
+            torch_dtype=torch.float32,
+        )
+        model = OPTForCausalLM(config)
+    model.save_pretrained(args.out, safe_serialization=True)
+    print(f"Saved tiny {args.arch} to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Offline batched inference through the public API.
+
+Parity example: reference `examples/offline_inference.py`.
+Usage: python examples/offline_inference.py [--model MODEL] [--temperature T]
+"""
+import argparse
+
+from intellillm_tpu import LLM, SamplingParams
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", type=str, default="facebook/opt-125m")
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--top-p", type=float, default=1.0)
+    parser.add_argument("--max-tokens", type=int, default=16)
+    parser.add_argument("--n", type=int, default=1)
+    parser.add_argument("--dtype", type=str, default="auto")
+    parser.add_argument("--max-model-len", type=int, default=None)
+    parser.add_argument("--num-device-blocks-override", type=int, default=None)
+    args = parser.parse_args()
+
+    prompts = [
+        "hello my name is",
+        "the president of the united states is",
+        "the capital of france is",
+        "the cat runs fast and the dog",
+    ]
+    sampling_params = SamplingParams(
+        n=args.n,
+        best_of=args.n,
+        temperature=args.temperature,
+        top_p=args.top_p,
+        max_tokens=args.max_tokens,
+    )
+
+    llm = LLM(model=args.model,
+              dtype=args.dtype,
+              max_model_len=args.max_model_len,
+              num_device_blocks_override=args.num_device_blocks_override)
+    outputs = llm.generate(prompts, sampling_params)
+    for output in outputs:
+        for comp in output.outputs:
+            print(f"Prompt: {output.prompt!r}, "
+                  f"Generated[{comp.index}]: {comp.text!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""intellillm-tpu: a TPU-native LLM serving framework.
+
+Continuous batching + paged KV cache + mesh tensor parallelism +
+OpenAI-compatible serving + predicted-length (SJF) scheduling — built on
+JAX/XLA/Pallas. Capability parity target: James-QiuHaoran/IntelliLLM
+(a vLLM 0.3.0 fork); see SURVEY.md for the component map.
+"""
+
+__version__ = "0.1.0"
+
+from intellillm_tpu.engine.arg_utils import AsyncEngineArgs, EngineArgs
+from intellillm_tpu.engine.llm_engine import LLMEngine
+from intellillm_tpu.entrypoints.llm import LLM
+from intellillm_tpu.outputs import CompletionOutput, RequestOutput
+from intellillm_tpu.sampling_params import SamplingParams
+
+__all__ = [
+    "LLM",
+    "LLMEngine",
+    "EngineArgs",
+    "AsyncEngineArgs",
+    "SamplingParams",
+    "RequestOutput",
+    "CompletionOutput",
+    "__version__",
+]

@@ -1,0 +1,68 @@
+"""Logical and physical KV-cache block handles.
+
+Role parity: reference `vllm/block.py` (LogicalTokenBlock :9,
+PhysicalTokenBlock :43). Physical blocks index into the preallocated HBM
+pool arrays owned by the CacheEngine; the host-side bookkeeping here is
+device-agnostic.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from intellillm_tpu.utils import Device
+
+_BLANK_TOKEN_ID = -1
+
+
+class LogicalTokenBlock:
+    """A block-sized span of a sequence's token ids (host bookkeeping)."""
+
+    __slots__ = ("block_number", "block_size", "token_ids", "num_tokens")
+
+    def __init__(self, block_number: int, block_size: int) -> None:
+        self.block_number = block_number
+        self.block_size = block_size
+        self.token_ids: List[int] = [_BLANK_TOKEN_ID] * block_size
+        self.num_tokens = 0
+
+    def is_empty(self) -> bool:
+        return self.num_tokens == 0
+
+    def get_num_empty_slots(self) -> int:
+        return self.block_size - self.num_tokens
+
+    def is_full(self) -> bool:
+        return self.num_tokens == self.block_size
+
+    def append_tokens(self, token_ids: List[int]) -> None:
+        assert len(token_ids) <= self.get_num_empty_slots()
+        self.token_ids[self.num_tokens:self.num_tokens + len(token_ids)] = token_ids
+        self.num_tokens += len(token_ids)
+
+    def get_token_ids(self) -> List[int]:
+        return self.token_ids[:self.num_tokens]
+
+    def get_last_token_id(self) -> int:
+        assert self.num_tokens > 0
+        return self.token_ids[self.num_tokens - 1]
+
+
+class PhysicalTokenBlock:
+    """A refcounted slot in the device (HBM) or host (swap) block pool."""
+
+    __slots__ = ("device", "block_number", "block_size", "ref_count")
+
+    def __init__(self, device: Device, block_number: int, block_size: int) -> None:
+        self.device = device
+        self.block_number = block_number
+        self.block_size = block_size
+        self.ref_count = 0
+
+    def __repr__(self) -> str:
+        return (f"PhysicalTokenBlock(device={self.device}, "
+                f"block_number={self.block_number}, "
+                f"ref_count={self.ref_count})")
+
+
+# A sequence's physical blocks, ordered by logical index.
+BlockTable = List[PhysicalTokenBlock]

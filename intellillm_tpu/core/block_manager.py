@@ -1,0 +1,278 @@
+"""Paged KV-cache block bookkeeping (host side).
+
+Role parity: reference `vllm/core/block_manager.py` (BlockAllocator :10,
+AllocStatus :54, BlockSpaceManager :68): logical→physical block maps,
+refcounted free lists per device, copy-on-write forking, host↔HBM swap
+planning, sliding-window block rings, allocation watermark. The physical
+block numbers index the HBM pool arrays held by the worker's CacheEngine;
+this module never touches device memory itself — it emits block-op plans
+(swap-in / swap-out / copy dicts) that the worker executes.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, Set, Tuple
+
+from intellillm_tpu.block import BlockTable, PhysicalTokenBlock
+from intellillm_tpu.sequence import Sequence, SequenceGroup, SequenceStatus
+from intellillm_tpu.utils import Device
+
+
+class BlockAllocator:
+    """Free-list allocator over a fixed pool of refcounted blocks."""
+
+    def __init__(self, device: Device, block_size: int, num_blocks: int) -> None:
+        self.device = device
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.free_blocks: List[PhysicalTokenBlock] = [
+            PhysicalTokenBlock(device, i, block_size)
+            for i in range(num_blocks)
+        ]
+
+    def allocate(self) -> PhysicalTokenBlock:
+        if not self.free_blocks:
+            raise ValueError("Out of memory! No free blocks are available.")
+        block = self.free_blocks.pop()
+        block.ref_count = 1
+        return block
+
+    def free(self, block: PhysicalTokenBlock) -> None:
+        if block.ref_count == 0:
+            raise ValueError(f"Double free! {block} is already freed.")
+        block.ref_count -= 1
+        if block.ref_count == 0:
+            self.free_blocks.append(block)
+
+    def get_num_free_blocks(self) -> int:
+        return len(self.free_blocks)
+
+
+class AllocStatus(enum.Enum):
+    """Admission verdict for a waiting group (reference block_manager.py:54)."""
+    OK = enum.auto()        # fits now
+    LATER = enum.auto()     # could fit once memory frees up
+    NEVER = enum.auto()     # can never fit; reject the request
+
+
+class BlockSpaceManager:
+    """Maps sequences' logical blocks onto the physical HBM/CPU pools."""
+
+    def __init__(
+        self,
+        block_size: int,
+        num_device_blocks: int,
+        num_cpu_blocks: int,
+        watermark: float = 0.01,
+        sliding_window: Optional[int] = None,
+    ) -> None:
+        self.block_size = block_size
+        self.num_total_device_blocks = num_device_blocks
+        self.num_total_cpu_blocks = num_cpu_blocks
+
+        self.block_sliding_window: Optional[int] = None
+        if sliding_window is not None:
+            assert sliding_window % block_size == 0, (sliding_window, block_size)
+            self.block_sliding_window = sliding_window // block_size
+
+        self.watermark = watermark
+        assert watermark >= 0.0
+        self.watermark_blocks = int(watermark * num_device_blocks)
+
+        self.device_allocator = BlockAllocator(Device.DEVICE, block_size,
+                                               num_device_blocks)
+        self.cpu_allocator = BlockAllocator(Device.CPU, block_size,
+                                            num_cpu_blocks)
+        # seq_id -> physical block table
+        self.block_tables: Dict[int, BlockTable] = {}
+
+    # --- admission -------------------------------------------------------
+
+    def can_allocate(self, seq_group: SequenceGroup) -> AllocStatus:
+        # All WAITING seqs in a group share the prompt, hence one table.
+        seq = seq_group.get_seqs(status=SequenceStatus.WAITING)[0]
+        num_required = len(seq.logical_token_blocks)
+
+        if seq_group.prefix is not None and seq_group.prefix.allocated:
+            num_required -= seq_group.prefix.get_num_blocks()
+
+        if self.block_sliding_window is not None:
+            num_required = min(num_required, self.block_sliding_window)
+
+        num_free = self.device_allocator.get_num_free_blocks()
+        if self.num_total_device_blocks - num_required < self.watermark_blocks:
+            return AllocStatus.NEVER
+        if num_free - num_required >= self.watermark_blocks:
+            return AllocStatus.OK
+        return AllocStatus.LATER
+
+    def allocate(self, seq_group: SequenceGroup) -> None:
+        seq = seq_group.get_seqs(status=SequenceStatus.WAITING)[0]
+        num_prompt_blocks = len(seq.logical_token_blocks)
+
+        block_table: BlockTable = []
+        prefix_block_table: BlockTable = []
+        num_prefix_blocks = 0
+
+        prefix = seq_group.prefix
+        if prefix is not None and prefix.allocated:
+            # Reuse already-computed prefix blocks (+1 ref each).
+            num_prefix_blocks = prefix.get_num_blocks()
+            for block in prefix.block_table:
+                block.ref_count += seq_group.num_seqs()
+                block_table.append(block)
+
+        for logical_idx in range(num_prefix_blocks, num_prompt_blocks):
+            if (self.block_sliding_window is not None
+                    and logical_idx >= self.block_sliding_window):
+                # Ring reuse: positions beyond the window wrap onto old blocks.
+                block = block_table[logical_idx % self.block_sliding_window]
+            else:
+                block = self.device_allocator.allocate()
+                # All seqs of the group share the full prompt.
+                block.ref_count = seq_group.num_seqs()
+            block_table.append(block)
+
+        if prefix is not None and not prefix.allocated:
+            # First group to bring this prefix in: pin its blocks.
+            num_prefix_blocks = prefix.get_num_blocks()
+            prefix_block_table = block_table[:num_prefix_blocks]
+            for block in prefix_block_table:
+                block.ref_count += 1
+            prefix.set_block_table(prefix_block_table)
+
+        for seq in seq_group.get_seqs(status=SequenceStatus.WAITING):
+            self.block_tables[seq.seq_id] = block_table.copy()
+
+    # --- decode growth ---------------------------------------------------
+
+    def can_append_slot(self, seq_group: SequenceGroup) -> bool:
+        # Worst case: every running seq needs one new block.
+        num_free = self.device_allocator.get_num_free_blocks()
+        num_seqs = seq_group.num_seqs(status=SequenceStatus.RUNNING)
+        return num_seqs <= num_free
+
+    def append_slot(self, seq: Sequence) -> Optional[Tuple[int, int]]:
+        """Ensure the last logical block has a physical slot.
+
+        Returns (src, dst) physical block numbers when a copy-on-write is
+        required (shared last block), else None.
+        """
+        logical_blocks = seq.logical_token_blocks
+        block_table = self.block_tables[seq.seq_id]
+
+        if len(block_table) < len(logical_blocks):
+            if (self.block_sliding_window
+                    and len(block_table) >= self.block_sliding_window):
+                block_table.append(
+                    block_table[len(block_table) % self.block_sliding_window])
+            else:
+                block_table.append(self.device_allocator.allocate())
+            return None
+
+        last_block = block_table[-1]
+        assert last_block.device == Device.DEVICE
+        if last_block.ref_count == 1:
+            return None
+        # Shared with a forked sibling: copy-on-write.
+        new_block = self.device_allocator.allocate()
+        block_table[-1] = new_block
+        self.device_allocator.free(last_block)
+        return last_block.block_number, new_block.block_number
+
+    def fork(self, parent_seq: Sequence, child_seq: Sequence) -> None:
+        src_block_table = self.block_tables[parent_seq.seq_id]
+        self.block_tables[child_seq.seq_id] = src_block_table.copy()
+        for block in src_block_table:
+            block.ref_count += 1
+
+    # --- swap ------------------------------------------------------------
+
+    def _get_physical_blocks(
+            self, seq_group: SequenceGroup) -> List[PhysicalTokenBlock]:
+        blocks: Set[PhysicalTokenBlock] = set()
+        for seq in seq_group.get_seqs():
+            if seq.is_finished():
+                continue
+            blocks.update(self.block_tables[seq.seq_id])
+        return list(blocks)
+
+    def can_swap_in(self, seq_group: SequenceGroup) -> bool:
+        blocks = self._get_physical_blocks(seq_group)
+        num_swapped = seq_group.num_seqs(status=SequenceStatus.SWAPPED)
+        num_free = self.device_allocator.get_num_free_blocks()
+        # +1 block headroom per seq for the imminent append.
+        return (len(blocks) + num_swapped <= num_free - self.watermark_blocks)
+
+    def swap_in(self, seq_group: SequenceGroup) -> Dict[int, int]:
+        """Plan CPU→HBM block moves; returns {cpu_block_no: device_block_no}."""
+        mapping: Dict[PhysicalTokenBlock, PhysicalTokenBlock] = {}
+        for seq in seq_group.get_seqs(status=SequenceStatus.SWAPPED):
+            new_block_table: BlockTable = []
+            for cpu_block in self.block_tables[seq.seq_id]:
+                if cpu_block in mapping:
+                    device_block = mapping[cpu_block]
+                    device_block.ref_count += 1
+                else:
+                    device_block = self.device_allocator.allocate()
+                    mapping[cpu_block] = device_block
+                new_block_table.append(device_block)
+                self.cpu_allocator.free(cpu_block)
+            self.block_tables[seq.seq_id] = new_block_table
+        return {
+            cpu.block_number: dev.block_number
+            for cpu, dev in mapping.items()
+        }
+
+    def can_swap_out(self, seq_group: SequenceGroup) -> bool:
+        return (len(self._get_physical_blocks(seq_group))
+                <= self.cpu_allocator.get_num_free_blocks())
+
+    def swap_out(self, seq_group: SequenceGroup) -> Dict[int, int]:
+        """Plan HBM→CPU block moves; returns {device_block_no: cpu_block_no}."""
+        mapping: Dict[PhysicalTokenBlock, PhysicalTokenBlock] = {}
+        for seq in seq_group.get_seqs(status=SequenceStatus.RUNNING):
+            new_block_table: BlockTable = []
+            for device_block in self.block_tables[seq.seq_id]:
+                if device_block in mapping:
+                    cpu_block = mapping[device_block]
+                    cpu_block.ref_count += 1
+                else:
+                    cpu_block = self.cpu_allocator.allocate()
+                    mapping[device_block] = cpu_block
+                new_block_table.append(cpu_block)
+                self.device_allocator.free(device_block)
+            self.block_tables[seq.seq_id] = new_block_table
+        return {
+            dev.block_number: cpu.block_number
+            for dev, cpu in mapping.items()
+        }
+
+    # --- free ------------------------------------------------------------
+
+    def _free_block_table(self, block_table: BlockTable) -> None:
+        for block in set(block_table):
+            if block.device == Device.DEVICE:
+                self.device_allocator.free(block)
+            else:
+                self.cpu_allocator.free(block)
+
+    def free(self, seq: Sequence) -> None:
+        if seq.seq_id not in self.block_tables:
+            return  # already freed or never allocated
+        self._free_block_table(self.block_tables[seq.seq_id])
+        del self.block_tables[seq.seq_id]
+
+    def reset(self) -> None:
+        for block_table in self.block_tables.values():
+            self._free_block_table(block_table)
+        self.block_tables.clear()
+
+    def get_block_table(self, seq: Sequence) -> List[int]:
+        return [b.block_number for b in self.block_tables[seq.seq_id]]
+
+    def get_num_free_device_blocks(self) -> int:
+        return self.device_allocator.get_num_free_blocks()
+
+    def get_num_free_cpu_blocks(self) -> int:
+        return self.cpu_allocator.get_num_free_blocks()

@@ -1,0 +1,97 @@
+"""Pluggable scheduling policies.
+
+Role parity: reference `vllm/core/policy.py` (Policy :16, FCFS :29,
+PolicyFactory :39) — which the IntelliLLM fork left as the integration
+point for its predicted-length SJF research (`scheduler/` dir, see
+SURVEY §2.10). Here SJF variants are first-class:
+
+- `fcfs`   — first-come-first-served (reference default).
+- `sjf`    — shortest-job-first on *known/predicted* response length
+             (`SequenceGroup.predicted_len`), oracle-style like the
+             reference experiments (`scheduler/run_exp_scheduling.py:36-61`).
+- `sjf_remaining` — shortest *remaining* predicted length (predicted_len
+             minus tokens already generated), which avoids starving
+             long-running jobs near completion.
+
+Unknown lengths sort last; ties break FCFS by arrival time.
+"""
+from __future__ import annotations
+
+from typing import Deque, List
+
+from intellillm_tpu.sequence import SequenceGroup
+
+
+class Policy:
+
+    def get_priority(self, now: float, seq_group: SequenceGroup) -> float:
+        """Higher = scheduled first."""
+        raise NotImplementedError
+
+    def sort_by_priority(
+        self,
+        now: float,
+        seq_groups: Deque[SequenceGroup],
+    ) -> List[SequenceGroup]:
+        return sorted(
+            seq_groups,
+            key=lambda sg: self.get_priority(now, sg),
+            reverse=True,
+        )
+
+
+class FCFS(Policy):
+
+    def get_priority(self, now: float, seq_group: SequenceGroup) -> float:
+        return now - seq_group.arrival_time
+
+
+class SJF(Policy):
+    """Shortest predicted job first; falls back to FCFS for unknown lengths."""
+
+    # Jobs with unknown length sort behind any predicted job.
+    _UNKNOWN = 10**9
+
+    def get_priority(self, now: float, seq_group: SequenceGroup) -> float:
+        plen = seq_group.predicted_len
+        if plen is None:
+            plen = self._UNKNOWN
+        # Primary: shorter job → higher priority. Secondary: older → higher.
+        age = min(now - seq_group.arrival_time, 10**6)
+        return -float(plen) + age * 1e-9
+
+
+class SJFRemaining(Policy):
+    """Shortest *remaining* predicted length first."""
+
+    _UNKNOWN = 10**9
+
+    def get_priority(self, now: float, seq_group: SequenceGroup) -> float:
+        plen = seq_group.predicted_len
+        if plen is None:
+            return -float(self._UNKNOWN)
+        generated = max(
+            (s.get_output_len() for s in seq_group.get_seqs()), default=0)
+        remaining = max(plen - generated, 0)
+        age = min(now - seq_group.arrival_time, 10**6)
+        return -float(remaining) + age * 1e-9
+
+
+class PolicyFactory:
+
+    _POLICY_REGISTRY = {
+        "fcfs": FCFS,
+        "sjf": SJF,
+        "sjf_remaining": SJFRemaining,
+    }
+
+    @classmethod
+    def get_policy(cls, policy_name: str, **kwargs) -> Policy:
+        if policy_name not in cls._POLICY_REGISTRY:
+            raise ValueError(f"Unknown scheduling policy: {policy_name!r}; "
+                             f"available: {sorted(cls._POLICY_REGISTRY)}")
+        return cls._POLICY_REGISTRY[policy_name](**kwargs)
+
+    @classmethod
+    def register(cls, name: str, policy_cls: type) -> None:
+        cls._POLICY_REGISTRY[name] = policy_cls

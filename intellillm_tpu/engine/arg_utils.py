@@ -1,0 +1,170 @@
+"""CLI/engine argument plumbing.
+
+Role parity: reference `vllm/engine/arg_utils.py` (EngineArgs :11,
+add_cli_args :52, create_engine_configs :268, AsyncEngineArgs :303).
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from intellillm_tpu.config import (CacheConfig, LoRAConfig, ModelConfig,
+                                   ParallelConfig, SchedulerConfig)
+
+
+@dataclass
+class EngineArgs:
+    model: str
+    tokenizer: Optional[str] = None
+    tokenizer_mode: str = "auto"
+    trust_remote_code: bool = False
+    seed: int = 0
+    max_model_len: Optional[int] = None
+    # Parallelism (mesh axes)
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    # KV cache
+    block_size: int = 16
+    hbm_utilization: float = 0.90
+    swap_space: float = 4.0  # GiB
+    kv_cache_dtype: str = "auto"
+    num_device_blocks_override: Optional[int] = None
+    # Scheduler
+    max_num_batched_tokens: Optional[int] = None
+    max_num_seqs: int = 256
+    max_paddings: int = 256
+    scheduling_policy: str = "fcfs"
+    # Model
+    dtype: str = "auto"
+    revision: Optional[str] = None
+    quantization: Optional[str] = None
+    enforce_eager: bool = False
+    # LoRA
+    enable_lora: bool = False
+    max_loras: int = 1
+    max_lora_rank: int = 16
+    lora_extra_vocab_size: int = 256
+    lora_dtype: str = "auto"
+    max_cpu_loras: Optional[int] = None
+    # Logging
+    disable_log_stats: bool = False
+
+    def __post_init__(self) -> None:
+        if self.tokenizer is None:
+            self.tokenizer = self.model
+
+    @staticmethod
+    def add_cli_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+        parser.add_argument("--model", type=str,
+                            default="facebook/opt-125m")
+        parser.add_argument("--tokenizer", type=str, default=None)
+        parser.add_argument("--tokenizer-mode", type=str, default="auto",
+                            choices=["auto", "slow"])
+        parser.add_argument("--trust-remote-code", action="store_true")
+        parser.add_argument("--seed", type=int, default=0)
+        parser.add_argument("--max-model-len", type=int, default=None)
+        parser.add_argument("--tensor-parallel-size", "-tp", type=int,
+                            default=1)
+        parser.add_argument("--data-parallel-size", "-dp", type=int,
+                            default=1)
+        parser.add_argument("--pipeline-parallel-size", "-pp", type=int,
+                            default=1)
+        parser.add_argument("--block-size", type=int, default=16,
+                            choices=[8, 16, 32, 64, 128])
+        parser.add_argument("--hbm-utilization", "--gpu-memory-utilization",
+                            type=float, default=0.90, dest="hbm_utilization")
+        parser.add_argument("--swap-space", type=float, default=4.0,
+                            help="CPU swap space per chip (GiB)")
+        parser.add_argument("--kv-cache-dtype", type=str, default="auto",
+                            choices=["auto", "bfloat16", "fp8_e5m2"])
+        parser.add_argument("--num-device-blocks-override", type=int,
+                            default=None)
+        parser.add_argument("--max-num-batched-tokens", type=int,
+                            default=None)
+        parser.add_argument("--max-num-seqs", type=int, default=256)
+        parser.add_argument("--max-paddings", type=int, default=256)
+        parser.add_argument("--scheduling-policy", type=str, default="fcfs",
+                            help="fcfs | sjf | sjf_remaining")
+        parser.add_argument("--dtype", type=str, default="auto",
+                            choices=["auto", "bfloat16", "float32", "float16"])
+        parser.add_argument("--revision", type=str, default=None)
+        parser.add_argument("--quantization", "-q", type=str, default=None)
+        parser.add_argument("--enforce-eager", action="store_true")
+        parser.add_argument("--enable-lora", action="store_true")
+        parser.add_argument("--max-loras", type=int, default=1)
+        parser.add_argument("--max-lora-rank", type=int, default=16)
+        parser.add_argument("--lora-extra-vocab-size", type=int, default=256)
+        parser.add_argument("--lora-dtype", type=str, default="auto")
+        parser.add_argument("--max-cpu-loras", type=int, default=None)
+        parser.add_argument("--disable-log-stats", action="store_true")
+        return parser
+
+    @classmethod
+    def from_cli_args(cls, args: argparse.Namespace) -> "EngineArgs":
+        attrs = [f.name for f in dataclasses.fields(cls)]
+        return cls(**{a: getattr(args, a) for a in attrs if hasattr(args, a)})
+
+    def create_engine_configs(self):
+        model_config = ModelConfig(
+            model=self.model,
+            tokenizer=self.tokenizer,
+            tokenizer_mode=self.tokenizer_mode,
+            trust_remote_code=self.trust_remote_code,
+            dtype=self.dtype,
+            seed=self.seed,
+            revision=self.revision,
+            max_model_len=self.max_model_len,
+            quantization=self.quantization,
+            enforce_eager=self.enforce_eager,
+        )
+        cache_config = CacheConfig(
+            block_size=self.block_size,
+            hbm_utilization=self.hbm_utilization,
+            swap_space_gib=self.swap_space,
+            cache_dtype=self.kv_cache_dtype,
+            num_device_blocks_override=self.num_device_blocks_override,
+            sliding_window=model_config.get_sliding_window(),
+        )
+        parallel_config = ParallelConfig(
+            tensor_parallel_size=self.tensor_parallel_size,
+            data_parallel_size=self.data_parallel_size,
+            pipeline_parallel_size=self.pipeline_parallel_size,
+        )
+        scheduler_config = SchedulerConfig(
+            max_num_batched_tokens=self.max_num_batched_tokens,
+            max_num_seqs=self.max_num_seqs,
+            max_model_len=model_config.max_model_len,
+            max_paddings=self.max_paddings,
+            policy=self.scheduling_policy,
+        )
+        lora_config = None
+        if self.enable_lora:
+            lora_config = LoRAConfig(
+                max_lora_rank=self.max_lora_rank,
+                max_loras=self.max_loras,
+                max_cpu_loras=self.max_cpu_loras,
+                lora_dtype=self.lora_dtype,
+                lora_extra_vocab_size=self.lora_extra_vocab_size,
+            )
+            lora_config.verify_with_model_config(model_config)
+            lora_config.verify_with_scheduler_config(scheduler_config)
+        return (model_config, cache_config, parallel_config, scheduler_config,
+                lora_config)
+
+
+@dataclass
+class AsyncEngineArgs(EngineArgs):
+    """Args for the async engine (reference arg_utils.py:303)."""
+    engine_use_ray: bool = False  # accepted for CLI parity; no Ray on TPU
+    disable_log_requests: bool = False
+    max_log_len: Optional[int] = None
+
+    @staticmethod
+    def add_cli_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+        parser = EngineArgs.add_cli_args(parser)
+        parser.add_argument("--disable-log-requests", action="store_true")
+        parser.add_argument("--max-log-len", type=int, default=None)
+        return parser

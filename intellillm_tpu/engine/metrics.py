@@ -1,0 +1,140 @@
+"""Engine metrics: Prometheus export + periodic stdout log.
+
+Role parity: reference `vllm/engine/metrics.py` (metric definitions :22-63,
+Stats :67, StatLogger.log :136) — same metric names (prefix `intellillm:`
+instead of `vllm:`), using `prometheus_client` instead of aioprometheus.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter, Gauge, Histogram
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+
+@dataclass
+class Stats:
+    """Snapshot of engine state for one iteration."""
+    now: float
+    num_running: int
+    num_swapped: int
+    num_waiting: int
+    device_cache_usage: float
+    cpu_cache_usage: float
+    num_prompt_tokens: int
+    num_generation_tokens: int
+    time_to_first_tokens: List[float] = field(default_factory=list)
+    time_per_output_tokens: List[float] = field(default_factory=list)
+    time_e2e_requests: List[float] = field(default_factory=list)
+
+
+class _Metrics:
+
+    _instance = None
+
+    def __new__(cls, labelnames: List[str]):
+        # Prometheus registries are process-global; build once.
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init(labelnames)
+        return cls._instance
+
+    def _init(self, labelnames: List[str]) -> None:
+        self.gauge_scheduler_running = Gauge(
+            "intellillm_num_requests_running",
+            "Number of requests currently running on TPU.", labelnames)
+        self.gauge_scheduler_swapped = Gauge(
+            "intellillm_num_requests_swapped",
+            "Number of requests swapped to CPU.", labelnames)
+        self.gauge_scheduler_waiting = Gauge(
+            "intellillm_num_requests_waiting",
+            "Number of requests waiting to be processed.", labelnames)
+        self.gauge_device_cache_usage = Gauge(
+            "intellillm_hbm_cache_usage_perc",
+            "HBM KV-cache usage. 1 means 100 percent usage.", labelnames)
+        self.gauge_cpu_cache_usage = Gauge(
+            "intellillm_cpu_cache_usage_perc",
+            "CPU swap KV-cache usage. 1 means 100 percent usage.", labelnames)
+        self.counter_prompt_tokens = Counter(
+            "intellillm_prompt_tokens_total",
+            "Number of prefill tokens processed.", labelnames)
+        self.counter_generation_tokens = Counter(
+            "intellillm_generation_tokens_total",
+            "Number of generation tokens processed.", labelnames)
+        self.histogram_time_to_first_token = Histogram(
+            "intellillm_time_to_first_token_seconds",
+            "Histogram of time to first token in seconds.", labelnames,
+            buckets=[0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25,
+                     0.5, 0.75, 1.0, 2.5, 5.0, 7.5, 10.0])
+        self.histogram_time_per_output_token = Histogram(
+            "intellillm_time_per_output_token_seconds",
+            "Histogram of time per output token in seconds.", labelnames,
+            buckets=[0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.2, 0.3, 0.4,
+                     0.5, 0.75, 1.0, 2.5])
+        self.histogram_e2e_request_latency = Histogram(
+            "intellillm_e2e_request_latency_seconds",
+            "Histogram of end to end request latency in seconds.", labelnames,
+            buckets=[1.0, 2.5, 5.0, 10.0, 15.0, 20.0, 30.0, 40.0, 50.0, 60.0])
+
+
+class StatLogger:
+    """Aggregates per-iteration stats; logs locally every `local_interval`
+    and exports to Prometheus continuously."""
+
+    def __init__(self, local_interval: float,
+                 labels: Dict[str, str]) -> None:
+        self.local_interval = local_interval
+        self.labels = labels
+        self.last_local_log = time.monotonic()
+        self.num_prompt_tokens: List[int] = []
+        self.num_generation_tokens: List[int] = []
+        self.metrics = _Metrics(list(labels.keys())) if _PROMETHEUS else None
+
+    def _throughput(self, tracked: List[int], now: float) -> float:
+        elapsed = now - self.last_local_log
+        return sum(tracked) / elapsed if elapsed > 0 else 0.0
+
+    def log(self, stats: Stats) -> None:
+        if self.metrics is not None:
+            m = self.metrics
+            lv = self.labels.values()
+            m.gauge_scheduler_running.labels(*lv).set(stats.num_running)
+            m.gauge_scheduler_swapped.labels(*lv).set(stats.num_swapped)
+            m.gauge_scheduler_waiting.labels(*lv).set(stats.num_waiting)
+            m.gauge_device_cache_usage.labels(*lv).set(stats.device_cache_usage)
+            m.gauge_cpu_cache_usage.labels(*lv).set(stats.cpu_cache_usage)
+            m.counter_prompt_tokens.labels(*lv).inc(stats.num_prompt_tokens)
+            m.counter_generation_tokens.labels(*lv).inc(
+                stats.num_generation_tokens)
+            for t in stats.time_to_first_tokens:
+                m.histogram_time_to_first_token.labels(*lv).observe(t)
+            for t in stats.time_per_output_tokens:
+                m.histogram_time_per_output_token.labels(*lv).observe(t)
+            for t in stats.time_e2e_requests:
+                m.histogram_e2e_request_latency.labels(*lv).observe(t)
+
+        self.num_prompt_tokens.append(stats.num_prompt_tokens)
+        self.num_generation_tokens.append(stats.num_generation_tokens)
+
+        if stats.now - self.last_local_log > self.local_interval:
+            prompt_tps = self._throughput(self.num_prompt_tokens, stats.now)
+            gen_tps = self._throughput(self.num_generation_tokens, stats.now)
+            logger.info(
+                "Avg prompt throughput: %.1f tokens/s, Avg generation "
+                "throughput: %.1f tokens/s, Running: %d reqs, Swapped: %d "
+                "reqs, Pending: %d reqs, HBM KV cache usage: %.1f%%, CPU KV "
+                "cache usage: %.1f%%", prompt_tps, gen_tps,
+                stats.num_running, stats.num_swapped, stats.num_waiting,
+                stats.device_cache_usage * 100, stats.cpu_cache_usage * 100)
+            self.num_prompt_tokens = []
+            self.num_generation_tokens = []
+            self.last_local_log = stats.now

@@ -1,0 +1,137 @@
+"""Offline batch-inference API.
+
+Role parity: reference `vllm/entrypoints/llm.py` (LLM :14, generate :122,
+_run_engine :200): enqueue N requests, drive `engine.step()` until
+drained, return outputs sorted by request id.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+from intellillm_tpu.engine.arg_utils import EngineArgs
+from intellillm_tpu.engine.llm_engine import LLMEngine
+from intellillm_tpu.outputs import RequestOutput
+from intellillm_tpu.sampling_params import SamplingParams
+from intellillm_tpu.utils import Counter
+
+
+class LLM:
+    """An LLM for offline generation over a TPU mesh.
+
+    Example:
+        llm = LLM(model="facebook/opt-125m")
+        outputs = llm.generate(["Hello, my name is"])
+    """
+
+    def __init__(
+        self,
+        model: str,
+        tokenizer: Optional[str] = None,
+        tokenizer_mode: str = "auto",
+        trust_remote_code: bool = False,
+        tensor_parallel_size: int = 1,
+        dtype: str = "auto",
+        quantization: Optional[str] = None,
+        revision: Optional[str] = None,
+        seed: int = 0,
+        hbm_utilization: float = 0.90,
+        swap_space: float = 4.0,
+        max_model_len: Optional[int] = None,
+        enforce_eager: bool = False,
+        disable_log_stats: bool = True,
+        scheduling_policy: str = "fcfs",
+        length_predictor=None,
+        **kwargs,
+    ) -> None:
+        engine_args = EngineArgs(
+            model=model,
+            tokenizer=tokenizer,
+            tokenizer_mode=tokenizer_mode,
+            trust_remote_code=trust_remote_code,
+            tensor_parallel_size=tensor_parallel_size,
+            dtype=dtype,
+            quantization=quantization,
+            revision=revision,
+            seed=seed,
+            hbm_utilization=hbm_utilization,
+            swap_space=swap_space,
+            max_model_len=max_model_len,
+            enforce_eager=enforce_eager,
+            disable_log_stats=disable_log_stats,
+            scheduling_policy=scheduling_policy,
+            **kwargs,
+        )
+        self.llm_engine = LLMEngine.from_engine_args(
+            engine_args, length_predictor=length_predictor)
+        self.request_counter = Counter()
+
+    def get_tokenizer(self):
+        return self.llm_engine.tokenizer.tokenizer
+
+    def generate(
+        self,
+        prompts: Optional[Union[str, List[str]]] = None,
+        sampling_params: Optional[Union[SamplingParams,
+                                        List[SamplingParams]]] = None,
+        prompt_token_ids: Optional[List[List[int]]] = None,
+        prefix_pos: Optional[Union[int, List[int]]] = None,
+        use_tqdm: bool = False,
+        lora_request=None,
+        predicted_lens: Optional[List[int]] = None,
+    ) -> List[RequestOutput]:
+        if prompts is None and prompt_token_ids is None:
+            raise ValueError("Either prompts or prompt_token_ids must be "
+                             "provided.")
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        if (prompts is not None and prompt_token_ids is not None
+                and len(prompts) != len(prompt_token_ids)):
+            raise ValueError("The lengths of prompts and prompt_token_ids "
+                             "must be the same.")
+        if sampling_params is None:
+            sampling_params = SamplingParams()
+
+        num_requests = (len(prompts)
+                        if prompts is not None else len(prompt_token_ids))
+        if isinstance(sampling_params, list):
+            if len(sampling_params) != num_requests:
+                raise ValueError(
+                    "The lengths of prompts and sampling_params must match.")
+            params_list = sampling_params
+        else:
+            params_list = [sampling_params] * num_requests
+
+        for i in range(num_requests):
+            prompt = prompts[i] if prompts is not None else None
+            token_ids = (prompt_token_ids[i]
+                         if prompt_token_ids is not None else None)
+            ppos = (prefix_pos[i] if isinstance(prefix_pos, list) else
+                    prefix_pos)
+            plen = predicted_lens[i] if predicted_lens is not None else None
+            request_id = str(next(self.request_counter))
+            self.llm_engine.add_request(request_id, prompt, params_list[i],
+                                        token_ids, lora_request=lora_request,
+                                        prefix_pos=ppos, predicted_len=plen)
+        return self._run_engine(use_tqdm)
+
+    def _run_engine(self, use_tqdm: bool) -> List[RequestOutput]:
+        pbar = None
+        if use_tqdm:
+            try:
+                from tqdm import tqdm
+                pbar = tqdm(total=self.llm_engine.get_num_unfinished_requests(),
+                            desc="Processed prompts")
+            except ImportError:
+                pass
+        outputs: List[RequestOutput] = []
+        while self.llm_engine.has_unfinished_requests():
+            step_outputs = self.llm_engine.step()
+            for output in step_outputs:
+                if output.finished:
+                    outputs.append(output)
+                    if pbar is not None:
+                        pbar.update(1)
+        if pbar is not None:
+            pbar.close()
+        outputs.sort(key=lambda x: int(x.request_id))
+        return outputs

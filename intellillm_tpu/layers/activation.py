@@ -1,0 +1,53 @@
+"""Activation functions.
+
+Role parity: reference `vllm/model_executor/layers/activation.py`
+(SiluAndMul :17, NewGELU :40, FastGELU :54, ScaledActivation :67, registry
+get_act_fn :120) + `csrc/activation_kernels.cu`. Plain jnp — XLA fuses
+these into the adjacent matmuls on TPU.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def silu_and_mul(x: jnp.ndarray) -> jnp.ndarray:
+    """Fused SwiGLU gate: in [..., 2d] (gate ++ up) -> silu(gate) * up."""
+    gate, up = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(gate) * up
+
+
+def gelu_new(x: jnp.ndarray) -> jnp.ndarray:
+    """HF NewGELU (tanh approximation with x^3 term)."""
+    c = math.sqrt(2.0 / math.pi)
+    xf = x.astype(jnp.float32)
+    out = 0.5 * xf * (1.0 + jnp.tanh(c * (xf + 0.044715 * xf**3)))
+    return out.astype(x.dtype)
+
+
+def gelu_fast(x: jnp.ndarray) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    out = 0.5 * xf * (1.0 + jnp.tanh(0.7978845608 * xf *
+                                     (1.0 + 0.044715 * xf * xf)))
+    return out.astype(x.dtype)
+
+
+_ACT_REGISTRY = {
+    "gelu": jax.nn.gelu,
+    "gelu_fast": gelu_fast,
+    "gelu_new": gelu_new,
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+
+def get_act_fn(act_fn_name: str) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    act_fn_name = act_fn_name.lower()
+    if act_fn_name not in _ACT_REGISTRY:
+        raise ValueError(f"Activation function {act_fn_name!r} not supported; "
+                         f"available: {sorted(_ACT_REGISTRY)}")
+    return _ACT_REGISTRY[act_fn_name]

@@ -1,0 +1,124 @@
+"""PagedAttention layer: cache write + phase dispatch.
+
+Role parity: reference `vllm/model_executor/layers/attention.py`
+(PagedAttention :22): writes new KV into the paged pool
+(`cache_ops.reshape_and_cache`, :94-102), then prompt-phase attention
+(xformers / Triton prefix kernel, :151-178) or decode-phase paged attention
+(CUDA V1/V2 kernels, :230-302). MQA/GQA, ALiBi (:196-227), sliding window
+(:131-133) supported.
+
+TPU redesign: one functional layer; `is_prompt` is a static (trace-time)
+flag so prefill and decode are separate XLA programs. The decode fast path
+is a Pallas kernel (ops/pallas/paged_attention.py) on TPU and the jnp
+gather reference elsewhere.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from intellillm_tpu.ops.attention import (context_attention_reference,
+                                          decode_attention_reference,
+                                          prefill_attention_reference)
+from intellillm_tpu.ops.kv_cache import reshape_and_cache
+
+_SUPPORTED_HEAD_SIZES = (64, 80, 96, 112, 128, 256)
+
+KVCache = Tuple[jnp.ndarray, jnp.ndarray]
+
+
+@struct.dataclass
+class AttentionMetadata:
+    """Per-step batch metadata handed into the jitted step function.
+
+    Shapes are bucket-padded by the ModelRunner so jit sees a bounded shape
+    set. Equivalent of the reference's InputMetadata
+    (`vllm/model_executor/input_metadata.py`).
+    """
+    # Static: selects the prefill vs decode program.
+    is_prompt: bool = struct.field(pytree_node=False)
+    # [B, L] (prefill) or [B, 1] (decode); flat slot = block*bs + offset,
+    # PAD_SLOT_ID (-1) for padding.
+    slot_mapping: jnp.ndarray = None
+    # [B] total valid context length per sequence (incl. current tokens).
+    context_lens: jnp.ndarray = None
+    # [B, max_blocks_per_seq] physical block ids (decode / prefix-prefill).
+    block_tables: Optional[jnp.ndarray] = None
+    # [B] cached-prefix length per seq (prefix-cached prefill only).
+    prefix_lens: Optional[jnp.ndarray] = None
+    # Static: whether this prefill reuses cached prefix blocks.
+    use_prefix: bool = struct.field(pytree_node=False, default=False)
+
+
+class PagedAttention:
+    """Attention over the paged KV pool. Stateless; weights live in the
+    caller's param tree."""
+
+    def __init__(
+        self,
+        num_heads: int,
+        head_size: int,
+        scale: float,
+        num_kv_heads: Optional[int] = None,
+        sliding_window: Optional[int] = None,
+        alibi_slopes=None,
+    ) -> None:
+        self.num_heads = num_heads
+        self.head_size = head_size
+        self.scale = scale
+        self.num_kv_heads = num_kv_heads or num_heads
+        self.sliding_window = sliding_window
+        self.alibi_slopes = (jnp.asarray(alibi_slopes, jnp.float32)
+                             if alibi_slopes is not None else None)
+        assert self.num_heads % self.num_kv_heads == 0
+
+    def __call__(
+        self,
+        query: jnp.ndarray,   # [B, L, Hq, D]
+        key: jnp.ndarray,     # [B, L, Hkv, D]
+        value: jnp.ndarray,   # [B, L, Hkv, D]
+        kv_cache: KVCache,
+        attn_metadata: AttentionMetadata,
+    ) -> Tuple[jnp.ndarray, KVCache]:
+        b, l, hq, d = query.shape
+        k_cache, v_cache = kv_cache
+
+        flat_k = key.reshape(b * l, self.num_kv_heads, d)
+        flat_v = value.reshape(b * l, self.num_kv_heads, d)
+        slots = attn_metadata.slot_mapping.reshape(-1)
+        k_cache, v_cache = reshape_and_cache(flat_k, flat_v, k_cache, v_cache,
+                                             slots)
+
+        if attn_metadata.is_prompt:
+            if attn_metadata.use_prefix:
+                new_lens = attn_metadata.context_lens - attn_metadata.prefix_lens
+                out = context_attention_reference(
+                    query, key, value, k_cache, v_cache,
+                    attn_metadata.block_tables, attn_metadata.prefix_lens,
+                    new_lens, self.scale, self.alibi_slopes)
+            else:
+                out = prefill_attention_reference(
+                    query, key, value, attn_metadata.context_lens, self.scale,
+                    self.sliding_window, self.alibi_slopes)
+        else:
+            out = _decode_dispatch(query, k_cache, v_cache,
+                                   attn_metadata.block_tables,
+                                   attn_metadata.context_lens, self.scale,
+                                   self.alibi_slopes)
+        return out, (k_cache, v_cache)
+
+
+def _decode_dispatch(q, k_cache, v_cache, block_tables, context_lens, scale,
+                     alibi_slopes):
+    """Choose the decode kernel: Pallas paged attention on TPU, jnp gather
+    reference elsewhere (CPU tests / interpreters)."""
+    from intellillm_tpu.ops import dispatch
+    if dispatch.use_pallas():
+        from intellillm_tpu.ops.pallas.paged_attention import paged_attention
+        return paged_attention(q, k_cache, v_cache, block_tables,
+                               context_lens, scale, alibi_slopes)
+    return decode_attention_reference(q, k_cache, v_cache, block_tables,
+                                      context_lens, scale, alibi_slopes)

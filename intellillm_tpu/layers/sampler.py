@@ -1,0 +1,225 @@
+"""Token sampling — device-side (inside the jitted step).
+
+Role parity: reference `vllm/model_executor/layers/sampler.py` (Sampler :15:
+penalties :166, temperature :189, top-k/top-p :189-236, min-p :221,
+greedy/random/beam branches :238-341, logprob extraction :426) and
+`sampling_metadata.py` (vectorized per-batch sampling tensors).
+
+TPU redesign: the reference samples on the driver GPU after a TP gather;
+here sampling is part of the single jitted step function — logits never
+leave the device, only the sampled ids + a fixed-size top-K logprob panel
+(used for beam search fork candidates and the `logprobs` API) come back to
+host. Per-row determinism comes from per-sequence seed arrays, not a global
+torch generator.
+
+Beam search: the device returns top-(K) log-softmax candidates per row; the
+host engine forks/prunes beams from that panel (2*beam_width <= K is
+enforced by bucketing K).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.sampling_params import SamplingParams, SamplingType
+
+_SAMPLING_EPS = 1e-5
+
+# Top-K panel buckets: K is padded to one of these so jit compiles a small
+# set of shapes (analogue of CUDA-graph size bucketing, but for sampling).
+LOGPROB_K_BUCKETS = (8, 16, 32, 64, 128)
+
+
+@dataclass
+class SamplingTensors:
+    """Host-built (numpy) per-row sampling parameters for one padded batch."""
+
+    temperatures: np.ndarray        # [N] f32
+    top_ps: np.ndarray              # [N] f32
+    top_ks: np.ndarray              # [N] i32 (vocab_size = disabled)
+    min_ps: np.ndarray              # [N] f32
+    presence_penalties: np.ndarray  # [N] f32
+    frequency_penalties: np.ndarray  # [N] f32
+    repetition_penalties: np.ndarray  # [N] f32
+    seeds: np.ndarray               # [N] u32
+    # Only populated when do_penalties (O(N*V) host cost gated off hot path):
+    prompt_mask: Optional[np.ndarray]    # [N, V] bool
+    output_counts: Optional[np.ndarray]  # [N, V] i32
+    do_penalties: bool
+    do_topk: bool
+    do_topp: bool
+    do_minp: bool
+    logprob_k: int                  # panel width (bucketed)
+
+    @classmethod
+    def build(
+        cls,
+        row_params: List[SamplingParams],
+        row_seeds: List[int],
+        row_token_ids: Optional[List[Tuple[List[int], List[int]]]],
+        vocab_size: int,
+        padded_n: int,
+    ) -> "SamplingTensors":
+        """row_token_ids: per row (prompt_token_ids, output_token_ids); only
+        consulted when penalties are active."""
+        n = len(row_params)
+        temps = np.ones(padded_n, np.float32)
+        top_ps = np.ones(padded_n, np.float32)
+        top_ks = np.full(padded_n, vocab_size, np.int32)
+        min_ps = np.zeros(padded_n, np.float32)
+        pres = np.zeros(padded_n, np.float32)
+        freq = np.zeros(padded_n, np.float32)
+        rep = np.ones(padded_n, np.float32)
+        seeds = np.zeros(padded_n, np.uint32)
+
+        do_penalties = do_topk = do_topp = do_minp = False
+        max_logprobs = 1
+        for i, sp in enumerate(row_params):
+            temps[i] = sp.temperature
+            top_ps[i] = sp.top_p
+            top_ks[i] = sp.top_k if sp.top_k > 0 else vocab_size
+            min_ps[i] = sp.min_p
+            pres[i] = sp.presence_penalty
+            freq[i] = sp.frequency_penalty
+            rep[i] = sp.repetition_penalty
+            seeds[i] = np.uint32(row_seeds[i] & 0xFFFFFFFF)
+            if (abs(sp.presence_penalty) >= _SAMPLING_EPS
+                    or abs(sp.frequency_penalty) >= _SAMPLING_EPS
+                    or abs(sp.repetition_penalty - 1.0) >= _SAMPLING_EPS):
+                do_penalties = True
+            if sp.top_k > 0:
+                do_topk = True
+            if sp.top_p < 1.0 - _SAMPLING_EPS:
+                do_topp = True
+            if sp.min_p > _SAMPLING_EPS:
+                do_minp = True
+            if sp.logprobs is not None:
+                max_logprobs = max(max_logprobs, sp.logprobs)
+            if sp.use_beam_search:
+                max_logprobs = max(max_logprobs, 2 * sp.best_of)
+
+        prompt_mask = None
+        output_counts = None
+        if do_penalties and row_token_ids is not None:
+            prompt_mask = np.zeros((padded_n, vocab_size), np.bool_)
+            output_counts = np.zeros((padded_n, vocab_size), np.int32)
+            for i, (prompt_ids, output_ids) in enumerate(row_token_ids):
+                prompt_mask[i, np.asarray(prompt_ids, np.int64)] = True
+                if output_ids:
+                    np.add.at(output_counts[i], np.asarray(output_ids, np.int64), 1)
+
+        logprob_k = LOGPROB_K_BUCKETS[-1]
+        for b in LOGPROB_K_BUCKETS:
+            if b >= max_logprobs:
+                logprob_k = b
+                break
+
+        return cls(temps, top_ps, top_ks, min_ps, pres, freq, rep, seeds,
+                   prompt_mask, output_counts, do_penalties, do_topk,
+                   do_topp, do_minp, logprob_k)
+
+
+def apply_penalties(
+    logits: jnp.ndarray,          # [N, V] f32
+    prompt_mask: jnp.ndarray,     # [N, V] bool
+    output_counts: jnp.ndarray,   # [N, V] i32
+    presence_penalties: jnp.ndarray,
+    frequency_penalties: jnp.ndarray,
+    repetition_penalties: jnp.ndarray,
+) -> jnp.ndarray:
+    """Reference semantics (sampler.py:166-188): repetition penalty scales
+    logits of any seen token (prompt or output); frequency/presence subtract
+    based on output counts."""
+    seen = prompt_mask | (output_counts > 0)
+    rp = repetition_penalties[:, None]
+    logits = jnp.where(
+        seen, jnp.where(logits > 0, logits / rp, logits * rp), logits)
+    logits = logits - frequency_penalties[:, None] * output_counts
+    logits = logits - presence_penalties[:, None] * (output_counts > 0)
+    return logits
+
+
+def _apply_top_k_top_p_min_p(
+    logits: jnp.ndarray,   # [N, V] f32
+    top_ks: jnp.ndarray,   # [N] i32
+    top_ps: jnp.ndarray,   # [N] f32
+    min_ps: jnp.ndarray,   # [N] f32
+    do_topk: bool,
+    do_topp: bool,
+    do_minp: bool,
+) -> jnp.ndarray:
+    if not (do_topk or do_topp or do_minp):
+        return logits
+    vocab = logits.shape[-1]
+    sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)  # desc
+    if do_topk:
+        k_idx = jnp.clip(top_ks - 1, 0, vocab - 1)
+        kth = jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=-1)
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if do_topp:
+        sp = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(sp, axis=-1)
+        keep = (cum - sp) < top_ps[:, None]           # always keeps argmax
+        num_keep = jnp.maximum(keep.sum(axis=-1), 1)
+        thr = jnp.take_along_axis(sorted_logits, (num_keep - 1)[:, None],
+                                  axis=-1)
+        logits = jnp.where(logits < thr, -jnp.inf, logits)
+    if do_minp:
+        probs = jax.nn.softmax(logits, axis=-1)
+        max_p = probs.max(axis=-1, keepdims=True)
+        logits = jnp.where(probs < min_ps[:, None] * max_p, -jnp.inf, logits)
+    return logits
+
+
+def sample(
+    logits: jnp.ndarray,     # [N, V] — pre-softmax model logits (f32)
+    temperatures: jnp.ndarray,
+    top_ks: jnp.ndarray,
+    top_ps: jnp.ndarray,
+    min_ps: jnp.ndarray,
+    seeds: jnp.ndarray,      # [N] u32
+    *,
+    logprob_k: int,
+    num_samples: int = 1,
+    do_topk: bool = False,
+    do_topp: bool = False,
+    do_minp: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sample `num_samples` tokens per row (S>1 only for best_of>1 prompt
+    rows; each sample uses an independent fold of the row seed).
+
+    Returns (sampled_ids [N, S], sampled_logprobs [N, S],
+             topk_ids [N, K], topk_logprobs [N, K]).
+    Logprobs are of the *unfiltered* distribution (reference behavior:
+    logprob extraction precedes top-k/p masking, sampler.py:426).
+    """
+    logits = logits.astype(jnp.float32)
+    # Raw log-softmax panel for the API/beam search.
+    raw_logprobs = jax.nn.log_softmax(logits, axis=-1)
+    topk_logprobs, topk_ids = jax.lax.top_k(raw_logprobs, logprob_k)
+
+    greedy_ids = jnp.argmax(logits, axis=-1)
+
+    # Random path: temperature-scale then filter then Gumbel-argmax.
+    is_greedy = temperatures < _SAMPLING_EPS
+    safe_temp = jnp.where(is_greedy, 1.0, temperatures)
+    scaled = logits / safe_temp[:, None]
+    scaled = _apply_top_k_top_p_min_p(scaled, top_ks, top_ps, min_ps,
+                                      do_topk, do_topp, do_minp)
+
+    def row_gumbel(seed: jnp.ndarray, row: jnp.ndarray) -> jnp.ndarray:
+        key = jax.random.PRNGKey(seed)
+        return jax.random.gumbel(key, (num_samples, ) + row.shape,
+                                 dtype=row.dtype)
+
+    gumbel = jax.vmap(row_gumbel)(seeds.astype(jnp.uint32), scaled)  # [N,S,V]
+    random_ids = jnp.argmax(scaled[:, None, :] + gumbel, axis=-1)    # [N,S]
+
+    sampled = jnp.where(is_greedy[:, None], greedy_ids[:, None],
+                        random_ids).astype(jnp.int32)
+    sampled_logprobs = jnp.take_along_axis(raw_logprobs, sampled, axis=-1)
+    return sampled, sampled_logprobs, topk_ids.astype(jnp.int32), topk_logprobs

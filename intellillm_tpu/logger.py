@@ -1,0 +1,23 @@
+"""Logging for intellillm-tpu.
+
+Role parity: reference `vllm/logger.py` (custom formatter + root handler).
+"""
+import logging
+import sys
+
+_FORMAT = "%(levelname)s %(asctime)s [%(name)s:%(lineno)d] %(message)s"
+_DATE_FORMAT = "%m-%d %H:%M:%S"
+
+_root = logging.getLogger("intellillm_tpu")
+_root.setLevel(logging.INFO)
+_root.propagate = False
+
+_handler = logging.StreamHandler(sys.stdout)
+_handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+_root.addHandler(_handler)
+
+
+def init_logger(name: str) -> logging.Logger:
+    if name.startswith("intellillm_tpu"):
+        return logging.getLogger(name)
+    return _root.getChild(name)

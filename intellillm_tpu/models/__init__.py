@@ -1,0 +1,33 @@
+"""Model registry: HF architecture string → model class.
+
+Role parity: reference `vllm/model_executor/models/__init__.py:12-44`
+(~25 architectures). Families land here as they are built; Llama covers
+every config that uses the llama layer recipe (Mistral, Yi, InternLM...)
+via HF config introspection.
+"""
+from typing import Dict, Type
+
+from intellillm_tpu.models.llama import LlamaForCausalLM
+from intellillm_tpu.models.opt import OPTForCausalLM
+
+_MODEL_REGISTRY: Dict[str, Type] = {
+    "LlamaForCausalLM": LlamaForCausalLM,
+    "LLaMAForCausalLM": LlamaForCausalLM,
+    "MistralForCausalLM": LlamaForCausalLM,
+    "YiForCausalLM": LlamaForCausalLM,
+    "InternLMForCausalLM": LlamaForCausalLM,
+    "OPTForCausalLM": OPTForCausalLM,
+}
+
+
+def register_model(arch: str, cls: Type) -> None:
+    _MODEL_REGISTRY[arch] = cls
+
+
+def get_model_class(architectures) -> Type:
+    for arch in architectures:
+        if arch in _MODEL_REGISTRY:
+            return _MODEL_REGISTRY[arch]
+    raise ValueError(
+        f"Model architectures {architectures} are not supported for now. "
+        f"Supported architectures: {sorted(_MODEL_REGISTRY)}")

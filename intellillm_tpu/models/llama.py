@@ -1,0 +1,149 @@
+"""Llama model family (Llama/Llama-2, Mistral, Yi, InternLM — any HF config
+with the llama layer recipe: RMSNorm + rope + GQA + SwiGLU).
+
+Role parity: reference `vllm/model_executor/models/llama.py` (LlamaMLP :53,
+LlamaAttention :83, LlamaDecoderLayer :161, LlamaModel :223,
+LlamaForCausalLM :271) and `mistral.py` (same recipe + sliding window).
+TPU redesign: functional forward over an explicit param pytree; TP comes
+from mesh sharding of the tree, not Megatron layer classes.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.activation import get_act_fn
+from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
+                                             PagedAttention)
+from intellillm_tpu.layers.normalization import fused_add_rms_norm, rms_norm
+from intellillm_tpu.layers.rotary_embedding import get_rope
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+class LlamaForCausalLM:
+
+    supports_lora = True
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.num_hidden_layers
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = getattr(cfg, "num_key_value_heads",
+                                    self.num_heads)
+        self.hidden_size = cfg.hidden_size
+        self.head_size = getattr(cfg, "head_dim", None) or (
+            self.hidden_size // self.num_heads)
+        self.rms_eps = getattr(cfg, "rms_norm_eps", 1e-6)
+        self.act = get_act_fn(getattr(cfg, "hidden_act", "silu"))
+        self.tie_word_embeddings = getattr(cfg, "tie_word_embeddings", False)
+
+        rope_theta = getattr(cfg, "rope_theta", 10000.0)
+        rope_scaling = getattr(cfg, "rope_scaling", None)
+        max_pos = getattr(cfg, "max_position_embeddings", 8192)
+        self.rope = get_rope(self.head_size, self.head_size, max_pos,
+                             rope_theta, is_neox_style=True,
+                             rope_scaling=rope_scaling)
+        self.attn = PagedAttention(
+            num_heads=self.num_heads,
+            head_size=self.head_size,
+            scale=self.head_size**-0.5,
+            num_kv_heads=self.num_kv_heads,
+            sliding_window=getattr(cfg, "sliding_window", None),
+        )
+
+    def __call__(
+        self,
+        params: Params,
+        input_ids: jnp.ndarray,   # [B, L]
+        positions: jnp.ndarray,   # [B, L]
+        kv_caches: List[KVCache],
+        attn_metadata: AttentionMetadata,
+    ) -> Tuple[jnp.ndarray, List[KVCache]]:
+        h = params["embed_tokens"][input_ids]
+        residual = None
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, residual, cache = self._layer(lp, h, residual, kv_caches[i],
+                                             attn_metadata, positions)
+            new_caches.append(cache)
+        h, _ = fused_add_rms_norm(h, residual, params["norm"], self.rms_eps)
+        return h, new_caches
+
+    def _layer(self, lp: Params, h, residual, kv_cache, attn_metadata,
+               positions):
+        b, l, e = h.shape
+        if residual is None:
+            residual = h
+            h = rms_norm(h, lp["input_norm"], self.rms_eps)
+        else:
+            h, residual = fused_add_rms_norm(h, residual, lp["input_norm"],
+                                             self.rms_eps)
+        q = (h @ lp["q"]).reshape(b, l, self.num_heads, self.head_size)
+        k = (h @ lp["k"]).reshape(b, l, self.num_kv_heads, self.head_size)
+        v = (h @ lp["v"]).reshape(b, l, self.num_kv_heads, self.head_size)
+        q, k = self.rope(positions, q, k)
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        h = attn_out.reshape(b, l, self.num_heads * self.head_size) @ lp["o"]
+
+        h, residual = fused_add_rms_norm(h, residual, lp["post_attn_norm"],
+                                         self.rms_eps)
+        gate = h @ lp["gate"]
+        up = h @ lp["up"]
+        h = (self.act(gate) * up) @ lp["down"]
+        return h, residual, kv_cache
+
+    def compute_logits(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
+        lm_head = params["lm_head"] if params.get("lm_head") is not None \
+            else params["embed_tokens"].T
+        return hidden @ lm_head
+
+    # --- weights ---------------------------------------------------------
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if "rotary_emb.inv_freq" in name:
+                continue
+            raw[name] = arr
+
+        def W(key: str) -> np.ndarray:
+            return cast_array(raw[key].T, self.dtype)
+
+        def V(key: str) -> np.ndarray:
+            return cast_array(raw[key], self.dtype)
+
+        params: Params = {
+            "embed_tokens": V("model.embed_tokens.weight"),
+            "norm": V("model.norm.weight"),
+            "lm_head": (W("lm_head.weight")
+                        if ("lm_head.weight" in raw
+                            and not self.tie_word_embeddings) else None),
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            lp = f"model.layers.{i}."
+            params["layers"].append({
+                "input_norm": V(lp + "input_layernorm.weight"),
+                "post_attn_norm": V(lp + "post_attention_layernorm.weight"),
+                "q": W(lp + "self_attn.q_proj.weight"),
+                "k": W(lp + "self_attn.k_proj.weight"),
+                "v": W(lp + "self_attn.v_proj.weight"),
+                "o": W(lp + "self_attn.o_proj.weight"),
+                "gate": W(lp + "mlp.gate_proj.weight"),
+                "up": W(lp + "mlp.up_proj.weight"),
+                "down": W(lp + "mlp.down_proj.weight"),
+            })
+        return params

@@ -1,0 +1,29 @@
+"""Model instantiation + weight loading.
+
+Role parity: reference `vllm/model_executor/model_loader.py` (get_model
+:40): architecture lookup → model class → load_weights. The returned
+params are a host pytree; the Worker device_puts / shards them over the
+mesh.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.logger import init_logger
+from intellillm_tpu.models import get_model_class
+
+logger = init_logger(__name__)
+
+
+def get_model(model_config: ModelConfig,
+              load_format: str = "auto") -> Tuple[Any, Any]:
+    """Returns (model, host_params)."""
+    architectures = getattr(model_config.hf_config, "architectures", [])
+    model_class = get_model_class(architectures)
+    model = model_class(model_config)
+    logger.info("Loading weights for %s (%s, dtype=%s)", model_config.model,
+                model_class.__name__, model_config.dtype)
+    params = model.load_weights(model_config.model, load_format,
+                                model_config.revision)
+    return model, params

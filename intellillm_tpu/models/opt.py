@@ -1,0 +1,174 @@
+"""OPT model family (facebook/opt-*).
+
+Role parity: reference `vllm/model_executor/models/opt.py` (OPTAttention,
+OPTDecoderLayer, OPTForCausalLM). TPU redesign: functional forward over an
+explicit param pytree; tensor parallelism is applied by sharding the param
+tree over the mesh (see `parallel/sharding.py`) instead of Megatron-style
+column/row layer classes.
+
+HF quirks preserved: position embedding offset of 2; optional
+project_in/project_out (opt-350m); do_layer_norm_before switch; tied
+lm_head = embed_tokens.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.activation import get_act_fn
+from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
+                                             PagedAttention)
+from intellillm_tpu.layers.normalization import layer_norm
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+def _linear(x: jnp.ndarray, p: Params) -> jnp.ndarray:
+    out = x @ p["w"]
+    if p.get("b") is not None:
+        out = out + p["b"]
+    return out
+
+
+class OPTForCausalLM:
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.num_hidden_layers
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = self.num_heads  # OPT has no GQA
+        self.hidden_size = cfg.hidden_size
+        self.head_size = self.hidden_size // self.num_heads
+        self.act = get_act_fn(cfg.activation_function)
+        self.do_layer_norm_before = getattr(cfg, "do_layer_norm_before", True)
+        self.attn = PagedAttention(
+            num_heads=self.num_heads,
+            head_size=self.head_size,
+            scale=self.head_size**-0.5,
+            num_kv_heads=self.num_kv_heads,
+        )
+
+    # --- forward ---------------------------------------------------------
+
+    def __call__(
+        self,
+        params: Params,
+        input_ids: jnp.ndarray,   # [B, L]
+        positions: jnp.ndarray,   # [B, L]
+        kv_caches: List[KVCache],
+        attn_metadata: AttentionMetadata,
+    ) -> Tuple[jnp.ndarray, List[KVCache]]:
+        b, l = input_ids.shape
+        h = params["embed_tokens"][input_ids]
+        if params.get("project_in") is not None:
+            h = h @ params["project_in"]
+        # OPT's learned positions are offset by 2 (HF modeling_opt).
+        pos_emb = params["embed_positions"][positions + 2]
+        h = h + pos_emb
+
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, cache = self._layer(lp, h, kv_caches[i], attn_metadata)
+            new_caches.append(cache)
+
+        if params.get("final_norm") is not None:
+            h = layer_norm(h, params["final_norm"]["w"],
+                           params["final_norm"]["b"])
+        if params.get("project_out") is not None:
+            h = h @ params["project_out"]
+        return h, new_caches
+
+    def _layer(self, lp: Params, h: jnp.ndarray, kv_cache: KVCache,
+               attn_metadata: AttentionMetadata):
+        b, l, e = h.shape
+        residual = h
+        if self.do_layer_norm_before:
+            h = layer_norm(h, lp["attn_norm"]["w"], lp["attn_norm"]["b"])
+        q = _linear(h, lp["q"]).reshape(b, l, self.num_heads, self.head_size)
+        k = _linear(h, lp["k"]).reshape(b, l, self.num_kv_heads, self.head_size)
+        v = _linear(h, lp["v"]).reshape(b, l, self.num_kv_heads, self.head_size)
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        h = _linear(attn_out.reshape(b, l, e), lp["o"])
+        h = residual + h
+        if not self.do_layer_norm_before:
+            h = layer_norm(h, lp["attn_norm"]["w"], lp["attn_norm"]["b"])
+
+        residual = h
+        if self.do_layer_norm_before:
+            h = layer_norm(h, lp["mlp_norm"]["w"], lp["mlp_norm"]["b"])
+        h = _linear(self.act(_linear(h, lp["fc1"])), lp["fc2"])
+        h = residual + h
+        if not self.do_layer_norm_before:
+            h = layer_norm(h, lp["mlp_norm"]["w"], lp["mlp_norm"]["b"])
+        return h, kv_cache
+
+    def compute_logits(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
+        """hidden [N, E] -> logits [N, V] (lm_head tied to embed_tokens)."""
+        if params.get("project_out") is not None:
+            pass  # project_out already applied in __call__
+        return hidden @ params["embed_tokens"].T
+
+    # --- weights ---------------------------------------------------------
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if name.startswith("decoder."):     # some checkpoints omit "model."
+                name = "model." + name
+            if name == "lm_head.weight":
+                continue  # tied to embed_tokens
+            raw[name] = arr
+
+        def W(key: str) -> np.ndarray:
+            return cast_array(raw[key].T, self.dtype)  # torch [out,in] -> [in,out]
+
+        def BV(key: str) -> Optional[np.ndarray]:
+            return cast_array(raw[key], self.dtype) if key in raw else None
+
+        p = "model.decoder."
+        params: Params = {
+            "embed_tokens": cast_array(raw[p + "embed_tokens.weight"], self.dtype),
+            "embed_positions": cast_array(raw[p + "embed_positions.weight"], self.dtype),
+            "project_in": (W(p + "project_in.weight")
+                           if p + "project_in.weight" in raw else None),
+            "project_out": (W(p + "project_out.weight")
+                            if p + "project_out.weight" in raw else None),
+            "final_norm": None,
+            "layers": [],
+        }
+        if p + "final_layer_norm.weight" in raw:
+            params["final_norm"] = {
+                "w": BV(p + "final_layer_norm.weight"),
+                "b": BV(p + "final_layer_norm.bias"),
+            }
+        for i in range(self.num_layers):
+            lp = f"{p}layers.{i}."
+            params["layers"].append({
+                "attn_norm": {"w": BV(lp + "self_attn_layer_norm.weight"),
+                              "b": BV(lp + "self_attn_layer_norm.bias")},
+                "q": {"w": W(lp + "self_attn.q_proj.weight"),
+                      "b": BV(lp + "self_attn.q_proj.bias")},
+                "k": {"w": W(lp + "self_attn.k_proj.weight"),
+                      "b": BV(lp + "self_attn.k_proj.bias")},
+                "v": {"w": W(lp + "self_attn.v_proj.weight"),
+                      "b": BV(lp + "self_attn.v_proj.bias")},
+                "o": {"w": W(lp + "self_attn.out_proj.weight"),
+                      "b": BV(lp + "self_attn.out_proj.bias")},
+                "mlp_norm": {"w": BV(lp + "final_layer_norm.weight"),
+                             "b": BV(lp + "final_layer_norm.bias")},
+                "fc1": {"w": W(lp + "fc1.weight"), "b": BV(lp + "fc1.bias")},
+                "fc2": {"w": W(lp + "fc2.weight"), "b": BV(lp + "fc2.bias")},
+            })
+        return params

@@ -1,0 +1,16 @@
+"""Device ops: the TPU-native equivalents of the reference's `csrc/` CUDA
+kernels (`csrc/pybind.cpp` ops/cache_ops), implemented as jnp functions that
+XLA fuses, with Pallas kernels for the ops where hand control of HBM traffic
+pays (paged-attention decode, prefill attention)."""
+from intellillm_tpu.ops.kv_cache import (copy_blocks, reshape_and_cache,
+                                         swap_blocks)
+from intellillm_tpu.ops.attention import (decode_attention_reference,
+                                          prefill_attention_reference)
+
+__all__ = [
+    "copy_blocks",
+    "reshape_and_cache",
+    "swap_blocks",
+    "decode_attention_reference",
+    "prefill_attention_reference",
+]

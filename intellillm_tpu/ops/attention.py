@@ -1,0 +1,196 @@
+"""Attention ops over the paged KV pool — reference (pure jnp) impls.
+
+Role parity:
+- prefill: reference used xformers `memory_efficient_attention_forward`
+  with a BlockDiagonalCausalMask (`vllm/model_executor/layers/attention.py:151-161`).
+  Here: batched padded causal attention; XLA fuses the softmax chain. A
+  Pallas flash kernel (ops/pallas/flash_attention.py) takes over on TPU for
+  long sequences.
+- decode: reference `ops.paged_attention_v1/v2` CUDA kernels
+  (`csrc/attention/attention_kernels.cu`). Here: block-table gather +
+  masked attention (correct everywhere, used for tests/CPU), with the
+  Pallas paged-attention kernel (ops/pallas/paged_attention.py) as the TPU
+  fast path.
+
+GQA/MQA is handled by reshaping queries to [.., kv_heads, group, ..] rather
+than materializing repeated KV heads (reference expands heads instead,
+attention.py:106-120 — wasteful on HBM bandwidth).
+ALiBi biases (attention.py:196-227) and sliding windows (:131-133) are
+supported in both phases.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float("-inf")
+
+
+def _grouped_query_reshape(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
+    """[..., num_q_heads, D] -> [..., num_kv_heads, group_size, D]."""
+    *lead, num_q_heads, d = q.shape
+    assert num_q_heads % num_kv_heads == 0, (num_q_heads, num_kv_heads)
+    group = num_q_heads // num_kv_heads
+    return q.reshape(*lead, num_kv_heads, group, d)
+
+
+def prefill_attention_reference(
+    q: jnp.ndarray,            # [B, L, Hq, D]
+    k: jnp.ndarray,            # [B, L, Hkv, D]
+    v: jnp.ndarray,            # [B, L, Hkv, D]
+    context_lens: jnp.ndarray,  # [B] int32 — actual (unpadded) lengths
+    scale: float,
+    sliding_window: Optional[int] = None,
+    alibi_slopes: Optional[jnp.ndarray] = None,  # [Hq]
+) -> jnp.ndarray:
+    """Causal self-attention over padded prompt batches.
+
+    Returns [B, L, Hq, D]; rows past context_lens produce zeros.
+    """
+    b, l, hq, d = q.shape
+    hkv = k.shape[2]
+    qg = _grouped_query_reshape(q, hkv)  # [B, L, Hkv, G, D]
+
+    # scores[b, h, g, i, j] = q_i · k_j
+    scores = jnp.einsum("blhgd,bmhd->bhglm", qg * scale, k,
+                        preferred_element_type=jnp.float32)
+
+    pos_q = jnp.arange(l)[:, None]   # i
+    pos_k = jnp.arange(l)[None, :]   # j
+    mask = pos_k <= pos_q            # causal
+    if sliding_window is not None:
+        mask &= pos_k > (pos_q - sliding_window)
+    # mask out padded keys
+    valid_k = pos_k < context_lens[:, None, None, None, None]
+    full_mask = mask[None, None, None, :, :] & valid_k
+
+    if alibi_slopes is not None:
+        # bias = -slope * (i - j), per query head
+        dist = (pos_q - pos_k).astype(jnp.float32)  # [L, L]
+        bias = -alibi_slopes.reshape(hkv, hq // hkv, 1, 1) * dist[None, None]
+        scores = scores + bias[None]
+
+    scores = jnp.where(full_mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # Fully-masked (padded) query rows softmax to NaN; zero them.
+    probs = jnp.where(full_mask.any(axis=-1, keepdims=True), probs, 0.0)
+    out = jnp.einsum("bhglm,bmhd->blhgd", probs, v.astype(probs.dtype))
+    return out.reshape(b, l, hq, d).astype(q.dtype)
+
+
+def context_attention_reference(
+    q: jnp.ndarray,             # [B, L, Hq, D] — the new (suffix) tokens
+    k_new: jnp.ndarray,         # [B, L, Hkv, D]
+    v_new: jnp.ndarray,         # [B, L, Hkv, D]
+    k_cache: jnp.ndarray,       # [num_blocks, Hkv, bs, D] — holds the prefix
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    prefix_lens: jnp.ndarray,   # [B] int32 — cached prefix length per seq
+    new_lens: jnp.ndarray,      # [B] int32 — actual new-token count
+    scale: float,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Prefill attention when part of the context is already cached (prefix
+    caching / chunked prefill). Role parity: the reference's 728-line Triton
+    `context_attention_fwd` (`layers/triton_kernel/prefix_prefill.py`).
+
+    Each new token attends to [cached prefix ++ causal new tokens].
+    """
+    from intellillm_tpu.ops.kv_cache import gather_kv_for_attention
+
+    b, l, hq, d = q.shape
+    hkv = k_new.shape[2]
+    nb, _, bs, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    max_ctx = max_blocks * bs
+
+    # Gather prefix KV: [B, max_ctx, Hkv, D]
+    k_pre = gather_kv_for_attention(k_cache, block_tables)
+    v_pre = gather_kv_for_attention(v_cache, block_tables)
+
+    qg = _grouped_query_reshape(q, hkv) * scale
+
+    # Prefix scores: every new token sees all valid prefix positions.
+    s_pre = jnp.einsum("blhgd,bmhd->bhglm", qg, k_pre,
+                       preferred_element_type=jnp.float32)
+    pre_pos = jnp.arange(max_ctx)[None, :]
+    pre_valid = pre_pos < prefix_lens[:, None]           # [B, max_ctx]
+    q_pos = jnp.arange(l)[None, :]
+    q_valid = q_pos < new_lens[:, None]                  # [B, L]
+    mask_pre = (q_valid[:, None, None, :, None] &
+                pre_valid[:, None, None, None, :])
+    s_pre = jnp.where(mask_pre, s_pre, _NEG_INF)
+
+    # New-token scores: causal within the suffix.
+    s_new = jnp.einsum("blhgd,bmhd->bhglm", qg, k_new,
+                       preferred_element_type=jnp.float32)
+    causal = (jnp.arange(l)[:, None] >= jnp.arange(l)[None, :])
+    mask_new = (causal[None, None, None, :, :] &
+                q_valid[:, None, None, :, None] &
+                q_valid[:, None, None, None, :])
+    s_new = jnp.where(mask_new, s_new, _NEG_INF)
+
+    if alibi_slopes is not None:
+        slopes = alibi_slopes.reshape(hkv, hq // hkv)
+        abs_q = prefix_lens[:, None] + jnp.arange(l)[None, :]     # [B, L]
+        dist_pre = abs_q[:, :, None] - pre_pos[:, None, :]        # [B, L, M]
+        s_pre = s_pre - (slopes[None, :, :, None, None] *
+                         dist_pre[:, None, None, :, :])
+        dist_new = (jnp.arange(l)[:, None] - jnp.arange(l)[None, :])
+        s_new = s_new - (slopes[None, :, :, None, None] *
+                         dist_new[None, None, None].astype(jnp.float32))
+
+    scores = jnp.concatenate([s_pre, s_new], axis=-1)
+    any_valid = jnp.concatenate(
+        [mask_pre, mask_new], axis=-1).any(axis=-1, keepdims=True)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(any_valid, probs, 0.0)
+    v_all = jnp.concatenate([v_pre, v_new], axis=1).astype(probs.dtype)
+    out = jnp.einsum("bhglm,bmhd->blhgd", probs, v_all)
+    return out.reshape(b, l, hq, d).astype(q.dtype)
+
+
+def decode_attention_reference(
+    q: jnp.ndarray,             # [B, 1, Hq, D]
+    k_cache: jnp.ndarray,       # [num_blocks, Hkv, block_size, D]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks_per_seq] int32
+    context_lens: jnp.ndarray,  # [B] int32 (length including current token)
+    scale: float,
+    alibi_slopes: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Single-token decode attention via block-table gather.
+
+    Correct-everywhere baseline for the Pallas paged-attention kernel; used
+    directly on CPU (tests) and as the numerics oracle in kernel tests.
+    """
+    from intellillm_tpu.ops.kv_cache import gather_kv_for_attention
+
+    b = q.shape[0]
+    hq, d = q.shape[2], q.shape[3]
+    nb, hkv, bs, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    max_ctx = max_blocks * bs
+
+    k = gather_kv_for_attention(k_cache, block_tables)
+    v = gather_kv_for_attention(v_cache, block_tables)
+
+    qg = _grouped_query_reshape(q[:, 0], hkv)  # [B, Hkv, G, D]
+    scores = jnp.einsum("bhgd,bmhd->bhgm", qg * scale, k,
+                        preferred_element_type=jnp.float32)
+
+    pos = jnp.arange(max_ctx)[None, :]
+    valid = pos < context_lens[:, None]        # [B, max_ctx]
+
+    if alibi_slopes is not None:
+        slopes = alibi_slopes.reshape(hkv, hq // hkv)
+        dist = (context_lens[:, None] - 1 - pos).astype(jnp.float32)
+        scores = scores - slopes[None, :, :, None] * dist[:, None, None, :]
+
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(valid.any(axis=-1)[:, None, None, None], probs, 0.0)
+    out = jnp.einsum("bhgm,bmhd->bhgd", probs, v.astype(probs.dtype))
+    return out.reshape(b, 1, hq, d).astype(q.dtype)

@@ -1,0 +1,29 @@
+"""Kernel dispatch policy: Pallas fast paths vs jnp reference impls.
+
+The reference picks CUDA kernel V1 vs V2 by context length heuristics
+(`attention.py:230-302`); here the choice is Pallas-vs-jnp by backend, with
+an env/programmatic override for tests and debugging.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_FORCE: Optional[bool] = None
+
+
+def set_use_pallas(force: Optional[bool]) -> None:
+    """Force Pallas kernels on/off (None = auto by backend)."""
+    global _FORCE
+    _FORCE = force
+
+
+def use_pallas() -> bool:
+    if _FORCE is not None:
+        return _FORCE
+    env = os.environ.get("INTELLILLM_USE_PALLAS")
+    if env is not None:
+        return env not in ("0", "false", "False")
+    return jax.default_backend() == "tpu"

@@ -1,0 +1,151 @@
+"""KV-cache block-pool ops.
+
+Role parity: reference `csrc/cache_kernels.cu` — `reshape_and_cache` (:221,
+scatter of new K/V into the paged pool), `copy_blocks` (:88, CoW block
+copies), `swap_blocks` (:14, HBM↔host moves). On TPU these are functional
+jnp scatters/gathers on the pool arrays: under jit with buffer donation XLA
+performs them in place; swaps are `jax.device_put/device_get` transfers.
+
+Cache layout (per layer):
+    k_cache, v_cache: [num_blocks, num_kv_heads, block_size, head_size]
+
+The kv-head axis sits ahead of (block_size, head_size) so that a Pallas
+block of one (physical block, head) pair is a [block_size, head_size] tile
+— (16, 128) for bf16 at head_size 128, exactly the minimum bf16 tile — and
+so the pool shards over the mesh "model" axis on dim 1.
+
+Padding: PAD_SLOT_ID (-1) rows must NOT scatter (negative indices wrap in
+XLA scatter semantics — they'd silently corrupt the last pool block); they
+are remapped to an out-of-bounds sentinel which mode="drop" discards.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PAD_SLOT_ID = -1
+
+
+def reshape_and_cache(
+    key: jnp.ndarray,      # [num_tokens, num_kv_heads, head_size]
+    value: jnp.ndarray,    # [num_tokens, num_kv_heads, head_size]
+    k_cache: jnp.ndarray,  # [num_blocks, H, block_size, D]
+    v_cache: jnp.ndarray,
+    slot_mapping: jnp.ndarray,  # [num_tokens] i32; slot = block*block_size+off
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new K/V rows into the paged pool at their assigned slots."""
+    num_blocks, num_heads, block_size, head_size = k_cache.shape
+    # Negative (padding) slots → OOB sentinel, dropped by the scatter.
+    safe_slots = jnp.where(slot_mapping < 0, num_blocks * block_size,
+                           slot_mapping)
+    block_idx = safe_slots // block_size           # [T]
+    off_idx = safe_slots % block_size              # [T]
+    head_idx = jnp.arange(num_heads, dtype=slot_mapping.dtype)
+
+    k_cache = k_cache.at[block_idx[:, None], head_idx[None, :],
+                         off_idx[:, None]].set(
+                             key.astype(k_cache.dtype), mode="drop")
+    v_cache = v_cache.at[block_idx[:, None], head_idx[None, :],
+                         off_idx[:, None]].set(
+                             value.astype(v_cache.dtype), mode="drop")
+    return k_cache, v_cache
+
+
+def gather_kv_for_attention(
+    cache: jnp.ndarray,          # [NB, H, BS, D]
+    block_tables: jnp.ndarray,   # [B, W] i32
+) -> jnp.ndarray:
+    """Gather per-sequence context: returns [B, W*BS, H, D] (token-major)."""
+    b, w = block_tables.shape
+    nb, h, bs, d = cache.shape
+    g = cache[block_tables]              # [B, W, H, BS, D]
+    g = jnp.swapaxes(g, 2, 3)            # [B, W, BS, H, D]
+    return g.reshape(b, w * bs, h, d)
+
+
+def _pad_indices(idx: List[int], sentinel: int) -> "np.ndarray":
+    """Pad an index list to the next power of two with an out-of-bounds
+    sentinel so jit compiles a bounded set of shapes and extra rows drop."""
+    import numpy as np
+
+    n = max(len(idx), 1)
+    padded_n = 1 << (n - 1).bit_length()
+    out = np.full(padded_n, sentinel, np.int32)
+    out[:len(idx)] = idx
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0, ))
+def _copy_blocks_jit(kv_caches, src_idx, dst_idx):
+    out = []
+    for k_cache, v_cache in kv_caches:
+        k_cache = k_cache.at[dst_idx].set(k_cache[src_idx], mode="drop")
+        v_cache = v_cache.at[dst_idx].set(v_cache[src_idx], mode="drop")
+        out.append((k_cache, v_cache))
+    return out
+
+
+def copy_blocks(
+    kv_caches: List[Tuple[jnp.ndarray, jnp.ndarray]],
+    src_to_dsts: Dict[int, List[int]],
+) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Copy-on-write block copies, applied to every layer's pool.
+
+    Runs as one donated jit call so XLA updates the pools in place (an
+    eager .at[].set would rewrite every pool array in full each step)."""
+    if not src_to_dsts:
+        return kv_caches
+    srcs: List[int] = []
+    dsts: List[int] = []
+    for src, dst_list in src_to_dsts.items():
+        for dst in dst_list:
+            srcs.append(src)
+            dsts.append(dst)
+    num_blocks = kv_caches[0][0].shape[0]
+    src_idx = jnp.asarray(_pad_indices(srcs, 0))  # clamped gather rows are
+    dst_idx = jnp.asarray(_pad_indices(dsts, num_blocks))  # dropped on write
+    return _copy_blocks_jit(kv_caches, src_idx, dst_idx)
+
+
+@jax.jit
+def _gather_blocks(cache: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    return cache[idx]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, ))
+def _scatter_blocks_jit(cache, rows, dst_idx):
+    return cache.at[dst_idx].set(rows, mode="drop")
+
+
+def swap_blocks(
+    src_cache: jnp.ndarray,
+    dst_cache,
+    src_to_dst: Dict[int, int],
+    direction: str,
+):
+    """Move whole blocks between the HBM pool and the host swap pool.
+
+    direction="out": src is the device pool (jnp), dst a host numpy pool.
+    direction="in":  src is the host numpy pool, dst the device pool
+    (donated → in-place scatter).
+    Returns the updated destination pool.
+    """
+    import numpy as np
+
+    srcs = list(src_to_dst.keys())
+    dsts = list(src_to_dst.values())
+    if direction == "out":
+        idx = _pad_indices(srcs, 0)
+        gathered = np.asarray(_gather_blocks(src_cache, jnp.asarray(idx)))
+        dst_cache[np.asarray(dsts)] = gathered[:len(dsts)]
+        return dst_cache
+    elif direction == "in":
+        num_blocks = dst_cache.shape[0]
+        idx = _pad_indices(srcs, 0)          # host gather: any valid row
+        rows = jnp.asarray(np.ascontiguousarray(src_cache[idx]))
+        dst_idx = jnp.asarray(_pad_indices(dsts, num_blocks))
+        return _scatter_blocks_jit(dst_cache, rows, dst_idx)
+    raise ValueError(f"Unknown swap direction: {direction}")

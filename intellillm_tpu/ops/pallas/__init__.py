@@ -1,0 +1,3 @@
+"""Pallas (Mosaic) TPU kernels — the equivalents of the reference's CUDA
+kernels in `csrc/` (paged attention, prefill attention, quant matmuls,
+MoE grouped matmul, LoRA bgmv)."""

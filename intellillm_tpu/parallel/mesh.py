@@ -1,0 +1,100 @@
+"""Device mesh + sharding: the TPU-native replacement for the reference's
+entire parallelism stack.
+
+Role parity map (SURVEY §2.6):
+- Megatron-style TP layer classes (`vllm/model_executor/layers/linear.py`
+  ColumnParallelLinear :130 / RowParallelLinear :444,
+  `vocab_parallel_embedding.py` :39) → `PartitionSpec`s over the mesh
+  "model" axis; XLA GSPMD inserts the same all-reduces
+  (2 per decoder layer + 1 at sampling, SURVEY §3.3) as ICI collectives.
+- NCCL process groups + `communication_op.py` wrappers + custom IPC
+  all-reduce (`csrc/custom_all_reduce.cu`) → `jax.lax.psum` et al., emitted
+  by the compiler. Nothing to hand-write; this module only describes WHERE
+  tensors live.
+- Ray actor orchestration (`engine/ray_utils.py`) → single controller: one
+  process drives every chip in the mesh.
+
+Mesh axes: ("data", "model"). TP = size of "model"; DP = size of "data"
+(used by the multi-chip dry-run/training-style step; online serving scales
+DP by engine replicas, same as the reference).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from intellillm_tpu.config import ParallelConfig
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def build_mesh(parallel_config: ParallelConfig,
+               devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    tp = parallel_config.tensor_parallel_size
+    dp = parallel_config.data_parallel_size
+    need = tp * dp
+    if need > len(devices):
+        raise ValueError(
+            f"Requested tp={tp} dp={dp} but only {len(devices)} devices "
+            "are visible.")
+    mesh_devices = np.asarray(devices[:need]).reshape(dp, tp)
+    return Mesh(mesh_devices, axis_names=("data", "model"))
+
+
+def is_single_device(mesh: Mesh) -> bool:
+    return mesh.devices.size == 1
+
+
+def shard_params(host_params: Any, mesh: Mesh, model) -> Any:
+    """Place the host param pytree onto the mesh.
+
+    Uses the model's `partition_specs()` (a pytree of PartitionSpec
+    mirroring the param tree) when tensor parallelism is active; falls back
+    to replication for leaves whose dims don't divide the axis (e.g. GQA
+    kv projections with fewer kv heads than tp degree — the reference
+    replicates kv heads the same way, `config.py:256-264`).
+    """
+    if is_single_device(mesh):
+        return jax.device_put(host_params)
+
+    specs = None
+    if hasattr(model, "partition_specs"):
+        specs = model.partition_specs()
+    if specs is None:
+        logger.warning("Model has no partition_specs; replicating params.")
+        return jax.device_put(host_params,
+                              NamedSharding(mesh, P()))
+
+    def place(leaf, spec):
+        spec = spec if spec is not None else P()
+        # Validate divisibility; replicate non-dividing dims.
+        fixed = []
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                fixed.append(None)
+                continue
+            axis_size = mesh.shape[axis]
+            if leaf.shape[dim] % axis_size != 0:
+                logger.warning(
+                    "Param dim %d (%d) not divisible by %s=%d; replicating.",
+                    dim, leaf.shape[dim], axis, axis_size)
+                fixed.append(None)
+            else:
+                fixed.append(axis)
+        return jax.device_put(leaf, NamedSharding(mesh, P(*fixed)))
+
+    return jax.tree.map(place, host_params, specs)
+
+
+def shard_kv_cache(mesh: Mesh) -> Optional[NamedSharding]:
+    """KV pool sharding: [num_blocks, num_kv_heads, block_size, head_size]
+    sharded by kv-head over "model" (the TP equivalent of the reference's
+    KV-head division, `config.py:256-264`)."""
+    if mesh is None or is_single_device(mesh):
+        return None
+    return NamedSharding(mesh, P(None, "model", None, None))

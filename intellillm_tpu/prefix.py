@@ -1,0 +1,64 @@
+"""Shared prompt-prefix pool (experimental prefix caching).
+
+Role parity: reference `vllm/prefix.py` (Prefix :6, PrefixPool :77):
+hash-keyed pool of shared prompt prefixes whose KV blocks are refcounted
+into each allocating sequence group; `computed` flips after the first
+prefill writes the prefix KV into the pool.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from intellillm_tpu.block import BlockTable
+
+
+class Prefix:
+    """A block-aligned shared prefix of token ids."""
+
+    def __init__(self, token_ids: Sequence[int], block_size: int) -> None:
+        self.token_ids = tuple(token_ids)
+        self.block_size = block_size
+        self.length = len(token_ids)
+        self.hash = hash(self.token_ids)
+        assert self.length % block_size == 0
+        self.block_table: Optional[BlockTable] = None
+        self.computed = False
+
+    @property
+    def allocated(self) -> bool:
+        return self.block_table is not None
+
+    def get_num_blocks(self) -> int:
+        return self.length // self.block_size
+
+    def get_block_numbers(self) -> List[int]:
+        assert self.block_table is not None
+        return [block.block_number for block in self.block_table]
+
+    def get_length(self) -> int:
+        return self.length
+
+    def __hash__(self) -> int:
+        return self.hash
+
+    def set_block_table(self, block_table: BlockTable) -> None:
+        self.block_table = block_table.copy()
+
+
+class PrefixPool:
+    """Deduplicated pool of prefixes, keyed by token-id hash."""
+
+    def __init__(self, block_size: int) -> None:
+        self.prefixes: Dict[int, Prefix] = {}
+        self.block_size = block_size
+
+    def _truncate_to_block(self, token_ids: Sequence[int]) -> Tuple[int, ...]:
+        n = len(token_ids) // self.block_size * self.block_size
+        return tuple(token_ids[:n])
+
+    def add_or_get_prefix(self, token_ids: Sequence[int]) -> Optional[Prefix]:
+        token_ids = self._truncate_to_block(token_ids)
+        if len(token_ids) == 0:
+            return None
+        prefix = Prefix(token_ids, self.block_size)
+        return self.prefixes.setdefault(prefix.hash, prefix)

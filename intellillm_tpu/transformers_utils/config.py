@@ -1,0 +1,26 @@
+"""HF config loading glue.
+
+Role parity: reference `vllm/transformers_utils/config.py` (get_config with
+trust-remote-code shims). We rely on the installed `transformers` for config
+parsing; model *execution* is pure JAX.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from transformers import AutoConfig, PretrainedConfig
+
+
+def get_hf_config(
+    model: str,
+    trust_remote_code: bool = False,
+    revision: Optional[str] = None,
+) -> PretrainedConfig:
+    try:
+        return AutoConfig.from_pretrained(
+            model, trust_remote_code=trust_remote_code, revision=revision)
+    except ValueError as e:
+        if "trust_remote_code" in str(e):
+            raise RuntimeError(
+                f"Loading {model} requires trust_remote_code=True.") from e
+        raise

@@ -1,0 +1,101 @@
+"""Incremental detokenization.
+
+Role parity: reference `vllm/transformers_utils/tokenizer.py:149-241`
+(`convert_prompt_ids_to_tokens` / `detokenize_incrementally`, driven from
+`llm_engine.py:878-896`). The technique (two offsets into the token-piece
+list; only decode the suffix whose text is already stable) originates in
+HF text-generation-inference; re-implemented here.
+
+Why incremental: decoding the full output every step is O(n²) over a
+generation; BPE also glues multi-byte unicode across pieces, so the last
+piece(s) may be unstable (U+FFFD) until more tokens arrive.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+# How many trailing prompt tokens to seed the context window with: enough
+# for byte-level BPE to resolve cross-piece merges.
+_CONTEXT_TOKENS = 6
+
+
+def _convert_ids_to_clean_tokens(tokenizer, ids: List[int],
+                                 skip_special_tokens: bool) -> List[str]:
+    tokens = tokenizer.convert_ids_to_tokens(
+        ids, skip_special_tokens=skip_special_tokens)
+    # convert_ids_to_tokens may drop specials → shorter list; that's fine,
+    # offsets are relative to this list.
+    return tokens
+
+
+def _tokens_to_text(tokenizer, tokens: List[str], skip_special_tokens: bool,
+                    spaces_between_special_tokens: bool) -> str:
+    if not tokens:
+        return ""
+    # Fast path for standard BPE tokenizers.
+    if hasattr(tokenizer, "convert_tokens_to_string"):
+        if not spaces_between_special_tokens and hasattr(
+                tokenizer, "all_special_tokens"):
+            specials = set(tokenizer.all_special_tokens)
+            # Join groups around specials without inserting spaces.
+            parts: List[str] = []
+            chunk: List[str] = []
+            for t in tokens:
+                if t in specials:
+                    if chunk:
+                        parts.append(tokenizer.convert_tokens_to_string(chunk))
+                        chunk = []
+                    if not skip_special_tokens:
+                        parts.append(t)
+                else:
+                    chunk.append(t)
+            if chunk:
+                parts.append(tokenizer.convert_tokens_to_string(chunk))
+            return "".join(parts)
+        return tokenizer.convert_tokens_to_string(tokens)
+    return "".join(tokens)
+
+
+def detokenize_incrementally(
+    tokenizer,
+    all_input_ids: List[int],
+    prev_tokens: Optional[List[str]],
+    prefix_offset: int,
+    read_offset: int,
+    skip_special_tokens: bool = False,
+    spaces_between_special_tokens: bool = True,
+) -> Tuple[List[str], str, int, int]:
+    """Decode the newest token of a growing sequence.
+
+    Returns (new_token_pieces, new_decoded_text, prefix_offset, read_offset).
+    The caller accumulates: tokens += pieces; text += new_decoded_text.
+    """
+    if prev_tokens is None:
+        # First call (all_input_ids = prompt + the first sampled token):
+        # tokenize everything and seed the offsets to just before the new
+        # token, then fall through so its text is emitted below.
+        new_tokens = _convert_ids_to_clean_tokens(tokenizer, all_input_ids,
+                                                  skip_special_tokens)
+        output_tokens = new_tokens
+        read_offset = max(len(output_tokens) - 1, 0)
+        prefix_offset = max(read_offset - _CONTEXT_TOKENS, 0)
+    else:
+        new_id = all_input_ids[-1]
+        new_tokens = _convert_ids_to_clean_tokens(tokenizer, [new_id],
+                                                  skip_special_tokens)
+        output_tokens = prev_tokens + new_tokens
+
+    prefix_text = _tokens_to_text(tokenizer,
+                                  output_tokens[prefix_offset:read_offset],
+                                  skip_special_tokens,
+                                  spaces_between_special_tokens)
+    full_text = _tokens_to_text(tokenizer, output_tokens[prefix_offset:],
+                                skip_special_tokens,
+                                spaces_between_special_tokens)
+
+    if len(full_text) <= len(prefix_text) or full_text.endswith("�"):
+        # Unstable (mid-unicode or no visible progress): emit nothing yet.
+        return new_tokens, "", prefix_offset, read_offset
+
+    new_text = full_text[len(prefix_text):]
+    return new_tokens, new_text, read_offset, len(output_tokens)

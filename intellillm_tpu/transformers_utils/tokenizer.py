@@ -1,0 +1,74 @@
+"""Tokenizer loading + group wrapper.
+
+Role parity: reference `vllm/transformers_utils/tokenizer.py`
+(get_tokenizer :14, TokenizerGroup :91 with per-LoRA tokenizers and async
+encode).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from transformers import (AutoTokenizer, PreTrainedTokenizer,
+                          PreTrainedTokenizerFast)
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def get_tokenizer(
+    tokenizer_name: str,
+    *args,
+    tokenizer_mode: str = "auto",
+    trust_remote_code: bool = False,
+    revision: Optional[str] = None,
+    **kwargs,
+):
+    if tokenizer_mode == "slow":
+        if kwargs.get("use_fast", False):
+            raise ValueError("Cannot use the fast tokenizer in slow mode.")
+        kwargs["use_fast"] = False
+    tokenizer = AutoTokenizer.from_pretrained(
+        tokenizer_name, *args, trust_remote_code=trust_remote_code,
+        revision=revision, **kwargs)
+    if not isinstance(tokenizer, PreTrainedTokenizerFast):
+        logger.warning(
+            "Using a slow tokenizer; consider a fast-tokenizer model for "
+            "better detokenization throughput.")
+    return tokenizer
+
+
+class TokenizerGroup:
+    """Tokenizer access for the engine; per-LoRA adapters may carry their
+    own tokenizer (reference tokenizer.py:91-146)."""
+
+    def __init__(self, tokenizer_id: str, enable_lora: bool = False,
+                 max_num_seqs: Optional[int] = None, **tokenizer_config):
+        self.tokenizer_id = tokenizer_id
+        self.tokenizer_config = tokenizer_config
+        self.enable_lora = enable_lora
+        self.tokenizer = get_tokenizer(tokenizer_id, **tokenizer_config)
+        self.lora_tokenizers = {}
+
+    def encode(self, prompt: str, request_id: Optional[str] = None,
+               lora_request=None) -> List[int]:
+        tokenizer = self.get_lora_tokenizer(lora_request)
+        return tokenizer.encode(prompt)
+
+    async def encode_async(self, prompt: str,
+                           request_id: Optional[str] = None,
+                           lora_request=None) -> List[int]:
+        return self.encode(prompt, request_id, lora_request)
+
+    def get_lora_tokenizer(self, lora_request=None):
+        if not lora_request or not self.enable_lora:
+            return self.tokenizer
+        lora_id = lora_request.lora_int_id
+        if lora_id not in self.lora_tokenizers:
+            try:
+                tok = get_tokenizer(lora_request.lora_local_path,
+                                    **self.tokenizer_config)
+            except OSError:
+                tok = self.tokenizer
+            self.lora_tokenizers[lora_id] = tok
+        return self.lora_tokenizers[lora_id]

@@ -1,0 +1,133 @@
+"""Shared utilities.
+
+Role parity: reference `vllm/utils.py` (Counter, random_uuid, memory helpers).
+TPU-first additions: shape-bucketing helpers (the XLA analogue of the
+reference's CUDA-graph capture sizes, `vllm/worker/model_runner.py:26-28`).
+"""
+from __future__ import annotations
+
+import enum
+import uuid
+from typing import Any, Iterable, List, Sequence
+
+
+class Device(enum.Enum):
+    DEVICE = "device"  # TPU HBM
+    CPU = "cpu"        # host memory (swap space)
+
+
+class Counter:
+    """Monotonic counter for request/sequence ids."""
+
+    def __init__(self, start: int = 0) -> None:
+        self.counter = start
+
+    def __next__(self) -> int:
+        i = self.counter
+        self.counter += 1
+        return i
+
+    def reset(self) -> None:
+        self.counter = 0
+
+
+def random_uuid() -> str:
+    return str(uuid.uuid4().hex)
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(a // -b)
+
+
+def round_up(x: int, mult: int) -> int:
+    return cdiv(x, mult) * mult
+
+
+def next_power_of_2(x: int) -> int:
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def pad_to_bucket(x: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= x. Buckets must be sorted ascending.
+
+    This is how we bound the number of distinct shapes XLA compiles: every
+    (batch, seq-len) is padded up to a bucket so jit caches a small, fixed
+    set of executables — the TPU analogue of the reference's CUDA-graph
+    batch-size capture list.
+    """
+    for b in buckets:
+        if b >= x:
+            return b
+    return buckets[-1]
+
+
+def default_batch_buckets(max_num_seqs: int) -> List[int]:
+    """Power-of-two batch buckets up to max_num_seqs."""
+    out = []
+    b = 1
+    while b < max_num_seqs:
+        out.append(b)
+        b *= 2
+    out.append(max_num_seqs)
+    return sorted(set(out))
+
+
+def default_len_buckets(max_len: int, start: int = 16) -> List[int]:
+    """Power-of-two sequence-length buckets up to max_len."""
+    out = []
+    b = start
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return sorted(set(out))
+
+
+def flatten_2d(lst: Iterable[Iterable[Any]]) -> List[Any]:
+    return [x for row in lst for x in row]
+
+
+STR_DTYPE_TO_JNP = {
+    "float32": "float32",
+    "float": "float32",
+    "bfloat16": "bfloat16",
+    "float16": "float16",
+    "half": "float16",
+    "fp8_e5m2": "float8_e5m2",
+}
+
+
+def get_device_memory_bytes(device=None) -> int:
+    """Total accelerator memory. Uses live device stats when the backend
+    exposes them; falls back to a conservative v5e figure (16 GiB)."""
+    import jax
+
+    dev = device or jax.local_devices()[0]
+    try:
+        stats = dev.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 16 * 1024**3
+
+
+def get_used_device_memory_bytes(device=None) -> int:
+    import jax
+
+    dev = device or jax.local_devices()[0]
+    try:
+        stats = dev.memory_stats()
+        if stats and "bytes_in_use" in stats:
+            return int(stats["bytes_in_use"])
+    except Exception:
+        pass
+    return 0
+
+
+def in_test_cpu_mode() -> bool:
+    import jax
+
+    return jax.default_backend() == "cpu"

@@ -1,0 +1,52 @@
+"""Golden greedy parity: our engine vs HF transformers (fp32).
+
+Reference pattern: `tests/models/test_models.py:16-41` (exact token
+equality under greedy fp32).
+"""
+import pytest
+
+from intellillm_tpu import LLM, SamplingParams
+
+MAX_TOKENS = 24
+
+
+def _engine_generate_greedy(model_dir, prompts, max_tokens):
+    llm = LLM(model=model_dir,
+              dtype="float32",
+              num_device_blocks_override=128,
+              max_model_len=128,
+              max_num_seqs=8,
+              max_paddings=512,
+              swap_space=0.01)
+    params = SamplingParams(temperature=0.0, max_tokens=max_tokens)
+    outputs = llm.generate(prompts, params)
+    return [o.outputs[0].token_ids for o in outputs]
+
+
+def _trim_eos(ids, eos=1):
+    out = []
+    for t in ids:
+        out.append(t)
+        if t == eos:
+            break
+    return out
+
+
+def test_opt_greedy_matches_hf(tiny_opt_dir, example_prompts, hf_runner):
+    hf = hf_runner(tiny_opt_dir)
+    hf_out = hf.generate_greedy(example_prompts, MAX_TOKENS)
+    our_out = _engine_generate_greedy(tiny_opt_dir, example_prompts,
+                                      MAX_TOKENS)
+    for i, (h, o) in enumerate(zip(hf_out, our_out)):
+        assert _trim_eos(h) == _trim_eos(o), (
+            f"prompt {i}: hf={h} ours={o}")
+
+
+def test_llama_greedy_matches_hf(tiny_llama_dir, example_prompts, hf_runner):
+    hf = hf_runner(tiny_llama_dir)
+    hf_out = hf.generate_greedy(example_prompts, MAX_TOKENS)
+    our_out = _engine_generate_greedy(tiny_llama_dir, example_prompts,
+                                      MAX_TOKENS)
+    for i, (h, o) in enumerate(zip(hf_out, our_out)):
+        assert _trim_eos(h) == _trim_eos(o), (
+            f"prompt {i}: hf={h} ours={o}")
