@@ -1,0 +1,129 @@
+"""Round benchmark: offline decode throughput on a Llama-2-7B-shaped model.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.json): Llama-2-7B serving on v5e-8 at >= 2000 output
+tok/s aggregate == 250 output tok/s per chip. This harness measures
+single-chip offline generation throughput (benchmark_throughput.py role,
+reference `benchmarks/benchmark_throughput.py`) with dummy (random)
+weights — checkpoint downloads are unavailable in this environment and
+throughput is weight-value-independent.
+
+Env knobs: INTELLILLM_BENCH_SIZE=7b|1b|tiny (default 7b),
+           INTELLILLM_BENCH_BS (default 16), INTELLILLM_BENCH_OUT (128).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_TOK_S_PER_CHIP = 250.0
+
+SIZES = {
+    # (hidden, inter, layers, heads, kv_heads, vocab)
+    "7b": (4096, 11008, 32, 32, 32, 32000),
+    "1b": (2048, 5632, 22, 32, 4, 32000),
+    "tiny": (256, 512, 2, 8, 8, 1024),
+}
+
+
+def build_engine(size: str, max_num_seqs: int, max_model_len: int,
+                 num_blocks: int, quantization=None):
+    from transformers import LlamaConfig
+
+    from intellillm_tpu.config import (CacheConfig, ModelConfig,
+                                       ParallelConfig, SchedulerConfig)
+    from intellillm_tpu.engine.llm_engine import LLMEngine
+
+    hidden, inter, layers, heads, kv_heads, vocab = SIZES[size]
+    hf_config = LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        num_key_value_heads=kv_heads, max_position_embeddings=4096,
+        tie_word_embeddings=False)
+    model_config = ModelConfig.from_hf_config(
+        hf_config, dtype="bfloat16", max_model_len=max_model_len,
+        load_format="dummy", quantization=quantization)
+    cache_config = CacheConfig(block_size=16,
+                               num_device_blocks_override=num_blocks,
+                               swap_space_gib=0.05)
+    scheduler_config = SchedulerConfig(
+        max_num_batched_tokens=max(2048, max_model_len),
+        max_num_seqs=max_num_seqs, max_model_len=max_model_len,
+        max_paddings=4096,
+        num_decode_steps=int(os.environ.get("INTELLILLM_BENCH_K", "16")))
+    return LLMEngine(model_config, cache_config, ParallelConfig(),
+                     scheduler_config, log_stats=False,
+                     skip_tokenizer_init=True)
+
+
+def run(engine, batch_size: int, input_len: int, output_len: int,
+        vocab: int):
+    from intellillm_tpu.sampling_params import SamplingParams
+
+    rng = np.random.default_rng(0)
+    for i in range(batch_size):
+        engine.add_request(
+            request_id=f"bench-{time.monotonic_ns()}-{i}",
+            prompt=None,
+            sampling_params=SamplingParams(temperature=0.0,
+                                           max_tokens=output_len,
+                                           ignore_eos=True),
+            prompt_token_ids=rng.integers(0, vocab, input_len).tolist(),
+        )
+    out_tokens = 0
+    start = time.perf_counter()
+    while engine.has_unfinished_requests():
+        for ro in engine.step():
+            if ro.finished:
+                out_tokens += sum(len(c.token_ids) for c in ro.outputs)
+    elapsed = time.perf_counter() - start
+    return out_tokens, elapsed
+
+
+def main():
+    size = os.environ.get("INTELLILLM_BENCH_SIZE", "7b")
+    # 7B bf16 weights are 13.5 GiB of the 16 GiB v5e chip — they only fit
+    # with int8 weight quantization (6.7 GiB), which also frees HBM for a
+    # real KV pool / batch. One 7B KV block (16 tokens) is 8 MiB.
+    quant = os.environ.get("INTELLILLM_BENCH_QUANT",
+                           "int8" if size == "7b" else "none")
+    quant = None if quant in ("none", "") else quant
+    default_bs = {"7b": 16, "1b": 32, "tiny": 64}[size]
+    batch_size = int(os.environ.get("INTELLILLM_BENCH_BS", default_bs))
+    input_len = int(os.environ.get("INTELLILLM_BENCH_IN", "128"))
+    output_len = int(os.environ.get("INTELLILLM_BENCH_OUT", "128"))
+    max_model_len = 512
+    num_blocks = {"7b": 512, "1b": 2048, "tiny": 4096}[size]
+    vocab = SIZES[size][5]
+
+    try:
+        engine = build_engine(size, batch_size, max_model_len, num_blocks,
+                              quantization=quant)
+    except Exception as e:
+        print(json.dumps({"metric": "error", "value": 0, "unit": str(e),
+                          "vs_baseline": 0.0}))
+        raise
+
+    # Warmup: compile prefill+decode buckets on a short run.
+    run(engine, batch_size, input_len, 4, vocab)
+
+    out_tokens, elapsed = run(engine, batch_size, input_len, output_len,
+                              vocab)
+    tok_s = out_tokens / elapsed
+    print(json.dumps({
+        "metric": f"llama2-{size}-dummy offline output tok/s/chip "
+                  f"(bs={batch_size}, in={input_len}, out={output_len}, "
+                  f"greedy, {'int8-w' if quant else 'bf16'})",
+        "value": round(tok_s, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,7 +41,9 @@ class ModelConfig:
         max_model_len: Optional[int] = None,
         quantization: Optional[str] = None,
         enforce_eager: bool = False,
+        load_format: str = "auto",
         max_context_len_to_capture: Optional[int] = None,
+        hf_config_override=None,
     ) -> None:
         self.model = model
         self.tokenizer = tokenizer or model
@@ -51,12 +53,28 @@ class ModelConfig:
         self.revision = revision
         self.quantization = quantization
         self.enforce_eager = enforce_eager
+        self.load_format = load_format
 
-        self.hf_config = get_hf_config(model, trust_remote_code, revision)
+        self.hf_config = (hf_config_override if hf_config_override is not None
+                          else get_hf_config(model, trust_remote_code,
+                                             revision))
         self.dtype = _get_and_verify_dtype(self.hf_config, dtype)
         self.max_model_len = _get_and_verify_max_len(self.hf_config, max_model_len)
         self._verify_tokenizer_mode()
         self._verify_quantization()
+
+    @classmethod
+    def from_hf_config(cls, hf_config, dtype: str = "auto",
+                       max_model_len: Optional[int] = None,
+                       load_format: str = "dummy",
+                       quantization: Optional[str] = None,
+                       seed: int = 0) -> "ModelConfig":
+        """Build a ModelConfig from an in-memory HF config (no checkpoint
+        dir) — for dummy-weight benchmarking and multi-chip dry runs."""
+        return cls(model=getattr(hf_config, "name_or_path", "") or "in-memory",
+                   dtype=dtype, seed=seed, max_model_len=max_model_len,
+                   load_format=load_format, quantization=quantization,
+                   hf_config_override=hf_config)
 
     def _verify_tokenizer_mode(self) -> None:
         if self.tokenizer_mode not in ("auto", "slow"):
@@ -219,6 +237,7 @@ class SchedulerConfig:
         max_model_len: int = 2048,
         max_paddings: int = 256,
         policy: str = "fcfs",
+        num_decode_steps: int = 8,
     ) -> None:
         if max_num_batched_tokens is not None:
             self.max_num_batched_tokens = max_num_batched_tokens
@@ -228,6 +247,12 @@ class SchedulerConfig:
         self.max_model_len = max_model_len
         self.max_paddings = max_paddings
         self.policy = policy
+        # Decode iterations fused into one jitted device call (multi-step
+        # decode). The host sees one dispatch + one result fetch per K
+        # tokens instead of per token — the TPU-side answer to the
+        # reference's CUDA-graph + async-loop host-latency hiding. Beam
+        # search and penalty-bearing batches fall back to 1.
+        self.num_decode_steps = num_decode_steps
         self._verify_args()
 
     def _verify_args(self) -> None:
@@ -238,6 +263,8 @@ class SchedulerConfig:
         if self.max_num_batched_tokens < self.max_num_seqs:
             raise ValueError(
                 "max_num_batched_tokens must be >= max_num_seqs")
+        if self.num_decode_steps < 1:
+            raise ValueError("num_decode_steps must be >= 1")
 
 
 @dataclass

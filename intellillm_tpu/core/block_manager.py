@@ -146,39 +146,50 @@ class BlockSpaceManager:
 
     # --- decode growth ---------------------------------------------------
 
-    def can_append_slot(self, seq_group: SequenceGroup) -> bool:
-        # Worst case: every running seq needs one new block.
+    def can_append_slots(self, seq_group: SequenceGroup,
+                         num_slots: int = 1) -> bool:
+        """Conservative check: every running seq may need a CoW block plus
+        the blocks covering `num_slots` lookahead tokens (multi-step
+        decode reserves K slots per scheduling pass)."""
         num_free = self.device_allocator.get_num_free_blocks()
         num_seqs = seq_group.num_seqs(status=SequenceStatus.RUNNING)
-        return num_seqs <= num_free
+        blocks_per_seq = 1 + (num_slots - 1) // self.block_size + 1
+        return num_seqs * blocks_per_seq <= num_free
 
-    def append_slot(self, seq: Sequence) -> Optional[Tuple[int, int]]:
-        """Ensure the last logical block has a physical slot.
+    def append_slots(self, seq: Sequence,
+                     num_slots: int = 1) -> List[Tuple[int, int]]:
+        """Ensure physical slots exist for the next `num_slots` token
+        positions (positions len-1 .. len+num_slots-2 get written by the
+        fused decode steps).
 
-        Returns (src, dst) physical block numbers when a copy-on-write is
-        required (shared last block), else None.
+        Returns [(src, dst)] copy-on-write pairs (shared trailing block).
         """
-        logical_blocks = seq.logical_token_blocks
         block_table = self.block_tables[seq.seq_id]
+        total_tokens = seq.get_len() + num_slots - 1
+        blocks_needed = (total_tokens + self.block_size - 1) // self.block_size
 
-        if len(block_table) < len(logical_blocks):
+        cows: List[Tuple[int, int]] = []
+        # CoW the current last block only when shared AND actually written
+        # this step (the first write position falls inside it); writes to
+        # fresh blocks never need a copy.
+        first_write_block = (seq.get_len() - 1) // self.block_size
+        if block_table and first_write_block < len(block_table):
+            last_block = block_table[-1]
+            assert last_block.device == Device.DEVICE
+            if last_block.ref_count > 1:
+                new_block = self.device_allocator.allocate()
+                block_table[-1] = new_block
+                self.device_allocator.free(last_block)
+                cows.append((last_block.block_number, new_block.block_number))
+
+        while len(block_table) < blocks_needed:
             if (self.block_sliding_window
                     and len(block_table) >= self.block_sliding_window):
                 block_table.append(
                     block_table[len(block_table) % self.block_sliding_window])
             else:
                 block_table.append(self.device_allocator.allocate())
-            return None
-
-        last_block = block_table[-1]
-        assert last_block.device == Device.DEVICE
-        if last_block.ref_count == 1:
-            return None
-        # Shared with a forked sibling: copy-on-write.
-        new_block = self.device_allocator.allocate()
-        block_table[-1] = new_block
-        self.device_allocator.free(last_block)
-        return last_block.block_number, new_block.block_number
+        return cows
 
     def fork(self, parent_seq: Sequence, child_seq: Sequence) -> None:
         src_block_table = self.block_tables[parent_seq.seq_id]

@@ -51,6 +51,7 @@ class SchedulerOutputs:
         blocks_to_swap_out: Dict[int, int],
         blocks_to_copy: Dict[int, List[int]],
         ignored_seq_groups: List[SequenceGroup],
+        num_decode_steps: int = 1,
     ) -> None:
         self.scheduled_seq_groups = scheduled_seq_groups
         self.prompt_run = prompt_run
@@ -59,6 +60,8 @@ class SchedulerOutputs:
         self.blocks_to_swap_out = blocks_to_swap_out
         self.blocks_to_copy = blocks_to_copy
         self.ignored_seq_groups = ignored_seq_groups
+        # Fused decode iterations this batch (slots already reserved).
+        self.num_decode_steps = num_decode_steps
         assert not (blocks_to_swap_in and blocks_to_swap_out)
 
     def is_empty(self) -> bool:
@@ -225,11 +228,29 @@ class Scheduler:
         # lowest-priority running groups get preempted when memory runs out.
         self.running = deque(self.policy.sort_by_priority(now, self.running))
 
+        # Fused decode-step count for this batch: beam-search groups need
+        # host fork/prune after every token, penalty-bearing groups need
+        # fresh token counts, so their presence forces K=1. Swapped groups
+        # are included since they may join this very batch via swap-in.
+        num_steps = self.scheduler_config.num_decode_steps
+        for sg in list(self.running) + list(self.swapped):
+            sp = sg.sampling_params
+            if (sp.use_beam_search or sp.presence_penalty
+                    or sp.frequency_penalty or sp.repetition_penalty != 1.0
+                    or sp.stop or sp.stop_token_ids):
+                num_steps = 1
+                break
+        # K is deliberately NOT clamped to remaining max_tokens: a varying K
+        # would compile a fresh decode executable per value. Overshoot
+        # tokens are discarded by the engine's stop checks; only {1, K}
+        # decode programs ever exist.
+
         running: Deque[SequenceGroup] = deque()
         preempted: List[SequenceGroup] = []
         while self.running:
             seq_group = self.running.popleft()
-            while not self.block_manager.can_append_slot(seq_group):
+            while not self.block_manager.can_append_slots(
+                    seq_group, num_steps):
                 if self.running:
                     victim = self.running.pop()  # lowest priority
                     self._preempt(victim, blocks_to_swap_out)
@@ -239,7 +260,7 @@ class Scheduler:
                     preempted.append(seq_group)
                     break
             else:
-                self._append_slot(seq_group, blocks_to_copy)
+                self._append_slots(seq_group, num_steps, blocks_to_copy)
                 running.append(seq_group)
         self.running = running
 
@@ -258,7 +279,7 @@ class Scheduler:
                     break
                 self.swapped.popleft()
                 self._swap_in(seq_group, blocks_to_swap_in)
-                self._append_slot(seq_group, blocks_to_copy)
+                self._append_slots(seq_group, num_steps, blocks_to_copy)
                 num_curr_seqs += num_new_seqs
                 self.running.append(seq_group)
 
@@ -272,6 +293,7 @@ class Scheduler:
             blocks_to_swap_out=blocks_to_swap_out,
             blocks_to_copy=blocks_to_copy,
             ignored_seq_groups=[],
+            num_decode_steps=num_steps,
         )
 
     def schedule(self) -> Tuple[List[SequenceGroupMetadata], SchedulerOutputs]:
@@ -312,15 +334,14 @@ class Scheduler:
         for seq in seq_group.get_seqs(status=SequenceStatus.WAITING):
             seq.status = SequenceStatus.RUNNING
 
-    def _append_slot(
+    def _append_slots(
         self,
         seq_group: SequenceGroup,
+        num_steps: int,
         blocks_to_copy: Dict[int, List[int]],
     ) -> None:
         for seq in seq_group.get_seqs(status=SequenceStatus.RUNNING):
-            cow = self.block_manager.append_slot(seq)
-            if cow is not None:
-                src, dst = cow
+            for src, dst in self.block_manager.append_slots(seq, num_steps):
                 blocks_to_copy.setdefault(src, []).append(dst)
 
     def _preempt(

@@ -37,6 +37,7 @@ class EngineArgs:
     max_num_seqs: int = 256
     max_paddings: int = 256
     scheduling_policy: str = "fcfs"
+    num_decode_steps: int = 8
     # Model
     dtype: str = "auto"
     revision: Optional[str] = None
@@ -88,6 +89,8 @@ class EngineArgs:
         parser.add_argument("--max-paddings", type=int, default=256)
         parser.add_argument("--scheduling-policy", type=str, default="fcfs",
                             help="fcfs | sjf | sjf_remaining")
+        parser.add_argument("--num-decode-steps", type=int, default=8,
+                            help="decode iterations fused per device call")
         parser.add_argument("--dtype", type=str, default="auto",
                             choices=["auto", "bfloat16", "float32", "float16"])
         parser.add_argument("--revision", type=str, default=None)
@@ -139,6 +142,7 @@ class EngineArgs:
             max_model_len=model_config.max_model_len,
             max_paddings=self.max_paddings,
             policy=self.scheduling_policy,
+            num_decode_steps=self.num_decode_steps,
         )
         lora_config = None
         if self.enable_lora:

@@ -47,6 +47,7 @@ class LLMEngine:
         lora_config: Optional[LoRAConfig] = None,
         log_stats: bool = True,
         length_predictor=None,
+        skip_tokenizer_init: bool = False,
     ) -> None:
         logger.info(
             "Initializing intellillm-tpu engine: model=%s dtype=%s tp=%d "
@@ -65,7 +66,11 @@ class LLMEngine:
         self.length_predictor = length_predictor
 
         self.seq_counter = Counter()
-        self._init_tokenizer()
+        self.skip_tokenizer_init = skip_tokenizer_init
+        if skip_tokenizer_init:
+            self.tokenizer = None
+        else:
+            self._init_tokenizer()
 
         self.worker = Worker(model_config, parallel_config, scheduler_config,
                              cache_config, lora_config)
@@ -220,28 +225,32 @@ class LLMEngine:
         seq_group_metadata_list, scheduler_outputs = self.scheduler.schedule()
 
         if not scheduler_outputs.is_empty():
-            output = self.worker.execute_model(
+            outputs = self.worker.execute_model(
                 seq_group_metadata_list,
                 scheduler_outputs.blocks_to_swap_in,
                 scheduler_outputs.blocks_to_swap_out,
                 scheduler_outputs.blocks_to_copy,
+                scheduler_outputs.num_decode_steps,
             )
         else:
-            output = []
+            outputs = []
 
-        return self._process_model_outputs(output, scheduler_outputs)
+        return self._process_model_outputs(outputs, scheduler_outputs)
 
     def _process_model_outputs(
         self,
-        output: SamplerOutput,
+        outputs_per_substep: List[SamplerOutput],
         scheduler_outputs: SchedulerOutputs,
     ) -> List[RequestOutput]:
         now = time.monotonic()
         scheduled_seq_groups = scheduler_outputs.scheduled_seq_groups
-        for seq_group, outputs in zip(scheduled_seq_groups, output):
-            if seq_group.first_token_time is None and outputs.samples:
-                seq_group.first_token_time = now
-            self._process_sequence_group_outputs(seq_group, outputs)
+        for output in outputs_per_substep:
+            for seq_group, outputs in zip(scheduled_seq_groups, output):
+                if seq_group.is_finished():
+                    continue  # finished at an earlier fused substep
+                if seq_group.first_token_time is None and outputs.samples:
+                    seq_group.first_token_time = now
+                self._process_sequence_group_outputs(seq_group, outputs)
 
         self.scheduler.free_finished_seq_groups()
 
@@ -273,13 +282,18 @@ class LLMEngine:
 
         parent_child: dict = {p.seq_id: [] for p in parent_seqs}
         for sample in outputs.samples:
-            parent_child[sample.parent_seq_id].append(sample)
+            # Samples for parents that finished at an earlier fused substep
+            # are surplus lookahead tokens: drop them.
+            if sample.parent_seq_id in parent_child:
+                parent_child[sample.parent_seq_id].append(sample)
 
         # (child, parent) pairs; a parent continuing itself is (parent, parent)
         child_seqs: List[Tuple[Sequence, Sequence]] = []
         for parent in parent_seqs:
             samples = parent_child[parent.seq_id]
             if not samples:
+                if not sampling_params.use_beam_search:
+                    continue
                 # Beam pruning dropped every continuation of this parent.
                 parent.status = SequenceStatus.FINISHED_ABORTED
                 seq_group.remove(parent.seq_id)
@@ -295,7 +309,8 @@ class LLMEngine:
             child_seqs.append((parent, parent))
 
         for seq, _ in child_seqs:
-            self._decode_sequence(seq, sampling_params)
+            if self.tokenizer is not None:
+                self._decode_sequence(seq, sampling_params)
             self._check_stop(seq, sampling_params)
 
         if not sampling_params.use_beam_search:
@@ -401,6 +416,8 @@ class LLMEngine:
         return worst >= best_possible
 
     def _get_eos_token_id(self) -> Optional[int]:
+        if self.tokenizer is None:
+            return None
         return getattr(self.tokenizer.tokenizer, "eos_token_id", None)
 
     # --- detokenization & stop checks ------------------------------------

@@ -1,0 +1,98 @@
+"""Minimal demo HTTP server (aiohttp).
+
+Role parity: reference `vllm/entrypoints/api_server.py` (FastAPI /generate
++ /health with StreamingResponse). FastAPI isn't available in the TPU
+image; aiohttp provides the same surface.
+
+Endpoints:
+    GET  /health       → 200
+    POST /generate     → {"text": [...]} or newline-delimited JSON stream
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import AsyncGenerator
+
+from aiohttp import web
+
+from intellillm_tpu.engine.arg_utils import AsyncEngineArgs
+from intellillm_tpu.engine.async_llm_engine import AsyncLLMEngine
+from intellillm_tpu.sampling_params import SamplingParams
+from intellillm_tpu.utils import random_uuid
+
+TIMEOUT_KEEP_ALIVE = 5
+engine: AsyncLLMEngine = None
+
+
+async def health(request: web.Request) -> web.Response:
+    return web.Response(status=200)
+
+
+async def generate(request: web.Request) -> web.StreamResponse:
+    """Generate completion for the request.
+
+    Body: {"prompt": str, "stream": bool, ...SamplingParams fields}
+    """
+    request_dict = await request.json()
+    prompt = request_dict.pop("prompt")
+    prefix_pos = request_dict.pop("prefix_pos", None)
+    stream = request_dict.pop("stream", False)
+    sampling_params = SamplingParams(**request_dict)
+    request_id = random_uuid()
+
+    results_generator = engine.generate(prompt, sampling_params, request_id,
+                                        prefix_pos=prefix_pos)
+
+    if stream:
+        response = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"})
+        await response.prepare(request)
+        async for request_output in results_generator:
+            text_outputs = [
+                request_output.prompt + output.text
+                for output in request_output.outputs
+            ]
+            await response.write(
+                (json.dumps({"text": text_outputs}) + "\n").encode())
+        await response.write_eof()
+        return response
+
+    final_output = None
+    async for request_output in results_generator:
+        if request.transport is not None and request.transport.is_closing():
+            await engine.abort(request_id)
+            return web.Response(status=499)
+        final_output = request_output
+
+    assert final_output is not None
+    text_outputs = [
+        final_output.prompt + output.text for output in final_output.outputs
+    ]
+    return web.json_response({"text": text_outputs})
+
+
+def build_app() -> web.Application:
+    app = web.Application()
+    app.router.add_get("/health", health)
+    app.router.add_post("/generate", generate)
+    return app
+
+
+def main():
+    global engine
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", type=str, default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser = AsyncEngineArgs.add_cli_args(parser)
+    args = parser.parse_args()
+
+    engine_args = AsyncEngineArgs.from_cli_args(args)
+    engine = AsyncLLMEngine.from_engine_args(engine_args)
+
+    web.run_app(build_app(), host=args.host, port=args.port,
+                keepalive_timeout=TIMEOUT_KEEP_ALIVE)
+
+
+if __name__ == "__main__":
+    main()

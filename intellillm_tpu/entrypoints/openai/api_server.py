@@ -1,0 +1,156 @@
+"""OpenAI-compatible API server (aiohttp).
+
+Role parity: reference `vllm/entrypoints/openai/api_server.py` (:48 app,
+routes /health :134, /v1/models :140, /v1/completions :161,
+/v1/chat/completions :146, /metrics :124, --api-key auth middleware).
+aiohttp replaces FastAPI (not present in the TPU image); the wire format
+is identical.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from intellillm_tpu.engine.arg_utils import AsyncEngineArgs
+from intellillm_tpu.engine.async_llm_engine import AsyncLLMEngine
+from intellillm_tpu.entrypoints.openai.protocol import (ChatCompletionRequest,
+                                                        CompletionRequest,
+                                                        ErrorResponse)
+from intellillm_tpu.entrypoints.openai.serving_chat import OpenAIServingChat
+from intellillm_tpu.entrypoints.openai.serving_completion import (
+    OpenAIServingCompletion)
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+openai_serving_chat: OpenAIServingChat = None
+openai_serving_completion: OpenAIServingCompletion = None
+
+
+def _error_to_response(error: ErrorResponse) -> web.Response:
+    return web.json_response(data={"error": error.model_dump()},
+                             status=error.code)
+
+
+async def health(request: web.Request) -> web.Response:
+    return web.Response(status=200)
+
+
+async def metrics(request: web.Request) -> web.Response:
+    from prometheus_client import REGISTRY, generate_latest
+    return web.Response(body=generate_latest(REGISTRY),
+                        content_type="text/plain")
+
+
+async def show_available_models(request: web.Request) -> web.Response:
+    models = await openai_serving_chat.show_available_models()
+    return web.json_response(models.model_dump())
+
+
+async def _streaming_response(request: web.Request,
+                              generator) -> web.StreamResponse:
+    response = web.StreamResponse(
+        headers={"Content-Type": "text/event-stream",
+                 "Cache-Control": "no-cache"})
+    await response.prepare(request)
+    async for chunk in generator:
+        await response.write(chunk.encode())
+    await response.write_eof()
+    return response
+
+
+async def create_chat_completion(request: web.Request) -> web.StreamResponse:
+    try:
+        body = ChatCompletionRequest(**await request.json())
+    except Exception as e:
+        return _error_to_response(
+            openai_serving_chat.create_error_response(str(e)))
+    generator = await openai_serving_chat.create_chat_completion(body)
+    if isinstance(generator, ErrorResponse):
+        return _error_to_response(generator)
+    if body.stream:
+        return await _streaming_response(request, generator)
+    return web.json_response(generator.model_dump())
+
+
+async def create_completion(request: web.Request) -> web.StreamResponse:
+    try:
+        body = CompletionRequest(**await request.json())
+    except Exception as e:
+        return _error_to_response(
+            openai_serving_completion.create_error_response(str(e)))
+    generator = await openai_serving_completion.create_completion(body)
+    if isinstance(generator, ErrorResponse):
+        return _error_to_response(generator)
+    if body.stream and not body.use_beam_search:
+        return await _streaming_response(request, generator)
+    return web.json_response(generator.model_dump())
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    api_key = request.app.get("api_key")
+    if api_key is not None and not request.path.startswith("/health"):
+        auth = request.headers.get("Authorization", "")
+        if auth != f"Bearer {api_key}":
+            return web.json_response({"error": "Unauthorized"}, status=401)
+    return await handler(request)
+
+
+def build_app(api_key: Optional[str] = None) -> web.Application:
+    app = web.Application(middlewares=[auth_middleware])
+    app["api_key"] = api_key
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/v1/models", show_available_models)
+    app.router.add_post("/v1/chat/completions", create_chat_completion)
+    app.router.add_post("/v1/completions", create_completion)
+    return app
+
+
+def make_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="intellillm-tpu OpenAI-compatible API server")
+    parser.add_argument("--host", type=str, default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--served-model-name", type=str, default=None)
+    parser.add_argument("--api-key", type=str, default=None)
+    parser.add_argument("--chat-template", type=str, default=None)
+    parser.add_argument("--response-role", type=str, default="assistant")
+    parser = AsyncEngineArgs.add_cli_args(parser)
+    return parser
+
+
+async def init_serving(engine: AsyncLLMEngine, served_model: str,
+                       response_role: str,
+                       chat_template: Optional[str]) -> None:
+    global openai_serving_chat, openai_serving_completion
+    openai_serving_chat = OpenAIServingChat(engine, served_model,
+                                            response_role, chat_template)
+    openai_serving_completion = OpenAIServingCompletion(engine, served_model)
+    await openai_serving_chat._post_init()
+    await openai_serving_completion._post_init()
+
+
+def main():
+    args = make_arg_parser().parse_args()
+    engine_args = AsyncEngineArgs.from_cli_args(args)
+    served_model = args.served_model_name or args.model
+
+    engine = AsyncLLMEngine.from_engine_args(engine_args)
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    loop.run_until_complete(
+        init_serving(engine, served_model, args.response_role,
+                     args.chat_template))
+    app = build_app(args.api_key)
+    web.run_app(app, host=args.host, port=args.port, loop=loop)
+
+
+if __name__ == "__main__":
+    main()

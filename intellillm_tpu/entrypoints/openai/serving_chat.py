@@ -1,0 +1,170 @@
+"""/v1/chat/completions implementation.
+
+Role parity: reference `vllm/entrypoints/openai/serving_chat.py`
+(OpenAIServingChat :19, streaming generator :86, chat template loader
+:245). Chat templates come from the tokenizer (`apply_chat_template`) or a
+--chat-template file.
+"""
+from __future__ import annotations
+
+import codecs
+import time
+from typing import AsyncIterator, List, Optional, Union
+
+from intellillm_tpu.engine.async_llm_engine import AsyncLLMEngine
+from intellillm_tpu.entrypoints.openai.protocol import (
+    ChatCompletionRequest, ChatCompletionResponse,
+    ChatCompletionResponseChoice, ChatCompletionResponseStreamChoice,
+    ChatCompletionStreamResponse, ChatMessage, DeltaMessage, ErrorResponse,
+    UsageInfo)
+from intellillm_tpu.entrypoints.openai.serving_completion import (
+    request_to_sampling_params)
+from intellillm_tpu.entrypoints.openai.serving_engine import OpenAIServing
+from intellillm_tpu.logger import init_logger
+from intellillm_tpu.outputs import RequestOutput
+from intellillm_tpu.utils import random_uuid
+
+logger = init_logger(__name__)
+
+
+class OpenAIServingChat(OpenAIServing):
+
+    def __init__(self, engine: AsyncLLMEngine, served_model: str,
+                 response_role: str = "assistant",
+                 chat_template: Optional[str] = None) -> None:
+        super().__init__(engine, served_model)
+        self.response_role = response_role
+        self._chat_template_arg = chat_template
+
+    async def _post_init(self) -> None:
+        await super()._post_init()
+        self._load_chat_template(self._chat_template_arg)
+
+    def _load_chat_template(self, chat_template: Optional[str]) -> None:
+        if chat_template is not None:
+            try:
+                with open(chat_template, "r") as f:
+                    self.tokenizer.chat_template = f.read()
+            except OSError:
+                # Inline jinja string (escaped newlines allowed).
+                self.tokenizer.chat_template = codecs.decode(
+                    chat_template, "unicode_escape")
+            logger.info("Using supplied chat template")
+        elif getattr(self.tokenizer, "chat_template", None):
+            logger.info("Using default chat template from tokenizer")
+        else:
+            logger.warning(
+                "No chat template defined; chat requests will error unless "
+                "the tokenizer provides one.")
+
+    def get_chat_request_role(self, request: ChatCompletionRequest) -> str:
+        if request.add_generation_prompt:
+            return self.response_role
+        return request.messages[-1]["role"]
+
+    async def create_chat_completion(
+        self, request: ChatCompletionRequest
+    ) -> Union[ErrorResponse, ChatCompletionResponse, AsyncIterator[str]]:
+        error = await self._check_model(request)
+        if error is not None:
+            return error
+
+        try:
+            prompt = self.tokenizer.apply_chat_template(
+                conversation=request.messages,
+                tokenize=False,
+                add_generation_prompt=request.add_generation_prompt)
+        except Exception as e:
+            return self.create_error_response(
+                f"Error in applying chat template from request: {e}")
+
+        request_id = f"chatcmpl-{random_uuid()}"
+        try:
+            token_ids = self._validate_prompt_and_tokenize(request,
+                                                           prompt=prompt)
+            sampling_params = request_to_sampling_params(request)
+        except (ValueError, NotImplementedError) as e:
+            return self.create_error_response(str(e))
+
+        result_generator = self.engine.generate(prompt, sampling_params,
+                                                request_id,
+                                                prompt_token_ids=token_ids)
+        if request.stream:
+            return self.chat_completion_stream_generator(
+                request, result_generator, request_id)
+        return await self.chat_completion_full_generator(
+            request, result_generator, request_id)
+
+    async def chat_completion_full_generator(
+            self, request: ChatCompletionRequest, result_generator,
+            request_id: str) -> Union[ErrorResponse, ChatCompletionResponse]:
+        model_name = request.model
+        created_time = int(time.time())
+        final_res: Optional[RequestOutput] = None
+        async for res in result_generator:
+            final_res = res
+        assert final_res is not None
+
+        role = self.get_chat_request_role(request)
+        choices = [
+            ChatCompletionResponseChoice(
+                index=output.index,
+                message=ChatMessage(role=role, content=output.text),
+                finish_reason=output.finish_reason,
+            ) for output in final_res.outputs
+        ]
+        num_prompt_tokens = len(final_res.prompt_token_ids)
+        num_generated_tokens = sum(
+            len(output.token_ids) for output in final_res.outputs)
+        return ChatCompletionResponse(
+            id=request_id,
+            created=created_time,
+            model=model_name,
+            choices=choices,
+            usage=UsageInfo(
+                prompt_tokens=num_prompt_tokens,
+                completion_tokens=num_generated_tokens,
+                total_tokens=num_prompt_tokens + num_generated_tokens,
+            ))
+
+    async def chat_completion_stream_generator(
+            self, request: ChatCompletionRequest, result_generator,
+            request_id: str) -> AsyncIterator[str]:
+        model_name = request.model
+        created_time = int(time.time())
+
+        role = self.get_chat_request_role(request)
+        first_chunk = ChatCompletionStreamResponse(
+            id=request_id,
+            created=created_time,
+            model=model_name,
+            choices=[
+                ChatCompletionResponseStreamChoice(
+                    index=i, delta=DeltaMessage(role=role),
+                    finish_reason=None) for i in range(request.n)
+            ])
+        yield f"data: {first_chunk.model_dump_json()}\n\n"
+
+        previous_texts = {}
+        finish_sent = set()
+        async for res in result_generator:
+            for output in res.outputs:
+                if output.index in finish_sent:
+                    continue
+                prev = previous_texts.get(output.index, "")
+                delta_text = output.text[len(prev):]
+                previous_texts[output.index] = output.text
+                chunk = ChatCompletionStreamResponse(
+                    id=request_id,
+                    created=created_time,
+                    model=model_name,
+                    choices=[
+                        ChatCompletionResponseStreamChoice(
+                            index=output.index,
+                            delta=DeltaMessage(content=delta_text),
+                            finish_reason=output.finish_reason)
+                    ])
+                yield f"data: {chunk.model_dump_json()}\n\n"
+                if output.finish_reason is not None:
+                    finish_sent.add(output.index)
+        yield "data: [DONE]\n\n"

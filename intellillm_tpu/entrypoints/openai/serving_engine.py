@@ -1,0 +1,109 @@
+"""Shared OpenAI-serving base.
+
+Role parity: reference `vllm/entrypoints/openai/serving_engine.py`
+(OpenAIServing :16 — model card checks, logprobs formatting :55, prompt
+validation :107).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple, Union
+
+from intellillm_tpu.engine.async_llm_engine import AsyncLLMEngine
+from intellillm_tpu.entrypoints.openai.protocol import (ErrorResponse,
+                                                        LogProbs, ModelCard,
+                                                        ModelList,
+                                                        ModelPermission)
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class OpenAIServing:
+
+    def __init__(self, engine: AsyncLLMEngine, served_model: str) -> None:
+        self.engine = engine
+        self.served_model = served_model
+        self.max_model_len = 0
+        self.tokenizer = None
+
+    async def _post_init(self) -> None:
+        engine_model_config = await self.engine.get_model_config()
+        self.max_model_len = engine_model_config.max_model_len
+        self.tokenizer = self.engine.engine.tokenizer.tokenizer
+
+    async def show_available_models(self) -> ModelList:
+        return ModelList(data=[
+            ModelCard(id=self.served_model,
+                      root=self.served_model,
+                      permission=[ModelPermission()])
+        ])
+
+    def _create_logprobs(
+        self,
+        token_ids: List[int],
+        top_logprobs: Optional[List[Optional[Dict[int, float]]]] = None,
+        num_output_top_logprobs: Optional[int] = None,
+        initial_text_offset: int = 0,
+    ) -> LogProbs:
+        logprobs = LogProbs()
+        last_token_len = 0
+        if num_output_top_logprobs:
+            logprobs.top_logprobs = []
+        for i, token_id in enumerate(token_ids):
+            step_top_logprobs = top_logprobs[i] if top_logprobs else None
+            token_logprob = (step_top_logprobs.get(token_id)
+                             if step_top_logprobs else None)
+            token = self.tokenizer.convert_ids_to_tokens(token_id)
+            logprobs.tokens.append(token)
+            logprobs.token_logprobs.append(token_logprob)
+            if len(logprobs.text_offset) == 0:
+                logprobs.text_offset.append(initial_text_offset)
+            else:
+                logprobs.text_offset.append(logprobs.text_offset[-1] +
+                                            last_token_len)
+            last_token_len = len(token)
+            if num_output_top_logprobs:
+                logprobs.top_logprobs.append({
+                    self.tokenizer.convert_ids_to_tokens(tid): lp
+                    for tid, lp in step_top_logprobs.items()
+                } if step_top_logprobs else None)
+        return logprobs
+
+    def create_error_response(
+            self, message: str, err_type: str = "BadRequestError",
+            status_code: int = 400) -> ErrorResponse:
+        return ErrorResponse(message=message, type=err_type,
+                             code=status_code)
+
+    async def _check_model(self, request) -> Optional[ErrorResponse]:
+        if request.model == self.served_model:
+            return None
+        return self.create_error_response(
+            message=f"The model `{request.model}` does not exist.",
+            err_type="NotFoundError", status_code=404)
+
+    def _validate_prompt_and_tokenize(
+        self,
+        request,
+        prompt: Optional[str] = None,
+        prompt_ids: Optional[List[int]] = None,
+    ) -> List[int]:
+        if (prompt is None) == (prompt_ids is None):
+            raise ValueError(
+                "Either prompt or prompt_ids should be provided.")
+        input_ids = (prompt_ids if prompt_ids is not None else
+                     self.tokenizer(prompt).input_ids)
+        token_num = len(input_ids)
+
+        if request.max_tokens is None:
+            request.max_tokens = self.max_model_len - token_num
+
+        if token_num + request.max_tokens > self.max_model_len:
+            raise ValueError(
+                f"This model's maximum context length is "
+                f"{self.max_model_len} tokens. However, you requested "
+                f"{request.max_tokens + token_num} tokens "
+                f"({token_num} in the messages, "
+                f"{request.max_tokens} in the completion). "
+                f"Please reduce the length of the messages or completion.")
+        return input_ids

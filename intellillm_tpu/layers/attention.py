@@ -51,6 +51,12 @@ class AttentionMetadata:
     prefix_lens: Optional[jnp.ndarray] = None
     # Static: whether this prefill reuses cached prefix blocks.
     use_prefix: bool = struct.field(pytree_node=False, default=False)
+    # Multi-step (fused) decode: tokens produced inside the fused loop live
+    # in per-layer staging buffers, not the pool. `staged` switches the
+    # layer to pool(read-only) + stage(read/write) attention; stage_index
+    # is the current substep (traced scalar).
+    staged: bool = struct.field(pytree_node=False, default=False)
+    stage_index: Optional[jnp.ndarray] = None
 
 
 class PagedAttention:
@@ -80,9 +86,12 @@ class PagedAttention:
         query: jnp.ndarray,   # [B, L, Hq, D]
         key: jnp.ndarray,     # [B, L, Hkv, D]
         value: jnp.ndarray,   # [B, L, Hkv, D]
-        kv_cache: KVCache,
+        kv_cache,             # KVCache, or (kp, vp, k_stage, v_stage) staged
         attn_metadata: AttentionMetadata,
-    ) -> Tuple[jnp.ndarray, KVCache]:
+    ):
+        if attn_metadata.staged:
+            return self._staged_decode(query, key, value, kv_cache,
+                                       attn_metadata)
         b, l, hq, d = query.shape
         k_cache, v_cache = kv_cache
 
@@ -110,15 +119,43 @@ class PagedAttention:
                                    self.alibi_slopes)
         return out, (k_cache, v_cache)
 
+    def _staged_decode(self, query, key, value, kv_cache, attn_metadata):
+        """Fused multi-step decode: pool is read-only; the substep's K/V go
+        into the staging buffer at stage_index, attention merges the pool
+        part (paged kernel, with logsumexp) and the stage part."""
+        from intellillm_tpu.ops.attention import (merge_attention_parts,
+                                                  staged_decode_attention)
+
+        k_pool, v_pool, k_stage, v_stage = kv_cache
+        k_idx = attn_metadata.stage_index
+
+        # Write this substep's K/V ([B, 1, Hkv, D]) into stage slot k
+        # (in-place dynamic-update-slice).
+        k_stage = jax.lax.dynamic_update_slice_in_dim(
+            k_stage, key.astype(k_stage.dtype), k_idx, axis=1)
+        v_stage = jax.lax.dynamic_update_slice_in_dim(
+            v_stage, value.astype(v_stage.dtype), k_idx, axis=1)
+
+        out_pool, lse_pool = _decode_dispatch(
+            query, k_pool, v_pool, attn_metadata.block_tables,
+            attn_metadata.context_lens, self.scale, self.alibi_slopes,
+            return_lse=True)
+        out_stage, lse_stage = staged_decode_attention(
+            query, k_stage, v_stage, k_idx, self.scale)
+        out = merge_attention_parts(out_pool, lse_pool, out_stage, lse_stage)
+        return out, (k_pool, v_pool, k_stage, v_stage)
+
 
 def _decode_dispatch(q, k_cache, v_cache, block_tables, context_lens, scale,
-                     alibi_slopes):
+                     alibi_slopes, return_lse: bool = False):
     """Choose the decode kernel: Pallas paged attention on TPU, jnp gather
     reference elsewhere (CPU tests / interpreters)."""
     from intellillm_tpu.ops import dispatch
     if dispatch.use_pallas():
         from intellillm_tpu.ops.pallas.paged_attention import paged_attention
         return paged_attention(q, k_cache, v_cache, block_tables,
-                               context_lens, scale, alibi_slopes)
+                               context_lens, scale, alibi_slopes,
+                               return_lse=return_lse)
     return decode_attention_reference(q, k_cache, v_cache, block_tables,
-                                      context_lens, scale, alibi_slopes)
+                                      context_lens, scale, alibi_slopes,
+                                      return_lse=return_lse)

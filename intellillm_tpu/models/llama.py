@@ -19,6 +19,9 @@ from intellillm_tpu.layers.activation import get_act_fn
 from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
                                              PagedAttention)
 from intellillm_tpu.layers.normalization import fused_add_rms_norm, rms_norm
+from intellillm_tpu.layers.quantization import (is_quantized, qmatmul,
+                                                quantize_int8,
+                                                quantize_int8_jax)
 from intellillm_tpu.layers.rotary_embedding import get_rope
 from intellillm_tpu.models.weight_utils import (cast_array,
                                                 hf_model_weights_iterator)
@@ -45,6 +48,7 @@ class LlamaForCausalLM:
         self.rms_eps = getattr(cfg, "rms_norm_eps", 1e-6)
         self.act = get_act_fn(getattr(cfg, "hidden_act", "silu"))
         self.tie_word_embeddings = getattr(cfg, "tie_word_embeddings", False)
+        self.quantization = model_config.quantization
 
         rope_theta = getattr(cfg, "rope_theta", 10000.0)
         rope_scaling = getattr(cfg, "rope_scaling", None)
@@ -88,26 +92,114 @@ class LlamaForCausalLM:
         else:
             h, residual = fused_add_rms_norm(h, residual, lp["input_norm"],
                                              self.rms_eps)
-        q = (h @ lp["q"]).reshape(b, l, self.num_heads, self.head_size)
-        k = (h @ lp["k"]).reshape(b, l, self.num_kv_heads, self.head_size)
-        v = (h @ lp["v"]).reshape(b, l, self.num_kv_heads, self.head_size)
+        q = qmatmul(h, lp["q"]).reshape(b, l, self.num_heads,
+                                        self.head_size)
+        k = qmatmul(h, lp["k"]).reshape(b, l, self.num_kv_heads,
+                                        self.head_size)
+        v = qmatmul(h, lp["v"]).reshape(b, l, self.num_kv_heads,
+                                        self.head_size)
         q, k = self.rope(positions, q, k)
         attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
-        h = attn_out.reshape(b, l, self.num_heads * self.head_size) @ lp["o"]
+        h = qmatmul(attn_out.reshape(b, l, self.num_heads * self.head_size),
+                    lp["o"])
 
         h, residual = fused_add_rms_norm(h, residual, lp["post_attn_norm"],
                                          self.rms_eps)
-        gate = h @ lp["gate"]
-        up = h @ lp["up"]
-        h = (self.act(gate) * up) @ lp["down"]
+        gate = qmatmul(h, lp["gate"])
+        up = qmatmul(h, lp["up"])
+        h = qmatmul(self.act(gate) * up, lp["down"])
         return h, residual, kv_cache
 
     def compute_logits(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
-        lm_head = params["lm_head"] if params.get("lm_head") is not None \
-            else params["embed_tokens"].T
-        return hidden @ lm_head
+        lm_head = params.get("lm_head")
+        if lm_head is None:
+            return hidden @ params["embed_tokens"].T
+        return qmatmul(hidden, lm_head)
+
+    # --- sharding --------------------------------------------------------
+
+    def partition_specs(self):
+        """PartitionSpec tree mirroring the param tree: the TP sharding that
+        replaces the reference's Megatron column/row layer classes
+        (`layers/linear.py:130,444`; vocab sharding
+        `vocab_parallel_embedding.py:39`). Weights are stored [in, out]."""
+        from jax.sharding import PartitionSpec as P
+
+        def w(spec):
+            """Quantized weights shard q on the same dims; scales follow
+            the output dim."""
+            if self.quantization != "int8":
+                return spec
+            return {"q": spec, "s": P(spec[1])}
+
+        layer = {
+            "input_norm": P(),
+            "post_attn_norm": P(),
+            "q": w(P(None, "model")),
+            "k": w(P(None, "model")),
+            "v": w(P(None, "model")),
+            "o": w(P("model", None)),
+            "gate": w(P(None, "model")),
+            "up": w(P(None, "model")),
+            "down": w(P("model", None)),
+        }
+        import copy as _copy
+        return {
+            "embed_tokens": P("model", None),
+            "norm": P(),
+            "lm_head": w(P(None, "model")),
+            "layers": [_copy.deepcopy(layer) for _ in range(self.num_layers)],
+        }
 
     # --- weights ---------------------------------------------------------
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        """Random params generated on-device (dummy load format: the
+        reference's weight_utils.py:287 initialize_dummy_weights — used for
+        profiling and weight-free benchmarking)."""
+        import jax
+        import jax.numpy as jnp
+
+        dtype = jnp.dtype(self.dtype)
+        cfg = self.config
+        e = self.hidden_size
+        v = cfg.vocab_size
+        inter = cfg.intermediate_size
+        hq = self.num_heads * self.head_size
+        hkv = self.num_kv_heads * self.head_size
+        key = jax.random.PRNGKey(seed)
+
+        def rand(key, shape, scale=0.02):
+            w = (jax.random.normal(key, shape, jnp.float32) *
+                 scale).astype(dtype)
+            if self.quantization == "int8" and len(shape) == 2:
+                return quantize_int8_jax(w)
+            return w
+
+        keys = jax.random.split(key, self.num_layers + 3)
+        layers = []
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 7)
+            layers.append({
+                "input_norm": jnp.ones((e, ), dtype),
+                "post_attn_norm": jnp.ones((e, ), dtype),
+                "q": rand(lk[0], (e, hq)),
+                "k": rand(lk[1], (e, hkv)),
+                "v": rand(lk[2], (e, hkv)),
+                "o": rand(lk[3], (hq, e)),
+                "gate": rand(lk[4], (e, inter)),
+                "up": rand(lk[5], (e, inter)),
+                "down": rand(lk[6], (inter, e)),
+            })
+        # Embeddings stay unquantized (they're a gather, not a matmul).
+        embed = (jax.random.normal(keys[-3], (v, e), jnp.float32) *
+                 0.02).astype(dtype)
+        return {
+            "embed_tokens": embed,
+            "norm": jnp.ones((e, ), dtype),
+            "lm_head": rand(keys[-2], (e, v)),
+            "layers": layers,
+        }
 
     def load_weights(self, model_name_or_path: str,
                      load_format: str = "auto",
@@ -119,8 +211,11 @@ class LlamaForCausalLM:
                 continue
             raw[name] = arr
 
-        def W(key: str) -> np.ndarray:
-            return cast_array(raw[key].T, self.dtype)
+        def W(key: str):
+            w = cast_array(raw[key].T, self.dtype)
+            if self.quantization == "int8":
+                return quantize_int8(w)
+            return w
 
         def V(key: str) -> np.ndarray:
             return cast_array(raw[key], self.dtype)

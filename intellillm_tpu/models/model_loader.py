@@ -20,10 +20,22 @@ def get_model(model_config: ModelConfig,
               load_format: str = "auto") -> Tuple[Any, Any]:
     """Returns (model, host_params)."""
     architectures = getattr(model_config.hf_config, "architectures", [])
+    if not architectures:
+        # In-memory configs (from_hf_config) may lack the list; derive it.
+        architectures = [type(model_config.hf_config).__name__.replace(
+            "Config", "ForCausalLM")]
     model_class = get_model_class(architectures)
     model = model_class(model_config)
-    logger.info("Loading weights for %s (%s, dtype=%s)", model_config.model,
-                model_class.__name__, model_config.dtype)
-    params = model.load_weights(model_config.model, load_format,
-                                model_config.revision)
+    load_format = (model_config.load_format
+                   if model_config.load_format != "auto" else load_format)
+    if load_format == "dummy":
+        logger.info("Initializing dummy (random) weights for %s (%s)",
+                    model_config.model, model_class.__name__)
+        params = model.init_random_params(model_config.seed)
+    else:
+        logger.info("Loading weights for %s (%s, dtype=%s)",
+                    model_config.model, model_class.__name__,
+                    model_config.dtype)
+        params = model.load_weights(model_config.model, load_format,
+                                    model_config.revision)
     return model, params

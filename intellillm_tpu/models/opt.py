@@ -117,7 +117,76 @@ class OPTForCausalLM:
             pass  # project_out already applied in __call__
         return hidden @ params["embed_tokens"].T
 
+    # --- sharding --------------------------------------------------------
+
+    def partition_specs(self):
+        """TP sharding (see llama.partition_specs). Weights are [in, out];
+        biases of column-sharded layers shard with the output dim."""
+        from jax.sharding import PartitionSpec as P
+        col = {"w": P(None, "model"), "b": P("model")}
+        row = {"w": P("model", None), "b": P()}
+        norm = {"w": P(), "b": P()}
+        layer = {
+            "attn_norm": dict(norm),
+            "q": dict(col), "k": dict(col), "v": dict(col), "o": dict(row),
+            "mlp_norm": dict(norm),
+            "fc1": dict(col), "fc2": dict(row),
+        }
+        return {
+            "embed_tokens": P("model", None),
+            "embed_positions": P(),
+            "project_in": P(),
+            "project_out": P(),
+            "final_norm": dict(norm),
+            "layers": [dict(layer) for _ in range(self.num_layers)],
+        }
+
     # --- weights ---------------------------------------------------------
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        """Random params on device (dummy load format; see llama)."""
+        import jax
+        import jax.numpy as jnp
+
+        dtype = jnp.dtype(self.dtype)
+        cfg = self.config
+        e = self.hidden_size
+        v = cfg.vocab_size
+        ffn = cfg.ffn_dim
+        word = getattr(cfg, "word_embed_proj_dim", e)
+        max_pos = cfg.max_position_embeddings + 2
+        key = jax.random.PRNGKey(seed)
+
+        def rand(key, shape, scale=0.02):
+            return (jax.random.normal(key, shape, jnp.float32) *
+                    scale).astype(dtype)
+
+        def norm():
+            return {"w": jnp.ones((e, ), dtype), "b": jnp.zeros((e, ), dtype)}
+
+        def lin(key, din, dout):
+            return {"w": rand(key, (din, dout)),
+                    "b": jnp.zeros((dout, ), dtype)}
+
+        keys = jax.random.split(key, self.num_layers + 3)
+        layers = []
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 6)
+            layers.append({
+                "attn_norm": norm(),
+                "q": lin(lk[0], e, e), "k": lin(lk[1], e, e),
+                "v": lin(lk[2], e, e), "o": lin(lk[3], e, e),
+                "mlp_norm": norm(),
+                "fc1": lin(lk[4], e, ffn), "fc2": lin(lk[5], ffn, e),
+            })
+        return {
+            "embed_tokens": rand(keys[-3], (v, word)),
+            "embed_positions": rand(keys[-2], (max_pos, e)),
+            "project_in": None if word == e else rand(keys[-1], (word, e)),
+            "project_out": None if word == e else rand(keys[-1], (e, word)),
+            "final_norm": norm() if self.do_layer_norm_before else None,
+            "layers": layers,
+        }
 
     def load_weights(self, model_name_or_path: str,
                      load_format: str = "auto",

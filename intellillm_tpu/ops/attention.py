@@ -47,7 +47,9 @@ def prefill_attention_reference(
 ) -> jnp.ndarray:
     """Causal self-attention over padded prompt batches.
 
-    Returns [B, L, Hq, D]; rows past context_lens produce zeros.
+    Returns [B, L, Hq, D]. Query rows past context_lens attend to the
+    valid keys (cheap, finite garbage — ignored downstream); keys past
+    context_lens are masked out everywhere.
     """
     b, l, hq, d = q.shape
     hkv = k.shape[2]
@@ -160,11 +162,13 @@ def decode_attention_reference(
     context_lens: jnp.ndarray,  # [B] int32 (length including current token)
     scale: float,
     alibi_slopes: Optional[jnp.ndarray] = None,
-) -> jnp.ndarray:
+    return_lse: bool = False,
+):
     """Single-token decode attention via block-table gather.
 
     Correct-everywhere baseline for the Pallas paged-attention kernel; used
     directly on CPU (tests) and as the numerics oracle in kernel tests.
+    With return_lse, also returns logsumexp [B, Hq] for attention merging.
     """
     from intellillm_tpu.ops.kv_cache import gather_kv_for_attention
 
@@ -191,6 +195,67 @@ def decode_attention_reference(
 
     scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    probs = jnp.where(valid.any(axis=-1)[:, None, None, None], probs, 0.0)
+    any_valid = valid.any(axis=-1)[:, None, None, None]
+    probs = jnp.where(any_valid, probs, 0.0)
     out = jnp.einsum("bhgm,bmhd->bhgd", probs, v.astype(probs.dtype))
-    return out.reshape(b, 1, hq, d).astype(q.dtype)
+    out = out.reshape(b, 1, hq, d).astype(q.dtype)
+    if not return_lse:
+        return out
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)      # [B, Hkv, G]
+    lse = jnp.where(any_valid[..., 0], lse, _NEG_INF)
+    return out, lse.reshape(b, hq)
+
+
+def staged_decode_attention(
+    q: jnp.ndarray,          # [B, 1, Hq, D]
+    k_stage: jnp.ndarray,    # [B, S, Hkv, D] — staged tokens (pos n-1..n-1+S)
+    v_stage: jnp.ndarray,
+    stage_index,             # scalar: current substep k; slots 0..k valid
+    scale: float,
+):
+    """Attention over the in-flight staged tokens of a fused decode batch.
+
+    Returns (out [B, 1, Hq, D], lse [B, Hq]); combine with the pool part
+    via merge_attention_parts. Used by multi-step decode, where tokens
+    produced inside the fused loop live in a small staging buffer instead
+    of the paged pool (keeps the pool loop-invariant so XLA doesn't
+    double-buffer it through the scan).
+    """
+    b, s, hkv, d = k_stage.shape
+    hq = q.shape[2]
+    qg = _grouped_query_reshape(q[:, 0], hkv)  # [B, Hkv, G, D]
+    scores = jnp.einsum("bhgd,bshd->bhgs", qg * scale,
+                        k_stage.astype(qg.dtype),
+                        preferred_element_type=jnp.float32)
+    valid = jnp.arange(s)[None, :] <= stage_index       # [1, S]
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhgs,bshd->bhgd", p / l, v_stage.astype(p.dtype))
+    lse = (m + jnp.log(l))[..., 0]                      # [B, Hkv, G]
+    return (out.reshape(b, 1, hq, d).astype(q.dtype),
+            lse.reshape(b, hq))
+
+
+def merge_attention_parts(
+    out_a: jnp.ndarray,   # [B, 1, Hq, D]
+    lse_a: jnp.ndarray,   # [B, Hq]
+    out_b: jnp.ndarray,
+    lse_b: jnp.ndarray,
+) -> jnp.ndarray:
+    """Numerically-stable combination of two partial softmax-attention
+    results over disjoint key sets (the role of the reference V2 kernel's
+    cross-partition reduction, `attention_kernels.cu:462-501`)."""
+    # Clamp to a finite floor: an empty part may carry -inf, and
+    # (-inf) - (-inf) would poison pad rows with NaNs.
+    lse_a = jnp.maximum(lse_a, -1e30)
+    lse_b = jnp.maximum(lse_b, -1e30)
+    m = jnp.maximum(lse_a, lse_b)
+    wa = jnp.exp(lse_a - m)
+    wb = jnp.exp(lse_b - m)
+    denom = jnp.maximum(wa + wb, 1e-30)
+    wa = (wa / denom)[:, None, :, None]
+    wb = (wb / denom)[:, None, :, None]
+    return (out_a.astype(jnp.float32) * wa +
+            out_b.astype(jnp.float32) * wb).astype(out_a.dtype)

@@ -44,6 +44,7 @@ def _decode_kernel(
     v_ref,              # [1, 1, BS, D]
     # outputs
     out_ref,            # [1, 1, G, D]
+    lse_ref,            # [1, 1, G, 128] f32 logsumexp (col 0)
     # scratch
     m_ref,              # [G, 128] f32 running max
     l_ref,              # [G, 128] f32 running denominator
@@ -99,8 +100,12 @@ def _decode_kernel(
     @pl.when(w == num_w - 1)
     def _finalize():
         l = l_ref[:, 0][:, None]                             # [G, 1]
+        m = m_ref[:, 0][:, None]
         out = acc_ref[...] / jnp.where(l == 0.0, 1.0, l)
         out_ref[0, 0] = out.astype(out_ref.dtype)
+        # logsumexp over all attended keys; -1e30 when nothing attended.
+        lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG_INF)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
 
 
 @functools.partial(
@@ -134,7 +139,10 @@ def _paged_attention_call(q_grouped, k_cache, v_cache, block_tables,
             pl.BlockSpec((1, 1, bs, d), kv_index_map),
             pl.BlockSpec((1, 1, bs, d), kv_index_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, g, d), out_index_map),
+        out_specs=(
+            pl.BlockSpec((1, 1, g, d), out_index_map),
+            pl.BlockSpec((1, 1, g, 128), out_index_map),
+        ),
         scratch_shapes=[
             pltpu.VMEM((g, 128), jnp.float32),
             pltpu.VMEM((g, 128), jnp.float32),
@@ -144,14 +152,17 @@ def _paged_attention_call(q_grouped, k_cache, v_cache, block_tables,
 
     kernel = functools.partial(_decode_kernel, block_size=bs,
                                scale=scale_static)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q_grouped.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, g, d), q_grouped.dtype),
+            jax.ShapeDtypeStruct((b, hkv, g, 128), jnp.float32),
+        ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(flat_tables, context_lens, q_grouped, k_cache, v_cache)
-    return out
+    return out, lse[..., 0]
 
 
 def paged_attention(
@@ -162,18 +173,25 @@ def paged_attention(
     context_lens: jnp.ndarray,  # [B] i32
     scale: float,
     alibi_slopes: Optional[jnp.ndarray] = None,
-) -> jnp.ndarray:
-    """Decode-phase paged attention. Returns [B, 1, Hq, D]."""
+    return_lse: bool = False,
+):
+    """Decode-phase paged attention. Returns [B, 1, Hq, D] (and, with
+    return_lse, the per-head logsumexp [B, Hq] for attention merging)."""
     if alibi_slopes is not None:
         # ALiBi biases need absolute key positions; handled by the jnp
         # reference path until the biased kernel variant lands.
         from intellillm_tpu.ops.attention import decode_attention_reference
         return decode_attention_reference(q, k_cache, v_cache, block_tables,
-                                          context_lens, scale, alibi_slopes)
+                                          context_lens, scale, alibi_slopes,
+                                          return_lse=return_lse)
     b, one, hq, d = q.shape
     hkv = k_cache.shape[1]
     g = hq // hkv
     q_grouped = q.reshape(b, hkv, g, d)
-    out = _paged_attention_call(q_grouped, k_cache, v_cache, block_tables,
-                                context_lens, scale_static=float(scale))
-    return out.reshape(b, 1, hq, d)
+    out, lse = _paged_attention_call(q_grouped, k_cache, v_cache,
+                                     block_tables, context_lens,
+                                     scale_static=float(scale))
+    out = out.reshape(b, 1, hq, d)
+    if return_lse:
+        return out, lse.reshape(b, hq)
+    return out

@@ -70,8 +70,17 @@ def shard_params(host_params: Any, mesh: Mesh, model) -> Any:
         return jax.device_put(host_params,
                               NamedSharding(mesh, P()))
 
-    def place(leaf, spec):
-        spec = spec if spec is not None else P()
+    # Look specs up by tree path: the param tree may contain None where the
+    # spec tree has a leaf (e.g. tied lm_head), so a plain tree.map would
+    # see mismatched structures.
+    spec_by_path = {
+        jax.tree_util.keystr(path): spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P))[0]
+    }
+
+    def place(path, leaf):
+        spec = spec_by_path.get(jax.tree_util.keystr(path)) or P()
         # Validate divisibility; replicate non-dividing dims.
         fixed = []
         for dim, axis in enumerate(spec):
@@ -81,14 +90,15 @@ def shard_params(host_params: Any, mesh: Mesh, model) -> Any:
             axis_size = mesh.shape[axis]
             if leaf.shape[dim] % axis_size != 0:
                 logger.warning(
-                    "Param dim %d (%d) not divisible by %s=%d; replicating.",
-                    dim, leaf.shape[dim], axis, axis_size)
+                    "Param %s dim %d (%d) not divisible by %s=%d; "
+                    "replicating.", jax.tree_util.keystr(path), dim,
+                    leaf.shape[dim], axis, axis_size)
                 fixed.append(None)
             else:
                 fixed.append(axis)
         return jax.device_put(leaf, NamedSharding(mesh, P(*fixed)))
 
-    return jax.tree.map(place, host_params, specs)
+    return jax.tree_util.tree_map_with_path(place, host_params)
 
 
 def shard_kv_cache(mesh: Mesh) -> Optional[NamedSharding]:

@@ -12,10 +12,16 @@ execute_model :516, CUDAGraphRunner :701). TPU redesign:
   single-controller JAX passes batch arrays straight into the jitted,
   mesh-sharded step function; XLA moves what each chip needs over ICI.
 - Sampling runs inside the same jitted step (see layers/sampler.py) —
-  logits never leave the device; only sampled ids + a top-K logprob panel
-  are fetched to host.
-- KV caches are donated to the step function: XLA updates the pool
-  in place.
+  logits never leave the device.
+- **Multi-step decode**: K decode iterations are fused into one device
+  call (`lax.scan` over the model+sampler), with the per-token KV slots
+  computed on device from the block tables. The host pays one dispatch +
+  one fetch per K tokens — this is what hides host/interconnect latency
+  the way the reference hides CPU batch-prep behind CUDA graphs.
+- All sampler outputs pack into a single f32 array (ids bitcast) so the
+  device→host path is ONE transfer per step — transfers, not compute,
+  dominate when the TPU sits behind a network tunnel.
+- KV caches are donated: XLA updates the pool in place.
 """
 from __future__ import annotations
 
@@ -37,12 +43,15 @@ from intellillm_tpu.sampling_params import SamplingParams, SamplingType
 from intellillm_tpu.sequence import (SamplerOutput, SequenceGroupMetadata,
                                      SequenceGroupOutput, SequenceOutput)
 from intellillm_tpu.utils import (default_batch_buckets, default_len_buckets,
-                                  next_power_of_2, pad_to_bucket)
+                                  pad_to_bucket)
 
 logger = init_logger(__name__)
 
-_MIN_BLOCK_TABLE_WIDTH = 4
+# Min padded block-table width: large enough that short contexts share one
+# executable (each width bucket is a separate XLA compile of the model).
+_MIN_BLOCK_TABLE_WIDTH = 16
 _SAMPLE_BUCKETS = (1, 2, 4, 8, 16)
+_SEED_STRIDE = np.uint32(0x9E3779B9)  # per-substep seed fold
 
 
 class ModelRunner:
@@ -55,6 +64,7 @@ class ModelRunner:
         scheduler_config: SchedulerConfig,
         cache_config: CacheConfig,
         parallel_config: ParallelConfig,
+        mesh=None,
     ) -> None:
         self.model = model
         self.params = params
@@ -62,11 +72,14 @@ class ModelRunner:
         self.scheduler_config = scheduler_config
         self.cache_config = cache_config
         self.parallel_config = parallel_config
+        self.mesh = mesh
+        self._dp = (mesh.shape.get("data", 1) if mesh is not None else 1)
 
         self.block_size = cache_config.block_size
         self.sliding_window = model_config.get_sliding_window()
         self.vocab_size = model_config.get_vocab_size()
         self.engine_seed = model_config.seed
+        self.max_model_len = model_config.max_model_len
 
         self.batch_buckets = default_batch_buckets(
             scheduler_config.max_num_seqs)
@@ -77,47 +90,216 @@ class ModelRunner:
             max(max_blocks, _MIN_BLOCK_TABLE_WIDTH),
             start=_MIN_BLOCK_TABLE_WIDTH)
 
-        self._jit_step = jax.jit(
-            self._step_fn,
+        self._jit_prefill = jax.jit(
+            self._prefill_fn,
             static_argnames=("num_samples", "logprob_k", "do_topk", "do_topp",
                              "do_minp", "do_penalties"),
             donate_argnames=("kv_caches", ),
         )
+        self._jit_decode = jax.jit(
+            self._decode_fn,
+            static_argnames=("num_steps", "logprob_k", "do_topk", "do_topp",
+                             "do_minp", "do_penalties"),
+            donate_argnames=("kv_caches", ),
+        )
+        self._jit_decode_single = jax.jit(
+            self._decode_fn_single,
+            static_argnames=("logprob_k", "do_topk", "do_topp", "do_minp",
+                             "do_penalties"),
+            donate_argnames=("kv_caches", ),
+        )
 
-    # --- the jitted step --------------------------------------------------
+    # --- packing helpers --------------------------------------------------
 
-    def _step_fn(
-        self,
-        params,
-        kv_caches,
-        token_ids,        # [B, L] i32
-        positions,        # [B, L] i32
-        attn_metadata: AttentionMetadata,
-        logits_indices,   # [B] i32 — position of the sampling token per row
-        temperatures, top_ks, top_ps, min_ps, seeds,
-        pres_pen, freq_pen, rep_pen, prompt_mask, output_counts,
-        *,
-        num_samples: int,
-        logprob_k: int,
-        do_topk: bool,
-        do_topp: bool,
-        do_minp: bool,
-        do_penalties: bool,
-    ):
-        hidden, new_caches = self.model(params, token_ids, positions,
-                                        kv_caches, attn_metadata)
-        b = token_ids.shape[0]
-        sel = hidden[jnp.arange(b), logits_indices]          # [B, E]
-        logits = self.model.compute_logits(params, sel)      # [B, V]
+    @staticmethod
+    def _pack(sampled, sampled_lp, topk_ids, topk_lp):
+        """[B,T1] i32, [B,T1] f32, [B,T2,Kt] i32, [B,T2,Kt] f32 →
+        single [B, 2*T1 + 2*T2*Kt] int32 for a 1-fetch D2H.
+
+        Packed as INT (floats bitcast to their bit patterns): small ints
+        bitcast to f32 are denormals, which TPU ops flush to zero — the
+        reverse direction is safe.
+        """
+        b = sampled.shape[0]
+        parts = [
+            sampled,
+            jax.lax.bitcast_convert_type(sampled_lp, jnp.int32),
+            topk_ids.reshape(b, -1),
+            jax.lax.bitcast_convert_type(topk_lp, jnp.int32).reshape(b, -1),
+        ]
+        return jnp.concatenate(parts, axis=-1)
+
+    @staticmethod
+    def _unpack(packed: np.ndarray, t1: int, t2: int, kt: int):
+        """Inverse of _pack, on host numpy."""
+        o = 0
+        sampled = packed[:, o:o + t1]; o += t1
+        sampled_lp = packed[:, o:o + t1].view(np.float32); o += t1
+        topk_ids = packed[:, o:o + t2 * kt].reshape(-1, t2, kt); o += t2 * kt
+        topk_lp = packed[:, o:o + t2 * kt].view(np.float32).reshape(
+            -1, t2, kt)
+        return sampled, sampled_lp, topk_ids, topk_lp
+
+    # --- jitted step functions -------------------------------------------
+
+    def _compute_logits_and_sample(self, params, hidden_rows, temperatures,
+                                   top_ks, top_ps, min_ps, seeds, pres_pen,
+                                   freq_pen, rep_pen, prompt_mask,
+                                   output_counts, *, num_samples, logprob_k,
+                                   do_topk, do_topp, do_minp, do_penalties):
+        logits = self.model.compute_logits(params, hidden_rows)
         logits = logits.astype(jnp.float32)
         if do_penalties:
             logits = apply_penalties(logits, prompt_mask, output_counts,
                                      pres_pen, freq_pen, rep_pen)
-        sampled, sampled_lp, topk_ids, topk_lp = sample(
-            logits, temperatures, top_ks, top_ps, min_ps, seeds,
-            logprob_k=logprob_k, num_samples=num_samples,
-            do_topk=do_topk, do_topp=do_topp, do_minp=do_minp)
-        return sampled, sampled_lp, topk_ids, topk_lp, new_caches
+        return sample(logits, temperatures, top_ks, top_ps, min_ps, seeds,
+                      logprob_k=logprob_k, num_samples=num_samples,
+                      do_topk=do_topk, do_topp=do_topp, do_minp=do_minp)
+
+    def _prefill_fn(self, params, kv_caches, token_ids, positions,
+                    attn_metadata, logits_indices, temperatures, top_ks,
+                    top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
+                    prompt_mask, output_counts, *, num_samples, logprob_k,
+                    do_topk, do_topp, do_minp, do_penalties):
+        hidden, new_caches = self.model(params, token_ids, positions,
+                                        kv_caches, attn_metadata)
+        b = token_ids.shape[0]
+        sel = hidden[jnp.arange(b), logits_indices]          # [B, E]
+        sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
+            params, sel, temperatures, top_ks, top_ps, min_ps, seeds,
+            pres_pen, freq_pen, rep_pen, prompt_mask, output_counts,
+            num_samples=num_samples, logprob_k=logprob_k, do_topk=do_topk,
+            do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties)
+        packed = self._pack(sampled, lp, tk_ids[:, None, :], tk_lp[:, None, :])
+        return packed, new_caches
+
+    def _decode_fn(self, params, kv_caches, token_ids, positions,
+                   block_tables, context_lens, temperatures, top_ks, top_ps,
+                   min_ps, seeds, pres_pen, freq_pen, rep_pen, prompt_mask,
+                   output_counts, *, num_steps, logprob_k, do_topk, do_topp,
+                   do_minp, do_penalties):
+        """K fused decode iterations (staged).
+
+        The paged pool stays loop-invariant (read-only) through the scan —
+        carrying it would make XLA double-buffer gigabytes. Each substep's
+        K/V land in small per-layer staging buffers [B, K, Hkv, D]; the
+        attention layer merges pool-part and stage-part by logsumexp, and
+        the staged tokens scatter into the pool ONCE after the scan.
+        """
+        assert self.sliding_window is None, (
+            "sliding-window models use the unstaged single-step decode")
+        bs = self.block_size
+        b = token_ids.shape[0]
+        base_pos = positions[:, 0]              # [B] = n-1
+        base_ctx = context_lens                 # [B] = n (0 for pad rows)
+        nb = kv_caches[0][0].shape[0]
+        oob_slot = nb * bs
+
+        hkv = kv_caches[0][0].shape[1]
+        d = kv_caches[0][0].shape[3]
+        cache_dtype = kv_caches[0][0].dtype
+        stages = [(jnp.zeros((b, num_steps, hkv, d), cache_dtype),
+                   jnp.zeros((b, num_steps, hkv, d), cache_dtype))
+                  for _ in range(len(kv_caches))]
+
+        # Tokens already in the pool: everything before the fused batch's
+        # first input token (which goes to stage slot 0).
+        pool_ctx = jnp.maximum(base_ctx - 1, 0)
+
+        def substep(carry, k):
+            cur_tokens, stages = carry
+            pos_k = jnp.minimum(base_pos + k, self.max_model_len - 1)
+            meta = AttentionMetadata(
+                is_prompt=False,
+                slot_mapping=None,
+                context_lens=pool_ctx,
+                block_tables=block_tables,
+                staged=True,
+                stage_index=k,
+            )
+            caches4 = [(kp, vp, sk, sv)
+                       for (kp, vp), (sk, sv) in zip(kv_caches, stages)]
+            hidden, caches4 = self.model(params, cur_tokens[:, None],
+                                         pos_k[:, None], caches4, meta)
+            stages = [(c[2], c[3]) for c in caches4]
+            seeds_k = seeds + k.astype(jnp.uint32) * _SEED_STRIDE
+            sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
+                params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
+                seeds_k, pres_pen, freq_pen, rep_pen, prompt_mask,
+                output_counts, num_samples=1, logprob_k=logprob_k,
+                do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
+                do_penalties=do_penalties)
+            next_tokens = sampled[:, 0]
+            return ((next_tokens, stages),
+                    (next_tokens, lp[:, 0], tk_ids, tk_lp))
+
+        (final_tokens, stages), ys = jax.lax.scan(
+            substep, (token_ids[:, 0], stages),
+            jnp.arange(num_steps, dtype=jnp.int32))
+
+        # Scatter all staged tokens (positions n-1 .. n+K-2) into the pool.
+        pos_all = base_pos[:, None] + jnp.arange(num_steps)[None, :]
+        pos_all = jnp.minimum(pos_all, self.max_model_len - 1)
+        li = pos_all // bs                               # [B, K]
+        slot_all = (jnp.take_along_axis(block_tables, li, axis=1) * bs +
+                    pos_all % bs)
+        slot_all = jnp.where(base_ctx[:, None] > 0, slot_all, oob_slot)
+        flat_slots = slot_all.reshape(-1)
+
+        from intellillm_tpu.ops.kv_cache import reshape_and_cache
+        new_caches = []
+        for (kp, vp), (sk, sv) in zip(kv_caches, stages):
+            kp, vp = reshape_and_cache(sk.reshape(b * num_steps, hkv, d),
+                                       sv.reshape(b * num_steps, hkv, d),
+                                       kp, vp, flat_slots)
+            new_caches.append((kp, vp))
+
+        sampled_k, lp_k, tk_ids_k, tk_lp_k = ys
+        # [K, B, ...] → [B, K, ...]
+        packed = self._pack(jnp.swapaxes(sampled_k, 0, 1),
+                            jnp.swapaxes(lp_k, 0, 1),
+                            jnp.swapaxes(tk_ids_k, 0, 1),
+                            jnp.swapaxes(tk_lp_k, 0, 1))
+        return packed, new_caches
+
+    def _decode_fn_single(self, params, kv_caches, token_ids, positions,
+                          block_tables, context_lens, temperatures, top_ks,
+                          top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
+                          prompt_mask, output_counts, *, logprob_k, do_topk,
+                          do_topp, do_minp, do_penalties):
+        """Unstaged single-step decode: writes KV to the pool before
+        attention. Required for sliding-window models (exact window
+        semantics need the ring layout) and used whenever K == 1."""
+        bs = self.block_size
+        wb = (self.sliding_window // bs) if self.sliding_window else None
+        b = token_ids.shape[0]
+        pos = positions[:, 0]
+        ctx = context_lens
+        nb = kv_caches[0][0].shape[0]
+
+        li = pos // bs
+        if wb is not None:
+            li = li % wb
+            ctx = jnp.minimum(ctx, self.sliding_window)
+        slot = (jnp.take_along_axis(block_tables, li[:, None],
+                                    axis=1)[:, 0] * bs + pos % bs)
+        slot = jnp.where(context_lens > 0, slot, nb * bs)
+        meta = AttentionMetadata(
+            is_prompt=False,
+            slot_mapping=slot[:, None],
+            context_lens=ctx,
+            block_tables=block_tables,
+        )
+        hidden, new_caches = self.model(params, token_ids, pos[:, None],
+                                        kv_caches, meta)
+        sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
+            params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
+            seeds, pres_pen, freq_pen, rep_pen, prompt_mask, output_counts,
+            num_samples=1, logprob_k=logprob_k, do_topk=do_topk,
+            do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties)
+        packed = self._pack(sampled, lp, tk_ids[:, None, :],
+                            tk_lp[:, None, :])
+        return packed, new_caches
 
     # --- batch prep -------------------------------------------------------
 
@@ -125,7 +307,7 @@ class ModelRunner:
         self,
         seq_group_metadata_list: List[SequenceGroupMetadata],
     ) -> Tuple[Dict[str, np.ndarray], AttentionMetadata, List[Tuple[str, int]]]:
-        rows: List[Tuple[str, int]] = []  # (request_id, seq_id) per row
+        rows: List[Tuple[str, int]] = []
         token_rows: List[List[int]] = []
         slot_rows: List[List[int]] = []
         ctx_lens: List[int] = []
@@ -201,12 +383,13 @@ class ModelRunner:
             for i, table in enumerate(block_tables):
                 bt[i, :len(table)] = table
 
+        place = self._place_batch_array
         attn_metadata = AttentionMetadata(
             is_prompt=True,
-            slot_mapping=jnp.asarray(slot_mapping),
-            context_lens=jnp.asarray(context_lens),
-            block_tables=jnp.asarray(bt) if bt is not None else None,
-            prefix_lens=jnp.asarray(np_prefix_lens) if use_prefix else None,
+            slot_mapping=place(slot_mapping),
+            context_lens=place(context_lens),
+            block_tables=place(bt) if bt is not None else None,
+            prefix_lens=place(np_prefix_lens) if use_prefix else None,
             use_prefix=use_prefix,
         )
         arrays = {"token_ids": token_ids, "positions": positions,
@@ -216,11 +399,10 @@ class ModelRunner:
     def _prepare_decode(
         self,
         seq_group_metadata_list: List[SequenceGroupMetadata],
-    ) -> Tuple[Dict[str, np.ndarray], AttentionMetadata, List[Tuple[str, int]]]:
+    ) -> Tuple[Dict[str, np.ndarray], List[Tuple[str, int]]]:
         rows: List[Tuple[str, int]] = []
         tokens: List[int] = []
         poss: List[int] = []
-        slots: List[int] = []
         ctxs: List[int] = []
         tables: List[List[int]] = []
 
@@ -228,23 +410,11 @@ class ModelRunner:
             assert not meta.is_prompt
             for seq_id, data in meta.seq_data.items():
                 n = data.get_len()
-                table = meta.block_tables[seq_id]
-                pos = n - 1
-                li = pos // self.block_size
-                if self.sliding_window is not None:
-                    wb = self.sliding_window // self.block_size
-                    li = li % wb if len(table) >= wb else li
-                slot = table[li] * self.block_size + pos % self.block_size
-
                 rows.append((meta.request_id, seq_id))
                 tokens.append(data.get_last_token_id())
-                poss.append(pos)
-                slots.append(slot)
-                if self.sliding_window is not None:
-                    ctxs.append(min(n, self.sliding_window))
-                else:
-                    ctxs.append(n)
-                tables.append(list(table))
+                poss.append(n - 1)
+                ctxs.append(n)
+                tables.append(list(meta.block_tables[seq_id]))
 
         b = pad_to_bucket(len(rows), self.batch_buckets)
         w = pad_to_bucket(max(max(len(t) for t in tables),
@@ -253,27 +423,30 @@ class ModelRunner:
 
         token_ids = np.zeros((b, 1), np.int32)
         positions = np.zeros((b, 1), np.int32)
-        slot_mapping = np.full((b, 1), PAD_SLOT_ID, np.int32)
         context_lens = np.zeros(b, np.int32)
         block_tables = np.zeros((b, w), np.int32)
-        logits_indices = np.zeros(b, np.int32)
 
         for i in range(len(rows)):
             token_ids[i, 0] = tokens[i]
             positions[i, 0] = poss[i]
-            slot_mapping[i, 0] = slots[i]
             context_lens[i] = ctxs[i]
             block_tables[i, :len(tables[i])] = tables[i]
 
-        attn_metadata = AttentionMetadata(
-            is_prompt=False,
-            slot_mapping=jnp.asarray(slot_mapping),
-            context_lens=jnp.asarray(context_lens),
-            block_tables=jnp.asarray(block_tables),
-        )
         arrays = {"token_ids": token_ids, "positions": positions,
-                  "logits_indices": logits_indices}
-        return arrays, attn_metadata, rows
+                  "context_lens": context_lens, "block_tables": block_tables}
+        return arrays, rows
+
+    def _place_batch_array(self, arr):
+        """Shard a [B, ...] host array over the mesh "data" axis (dp > 1),
+        else hand it to jit as-is."""
+        if arr is None:
+            return None
+        if self._dp <= 1:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P(*(("data", ) + (None, ) * (arr.ndim - 1)))
+        return jax.device_put(jnp.asarray(arr),
+                              NamedSharding(self.mesh, spec))
 
     def _row_seed(self, seq_id: int, step: int) -> int:
         # Deterministic per (engine seed, sequence, step).
@@ -287,17 +460,20 @@ class ModelRunner:
         self,
         seq_group_metadata_list: List[SequenceGroupMetadata],
         kv_caches,
-    ) -> Tuple[SamplerOutput, Any]:
+        num_decode_steps: int = 1,
+    ) -> Tuple[List[SamplerOutput], Any]:
+        """Returns (outputs_per_substep, new_kv_caches)."""
         if not seq_group_metadata_list:
             return [], kv_caches
 
         is_prompt = seq_group_metadata_list[0].is_prompt
+        place = self._place_batch_array
+
         if is_prompt:
             arrays, attn_metadata, rows = self._prepare_prompt(
                 seq_group_metadata_list)
         else:
-            arrays, attn_metadata, rows = self._prepare_decode(
-                seq_group_metadata_list)
+            arrays, rows = self._prepare_decode(seq_group_metadata_list)
 
         padded_n = arrays["token_ids"].shape[0]
 
@@ -316,7 +492,6 @@ class ModelRunner:
         st = SamplingTensors.build(row_params, row_seeds, row_tokens,
                                    self.vocab_size, padded_n)
 
-        # best_of>1 random prompts need multiple samples from one row.
         num_samples = 1
         if is_prompt:
             for sp in row_params:
@@ -326,34 +501,57 @@ class ModelRunner:
             num_samples = pad_to_bucket(num_samples, _SAMPLE_BUCKETS)
 
         zeros = np.zeros(padded_n, np.float32)
-        sampled, sampled_lp, topk_ids, topk_lp, new_caches = self._jit_step(
-            self.params, kv_caches,
-            jnp.asarray(arrays["token_ids"]), jnp.asarray(arrays["positions"]),
-            attn_metadata, jnp.asarray(arrays["logits_indices"]),
-            jnp.asarray(st.temperatures), jnp.asarray(st.top_ks),
-            jnp.asarray(st.top_ps), jnp.asarray(st.min_ps),
-            jnp.asarray(st.seeds),
-            jnp.asarray(st.presence_penalties if st.do_penalties else zeros),
-            jnp.asarray(st.frequency_penalties if st.do_penalties else zeros),
-            jnp.asarray(st.repetition_penalties if st.do_penalties
-                        else np.ones(padded_n, np.float32)),
-            jnp.asarray(st.prompt_mask) if st.do_penalties else None,
-            jnp.asarray(st.output_counts) if st.do_penalties else None,
-            num_samples=num_samples,
+        common = dict(
             logprob_k=st.logprob_k,
             do_topk=st.do_topk, do_topp=st.do_topp, do_minp=st.do_minp,
             do_penalties=st.do_penalties,
         )
+        sampling_args = (
+            place(st.temperatures), place(st.top_ks), place(st.top_ps),
+            place(st.min_ps), place(st.seeds),
+            place(st.presence_penalties if st.do_penalties else zeros),
+            place(st.frequency_penalties if st.do_penalties else zeros),
+            place(st.repetition_penalties if st.do_penalties
+                  else np.ones(padded_n, np.float32)),
+            place(st.prompt_mask) if st.do_penalties else None,
+            place(st.output_counts) if st.do_penalties else None,
+        )
 
-        sampled = np.asarray(sampled)          # [B, S]
-        sampled_lp = np.asarray(sampled_lp)    # [B, S]
-        topk_ids = np.asarray(topk_ids)        # [B, K]
-        topk_lp = np.asarray(topk_lp)          # [B, K]
+        if is_prompt:
+            packed, new_caches = self._jit_prefill(
+                self.params, kv_caches,
+                place(arrays["token_ids"]), place(arrays["positions"]),
+                attn_metadata, place(arrays["logits_indices"]),
+                *sampling_args, num_samples=num_samples, **common)
+            t1, t2 = num_samples, 1
+            num_steps = 1
+        else:
+            num_steps = num_decode_steps
+            if self.sliding_window is not None:
+                num_steps = 1  # exact window semantics need the ring layout
+            decode_args = (
+                self.params, kv_caches,
+                place(arrays["token_ids"]), place(arrays["positions"]),
+                place(arrays["block_tables"]), place(arrays["context_lens"]),
+                *sampling_args)
+            if num_steps == 1:
+                packed, new_caches = self._jit_decode_single(*decode_args,
+                                                             **common)
+            else:
+                packed, new_caches = self._jit_decode(*decode_args,
+                                                      num_steps=num_steps,
+                                                      **common)
+            t1 = t2 = num_steps
 
-        output = self._process_sampling(seq_group_metadata_list, rows,
-                                        sampled, sampled_lp, topk_ids,
-                                        topk_lp)
-        return output, new_caches
+        # ONE device→host transfer for everything.
+        packed = np.asarray(packed)
+        sampled, sampled_lp, topk_ids, topk_lp = self._unpack(
+            packed, t1, t2, st.logprob_k)
+
+        outputs = self._process_sampling(seq_group_metadata_list, rows,
+                                         sampled, sampled_lp, topk_ids,
+                                         topk_lp, is_prompt, num_steps)
+        return outputs, new_caches
 
     # --- sampler post-processing -----------------------------------------
 
@@ -361,72 +559,74 @@ class ModelRunner:
         self,
         seq_group_metadata_list: List[SequenceGroupMetadata],
         rows: List[Tuple[str, int]],
-        sampled: np.ndarray,
-        sampled_lp: np.ndarray,
-        topk_ids: np.ndarray,
-        topk_lp: np.ndarray,
-    ) -> SamplerOutput:
-        # Group rows by request in schedule order.
+        sampled: np.ndarray,      # [B, T1]
+        sampled_lp: np.ndarray,   # [B, T1]
+        topk_ids: np.ndarray,     # [B, T2, Kt]
+        topk_lp: np.ndarray,      # [B, T2, Kt]
+        is_prompt: bool,
+        num_steps: int,
+    ) -> List[SamplerOutput]:
+        """Build one SamplerOutput per fused substep."""
         row_idx_by_req: Dict[str, List[Tuple[int, int]]] = {}
         for i, (req_id, seq_id) in enumerate(rows):
             row_idx_by_req.setdefault(req_id, []).append((i, seq_id))
 
-        output: SamplerOutput = []
-        for meta in seq_group_metadata_list:
-            group_rows = row_idx_by_req[meta.request_id]
-            sp = meta.sampling_params
-            stype = sp.sampling_type
+        outputs_per_step: List[SamplerOutput] = []
+        for k in range(num_steps):
+            t = 0 if is_prompt else k
+            output: SamplerOutput = []
+            for meta in seq_group_metadata_list:
+                group_rows = row_idx_by_req[meta.request_id]
+                sp = meta.sampling_params
+                stype = sp.sampling_type
 
-            def logprob_dict(row: int, token: int, token_lp: float) -> Dict[int, float]:
-                d = {int(token): float(token_lp)}
-                if sp.logprobs:
-                    for t, lp in zip(topk_ids[row, :sp.logprobs],
-                                     topk_lp[row, :sp.logprobs]):
-                        d.setdefault(int(t), float(lp))
-                return d
+                def logprob_dict(row, token, token_lp):
+                    d = {int(token): float(token_lp)}
+                    if sp.logprobs:
+                        for tt, lp in zip(topk_ids[row, t, :sp.logprobs],
+                                          topk_lp[row, t, :sp.logprobs]):
+                            d.setdefault(int(tt), float(lp))
+                    return d
 
-            samples: List[SequenceOutput] = []
-            if stype == SamplingType.BEAM:
-                bw = sp.best_of
-                if meta.is_prompt:
-                    (row, parent_id) = group_rows[0]
-                    for j in range(2 * bw):
-                        samples.append(
-                            SequenceOutput(
-                                parent_id, int(topk_ids[row, j]),
-                                logprob_dict(row, topk_ids[row, j],
-                                             topk_lp[row, j])))
-                else:
-                    # Across all live beams: candidates scored by
-                    # cumulative + token logprob; take top 2*bw.
-                    cands = []  # (score, parent_seq_id, row, j)
-                    for row, seq_id in group_rows:
-                        cum = meta.seq_data[seq_id].cumulative_logprob
+                samples: List[SequenceOutput] = []
+                if stype == SamplingType.BEAM:
+                    assert num_steps == 1
+                    bw = sp.best_of
+                    if meta.is_prompt:
+                        (row, parent_id) = group_rows[0]
                         for j in range(2 * bw):
-                            cands.append((cum + float(topk_lp[row, j]),
-                                          seq_id, row, j))
-                    cands.sort(key=lambda c: c[0], reverse=True)
-                    for score, seq_id, row, j in cands[:2 * bw]:
-                        samples.append(
-                            SequenceOutput(
-                                seq_id, int(topk_ids[row, j]),
-                                logprob_dict(row, topk_ids[row, j],
-                                             topk_lp[row, j])))
-            elif meta.is_prompt:
-                (row, parent_id) = group_rows[0]
-                for s in range(sp.best_of):
-                    tok = int(sampled[row, s])
-                    samples.append(
-                        SequenceOutput(
+                            samples.append(SequenceOutput(
+                                parent_id, int(topk_ids[row, 0, j]),
+                                logprob_dict(row, topk_ids[row, 0, j],
+                                             topk_lp[row, 0, j])))
+                    else:
+                        cands = []
+                        for row, seq_id in group_rows:
+                            cum = meta.seq_data[seq_id].cumulative_logprob
+                            for j in range(2 * bw):
+                                cands.append((cum + float(topk_lp[row, 0, j]),
+                                              seq_id, row, j))
+                        cands.sort(key=lambda c: c[0], reverse=True)
+                        for score, seq_id, row, j in cands[:2 * bw]:
+                            samples.append(SequenceOutput(
+                                seq_id, int(topk_ids[row, 0, j]),
+                                logprob_dict(row, topk_ids[row, 0, j],
+                                             topk_lp[row, 0, j])))
+                elif meta.is_prompt:
+                    (row, parent_id) = group_rows[0]
+                    for s in range(sp.best_of):
+                        tok = int(sampled[row, s])
+                        samples.append(SequenceOutput(
                             parent_id, tok,
                             logprob_dict(row, tok, sampled_lp[row, s])))
-            else:
-                for row, seq_id in group_rows:
-                    tok = int(sampled[row, 0])
-                    samples.append(
-                        SequenceOutput(seq_id, tok,
-                                       logprob_dict(row, tok,
-                                                    sampled_lp[row, 0])))
+                else:
+                    for row, seq_id in group_rows:
+                        tok = int(sampled[row, k])
+                        samples.append(SequenceOutput(
+                            seq_id, tok,
+                            logprob_dict(row, tok, sampled_lp[row, k])))
 
-            output.append(SequenceGroupOutput(samples, prompt_logprobs=None))
-        return output
+                output.append(SequenceGroupOutput(samples,
+                                                  prompt_logprobs=None))
+            outputs_per_step.append(output)
+        return outputs_per_step

@@ -71,7 +71,8 @@ class Worker:
                                         self.model_config,
                                         self.scheduler_config,
                                         self.cache_config,
-                                        self.parallel_config)
+                                        self.parallel_config,
+                                        mesh=self.mesh)
 
     # --- memory profiling -------------------------------------------------
 
@@ -117,6 +118,18 @@ class Worker:
                                 if tp > 1 and nkv % tp == 0 else block_bytes)
 
         temp_bytes = self._estimate_step_temp_bytes()
+        # Fused-decode staging buffers (2 per layer, [B, K, Hkv, D]) and
+        # XLA weight-relayout copies for the in-loop matmuls are temps the
+        # prefill lowering can't see; account for them analytically.
+        k_steps = self.scheduler_config.num_decode_steps
+        import jax.numpy as _jnp
+        from intellillm_tpu.utils import STR_DTYPE_TO_JNP as _M
+        stage_bytes = (2 * self.model_config.get_num_layers() *
+                       self.scheduler_config.max_num_seqs * k_steps *
+                       self.model_config.get_total_num_kv_heads() *
+                       self.model_config.get_head_size() *
+                       _jnp.dtype(_M[self.model_config.dtype]).itemsize)
+        temp_bytes += stage_bytes + int(0.10 * weights_bytes)
         available = int(total * hbm_utilization) - weights_bytes - temp_bytes
         num_device_blocks = max(available // block_bytes_per_chip, 0)
         logger.info(
@@ -161,7 +174,7 @@ class Worker:
             i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
             f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)
             u32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.uint32)
-            lowered = runner._jit_step.lower(
+            lowered = runner._jit_prefill.lower(
                 self.params, kv_struct, i32(b, l), i32(b, l), meta, i32(b),
                 f32(b), i32(b), f32(b), f32(b), u32(b),
                 f32(b), f32(b), f32(b), None, None,
@@ -200,7 +213,10 @@ class Worker:
         blocks_to_swap_in: Dict[int, int],
         blocks_to_swap_out: Dict[int, int],
         blocks_to_copy: Dict[int, List[int]],
-    ) -> SamplerOutput:
+        num_decode_steps: int = 1,
+    ) -> List[SamplerOutput]:
+        """Returns one SamplerOutput per fused decode substep (length 1 for
+        prompt runs and unfused decodes)."""
         if blocks_to_swap_out:
             self.cache_engine.swap_out(blocks_to_swap_out)
         if blocks_to_swap_in:
@@ -211,7 +227,8 @@ class Worker:
         if not seq_group_metadata_list:
             return []
 
-        output, new_caches = self.model_runner.execute_model(
-            seq_group_metadata_list, self.cache_engine.device_cache)
+        outputs, new_caches = self.model_runner.execute_model(
+            seq_group_metadata_list, self.cache_engine.device_cache,
+            num_decode_steps)
         self.cache_engine.device_cache = new_caches
-        return output
+        return outputs

@@ -1,0 +1,133 @@
+"""BlockSpaceManager unit tests (reference test strategy: engine-logic
+tests with synthetic sequences, SURVEY §4)."""
+import pytest
+
+from intellillm_tpu.block import PhysicalTokenBlock
+from intellillm_tpu.core.block_manager import (AllocStatus, BlockAllocator,
+                                               BlockSpaceManager)
+from intellillm_tpu.sampling_params import SamplingParams
+from intellillm_tpu.sequence import Sequence, SequenceGroup, SequenceStatus
+from intellillm_tpu.utils import Device
+
+
+def make_group(seq_id, prompt_len, block_size=4, best_of=1):
+    seq = Sequence(seq_id, "x", list(range(prompt_len)), block_size)
+    sp = SamplingParams(temperature=1.0 if best_of > 1 else 0.0,
+                       best_of=best_of, n=best_of)
+    return SequenceGroup(f"req{seq_id}", [seq], sp, 0.0), seq
+
+
+def test_block_allocator_refcounting():
+    alloc = BlockAllocator(Device.DEVICE, 4, 4)
+    blocks = [alloc.allocate() for _ in range(4)]
+    assert alloc.get_num_free_blocks() == 0
+    with pytest.raises(ValueError):
+        alloc.allocate()
+    for b in blocks:
+        alloc.free(b)
+        with pytest.raises(ValueError):
+            alloc.free(b)  # double free
+    assert alloc.get_num_free_blocks() == 4
+
+
+def test_allocate_and_free():
+    bm = BlockSpaceManager(block_size=4, num_device_blocks=8,
+                           num_cpu_blocks=4)
+    group, seq = make_group(0, prompt_len=10)  # 3 blocks
+    assert bm.can_allocate(group) == AllocStatus.OK
+    bm.allocate(group)
+    assert len(bm.get_block_table(seq)) == 3
+    assert bm.get_num_free_device_blocks() == 5
+    bm.free(seq)
+    assert bm.get_num_free_device_blocks() == 8
+
+
+def test_allocate_never_fits():
+    bm = BlockSpaceManager(block_size=4, num_device_blocks=2,
+                           num_cpu_blocks=2)
+    group, _ = make_group(0, prompt_len=100)
+    assert bm.can_allocate(group) == AllocStatus.NEVER
+
+
+def test_append_slots_grows_and_cow():
+    bm = BlockSpaceManager(block_size=4, num_device_blocks=8,
+                           num_cpu_blocks=4)
+    group, seq = make_group(0, prompt_len=4, best_of=2)
+    seq.status = SequenceStatus.WAITING
+    bm.allocate(group)
+    seq.status = SequenceStatus.RUNNING
+
+    # Fork: child shares blocks.
+    child = seq.fork(1)
+    group.add(child)
+    bm.fork(seq, child)
+    table = bm.block_tables[seq.seq_id]
+    assert all(b.ref_count == 2 for b in table)
+
+    # Append a token to parent: prompt block full → new block, no CoW.
+    seq.append_token_id(7, {7: 0.0})
+    cows = bm.append_slots(seq, 1)
+    assert cows == []
+    assert len(bm.block_tables[seq.seq_id]) == 2
+
+    # Parent's new last block is unshared; append within it → no CoW.
+    seq.append_token_id(8, {8: 0.0})
+    assert bm.append_slots(seq, 1) == []
+
+    # Child appends: its last block (the shared prompt block) is full, so
+    # a new block is allocated; no CoW needed for full blocks.
+    child.append_token_id(9, {9: 0.0})
+    assert bm.append_slots(child, 1) == []
+
+
+def test_cow_on_shared_partial_block():
+    bm = BlockSpaceManager(block_size=4, num_device_blocks=8,
+                           num_cpu_blocks=4)
+    # Prompt 2 tokens → one partially-filled block, then fork.
+    group, seq = make_group(0, prompt_len=2, best_of=2)
+    bm.allocate(group)
+    seq.status = SequenceStatus.RUNNING
+    child = seq.fork(1)
+    group.add(child)
+    bm.fork(seq, child)
+
+    seq.append_token_id(5, {5: 0.0})
+    cows = bm.append_slots(seq, 1)
+    assert len(cows) == 1  # shared partial block copied
+    src, dst = cows[0]
+    assert src != dst
+    # Parent's table now unshared.
+    assert bm.block_tables[seq.seq_id][-1].ref_count == 1
+
+
+def test_multi_slot_reservation():
+    bm = BlockSpaceManager(block_size=4, num_device_blocks=8,
+                           num_cpu_blocks=4)
+    group, seq = make_group(0, prompt_len=4)
+    bm.allocate(group)
+    seq.status = SequenceStatus.RUNNING
+    seq.append_token_id(1, {1: 0.0})
+    # Reserve 8 lookahead slots: tokens at positions 4..11 → 3 blocks total.
+    bm.append_slots(seq, 8)
+    assert len(bm.block_tables[seq.seq_id]) == 3
+
+
+def test_swap_out_and_in():
+    bm = BlockSpaceManager(block_size=4, num_device_blocks=4,
+                           num_cpu_blocks=4)
+    group, seq = make_group(0, prompt_len=8, best_of=2)
+    bm.allocate(group)
+    for s in group.get_seqs():
+        s.status = SequenceStatus.RUNNING
+
+    assert bm.can_swap_out(group)
+    mapping = bm.swap_out(group)
+    assert len(mapping) == 2
+    assert bm.get_num_free_device_blocks() == 4
+    for s in group.get_seqs():
+        s.status = SequenceStatus.SWAPPED
+
+    assert bm.can_swap_in(group)
+    mapping_in = bm.swap_in(group)
+    assert set(mapping_in.keys()) == set(mapping.values())
+    assert bm.get_num_free_device_blocks() == 2

@@ -1,0 +1,139 @@
+"""Scheduler unit tests: admission budgets, preemption, policy ordering."""
+import pytest
+
+from intellillm_tpu.config import CacheConfig, SchedulerConfig
+from intellillm_tpu.core.policy import PolicyFactory
+from intellillm_tpu.core.scheduler import Scheduler
+from intellillm_tpu.sampling_params import SamplingParams
+from intellillm_tpu.sequence import Sequence, SequenceGroup, SequenceStatus
+
+
+def make_scheduler(num_blocks=16, block_size=4, max_num_seqs=8,
+                   policy="fcfs", num_decode_steps=1, max_model_len=64):
+    cache_config = CacheConfig(block_size=block_size, swap_space_gib=0.001)
+    cache_config.num_device_blocks = num_blocks
+    cache_config.num_cpu_blocks = 8
+    scheduler_config = SchedulerConfig(
+        max_num_batched_tokens=max(64, max_model_len),
+        max_num_seqs=max_num_seqs,
+        max_model_len=max_model_len,
+        max_paddings=256,
+        policy=policy,
+        num_decode_steps=num_decode_steps)
+    return Scheduler(scheduler_config, cache_config)
+
+
+def add_request(scheduler, rid, prompt_len, block_size=4,
+                predicted_len=None, **sp_kwargs):
+    seq = Sequence(int(rid), "x", list(range(prompt_len)), block_size)
+    sp = SamplingParams(**sp_kwargs) if sp_kwargs else SamplingParams(
+        temperature=0.0, max_tokens=16)
+    group = SequenceGroup(rid, [seq], sp, arrival_time=float(rid),
+                          predicted_len=predicted_len)
+    scheduler.add_seq_group(group)
+    return group, seq
+
+
+def append_token(group):
+    for seq in group.get_seqs(SequenceStatus.RUNNING):
+        seq.append_token_id(1, {1: 0.0})
+
+
+def test_prefill_first_then_decode():
+    s = make_scheduler()
+    g1, _ = add_request(s, "0", 6)
+    g2, _ = add_request(s, "1", 5)
+    metas, out = s.schedule()
+    assert out.prompt_run and len(metas) == 2
+    append_token(g1)
+    append_token(g2)
+    metas, out = s.schedule()
+    assert not out.prompt_run
+    assert len(metas) == 2
+
+
+def test_prompt_too_long_is_ignored():
+    s = make_scheduler(max_model_len=8)
+    g, seq = add_request(s, "0", 100)
+    metas, out = s.schedule()
+    assert not metas
+    assert out.ignored_seq_groups == [g]
+    assert seq.status == SequenceStatus.FINISHED_IGNORED
+
+
+def test_admission_respects_max_num_seqs():
+    s = make_scheduler(max_num_seqs=2, num_blocks=64)
+    for i in range(4):
+        add_request(s, str(i), 4)
+    metas, out = s.schedule()
+    assert len(metas) == 2
+    assert len(s.waiting) == 2
+
+
+def test_preemption_by_recompute_when_out_of_blocks():
+    # 4 blocks of 4 tokens; two seqs with 8-token prompts fill everything.
+    s = make_scheduler(num_blocks=4, block_size=4)
+    g1, _ = add_request(s, "0", 8)
+    g2, _ = add_request(s, "1", 8)
+    metas, out = s.schedule()
+    assert len(metas) == 2
+    append_token(g1)
+    append_token(g2)
+    # Decode needs a new block per seq; none free → lowest-priority (g2,
+    # arrived later) preempted by recompute back to waiting.
+    metas, out = s.schedule()
+    assert not out.prompt_run
+    assert len(metas) == 1
+    assert metas[0].request_id == "0"
+    assert g2.get_seqs()[0].status == SequenceStatus.WAITING
+    assert len(s.waiting) == 1
+
+
+def test_sjf_policy_orders_waiting_by_predicted_len():
+    s = make_scheduler(policy="sjf", max_num_seqs=1, num_blocks=64)
+    add_request(s, "0", 4, predicted_len=500)
+    g_short, _ = add_request(s, "1", 4, predicted_len=5)
+    metas, out = s.schedule()
+    assert [m.request_id for m in metas] == ["1"], (
+        "SJF must admit the shortest predicted job first")
+
+
+def test_fcfs_policy_priority():
+    fcfs = PolicyFactory.get_policy("fcfs")
+    g_old = SequenceGroup("a", [Sequence(0, "x", [1], 4)],
+                          SamplingParams(), arrival_time=0.0)
+    g_new = SequenceGroup("b", [Sequence(1, "x", [1], 4)],
+                          SamplingParams(), arrival_time=10.0)
+    order = fcfs.sort_by_priority(100.0, [g_new, g_old])
+    assert [g.request_id for g in order] == ["a", "b"]
+
+
+def test_multi_step_reserves_blocks():
+    s = make_scheduler(num_blocks=16, block_size=4, num_decode_steps=8)
+    g, seq = add_request(s, "0", 4)
+    s.schedule()
+    append_token(g)
+    metas, out = s.schedule()
+    assert out.num_decode_steps == 8
+    # 4 prompt tokens + 1 output + 7 lookahead = 12 tokens → 3 blocks.
+    assert len(s.block_manager.block_tables[seq.seq_id]) == 3
+
+
+def test_beam_group_forces_single_step():
+    s = make_scheduler(num_blocks=32, block_size=4, num_decode_steps=8)
+    g, seq = add_request(s, "0", 4, use_beam_search=True, best_of=2,
+                         temperature=0.0, max_tokens=8)
+    s.schedule()
+    append_token(g)
+    metas, out = s.schedule()
+    assert out.num_decode_steps == 1
+
+
+def test_abort():
+    s = make_scheduler()
+    g, seq = add_request(s, "0", 4)
+    s.schedule()
+    s.abort_seq_group("0")
+    assert not s.has_unfinished_seqs()
+    assert seq.status == SequenceStatus.FINISHED_ABORTED
+    assert s.block_manager.get_num_free_device_blocks() == 16

@@ -1,0 +1,73 @@
+"""Pallas paged-attention kernel vs the jnp reference over a parameter
+grid (reference pattern: `tests/kernels/test_attention.py` sweeps dtypes ×
+head configs × block sizes against `ref_single_query_cached_kv_attention`).
+
+The kernel needs a real TPU; on CPU these tests are skipped (the engine
+itself uses the reference path there).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from intellillm_tpu.ops.attention import decode_attention_reference
+
+requires_tpu = pytest.mark.skipif(jax.default_backend() != "tpu",
+                                  reason="Pallas kernel requires TPU")
+
+
+def make_cache(rng, nb, hkv, bs, d, dtype):
+    k = rng.normal(size=(nb, hkv, bs, d)).astype(dtype)
+    v = rng.normal(size=(nb, hkv, bs, d)).astype(dtype)
+    return jnp.asarray(k), jnp.asarray(v)
+
+
+@requires_tpu
+@pytest.mark.parametrize("hq,hkv", [(8, 8), (8, 2), (4, 1)])
+@pytest.mark.parametrize("d", [64, 128])
+@pytest.mark.parametrize("ctx_lens", [[1, 17, 63, 128]])
+def test_paged_attention_matches_reference(hq, hkv, d, ctx_lens):
+    from intellillm_tpu.ops.pallas.paged_attention import paged_attention
+
+    rng = np.random.default_rng(0)
+    b = len(ctx_lens)
+    nb, bs = 64, 16
+    k_cache, v_cache = make_cache(rng, nb, hkv, bs, d, np.float32)
+    q = jnp.asarray(rng.normal(size=(b, 1, hq, d)).astype(np.float32))
+
+    w = 8
+    tables = rng.permutation(nb)[:b * w].reshape(b, w).astype(np.int32)
+    block_tables = jnp.asarray(tables)
+    context_lens = jnp.asarray(np.asarray(ctx_lens, np.int32))
+    scale = d**-0.5
+
+    out_k = paged_attention(q, k_cache, v_cache, block_tables, context_lens,
+                            scale)
+    out_r = decode_attention_reference(q, k_cache, v_cache, block_tables,
+                                       context_lens, scale)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                               rtol=2e-2, atol=2e-2)
+
+
+@requires_tpu
+def test_paged_attention_lse_matches_reference():
+    from intellillm_tpu.ops.pallas.paged_attention import paged_attention
+
+    rng = np.random.default_rng(1)
+    b, hq, hkv, d, nb, bs, w = 2, 4, 2, 128, 32, 16, 4
+    k_cache, v_cache = make_cache(rng, nb, hkv, bs, d, np.float32)
+    q = jnp.asarray(rng.normal(size=(b, 1, hq, d)).astype(np.float32))
+    block_tables = jnp.asarray(
+        rng.permutation(nb)[:b * w].reshape(b, w).astype(np.int32))
+    context_lens = jnp.asarray(np.asarray([5, 40], np.int32))
+    scale = d**-0.5
+
+    out_k, lse_k = paged_attention(q, k_cache, v_cache, block_tables,
+                                   context_lens, scale, return_lse=True)
+    out_r, lse_r = decode_attention_reference(q, k_cache, v_cache,
+                                              block_tables, context_lens,
+                                              scale, return_lse=True)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(lse_k), np.asarray(lse_r),
+                               rtol=2e-2, atol=2e-2)

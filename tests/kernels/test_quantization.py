@@ -1,0 +1,44 @@
+"""int8 weight-only quantization numerics."""
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.layers.quantization import (qmatmul, quantize_int8,
+                                                quantize_int8_jax)
+
+
+def test_quantize_roundtrip_error_small():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(64, 32)).astype(np.float32) * 0.1
+    qw = quantize_int8(w)
+    deq = qw["q"].astype(np.float32) * qw["s"][None, :]
+    rel = np.abs(deq - w).max() / np.abs(w).max()
+    assert rel < 0.01  # < 1% of max magnitude per int8 per-channel
+
+
+def test_qmatmul_matches_dequant_matmul():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, 64)).astype(np.float32)
+    w = rng.normal(size=(64, 32)).astype(np.float32) * 0.05
+    qw = quantize_int8(w)
+    out_q = np.asarray(qmatmul(jnp.asarray(x),
+                               {"q": jnp.asarray(qw["q"]),
+                                "s": jnp.asarray(qw["s"])}))
+    deq = qw["q"].astype(np.float32) * qw["s"][None, :]
+    out_ref = x @ deq
+    np.testing.assert_allclose(out_q, out_ref, rtol=1e-3, atol=1e-3)
+
+
+def test_qmatmul_passthrough_plain_weights():
+    x = jnp.ones((2, 4))
+    w = jnp.ones((4, 3))
+    np.testing.assert_allclose(np.asarray(qmatmul(x, w)),
+                               np.full((2, 3), 4.0))
+
+
+def test_jax_variant_matches_numpy():
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(32, 16)).astype(np.float32)
+    q_np = quantize_int8(w)
+    q_jx = quantize_int8_jax(jnp.asarray(w))
+    np.testing.assert_array_equal(q_np["q"], np.asarray(q_jx["q"]))
+    np.testing.assert_allclose(q_np["s"], np.asarray(q_jx["s"]), rtol=1e-6)

@@ -1,0 +1,109 @@
+"""Sampler unit tests with synthetic logits (reference pattern:
+`tests/samplers/test_sampler.py` MockLogitsSampler)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from intellillm_tpu.layers.sampler import (SamplingTensors, apply_penalties,
+                                           sample)
+from intellillm_tpu.sampling_params import SamplingParams
+
+
+def run_sample(logits, temps, top_ks=None, top_ps=None, min_ps=None,
+               seeds=None, **kw):
+    n, v = logits.shape
+    temps = jnp.asarray(temps, jnp.float32)
+    top_ks = jnp.asarray(top_ks if top_ks is not None else [v] * n, jnp.int32)
+    top_ps = jnp.asarray(top_ps if top_ps is not None else [1.0] * n,
+                         jnp.float32)
+    min_ps = jnp.asarray(min_ps if min_ps is not None else [0.0] * n,
+                         jnp.float32)
+    seeds = jnp.asarray(seeds if seeds is not None else np.arange(n),
+                        jnp.uint32)
+    return sample(jnp.asarray(logits), temps, top_ks, top_ps, min_ps, seeds,
+                  logprob_k=8, **kw)
+
+
+def test_greedy_picks_argmax():
+    logits = np.random.default_rng(0).normal(size=(4, 50)).astype(np.float32)
+    sampled, lp, tk_ids, tk_lp = run_sample(logits, temps=[0.0] * 4)
+    np.testing.assert_array_equal(np.asarray(sampled)[:, 0],
+                                  logits.argmax(-1))
+    # Sampled logprob matches log-softmax of argmax.
+    ref = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(lp)[:, 0],
+                               ref[np.arange(4), logits.argmax(-1)],
+                               rtol=1e-4)
+
+
+def test_topk_restricts_support():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(1, 100)).astype(np.float32)
+    top3 = set(np.argsort(logits[0])[-3:].tolist())
+    for seed in range(20):
+        sampled, *_ = run_sample(logits, temps=[1.0], top_ks=[3],
+                                 seeds=[seed], do_topk=True)
+        assert int(np.asarray(sampled)[0, 0]) in top3
+
+
+def test_topp_keeps_argmax():
+    logits = np.zeros((1, 10), np.float32)
+    logits[0, 7] = 10.0  # prob ~1
+    for seed in range(10):
+        sampled, *_ = run_sample(logits, temps=[1.0], top_ps=[0.1],
+                                 seeds=[seed], do_topp=True)
+        assert int(np.asarray(sampled)[0, 0]) == 7
+
+
+def test_seeded_sampling_deterministic():
+    logits = np.random.default_rng(2).normal(size=(2, 64)).astype(np.float32)
+    a = run_sample(logits, temps=[0.8, 0.8], seeds=[42, 43])[0]
+    b = run_sample(logits, temps=[0.8, 0.8], seeds=[42, 43])[0]
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = run_sample(logits, temps=[0.8, 0.8], seeds=[44, 45])[0]
+    assert not (np.asarray(a) == np.asarray(c)).all()
+
+
+def test_multi_sample_distinct_seeds():
+    logits = np.random.default_rng(3).normal(size=(1, 64)).astype(np.float32)
+    sampled, *_ = run_sample(logits, temps=[1.5], seeds=[7], num_samples=8)
+    vals = np.asarray(sampled)[0]
+    assert len(set(vals.tolist())) > 1, "independent samples expected"
+
+
+def test_topk_logprob_panel_sorted():
+    logits = np.random.default_rng(4).normal(size=(2, 30)).astype(np.float32)
+    _, _, tk_ids, tk_lp = run_sample(logits, temps=[0.0, 0.0])
+    lp = np.asarray(tk_lp)
+    assert (np.diff(lp, axis=-1) <= 1e-6).all(), "panel must be descending"
+    ref = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    np.testing.assert_allclose(lp[:, 0], ref.max(-1), rtol=1e-4)
+
+
+def test_penalties():
+    logits = jnp.zeros((1, 8), jnp.float32)
+    prompt_mask = np.zeros((1, 8), bool)
+    prompt_mask[0, 1] = True
+    counts = np.zeros((1, 8), np.int32)
+    counts[0, 2] = 3
+    out = apply_penalties(
+        logits, jnp.asarray(prompt_mask), jnp.asarray(counts),
+        presence_penalties=jnp.asarray([0.5], jnp.float32),
+        frequency_penalties=jnp.asarray([0.1], jnp.float32),
+        repetition_penalties=jnp.asarray([2.0], jnp.float32))
+    out = np.asarray(out)
+    assert out[0, 1] == 0.0  # rep penalty on 0 logit stays 0 (scaling)
+    np.testing.assert_allclose(out[0, 2], -0.1 * 3 - 0.5)  # freq + presence
+    assert out[0, 0] == 0.0  # untouched
+
+
+def test_sampling_tensors_build_flags():
+    sp_greedy = SamplingParams(temperature=0.0, max_tokens=4)
+    sp_topk = SamplingParams(temperature=0.9, top_k=5, max_tokens=4)
+    st = SamplingTensors.build([sp_greedy, sp_topk], [1, 2],
+                               [([1], []), ([2], [])], vocab_size=100,
+                               padded_n=4)
+    assert st.do_topk and not st.do_penalties
+    assert st.temperatures[1] == np.float32(0.9)
+    assert st.top_ks[0] == 100  # disabled → vocab
+    assert st.top_ks[2] == 100  # padding rows

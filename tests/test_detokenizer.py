@@ -1,0 +1,48 @@
+"""Incremental detokenization must equal one-shot decoding."""
+import pytest
+
+from intellillm_tpu.transformers_utils.detokenizer import (
+    detokenize_incrementally)
+
+
+@pytest.fixture(scope="module")
+def tokenizer(tmp_path_factory):
+    from tests.conftest import _build_word_tokenizer
+    d = str(tmp_path_factory.mktemp("tok"))
+    tok, _ = _build_word_tokenizer(d)
+    return tok
+
+
+def test_incremental_equals_full_decode(tokenizer):
+    text = "the cat runs fast and the dog is slow"
+    ids = tokenizer.encode(text)
+    prompt_ids, gen_ids = ids[:3], ids[3:]
+
+    tokens = None
+    prefix_offset = read_offset = 0
+    out_text = ""
+    all_ids = list(prompt_ids)
+    for tid in gen_ids:
+        all_ids.append(tid)
+        new_tokens, new_text, prefix_offset, read_offset = \
+            detokenize_incrementally(tokenizer, all_ids, tokens,
+                                     prefix_offset, read_offset,
+                                     skip_special_tokens=True)
+        if tokens is None:
+            tokens = new_tokens
+        else:
+            tokens.extend(new_tokens)
+        out_text += new_text
+
+    full = tokenizer.decode(gen_ids, skip_special_tokens=True)
+    assert out_text.strip() == full.strip()
+
+
+def test_first_token_not_dropped(tokenizer):
+    # Regression: the first generated token's text must appear.
+    ids = tokenizer.encode("hello name")
+    prompt_ids, first_gen = ids[:1], ids[1]
+    all_ids = prompt_ids + [first_gen]
+    _, new_text, _, _ = detokenize_incrementally(
+        tokenizer, all_ids, None, 0, 0, skip_special_tokens=True)
+    assert "name" in new_text
