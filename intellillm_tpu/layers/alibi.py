@@ -1,0 +1,22 @@
+"""ALiBi slope computation (shared by BLOOM/MPT/Falcon-alibi).
+
+Role parity: reference computes slopes per model file (e.g.
+`vllm/model_executor/models/bloom.py` _get_alibi_slopes).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def get_alibi_slopes(num_heads: int) -> np.ndarray:
+    closest = 2**math.floor(math.log2(num_heads))
+    base = 2.0**(-(2.0**-(math.log2(closest) - 3)))
+    slopes = [base**i for i in range(1, closest + 1)]
+    if closest != num_heads:
+        extra_base = 2.0**(-(2.0**-(math.log2(2 * closest) - 3)))
+        num_extra = num_heads - closest
+        slopes.extend(extra_base**i
+                      for i in range(1, 2 * num_extra + 1, 2))
+    return np.asarray(slopes, np.float32)

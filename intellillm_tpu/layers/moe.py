@@ -1,0 +1,63 @@
+"""Mixture-of-Experts feed-forward.
+
+Role parity: reference `vllm/model_executor/layers/fused_moe.py` (Triton
+grouped-GEMM over experts + CUDA `moe_align_block_size`,
+`csrc/moe_align_block_size_kernels.cu`). TPU redesign: the Triton
+sort-by-expert + grouped GEMM exists to keep GPU tiles dense; on TPU the
+idiomatic v0 is dense expert compute (every expert over every token,
+combined by routing weights) chunked over tokens so the [N_exp, chunk,
+inner] activations stay small — MXU utilization is perfect and there is
+no gather/scatter. A Pallas megablocks-style ragged GMM is the planned
+upgrade for high expert counts.
+
+Routing matches HF Mixtral: softmax over ALL experts → top-k → renormalize
+the selected weights.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from intellillm_tpu.utils import cdiv
+
+
+def moe_ffn(
+    x: jnp.ndarray,        # [T, D]
+    gate_w: jnp.ndarray,   # [D, N] router
+    w1: jnp.ndarray,       # [N, D, I]  (gate proj per expert)
+    w2: jnp.ndarray,       # [N, I, D]  (down proj per expert)
+    w3: jnp.ndarray,       # [N, D, I]  (up proj per expert)
+    top_k: int,
+    chunk_size: int = 256,
+) -> jnp.ndarray:
+    t, d = x.shape
+    n = w1.shape[0]
+
+    router_logits = (x.astype(jnp.float32) @ gate_w.astype(jnp.float32))
+    weights = jax.nn.softmax(router_logits, axis=-1)          # [T, N]
+    topw, topi = jax.lax.top_k(weights, top_k)                # [T, K]
+    topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(topi, n, dtype=jnp.float32)       # [T, K, N]
+    combine = (topw[..., None] * onehot).sum(axis=1)          # [T, N]
+
+    # Chunk tokens so [N, C, I] activations stay in budget.
+    pad_t = cdiv(t, chunk_size) * chunk_size
+    x_pad = jnp.pad(x, ((0, pad_t - t), (0, 0)))
+    comb_pad = jnp.pad(combine, ((0, pad_t - t), (0, 0)))
+    x_chunks = x_pad.reshape(pad_t // chunk_size, chunk_size, d)
+    c_chunks = comb_pad.reshape(pad_t // chunk_size, chunk_size, n)
+
+    def chunk_fn(carry, inp):
+        xc, cc = inp
+        h1 = jnp.einsum("td,ndi->nti", xc, w1,
+                        preferred_element_type=jnp.float32)
+        h3 = jnp.einsum("td,ndi->nti", xc, w3,
+                        preferred_element_type=jnp.float32)
+        h = jax.nn.silu(h1) * h3
+        out = jnp.einsum("nti,nid->ntd", h.astype(x.dtype), w2,
+                         preferred_element_type=jnp.float32)   # [N, C, D]
+        mixed = jnp.einsum("ntd,tn->td", out, cc)
+        return carry, mixed.astype(x.dtype)
+
+    _, outs = jax.lax.scan(chunk_fn, None, (x_chunks, c_chunks))
+    return outs.reshape(pad_t, d)[:t]

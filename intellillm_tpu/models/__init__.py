@@ -7,8 +7,15 @@ via HF config introspection.
 """
 from typing import Dict, Type
 
+from intellillm_tpu.models.bloom import BloomForCausalLM
+from intellillm_tpu.models.gpt2 import GPT2LMHeadModel
+from intellillm_tpu.models.gpt_neox import GPTNeoXForCausalLM
+from intellillm_tpu.models.gptj import GPTJForCausalLM
 from intellillm_tpu.models.llama import LlamaForCausalLM
+from intellillm_tpu.models.mixtral import MixtralForCausalLM
 from intellillm_tpu.models.opt import OPTForCausalLM
+from intellillm_tpu.models.phi import PhiForCausalLM
+from intellillm_tpu.models.qwen2 import Qwen2ForCausalLM
 
 _MODEL_REGISTRY: Dict[str, Type] = {
     "LlamaForCausalLM": LlamaForCausalLM,
@@ -16,7 +23,16 @@ _MODEL_REGISTRY: Dict[str, Type] = {
     "MistralForCausalLM": LlamaForCausalLM,
     "YiForCausalLM": LlamaForCausalLM,
     "InternLMForCausalLM": LlamaForCausalLM,
+    "DeciLMForCausalLM": LlamaForCausalLM,
     "OPTForCausalLM": OPTForCausalLM,
+    "GPT2LMHeadModel": GPT2LMHeadModel,
+    "MixtralForCausalLM": MixtralForCausalLM,
+    "Qwen2ForCausalLM": Qwen2ForCausalLM,
+    "BloomForCausalLM": BloomForCausalLM,
+    "GPTNeoXForCausalLM": GPTNeoXForCausalLM,
+    "GPTJForCausalLM": GPTJForCausalLM,
+    "PhiForCausalLM": PhiForCausalLM,
+    "StableLMEpochForCausalLM": LlamaForCausalLM,
 }
 
 

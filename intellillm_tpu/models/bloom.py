@@ -1,0 +1,162 @@
+"""BLOOM family (bloom-560m..176b, bloomz).
+
+Role parity: reference `vllm/model_executor/models/bloom.py`. ALiBi
+attention (no positional embeddings), embedding layernorm, fused QKV with
+per-head [q,k,v] interleave, pre-LN, tied lm head.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.activation import gelu_new
+from intellillm_tpu.layers.alibi import get_alibi_slopes
+from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
+                                             PagedAttention)
+from intellillm_tpu.layers.normalization import layer_norm
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+class BloomForCausalLM:
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.n_layer
+        self.num_heads = cfg.n_head
+        self.hidden_size = cfg.hidden_size
+        self.head_size = self.hidden_size // self.num_heads
+        self.ln_eps = getattr(cfg, "layer_norm_epsilon", 1e-5)
+        self.attn = PagedAttention(
+            num_heads=self.num_heads,
+            head_size=self.head_size,
+            scale=self.head_size**-0.5,
+            num_kv_heads=self.num_heads,
+            alibi_slopes=get_alibi_slopes(self.num_heads),
+        )
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 attn_metadata):
+        h = params["word_embeddings"][input_ids]
+        h = layer_norm(h, params["emb_norm"]["w"], params["emb_norm"]["b"],
+                       self.ln_eps)
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, cache = self._layer(lp, h, kv_caches[i], attn_metadata)
+            new_caches.append(cache)
+        h = layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
+                       self.ln_eps)
+        return h, new_caches
+
+    def _layer(self, lp, h, kv_cache, attn_metadata):
+        b, l, e = h.shape
+        residual = h
+        h = layer_norm(h, lp["ln_attn"]["w"], lp["ln_attn"]["b"], self.ln_eps)
+        qkv = h @ lp["qkv"]["w"] + lp["qkv"]["b"]
+        # BLOOM interleaves per head: [..., H, 3, D]
+        qkv = qkv.reshape(b, l, self.num_heads, 3, self.head_size)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        h = attn_out.reshape(b, l, e) @ lp["dense"]["w"] + lp["dense"]["b"]
+        h = residual + h
+
+        residual = h
+        h = layer_norm(h, lp["ln_mlp"]["w"], lp["ln_mlp"]["b"], self.ln_eps)
+        h = gelu_new(h @ lp["up"]["w"] + lp["up"]["b"])
+        h = h @ lp["down"]["w"] + lp["down"]["b"]
+        return residual + h, kv_cache
+
+    def compute_logits(self, params, hidden):
+        return hidden @ params["word_embeddings"].T
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        col = {"w": P(None, "model"), "b": P("model")}
+        row = {"w": P("model", None), "b": P()}
+        norm = {"w": P(), "b": P()}
+        layer = {"ln_attn": dict(norm), "ln_mlp": dict(norm),
+                 "qkv": dict(col), "dense": dict(row),
+                 "up": dict(col), "down": dict(row)}
+        return {"word_embeddings": P("model", None),
+                "emb_norm": dict(norm), "ln_f": dict(norm),
+                "layers": [dict(layer) for _ in range(self.num_layers)]}
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        import jax
+        dtype = jnp.dtype(self.dtype)
+        e = self.hidden_size
+        key = jax.random.PRNGKey(seed)
+
+        def rand(k, shape):
+            return (jax.random.normal(k, shape, jnp.float32) *
+                    0.02).astype(dtype)
+
+        def norm():
+            return {"w": jnp.ones((e, ), dtype), "b": jnp.zeros((e, ), dtype)}
+
+        def lin(k, din, dout):
+            return {"w": rand(k, (din, dout)),
+                    "b": jnp.zeros((dout, ), dtype)}
+
+        keys = jax.random.split(key, self.num_layers + 1)
+        layers = []
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 4)
+            layers.append({"ln_attn": norm(), "ln_mlp": norm(),
+                           "qkv": lin(lk[0], e, 3 * e),
+                           "dense": lin(lk[1], e, e),
+                           "up": lin(lk[2], e, 4 * e),
+                           "down": lin(lk[3], 4 * e, e)})
+        return {"word_embeddings": rand(keys[-1], (self.config.vocab_size, e)),
+                "emb_norm": norm(), "ln_f": norm(), "layers": layers}
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if name.startswith("transformer."):
+                name = name[len("transformer."):]
+            if name == "lm_head.weight":
+                continue
+            raw[name] = arr
+
+        def W(key):
+            return cast_array(raw[key].T, self.dtype)
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        def norm(prefix):
+            return {"w": V(prefix + ".weight"), "b": V(prefix + ".bias")}
+
+        def lin(prefix):
+            return {"w": W(prefix + ".weight"), "b": V(prefix + ".bias")}
+
+        params: Params = {
+            "word_embeddings": V("word_embeddings.weight"),
+            "emb_norm": norm("word_embeddings_layernorm"),
+            "ln_f": norm("ln_f"),
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            p = f"h.{i}."
+            params["layers"].append({
+                "ln_attn": norm(p + "input_layernorm"),
+                "ln_mlp": norm(p + "post_attention_layernorm"),
+                "qkv": lin(p + "self_attention.query_key_value"),
+                "dense": lin(p + "self_attention.dense"),
+                "up": lin(p + "mlp.dense_h_to_4h"),
+                "down": lin(p + "mlp.dense_4h_to_h"),
+            })
+        return params

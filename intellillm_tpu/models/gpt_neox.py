@@ -1,0 +1,173 @@
+"""GPT-NeoX family (pythia, gpt-neox-20b, dolly-v2, stablelm-base-alpha).
+
+Role parity: reference `vllm/model_executor/models/gpt_neox.py`. Partial
+rotary (rotary_pct), per-head-interleaved fused QKV, parallel residual
+(use_parallel_residual), untied embed_out head.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.activation import get_act_fn
+from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
+                                             PagedAttention)
+from intellillm_tpu.layers.normalization import layer_norm
+from intellillm_tpu.layers.rotary_embedding import get_rope
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+class GPTNeoXForCausalLM:
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.num_hidden_layers
+        self.num_heads = cfg.num_attention_heads
+        self.hidden_size = cfg.hidden_size
+        self.head_size = self.hidden_size // self.num_heads
+        self.ln_eps = getattr(cfg, "layer_norm_eps", 1e-5)
+        self.act = get_act_fn(getattr(cfg, "hidden_act", "gelu"))
+        self.parallel_residual = getattr(cfg, "use_parallel_residual", True)
+        rotary_dim = int(self.head_size *
+                         getattr(cfg, "rotary_pct", 1.0))
+        self.rope = get_rope(self.head_size, rotary_dim,
+                             cfg.max_position_embeddings,
+                             getattr(cfg, "rotary_emb_base", 10000),
+                             is_neox_style=True)
+        self.attn = PagedAttention(self.num_heads, self.head_size,
+                                   self.head_size**-0.5, self.num_heads)
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 attn_metadata):
+        h = params["embed_in"][input_ids]
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, cache = self._layer(lp, h, kv_caches[i], attn_metadata,
+                                   positions)
+            new_caches.append(cache)
+        h = layer_norm(h, params["final_norm"]["w"], params["final_norm"]["b"],
+                       self.ln_eps)
+        return h, new_caches
+
+    def _attend(self, lp, x, kv_cache, attn_metadata, positions):
+        b, l, e = x.shape
+        qkv = x @ lp["qkv"]["w"] + lp["qkv"]["b"]
+        qkv = qkv.reshape(b, l, self.num_heads, 3, self.head_size)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        q, k = self.rope(positions, q, k)
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        out = attn_out.reshape(b, l, e) @ lp["dense"]["w"] + lp["dense"]["b"]
+        return out, kv_cache
+
+    def _mlp(self, lp, x):
+        h = self.act(x @ lp["up"]["w"] + lp["up"]["b"])
+        return h @ lp["down"]["w"] + lp["down"]["b"]
+
+    def _layer(self, lp, h, kv_cache, attn_metadata, positions):
+        ln1 = layer_norm(h, lp["ln1"]["w"], lp["ln1"]["b"], self.ln_eps)
+        attn_out, kv_cache = self._attend(lp, ln1, kv_cache, attn_metadata,
+                                          positions)
+        if self.parallel_residual:
+            ln2 = layer_norm(h, lp["ln2"]["w"], lp["ln2"]["b"], self.ln_eps)
+            h = h + attn_out + self._mlp(lp, ln2)
+        else:
+            h = h + attn_out
+            ln2 = layer_norm(h, lp["ln2"]["w"], lp["ln2"]["b"], self.ln_eps)
+            h = h + self._mlp(lp, ln2)
+        return h, kv_cache
+
+    def compute_logits(self, params, hidden):
+        return hidden @ params["embed_out"]
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        col = {"w": P(None, "model"), "b": P("model")}
+        row = {"w": P("model", None), "b": P()}
+        norm = {"w": P(), "b": P()}
+        layer = {"ln1": dict(norm), "ln2": dict(norm), "qkv": dict(col),
+                 "dense": dict(row), "up": dict(col), "down": dict(row)}
+        return {"embed_in": P("model", None), "embed_out": P(None, "model"),
+                "final_norm": dict(norm),
+                "layers": [dict(layer) for _ in range(self.num_layers)]}
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        import jax
+        dtype = jnp.dtype(self.dtype)
+        e = self.hidden_size
+        inter = self.config.intermediate_size
+        key = jax.random.PRNGKey(seed)
+
+        def rand(k, shape):
+            return (jax.random.normal(k, shape, jnp.float32) *
+                    0.02).astype(dtype)
+
+        def norm():
+            return {"w": jnp.ones((e, ), dtype), "b": jnp.zeros((e, ), dtype)}
+
+        def lin(k, din, dout):
+            return {"w": rand(k, (din, dout)),
+                    "b": jnp.zeros((dout, ), dtype)}
+
+        keys = jax.random.split(key, self.num_layers + 2)
+        layers = []
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 4)
+            layers.append({"ln1": norm(), "ln2": norm(),
+                           "qkv": lin(lk[0], e, 3 * e),
+                           "dense": lin(lk[1], e, e),
+                           "up": lin(lk[2], e, inter),
+                           "down": lin(lk[3], inter, e)})
+        return {"embed_in": rand(keys[-2], (self.config.vocab_size, e)),
+                "embed_out": rand(keys[-1], (e, self.config.vocab_size)),
+                "final_norm": norm(), "layers": layers}
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if "rotary_emb" in name or "masked_bias" in name \
+                    or name.endswith("attention.bias"):
+                continue
+            raw[name] = arr
+
+        def W(key):
+            return cast_array(raw[key].T, self.dtype)
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        def norm(prefix):
+            return {"w": V(prefix + ".weight"), "b": V(prefix + ".bias")}
+
+        def lin(prefix):
+            return {"w": W(prefix + ".weight"), "b": V(prefix + ".bias")}
+
+        params: Params = {
+            "embed_in": V("gpt_neox.embed_in.weight"),
+            "embed_out": W("embed_out.weight"),
+            "final_norm": norm("gpt_neox.final_layer_norm"),
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            p = f"gpt_neox.layers.{i}."
+            params["layers"].append({
+                "ln1": norm(p + "input_layernorm"),
+                "ln2": norm(p + "post_attention_layernorm"),
+                "qkv": lin(p + "attention.query_key_value"),
+                "dense": lin(p + "attention.dense"),
+                "up": lin(p + "mlp.dense_h_to_4h"),
+                "down": lin(p + "mlp.dense_4h_to_h"),
+            })
+        return params

@@ -1,0 +1,155 @@
+"""GPT-J (gpt-j-6b).
+
+Role parity: reference `vllm/model_executor/models/gpt_j.py`. Interleaved
+(gptj-style) rotary on rotary_dim dims, parallel attention+MLP off one
+LN, biased untied lm head.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.activation import get_act_fn
+from intellillm_tpu.layers.attention import KVCache, PagedAttention
+from intellillm_tpu.layers.normalization import layer_norm
+from intellillm_tpu.layers.rotary_embedding import get_rope
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+class GPTJForCausalLM:
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.n_layer
+        self.num_heads = cfg.n_head
+        self.hidden_size = cfg.n_embd
+        self.head_size = self.hidden_size // self.num_heads
+        self.ln_eps = getattr(cfg, "layer_norm_epsilon", 1e-5)
+        self.act = get_act_fn(getattr(cfg, "activation_function", "gelu_new"))
+        rotary_dim = getattr(cfg, "rotary_dim", None) or self.head_size
+        self.rope = get_rope(self.head_size, rotary_dim, cfg.n_positions,
+                             10000.0, is_neox_style=False)
+        self.attn = PagedAttention(self.num_heads, self.head_size,
+                                   self.head_size**-0.5, self.num_heads)
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 attn_metadata):
+        h = params["wte"][input_ids]
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, cache = self._layer(lp, h, kv_caches[i], attn_metadata,
+                                   positions)
+            new_caches.append(cache)
+        h = layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
+                       self.ln_eps)
+        return h, new_caches
+
+    def _layer(self, lp, h, kv_cache, attn_metadata, positions):
+        b, l, e = h.shape
+        residual = h
+        x = layer_norm(h, lp["ln"]["w"], lp["ln"]["b"], self.ln_eps)
+        q = (x @ lp["q"]).reshape(b, l, self.num_heads, self.head_size)
+        k = (x @ lp["k"]).reshape(b, l, self.num_heads, self.head_size)
+        v = (x @ lp["v"]).reshape(b, l, self.num_heads, self.head_size)
+        q, k = self.rope(positions, q, k)
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        attn_out = attn_out.reshape(b, l, e) @ lp["out"]
+        mlp = self.act(x @ lp["fc_in"]["w"] + lp["fc_in"]["b"])
+        mlp = mlp @ lp["fc_out"]["w"] + lp["fc_out"]["b"]
+        return residual + attn_out + mlp, kv_cache
+
+    def compute_logits(self, params, hidden):
+        return hidden @ params["lm_head"]["w"] + params["lm_head"]["b"]
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        layer = {"ln": {"w": P(), "b": P()},
+                 "q": P(None, "model"), "k": P(None, "model"),
+                 "v": P(None, "model"), "out": P("model", None),
+                 "fc_in": {"w": P(None, "model"), "b": P("model")},
+                 "fc_out": {"w": P("model", None), "b": P()}}
+        return {"wte": P("model", None), "ln_f": {"w": P(), "b": P()},
+                "lm_head": {"w": P(None, "model"), "b": P("model")},
+                "layers": [dict(layer) for _ in range(self.num_layers)]}
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        import jax
+        dtype = jnp.dtype(self.dtype)
+        e = self.hidden_size
+        inner = getattr(self.config, "n_inner", None) or 4 * e
+        v = self.config.vocab_size
+        key = jax.random.PRNGKey(seed)
+
+        def rand(k, shape):
+            return (jax.random.normal(k, shape, jnp.float32) *
+                    0.02).astype(dtype)
+
+        keys = jax.random.split(key, self.num_layers + 2)
+        layers = []
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 6)
+            layers.append({
+                "ln": {"w": jnp.ones((e, ), dtype),
+                       "b": jnp.zeros((e, ), dtype)},
+                "q": rand(lk[0], (e, e)), "k": rand(lk[1], (e, e)),
+                "v": rand(lk[2], (e, e)), "out": rand(lk[3], (e, e)),
+                "fc_in": {"w": rand(lk[4], (e, inner)),
+                          "b": jnp.zeros((inner, ), dtype)},
+                "fc_out": {"w": rand(lk[5], (inner, e)),
+                           "b": jnp.zeros((e, ), dtype)},
+            })
+        return {"wte": rand(keys[-2], (v, e)),
+                "ln_f": {"w": jnp.ones((e, ), dtype),
+                         "b": jnp.zeros((e, ), dtype)},
+                "lm_head": {"w": rand(keys[-1], (e, v)),
+                            "b": jnp.zeros((v, ), dtype)},
+                "layers": layers}
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if name.startswith("transformer."):
+                name = name[len("transformer."):]
+            if ".attn.bias" in name or ".attn.masked_bias" in name:
+                continue
+            raw[name] = arr
+
+        def W(key):
+            return cast_array(raw[key].T, self.dtype)
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        params: Params = {
+            "wte": V("wte.weight"),
+            "ln_f": {"w": V("ln_f.weight"), "b": V("ln_f.bias")},
+            "lm_head": {"w": W("lm_head.weight"), "b": V("lm_head.bias")},
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            p = f"h.{i}."
+            params["layers"].append({
+                "ln": {"w": V(p + "ln_1.weight"), "b": V(p + "ln_1.bias")},
+                "q": W(p + "attn.q_proj.weight"),
+                "k": W(p + "attn.k_proj.weight"),
+                "v": W(p + "attn.v_proj.weight"),
+                "out": W(p + "attn.out_proj.weight"),
+                "fc_in": {"w": W(p + "mlp.fc_in.weight"),
+                          "b": V(p + "mlp.fc_in.bias")},
+                "fc_out": {"w": W(p + "mlp.fc_out.weight"),
+                           "b": V(p + "mlp.fc_out.bias")},
+            })
+        return params

@@ -1,0 +1,138 @@
+"""Mixtral MoE family (mixtral-8x7b etc.).
+
+Role parity: reference `vllm/model_executor/models/mixtral.py` (MixtralMoE
+:57 routing through fused_moe :138) + `mixtral_quant.py`. Llama-style
+attention (GQA + rope + RMSNorm) with a top-2 MoE feed-forward.
+Expert weights stack to [num_experts, in, out] so expert parallelism is a
+mesh axis away (shard dim 0 over "model" for EP, or dims 1/2 for TP).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.attention import KVCache
+from intellillm_tpu.layers.moe import moe_ffn
+from intellillm_tpu.layers.normalization import fused_add_rms_norm, rms_norm
+from intellillm_tpu.layers.quantization import qmatmul
+from intellillm_tpu.models.llama import LlamaForCausalLM, Params
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+
+class MixtralForCausalLM(LlamaForCausalLM):
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        super().__init__(model_config)
+        cfg = model_config.hf_config
+        self.num_experts = cfg.num_local_experts
+        self.top_k = cfg.num_experts_per_tok
+        self.intermediate = cfg.intermediate_size
+
+    def _layer(self, lp, h, residual, kv_cache, attn_metadata, positions):
+        b, l, e = h.shape
+        if residual is None:
+            residual = h
+            h = rms_norm(h, lp["input_norm"], self.rms_eps)
+        else:
+            h, residual = fused_add_rms_norm(h, residual, lp["input_norm"],
+                                             self.rms_eps)
+        q = qmatmul(h, lp["q"]).reshape(b, l, self.num_heads, self.head_size)
+        k = qmatmul(h, lp["k"]).reshape(b, l, self.num_kv_heads,
+                                        self.head_size)
+        v = qmatmul(h, lp["v"]).reshape(b, l, self.num_kv_heads,
+                                        self.head_size)
+        q, k = self.rope(positions, q, k)
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        h = qmatmul(attn_out.reshape(b, l, self.num_heads * self.head_size),
+                    lp["o"])
+
+        h, residual = fused_add_rms_norm(h, residual, lp["post_attn_norm"],
+                                         self.rms_eps)
+        flat = h.reshape(b * l, e)
+        moe_out = moe_ffn(flat, lp["gate_router"], lp["w1"], lp["w2"],
+                          lp["w3"], self.top_k)
+        return moe_out.reshape(b, l, e), residual, kv_cache
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        specs = super().partition_specs()
+        for layer in specs["layers"]:
+            for k in ("gate", "up", "down"):
+                layer.pop(k, None)
+            layer["gate_router"] = P()
+            # Expert-stacked weights: dim 0 = expert axis (EP candidate),
+            # shard the wide inner dim over "model" for TP.
+            layer["w1"] = P(None, None, "model")
+            layer["w3"] = P(None, None, "model")
+            layer["w2"] = P(None, "model", None)
+        return specs
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        import jax
+        params = super().init_random_params(seed)
+        dtype = jnp.dtype(self.dtype)
+        e, i, n = self.hidden_size, self.intermediate, self.num_experts
+        key = jax.random.PRNGKey(seed + 1)
+
+        def rand(k, shape):
+            return (jax.random.normal(k, shape, jnp.float32) *
+                    0.02).astype(dtype)
+
+        for li, layer in enumerate(params["layers"]):
+            for k in ("gate", "up", "down"):
+                layer.pop(k, None)
+            lk = jax.random.split(jax.random.fold_in(key, li), 4)
+            layer["gate_router"] = rand(lk[0], (e, n)).astype(jnp.float32)
+            layer["w1"] = rand(lk[1], (n, e, i))
+            layer["w2"] = rand(lk[2], (n, i, e))
+            layer["w3"] = rand(lk[3], (n, e, i))
+        return params
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if "rotary_emb.inv_freq" in name:
+                continue
+            raw[name] = arr
+
+        def W(key):
+            return cast_array(raw[key].T, self.dtype)
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        params: Params = {
+            "embed_tokens": V("model.embed_tokens.weight"),
+            "norm": V("model.norm.weight"),
+            "lm_head": W("lm_head.weight") if "lm_head.weight" in raw else None,
+            "layers": [],
+        }
+        n = self.num_experts
+        for i in range(self.num_layers):
+            lp = f"model.layers.{i}."
+            moe = lp + "block_sparse_moe."
+            layer = {
+                "input_norm": V(lp + "input_layernorm.weight"),
+                "post_attn_norm": V(lp + "post_attention_layernorm.weight"),
+                "q": W(lp + "self_attn.q_proj.weight"),
+                "k": W(lp + "self_attn.k_proj.weight"),
+                "v": W(lp + "self_attn.v_proj.weight"),
+                "o": W(lp + "self_attn.o_proj.weight"),
+                "gate_router": cast_array(raw[moe + "gate.weight"].T,
+                                          "float32"),
+                "w1": np.stack([W(f"{moe}experts.{j}.w1.weight")
+                                for j in range(n)]),
+                "w2": np.stack([W(f"{moe}experts.{j}.w2.weight")
+                                for j in range(n)]),
+                "w3": np.stack([W(f"{moe}experts.{j}.w3.weight")
+                                for j in range(n)]),
+            }
+            params["layers"].append(layer)
+        return params

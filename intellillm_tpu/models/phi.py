@@ -1,0 +1,172 @@
+"""Phi family (phi-1, phi-1.5, phi-2).
+
+Role parity: reference `vllm/model_executor/models/phi.py` (named phi_1_5
+there). LayerNorm (not RMS), partial rotary, parallel attention+MLP off a
+single pre-LN, biased projections, biased untied lm head.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.activation import get_act_fn
+from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
+                                             PagedAttention)
+from intellillm_tpu.layers.normalization import layer_norm
+from intellillm_tpu.layers.rotary_embedding import get_rope
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+class PhiForCausalLM:
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.num_hidden_layers
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = getattr(cfg, "num_key_value_heads",
+                                    None) or self.num_heads
+        self.hidden_size = cfg.hidden_size
+        self.head_size = self.hidden_size // self.num_heads
+        self.ln_eps = getattr(cfg, "layer_norm_eps", 1e-5)
+        self.act = get_act_fn(getattr(cfg, "hidden_act", "gelu_new"))
+        rotary_dim = int(self.head_size *
+                         getattr(cfg, "partial_rotary_factor", 0.5))
+        self.rope = get_rope(self.head_size, rotary_dim,
+                             cfg.max_position_embeddings,
+                             getattr(cfg, "rope_theta", 10000.0),
+                             is_neox_style=True)
+        self.attn = PagedAttention(self.num_heads, self.head_size,
+                                   self.head_size**-0.5, self.num_kv_heads)
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 attn_metadata):
+        h = params["embed_tokens"][input_ids]
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, cache = self._layer(lp, h, kv_caches[i], attn_metadata,
+                                   positions)
+            new_caches.append(cache)
+        h = layer_norm(h, params["final_norm"]["w"], params["final_norm"]["b"],
+                       self.ln_eps)
+        return h, new_caches
+
+    def _layer(self, lp, h, kv_cache, attn_metadata, positions):
+        b, l, e = h.shape
+        residual = h
+        x = layer_norm(h, lp["ln"]["w"], lp["ln"]["b"], self.ln_eps)
+
+        q = (x @ lp["q"]["w"] + lp["q"]["b"]).reshape(
+            b, l, self.num_heads, self.head_size)
+        k = (x @ lp["k"]["w"] + lp["k"]["b"]).reshape(
+            b, l, self.num_kv_heads, self.head_size)
+        v = (x @ lp["v"]["w"] + lp["v"]["b"]).reshape(
+            b, l, self.num_kv_heads, self.head_size)
+        q, k = self.rope(positions, q, k)
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        attn_out = (attn_out.reshape(b, l, e) @ lp["dense"]["w"] +
+                    lp["dense"]["b"])
+
+        mlp_out = self.act(x @ lp["fc1"]["w"] + lp["fc1"]["b"])
+        mlp_out = mlp_out @ lp["fc2"]["w"] + lp["fc2"]["b"]
+        return residual + attn_out + mlp_out, kv_cache
+
+    def compute_logits(self, params, hidden):
+        return hidden @ params["lm_head"]["w"] + params["lm_head"]["b"]
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        col = {"w": P(None, "model"), "b": P("model")}
+        row = {"w": P("model", None), "b": P()}
+        norm = {"w": P(), "b": P()}
+        layer = {"ln": dict(norm), "q": dict(col), "k": dict(col),
+                 "v": dict(col), "dense": dict(row), "fc1": dict(col),
+                 "fc2": dict(row)}
+        return {"embed_tokens": P("model", None), "final_norm": dict(norm),
+                "lm_head": {"w": P(None, "model"), "b": P("model")},
+                "layers": [dict(layer) for _ in range(self.num_layers)]}
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        import jax
+        dtype = jnp.dtype(self.dtype)
+        e = self.hidden_size
+        inter = self.config.intermediate_size
+        hkv = self.num_kv_heads * self.head_size
+        v = self.config.vocab_size
+        key = jax.random.PRNGKey(seed)
+
+        def rand(k, shape):
+            return (jax.random.normal(k, shape, jnp.float32) *
+                    0.02).astype(dtype)
+
+        def norm():
+            return {"w": jnp.ones((e, ), dtype), "b": jnp.zeros((e, ), dtype)}
+
+        def lin(k, din, dout):
+            return {"w": rand(k, (din, dout)),
+                    "b": jnp.zeros((dout, ), dtype)}
+
+        keys = jax.random.split(key, self.num_layers + 2)
+        layers = []
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 6)
+            layers.append({"ln": norm(),
+                           "q": lin(lk[0], e, e), "k": lin(lk[1], e, hkv),
+                           "v": lin(lk[2], e, hkv),
+                           "dense": lin(lk[3], e, e),
+                           "fc1": lin(lk[4], e, inter),
+                           "fc2": lin(lk[5], inter, e)})
+        return {"embed_tokens": rand(keys[-2], (v, e)),
+                "final_norm": norm(),
+                "lm_head": lin(keys[-1], e, v),
+                "layers": layers}
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if "rotary_emb" in name:
+                continue
+            raw[name] = arr
+
+        def W(key):
+            return cast_array(raw[key].T, self.dtype)
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        def norm(prefix):
+            return {"w": V(prefix + ".weight"), "b": V(prefix + ".bias")}
+
+        def lin(prefix):
+            return {"w": W(prefix + ".weight"), "b": V(prefix + ".bias")}
+
+        params: Params = {
+            "embed_tokens": V("model.embed_tokens.weight"),
+            "final_norm": norm("model.final_layernorm"),
+            "lm_head": lin("lm_head"),
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            p = f"model.layers.{i}."
+            params["layers"].append({
+                "ln": norm(p + "input_layernorm"),
+                "q": lin(p + "self_attn.q_proj"),
+                "k": lin(p + "self_attn.k_proj"),
+                "v": lin(p + "self_attn.v_proj"),
+                "dense": lin(p + "self_attn.dense"),
+                "fc1": lin(p + "mlp.fc1"),
+                "fc2": lin(p + "mlp.fc2"),
+            })
+        return params

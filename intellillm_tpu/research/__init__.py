@@ -1,0 +1,17 @@
+"""IntelliLLM research layer: predicted-response-length (SJF) scheduling.
+
+Role parity: reference `scheduler/` directory (821 LoC — the fork's
+raison d'être, SURVEY §2.10):
+- `gen_model_responses.py`  → research/dataset.py:generate_responses
+- `gen_predictor_dataset.py`→ research/dataset.py:build_predictor_dataset
+- `predictor.py` (BERT)     → research/predictor.py (JAX/optax model)
+- `run_exp_scheduling.py`   → research/experiments.py:run_scheduling_experiment
+- `auto_eval.py`            → research/experiments.py:auto_eval
+
+Upgrades over the reference: the predictor is TPU-native (JAX), and SJF
+runs *inside* the continuous-batching scheduler (core/policy.py 'sjf' /
+'sjf_remaining') instead of only pre-sorting a submission batch; the
+engine consults the predictor automatically via
+`LLMEngine(length_predictor=...)`.
+"""
+from intellillm_tpu.research.predictor import LengthPredictor

@@ -1,0 +1,81 @@
+"""Predictor dataset generation.
+
+Role parity: reference `scheduler/gen_model_responses.py` (sample prompts,
+generate responses greedily, save prompt/response/response_length CSV) and
+`scheduler/gen_predictor_dataset.py` (tokenize with tail-truncation,
+percentile class thresholds :54-57 — p50=24, p99=977 for opt-350m).
+
+The reference samples prompts from lmsys-chat-1m; this environment has no
+dataset downloads, so callers supply prompts (or use synthetic_prompts for
+self-contained experiments).
+"""
+from __future__ import annotations
+
+import csv
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from intellillm_tpu.logger import init_logger
+from intellillm_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+
+def synthetic_prompts(tokenizer, num_prompts: int, seed: int = 0,
+                      min_len: int = 3, max_len: int = 24) -> List[str]:
+    """Self-contained prompt set built from the tokenizer's own vocab."""
+    rng = random.Random(seed)
+    vocab = [t for t in tokenizer.get_vocab().keys()
+             if t.isalpha() and len(t) > 1]
+    prompts = []
+    for _ in range(num_prompts):
+        n = rng.randint(min_len, max_len)
+        prompts.append(" ".join(rng.choices(vocab, k=n)))
+    return prompts
+
+
+def generate_responses(
+    llm,
+    prompts: Sequence[str],
+    max_tokens: int = 512,
+    out_csv: Optional[str] = None,
+) -> List[Dict]:
+    """Greedy responses + lengths for predictor training
+    (reference gen_model_responses.py:49-76)."""
+    params = SamplingParams(temperature=0.0, max_tokens=max_tokens)
+    outputs = llm.generate(list(prompts), params)
+    rows = []
+    for out in outputs:
+        comp = out.outputs[0]
+        rows.append({
+            "prompt": out.prompt,
+            "response": comp.text,
+            "response_length": len(comp.token_ids),
+        })
+    if out_csv:
+        with open(out_csv, "w", newline="") as f:
+            w = csv.DictWriter(f,
+                               fieldnames=["prompt", "response",
+                                           "response_length"])
+            w.writeheader()
+            w.writerows(rows)
+        logger.info("Wrote %d rows to %s", len(rows), out_csv)
+    return rows
+
+
+def percentile_thresholds(response_lens: Sequence[int],
+                          percentiles: Sequence[float] = (50, 99)
+                          ) -> Tuple[int, ...]:
+    """Class-bucket thresholds (reference gen_predictor_dataset.py:54-57)."""
+    arr = np.asarray(response_lens)
+    return tuple(int(np.percentile(arr, p)) for p in percentiles)
+
+
+def load_responses_csv(path: str) -> List[Dict]:
+    with open(path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    for r in rows:
+        r["response_length"] = int(r["response_length"])
+    return rows

@@ -1,0 +1,251 @@
+"""Response-length predictor (TPU-native).
+
+Role parity: reference `scheduler/predictor.py` (435 LoC):
+BertClassificationModel :21 / BertRegressionModel :49, five task types
+:320-326, training with linear LR decay :114-180, eval :182-235,
+per-prompt latency logging :238-277.
+
+TPU redesign: instead of fine-tuning a torch BERT, a compact JAX model —
+mean-pooled token embeddings + 2-layer MLP — trained with optax. Orders of
+magnitude cheaper per prediction (the predictor sits on the request
+admission path, so latency matters: reference logs per-prompt BERT
+latency for exactly this reason), and it shares the serving tokenizer, so
+no second vocabulary is shipped.
+
+Tasks (reference parity):
+- "regression":      predict log1p(response_len) directly
+- "classification":  percentile-bucket classes (e.g. p50/p99 thresholds,
+                     reference gen_predictor_dataset.py:54-57)
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class PredictorConfig:
+    vocab_size: int = 32000
+    embed_dim: int = 128
+    hidden_dim: int = 256
+    max_prompt_tokens: int = 512     # truncate keeping the TAIL (reference
+                                     # gen_predictor_dataset.py:7-13)
+    task: str = "regression"         # or "classification"
+    class_thresholds: Tuple[int, ...] = ()   # bucket upper bounds
+    lr: float = 1e-3
+    batch_size: int = 64
+    epochs: int = 10
+    seed: int = 0
+
+
+class LengthPredictor:
+    """Predicts response length from prompt token ids."""
+
+    def __init__(self, config: PredictorConfig, tokenizer=None) -> None:
+        self.config = config
+        self.tokenizer = tokenizer
+        self.params = self._init_params(jax.random.PRNGKey(config.seed))
+        self._predict_jit = jax.jit(self._forward)
+        # Rolling prediction latency stats (reference predictor.py:238-277).
+        self.latencies_ms: List[float] = []
+
+    @property
+    def num_outputs(self) -> int:
+        if self.config.task == "classification":
+            return len(self.config.class_thresholds) + 1
+        return 1
+
+    def _init_params(self, key):
+        c = self.config
+        k1, k2, k3 = jax.random.split(key, 3)
+        scale = 0.02
+        return {
+            "embed": jax.random.normal(k1, (c.vocab_size, c.embed_dim)) * scale,
+            "w1": jax.random.normal(k2, (c.embed_dim + 1, c.hidden_dim)) * scale,
+            "b1": jnp.zeros((c.hidden_dim, )),
+            "w2": jax.random.normal(k3, (c.hidden_dim, self.num_outputs)) * scale,
+            "b2": jnp.zeros((self.num_outputs, )),
+        }
+
+    def _forward(self, params, token_ids, lengths):
+        """token_ids [B, T] (0-padded), lengths [B] → [B, num_outputs]."""
+        emb = params["embed"][token_ids]                     # [B, T, E]
+        mask = (jnp.arange(token_ids.shape[1])[None, :] <
+                lengths[:, None]).astype(emb.dtype)
+        pooled = (emb * mask[:, :, None]).sum(1) / jnp.maximum(
+            mask.sum(1, keepdims=True), 1.0)
+        # Prompt length itself is a strong predictor; append it as a
+        # feature (log-scaled).
+        feat = jnp.concatenate(
+            [pooled, jnp.log1p(lengths.astype(emb.dtype))[:, None]], axis=-1)
+        h = jax.nn.relu(feat @ params["w1"] + params["b1"])
+        return h @ params["w2"] + params["b2"]
+
+    # --- data prep -------------------------------------------------------
+
+    def _encode(self, prompts_or_ids) -> Tuple[np.ndarray, np.ndarray]:
+        c = self.config
+        rows = []
+        for p in prompts_or_ids:
+            if isinstance(p, str):
+                assert self.tokenizer is not None, "tokenizer required"
+                ids = self.tokenizer.encode(p)
+            else:
+                ids = list(p)
+            rows.append(ids[-c.max_prompt_tokens:])  # keep the tail
+        lengths = np.asarray([len(r) for r in rows], np.int32)
+        t = max(int(lengths.max()), 1) if len(rows) else 1
+        out = np.zeros((len(rows), t), np.int32)
+        for i, r in enumerate(rows):
+            out[i, :len(r)] = np.clip(r, 0, c.vocab_size - 1)
+        return out, lengths
+
+    def _targets(self, response_lens: Sequence[int]) -> np.ndarray:
+        c = self.config
+        y = np.asarray(response_lens, np.float32)
+        if c.task == "classification":
+            classes = np.zeros(len(y), np.int32)
+            for th in c.class_thresholds:
+                classes += (y > th).astype(np.int32)
+            return classes
+        return np.log1p(y)
+
+    # --- training --------------------------------------------------------
+
+    def train(self, prompts, response_lens: Sequence[int],
+              val_fraction: float = 0.1) -> Dict[str, float]:
+        c = self.config
+        x, xlen = self._encode(prompts)
+        y = self._targets(response_lens)
+
+        n = len(y)
+        rng = np.random.default_rng(c.seed)
+        perm = rng.permutation(n)
+        n_val = max(int(n * val_fraction), 1)
+        val_idx, train_idx = perm[:n_val], perm[n_val:]
+
+        steps_per_epoch = max(len(train_idx) // c.batch_size, 1)
+        total_steps = steps_per_epoch * c.epochs
+        # Linear LR decay (reference predictor.py:140-150).
+        schedule = optax.linear_schedule(c.lr, 0.0, total_steps)
+        tx = optax.adamw(schedule)
+        opt_state = tx.init(self.params)
+
+        def loss_fn(params, xb, lb, yb):
+            out = self._forward(params, xb, lb)
+            if c.task == "classification":
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    out, yb).mean()
+            return jnp.mean((out[:, 0] - yb)**2)
+
+        @jax.jit
+        def step(params, opt_state, xb, lb, yb):
+            loss, grads = jax.value_and_grad(loss_fn)(params, xb, lb, yb)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        t0 = time.monotonic()
+        for epoch in range(c.epochs):
+            rng.shuffle(train_idx)
+            losses = []
+            for s in range(steps_per_epoch):
+                idx = train_idx[s * c.batch_size:(s + 1) * c.batch_size]
+                if len(idx) == 0:
+                    continue
+                self.params, opt_state, loss = step(
+                    self.params, opt_state, jnp.asarray(x[idx]),
+                    jnp.asarray(xlen[idx]), jnp.asarray(y[idx]))
+                losses.append(float(loss))
+            logger.info("predictor epoch %d/%d loss=%.4f", epoch + 1,
+                        c.epochs, float(np.mean(losses)) if losses else 0.0)
+
+        metrics = self.evaluate(x[val_idx], xlen[val_idx], y[val_idx])
+        metrics["train_time_s"] = time.monotonic() - t0
+        logger.info("predictor eval: %s", metrics)
+        return metrics
+
+    def evaluate(self, x, xlen, y) -> Dict[str, float]:
+        out = np.asarray(self._predict_jit(self.params, jnp.asarray(x),
+                                           jnp.asarray(xlen)))
+        if self.config.task == "classification":
+            pred = out.argmax(-1)
+            acc = float((pred == y).mean())
+            # Macro F1 (reference eval computes accuracy/F1, :182-235).
+            f1s = []
+            for cls in range(self.num_outputs):
+                tp = float(((pred == cls) & (y == cls)).sum())
+                fp = float(((pred == cls) & (y != cls)).sum())
+                fn = float(((pred != cls) & (y == cls)).sum())
+                denom = 2 * tp + fp + fn
+                f1s.append(2 * tp / denom if denom else 0.0)
+            return {"accuracy": acc, "macro_f1": float(np.mean(f1s))}
+        pred = out[:, 0]
+        return {
+            "l1": float(np.abs(pred - y).mean()),
+            "mse": float(((pred - y)**2).mean()),
+        }
+
+    # --- inference (engine admission path) --------------------------------
+
+    def predict(self, prompt: Optional[str],
+                prompt_token_ids: Optional[Sequence[int]] = None) -> int:
+        """Predicted response length in tokens (engine hook:
+        LLMEngine.add_request → SequenceGroup.predicted_len)."""
+        t0 = time.monotonic()
+        src = [prompt_token_ids if prompt_token_ids is not None else prompt]
+        x, xlen = self._encode(src)
+        out = np.asarray(self._predict_jit(self.params, jnp.asarray(x),
+                                           jnp.asarray(xlen)))[0]
+        if self.config.task == "classification":
+            # Midpoint of the predicted bucket; the open-ended top bucket
+            # extrapolates to 4x the last threshold.
+            cls = int(out.argmax())
+            last = (self.config.class_thresholds[-1]
+                    if self.config.class_thresholds else 128)
+            edges = (0, ) + tuple(self.config.class_thresholds) + (4 * last, )
+            result = int((edges[cls] + edges[cls + 1]) / 2)
+        else:
+            result = int(np.expm1(out[0]))
+        self.latencies_ms.append((time.monotonic() - t0) * 1e3)
+        return max(result, 1)
+
+    def latency_stats(self) -> Dict[str, float]:
+        if not self.latencies_ms:
+            return {}
+        arr = np.asarray(self.latencies_ms)
+        return {"mean_ms": float(arr.mean()),
+                "p50_ms": float(np.percentile(arr, 50)),
+                "p99_ms": float(np.percentile(arr, 99))}
+
+    # --- persistence -----------------------------------------------------
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "predictor.npz"),
+                 **{k: np.asarray(v) for k, v in self.params.items()})
+        cfg = dict(self.config.__dict__)
+        cfg["class_thresholds"] = list(cfg["class_thresholds"])
+        with open(os.path.join(path, "predictor_config.json"), "w") as f:
+            json.dump(cfg, f)
+
+    @classmethod
+    def load(cls, path: str, tokenizer=None) -> "LengthPredictor":
+        with open(os.path.join(path, "predictor_config.json")) as f:
+            cfg = json.load(f)
+        cfg["class_thresholds"] = tuple(cfg["class_thresholds"])
+        pred = cls(PredictorConfig(**cfg), tokenizer)
+        data = np.load(os.path.join(path, "predictor.npz"))
+        pred.params = {k: jnp.asarray(data[k]) for k in data.files}
+        return pred
