@@ -101,10 +101,21 @@ def shard_params(host_params: Any, mesh: Mesh, model) -> Any:
     return jax.tree_util.tree_map_with_path(place, host_params)
 
 
-def shard_kv_cache(mesh: Mesh) -> Optional[NamedSharding]:
+def shard_kv_cache(mesh: Mesh,
+                   num_kv_heads: Optional[int] = None
+                   ) -> Optional[NamedSharding]:
     """KV pool sharding: [num_blocks, num_kv_heads, block_size, head_size]
     sharded by kv-head over "model" (the TP equivalent of the reference's
-    KV-head division, `config.py:256-264`)."""
+    KV-head division, `config.py:256-264`). When the kv-head count does not
+    divide the axis (GQA with few kv heads), the pool replicates — same as
+    the reference's kv-head replication for num_kv_heads < tp."""
     if mesh is None or is_single_device(mesh):
         return None
+    tp = mesh.shape["model"]
+    if num_kv_heads is not None and num_kv_heads % tp != 0:
+        logger.warning(
+            "KV pool: %d kv heads not divisible by tp=%d; replicating "
+            "cache (reference replicates kv heads the same way).",
+            num_kv_heads, tp)
+        return NamedSharding(mesh, P())
     return NamedSharding(mesh, P(None, "model", None, None))

@@ -194,7 +194,8 @@ class Worker:
 
     def init_cache_engine(self, cache_config: CacheConfig) -> None:
         self.cache_config = cache_config
-        kv_sharding = shard_kv_cache(self.mesh)
+        kv_sharding = shard_kv_cache(
+            self.mesh, self.model_config.get_total_num_kv_heads())
         self.cache_engine = CacheEngine(cache_config, self.model_config,
                                         self.parallel_config,
                                         sharding=kv_sharding)

@@ -1,0 +1,135 @@
+"""Multi-device golden-parity tests on the 8-virtual-CPU mesh.
+
+Reference pattern: `tests/distributed/test_comm_ops.py:19` +
+`vllm/test_utils.py:8-37` (real 2-GPU NCCL tests). TPU equivalent: the
+same engine driven over a `jax.sharding.Mesh` of 8 virtual CPU devices
+(provisioned in tests/conftest.py), asserting exact greedy-token equality
+with single-device runs and with HF transformers.
+"""
+import jax
+import pytest
+
+from intellillm_tpu import LLM, SamplingParams
+from tests.conftest import EXAMPLE_PROMPTS
+
+MAX_TOKENS = 16
+
+
+def _generate_greedy(model_dir, prompts, max_tokens, tp=1, dp=1):
+    llm = LLM(model=model_dir,
+              dtype="float32",
+              tensor_parallel_size=tp,
+              data_parallel_size=dp,
+              num_device_blocks_override=128,
+              max_model_len=128,
+              max_num_seqs=8,
+              max_paddings=512,
+              swap_space=0.01)
+    params = SamplingParams(temperature=0.0, max_tokens=max_tokens)
+    outputs = llm.generate(prompts, params)
+    return [o.outputs[0].token_ids for o in outputs], llm
+
+
+requires_8_devices = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+
+@pytest.fixture(scope="module")
+def single_device_reference(tiny_llama_dir):
+    """tp=1 greedy tokens, computed once for the whole module."""
+    ref, _ = _generate_greedy(tiny_llama_dir, list(EXAMPLE_PROMPTS),
+                              MAX_TOKENS)
+    return ref
+
+
+@requires_8_devices
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_greedy_matches_single_device(tiny_llama_dir, example_prompts,
+                                         single_device_reference, tp):
+    """TP-sharded run must produce the exact same greedy tokens as tp=1."""
+    got, _ = _generate_greedy(tiny_llama_dir, example_prompts, MAX_TOKENS,
+                              tp=tp)
+    for i, (r, g) in enumerate(zip(single_device_reference, got)):
+        assert r == g, f"prompt {i} tp={tp}: ref={r} got={g}"
+
+
+@requires_8_devices
+def test_dp2_tp4_greedy_matches_single_device(tiny_llama_dir,
+                                              example_prompts,
+                                              single_device_reference):
+    got, _ = _generate_greedy(tiny_llama_dir, example_prompts, MAX_TOKENS,
+                              tp=4, dp=2)
+    for i, (r, g) in enumerate(zip(single_device_reference, got)):
+        assert r == g, f"prompt {i} dp2xtp4: ref={r} got={g}"
+
+
+@requires_8_devices
+def test_tp_greedy_matches_hf(tiny_llama_dir, example_prompts, hf_runner):
+    """TP=2 run matches HF transformers greedy decode token-for-token."""
+    hf = hf_runner(tiny_llama_dir)
+    hf_out = hf.generate_greedy(example_prompts, MAX_TOKENS)
+    got, _ = _generate_greedy(tiny_llama_dir, example_prompts, MAX_TOKENS,
+                              tp=2)
+
+    def trim(ids, eos=1):
+        out = []
+        for t in ids:
+            out.append(t)
+            if t == eos:
+                break
+        return out
+
+    for i, (h, g) in enumerate(zip(hf_out, got)):
+        assert trim(h) == trim(g), f"prompt {i}: hf={h} got={g}"
+
+
+@requires_8_devices
+def test_params_and_cache_actually_sharded(tiny_llama_dir, example_prompts):
+    """Assert TP actually shards: at least the large matmul params and the
+    KV pool must have per-device shards smaller than the global shape
+    (i.e. sharding is not silent replication)."""
+    _, llm = _generate_greedy(tiny_llama_dir, example_prompts[:1],
+                              4, tp=4)
+    worker = llm.llm_engine.worker
+    mesh = worker.mesh
+    assert dict(mesh.shape) == {"data": 1, "model": 4}
+
+    sharded = 0
+    total = 0
+    for leaf in jax.tree.leaves(worker.params):
+        total += 1
+        shard_shape = leaf.sharding.shard_shape(leaf.shape)
+        if shard_shape != leaf.shape:
+            sharded += 1
+    # The bulk of params (qkv/o/mlp/embed) must be sharded; small vectors
+    # (norms, biases) replicate.
+    assert sharded >= total // 3, (
+        f"only {sharded}/{total} params sharded under tp=4")
+
+    # KV pool: [blocks, kv_heads=2, block, head] — kv_heads=2 does not
+    # divide tp=4, so it legitimately replicates for this tiny model; use
+    # a kv-divisible check on the sharding helper directly instead.
+    from jax.sharding import PartitionSpec as P
+    from intellillm_tpu.parallel.mesh import shard_kv_cache
+    kv_sh = shard_kv_cache(mesh)
+    assert kv_sh is not None and kv_sh.spec == P(None, "model", None, None)
+
+
+@requires_8_devices
+def test_kv_pool_sharded_when_divisible(tiny_llama_dir):
+    """With tp=2 the tiny model's 2 kv heads divide the axis: the pool
+    must physically shard by kv head."""
+    llm = LLM(model=tiny_llama_dir,
+              dtype="float32",
+              tensor_parallel_size=2,
+              num_device_blocks_override=64,
+              max_model_len=128,
+              max_num_seqs=4,
+              max_paddings=512,
+              swap_space=0.01)
+    cache = llm.llm_engine.worker.cache_engine.device_cache
+    k0, _ = cache[0]
+    shard_shape = k0.sharding.shard_shape(k0.shape)
+    assert shard_shape[1] == k0.shape[1] // 2, (
+        f"kv pool not sharded by head: global={k0.shape} "
+        f"shard={shard_shape}")
