@@ -83,6 +83,10 @@ class ModelConfig:
                 "must be 'auto' or 'slow'.")
 
     _SUPPORTED_QUANT = ("awq", "gptq", "squeezellm", "int8")
+    # Methods with a working TPU checkpoint loader; the rest are recognized
+    # (reference parity) but rejected with a clear error until their loader
+    # lands. Single source of truth: extend THIS tuple when adding a loader.
+    _LOADABLE_QUANT = ("int8", )
 
     def _verify_quantization(self) -> None:
         if self.quantization is None:
@@ -96,6 +100,14 @@ class ModelConfig:
             raise ValueError(
                 f"Unknown quantization method: {self.quantization}; "
                 f"supported: {self._SUPPORTED_QUANT}")
+        if (self.quantization is not None
+                and self.quantization not in self._LOADABLE_QUANT):
+            # Fail here with a clear message instead of an opaque KeyError
+            # at load_weights time.
+            raise NotImplementedError(
+                f"Quantization method '{self.quantization}' is not yet "
+                "supported on TPU (no checkpoint loader). Supported today: "
+                f"{self._LOADABLE_QUANT}.")
 
     # --- HF config introspection (reference config.py:222-268) ---
 

@@ -208,12 +208,18 @@ class BlockSpaceManager:
             blocks.update(self.block_tables[seq.seq_id])
         return list(blocks)
 
-    def can_swap_in(self, seq_group: SequenceGroup) -> bool:
+    def can_swap_in(self, seq_group: SequenceGroup,
+                    num_slots: int = 1) -> bool:
         blocks = self._get_physical_blocks(seq_group)
         num_swapped = seq_group.num_seqs(status=SequenceStatus.SWAPPED)
         num_free = self.device_allocator.get_num_free_blocks()
-        # +1 block headroom per seq for the imminent append.
-        return (len(blocks) + num_swapped <= num_free - self.watermark_blocks)
+        # Headroom per seq for the imminent append: with multi-step decode
+        # the scheduler reserves `num_slots` lookahead slots right after the
+        # swap-in, which may need a CoW block plus the blocks covering the
+        # lookahead tokens (same budget as can_append_slots).
+        blocks_per_seq = 1 + (num_slots - 1) // self.block_size + 1
+        return (len(blocks) + num_swapped * blocks_per_seq
+                <= num_free - self.watermark_blocks)
 
     def swap_in(self, seq_group: SequenceGroup) -> Dict[int, int]:
         """Plan CPU→HBM block moves; returns {cpu_block_no: device_block_no}."""

@@ -271,7 +271,7 @@ class Scheduler:
                                 for sg in self.running)
             while self.swapped:
                 seq_group = self.swapped[0]
-                if not self.block_manager.can_swap_in(seq_group):
+                if not self.block_manager.can_swap_in(seq_group, num_steps):
                     break
                 num_new_seqs = seq_group.get_max_num_running_seqs()
                 if (num_curr_seqs + num_new_seqs

@@ -76,6 +76,23 @@ class LLMEngine:
                              cache_config, lora_config)
         self.worker.init_model()
         self.worker.load_model()
+
+        # Fused multi-step decode is incompatible with ALiBi (bias needs
+        # the true query position per substep) and sliding window (exact
+        # window semantics need the ring layout). Clamp K HERE so the
+        # scheduler budgets lookahead slots consistently with what the
+        # runner will actually execute — deciding only in the runner would
+        # make the scheduler reserve blocks that are never consumed.
+        if scheduler_config.num_decode_steps > 1 and (
+                model_config.get_sliding_window() is not None
+                or getattr(self.worker.model, "uses_alibi", False)):
+            logger.info(
+                "Clamping num_decode_steps %d -> 1 (model uses %s).",
+                scheduler_config.num_decode_steps,
+                "sliding window" if model_config.get_sliding_window()
+                is not None else "ALiBi")
+            scheduler_config.num_decode_steps = 1
+
         self._init_cache()
 
         self.scheduler = Scheduler(scheduler_config, cache_config, lora_config)
@@ -167,6 +184,15 @@ class LLMEngine:
 
         prefix = None
         if prefix_pos is not None:
+            if self.model_config.get_sliding_window() is not None:
+                # The ring block layout stores only the last `window` tokens
+                # at wrapped slot indices, so cached-prefix attention cannot
+                # recover absolute key positions once the prefix exceeds the
+                # window. Same restriction as the reference (prefix caching
+                # + sliding window unsupported).
+                raise ValueError(
+                    "Prefix caching (prefix_pos) is not supported for "
+                    "sliding-window models.")
             prefix = self.scheduler.prefix_pool.add_or_get_prefix(
                 prompt_token_ids[:prefix_pos])
 

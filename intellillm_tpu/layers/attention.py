@@ -107,7 +107,8 @@ class PagedAttention:
                 out = context_attention_reference(
                     query, key, value, k_cache, v_cache,
                     attn_metadata.block_tables, attn_metadata.prefix_lens,
-                    new_lens, self.scale, self.alibi_slopes)
+                    new_lens, self.scale, self.alibi_slopes,
+                    self.sliding_window)
             else:
                 out = prefill_attention_reference(
                     query, key, value, attn_metadata.context_lens, self.scale,

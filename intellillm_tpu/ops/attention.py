@@ -93,6 +93,7 @@ def context_attention_reference(
     new_lens: jnp.ndarray,      # [B] int32 — actual new-token count
     scale: float,
     alibi_slopes: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Prefill attention when part of the context is already cached (prefix
     caching / chunked prefill). Role parity: the reference's 728-line Triton
@@ -123,6 +124,13 @@ def context_attention_reference(
     q_valid = q_pos < new_lens[:, None]                  # [B, L]
     mask_pre = (q_valid[:, None, None, :, None] &
                 pre_valid[:, None, None, None, :])
+    if sliding_window is not None:
+        # Query's absolute position is prefix_len + i; prefix key's is its
+        # slot index. Same window semantics as the non-prefix prefill path.
+        abs_q_w = prefix_lens[:, None] + q_pos                # [B, L]
+        in_window = (pre_pos[:, None, :] >
+                     abs_q_w[:, :, None] - sliding_window)    # [B, L, M]
+        mask_pre &= in_window[:, None, None, :, :]
     s_pre = jnp.where(mask_pre, s_pre, _NEG_INF)
 
     # New-token scores: causal within the suffix.
@@ -132,6 +140,12 @@ def context_attention_reference(
     mask_new = (causal[None, None, None, :, :] &
                 q_valid[:, None, None, :, None] &
                 q_valid[:, None, None, None, :])
+    if sliding_window is not None:
+        # Both absolute positions share the prefix offset, so the window
+        # check reduces to suffix-relative indices.
+        new_window = (jnp.arange(l)[None, :] >
+                      jnp.arange(l)[:, None] - sliding_window)
+        mask_new &= new_window[None, None, None, :, :]
     s_new = jnp.where(mask_new, s_new, _NEG_INF)
 
     if alibi_slopes is not None:

@@ -529,6 +529,11 @@ class ModelRunner:
             num_steps = num_decode_steps
             if self.sliding_window is not None:
                 num_steps = 1  # exact window semantics need the ring layout
+            if getattr(self.model, "uses_alibi", False):
+                # ALiBi bias needs the true query position; the staged scan
+                # holds context_lens constant across substeps, so fused
+                # multi-step decode would be off by k+1 per substep.
+                num_steps = 1
             decode_args = (
                 self.params, kv_caches,
                 place(arrays["token_ids"]), place(arrays["positions"]),

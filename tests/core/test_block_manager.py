@@ -131,3 +131,33 @@ def test_swap_out_and_in():
     mapping_in = bm.swap_in(group)
     assert set(mapping_in.keys()) == set(mapping.values())
     assert bm.get_num_free_device_blocks() == 2
+
+
+def test_can_swap_in_budgets_multi_step_slots():
+    """ADVICE r1: swap-in must budget the K lookahead slots the scheduler
+    reserves right after (CoW block + blocks covering K tokens per seq),
+    not just +1 block — otherwise allocate() can raise mid-step."""
+    bm = BlockSpaceManager(block_size=4, num_device_blocks=6,
+                           num_cpu_blocks=8, watermark=0.0)
+    group, seq = make_group(0, prompt_len=8, best_of=2)
+    bm.allocate(group)
+    for s in group.get_seqs():
+        s.status = SequenceStatus.RUNNING
+    # Fork a real second sequence so the group swaps TWO sequences (the
+    # per-seq multiplier in can_swap_in must be exercised with
+    # num_swapped > 1).
+    child = seq.fork(1)
+    group.add(child)
+    child.status = SequenceStatus.RUNNING
+    bm.fork(seq, child)
+    bm.swap_out(group)
+    for s in group.get_seqs():
+        s.status = SequenceStatus.SWAPPED
+    assert group.num_seqs(status=SequenceStatus.SWAPPED) == 2
+
+    # 6 free device blocks; the shared table needs 2 blocks, K=1 needs
+    # 2 headroom blocks per seq -> 2 + 2*2 = 6 fits exactly.
+    assert bm.can_swap_in(group, num_slots=1)
+    # K=8 lookahead needs 1 CoW + ceil((8-1)/4)+1 = 3 blocks per seq ->
+    # 2 + 2*3 = 8 > 6 free: must defer.
+    assert not bm.can_swap_in(group, num_slots=8)
