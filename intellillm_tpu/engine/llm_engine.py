@@ -83,9 +83,10 @@ class LLMEngine:
         # scheduler budgets lookahead slots consistently with what the
         # runner will actually execute — deciding only in the runner would
         # make the scheduler reserve blocks that are never consumed.
+        from intellillm_tpu.layers.attention import model_uses_alibi
         if scheduler_config.num_decode_steps > 1 and (
                 model_config.get_sliding_window() is not None
-                or getattr(self.worker.model, "uses_alibi", False)):
+                or model_uses_alibi(self.worker.model)):
             logger.info(
                 "Clamping num_decode_steps %d -> 1 (model uses %s).",
                 scheduler_config.num_decode_steps,

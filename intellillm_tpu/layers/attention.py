@@ -147,6 +147,31 @@ class PagedAttention:
         return out, (k_pool, v_pool, k_stage, v_stage)
 
 
+def model_uses_alibi(model) -> bool:
+    """True if any PagedAttention layer in the model applies ALiBi.
+
+    Derived from the layers themselves (not a per-model flag) so a new
+    ALiBi model family cannot silently miss the fused multi-step decode
+    clamp: the engine forces K=1 for ALiBi models because the staged scan
+    holds context_lens constant across substeps."""
+    seen = set()
+
+    def walk(obj, depth) -> bool:
+        if id(obj) in seen or depth > 4:
+            return False
+        seen.add(id(obj))
+        if isinstance(obj, PagedAttention):
+            return obj.alibi_slopes is not None
+        if isinstance(obj, (list, tuple)):
+            return any(walk(v, depth + 1) for v in obj)
+        d = getattr(obj, "__dict__", None)
+        if not isinstance(d, dict):
+            return False
+        return any(walk(v, depth + 1) for v in d.values())
+
+    return walk(model, 0)
+
+
 def _decode_dispatch(q, k_cache, v_cache, block_tables, context_lens, scale,
                      alibi_slopes, return_lse: bool = False):
     """Choose the decode kernel: Pallas paged attention on TPU, jnp gather

@@ -25,11 +25,6 @@ Params = Dict[str, Any]
 
 class BloomForCausalLM:
 
-    # ALiBi bias depends on the true query position; the fused multi-step
-    # decode path does not plumb per-substep positions, so the runner
-    # forces K=1 for alibi models (see ModelRunner.execute_model).
-    uses_alibi = True
-
     def __init__(self, model_config: ModelConfig) -> None:
         cfg = model_config.hf_config
         self.config = cfg

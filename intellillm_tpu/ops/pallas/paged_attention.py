@@ -2,22 +2,33 @@
 
 Role parity: reference `csrc/attention/attention_kernels.cu` (951 LoC —
 `paged_attention_v1/v2` block-table gather + online softmax, V2 adds
-cross-partition reduction). TPU redesign: one kernel covers both — the
-grid already partitions the KV walk per (sequence, kv-head), streaming one
-KV block per grid step through VMEM with an online-softmax accumulator in
-scratch, so no separate V2 reduction pass is needed.
+cross-partition reduction). One kernel covers both roles: the per-sequence
+KV walk is streamed through VMEM in multi-page groups with online-softmax
+accumulators, so no separate V2 reduction pass is needed.
 
-Key mechanics:
-- `PrefetchScalarGridSpec`: the block table and context lengths are
-  scalar-prefetched so BlockSpec index_maps can map grid step (b, h, w) to
-  the w-th *physical* block of sequence b — the DMA engine walks the paged
-  pool directly (the CUDA kernel's `block_table` gather loop).
-- Blocks past a sequence's length clamp to its last valid block; Pallas
-  skips the re-DMA of a repeated index, so short sequences in a wide
-  bucket cost (almost) no extra HBM traffic.
-- GQA: queries are laid out [B, Hkv, G, D] so each grid step's matmuls are
-  [G, D] @ [D, BS] — MQA/GQA needs no KV duplication (the reference
+Architecture (v3 — evolved against device-time traces):
+- v1 gridded (batch, kv_head, page): one 4 KiB DMA per grid step → 16k
+  grid steps/layer, ~5 ms/layer of DMA latency (>90% of decode time).
+- v2 gridded (batch, kv_head) with an inline page walk and double-buffered
+  multi-page DMA groups: ~0.65 ms/layer — still 4x off the HBM roofline
+  because each page DMA is one head = 4 KiB.
+- v3 (this file) additionally blocks over kv heads: each grid step owns
+  (sequence, HP kv heads) and every page DMA moves a contiguous
+  [HP, block_size, head_size] slab (32 KiB at HP=8/bf16/D=128). The last
+  page group prefetches the NEXT grid step's first group so the DMA
+  pipeline never drains across grid steps.
+- The paged pools stay in HBM (`memory_space=ANY`); the kernel issues
+  explicit `pltpu.make_async_copy`s against `k_hbm.at[page].at[head
+  slice]` — the block table (scalar-prefetched to SMEM) is read at
+  copy-issue time, which is the CUDA kernel's `block_table` gather loop.
+- GQA: queries are laid out [B, Hkv, G, D]; a grid step computes all G
+  query heads of its HP kv heads — no KV duplication (the reference
   expands KV heads instead, `attention.py:106-120`).
+- ALiBi is native: per-head slopes ride along in VMEM and bias the scores
+  by (key_pos - query_pos) before the online softmax, matching
+  `decode_attention_reference`.
+- Besides the attended output, the kernel emits the per-head logsumexp so
+  fused multi-step decode can merge pool-part and stage-part attention.
 
 Numerics: f32 accumulation regardless of cache dtype.
 """
@@ -28,130 +39,221 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
 
+def _group_copies(k_hbm_ref, v_hbm_ref, k_buf, v_buf, k_sem, v_sem,
+                  tables_ref, b, hb, g, buf, *, heads_per_block,
+                  pages_per_group, w_max):
+    """The async copies moving page-group g of sequence b / kv-head block
+    hb into VMEM buffer `buf`. Identical descriptor lists are built at
+    start and wait time (a DMA is identified by its (src, dst, sem))."""
+    copies = []
+    h0 = hb * heads_per_block
+    for j in range(pages_per_group):
+        idx = jnp.minimum(g * pages_per_group + j, w_max - 1)
+        page = tables_ref[b * w_max + idx]
+        # Chained single-axis dynamic slices: Mosaic supports dynamic
+        # indexing one (leading) axis at a time.
+        copies.append(pltpu.make_async_copy(
+            k_hbm_ref.at[page].at[pl.ds(h0, heads_per_block)],
+            k_buf.at[buf, j], k_sem.at[buf]))
+        copies.append(pltpu.make_async_copy(
+            v_hbm_ref.at[page].at[pl.ds(h0, heads_per_block)],
+            v_buf.at[buf, j], v_sem.at[buf]))
+    return copies
+
+
 def _decode_kernel(
-    # scalar-prefetch
-    block_tables_ref,   # [B * W] i32 (flattened)
+    # scalar prefetch (SMEM)
     context_lens_ref,   # [B] i32
+    tables_ref,         # [B * W] i32 (flattened)
+    buf_idx_ref,        # [1] i32 — VMEM buffer holding the next step's group 0
+    init_ref,           # [1] i32 — 1 until the first grid step has run
     # inputs
-    q_ref,              # [1, 1, G, D]
-    k_ref,              # [1, 1, BS, D]
-    v_ref,              # [1, 1, BS, D]
+    q_ref,              # [1, HP, G, D]
+    slopes_ref,         # [HP, G, 128] f32 ALiBi slopes, col 0 (0 = none)
+    k_hbm_ref,          # [NB, Hkv, BS, D] (HBM resident)
+    v_hbm_ref,
     # outputs
-    out_ref,            # [1, 1, G, D]
-    lse_ref,            # [1, 1, G, 128] f32 logsumexp (col 0)
+    o_ref,              # [1, HP, G, D]
+    lse_ref,            # [1, HP, G, 128] f32 logsumexp (col 0)
     # scratch
-    m_ref,              # [G, 128] f32 running max
-    l_ref,              # [G, 128] f32 running denominator
-    acc_ref,            # [G, D] f32 running numerator
+    k_buf,              # [2, P, HP, BS, D] VMEM double buffer
+    v_buf,
+    k_sem,              # DMA semaphores [2]
+    v_sem,
+    m_scr,              # [HP * G, 128] f32 running max
+    l_scr,              # [HP * G, 128] f32 running denominator
+    acc_scr,            # [HP * G, D] f32 running numerator
     *,
+    batch_size: int,
+    num_head_blocks: int,
+    heads_per_block: int,
+    num_groups_g: int,
+    pages_per_group: int,
     block_size: int,
     scale: float,
+    w_max: int,
 ):
     b = pl.program_id(0)
-    w = pl.program_id(2)
-    num_w = pl.num_programs(2)
-
+    hb = pl.program_id(1)
     ctx = context_lens_ref[b]
+    bk = pages_per_group * block_size
+    num_groups = jnp.maximum(lax.div(ctx + bk - 1, bk), 1)
+    hp, g_sz = heads_per_block, num_groups_g
 
-    @pl.when(w == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+    def copies(b_, hb_, g_, buf_):
+        return _group_copies(k_hbm_ref, v_hbm_ref, k_buf, v_buf, k_sem,
+                             v_sem, tables_ref, b_, hb_, g_, buf_,
+                             heads_per_block=hp,
+                             pages_per_group=pages_per_group, w_max=w_max)
 
-    # Only blocks that overlap the context contribute; later (clamped)
-    # repeats of the last block are skipped entirely.
-    @pl.when(w * block_size < ctx)
-    def _step():
-        q = q_ref[0, 0].astype(jnp.float32) * scale          # [G, D]
-        k = k_ref[0, 0].astype(jnp.float32)                  # [BS, D]
-        v = v_ref[0, 0].astype(jnp.float32)                  # [BS, D]
+    # Very first grid step starts its own group 0; afterwards every step's
+    # group 0 was prefetched by its predecessor.
+    @pl.when(init_ref[0] == 1)
+    def _first():
+        for c in copies(b, hb, 0, 0):
+            c.start()
+    init_ref[0] = 0
+    start_buf = buf_idx_ref[0]
 
-        s = jax.lax.dot_general(
-            q, k, (((1, ), (1, )), ((), ())),
-            preferred_element_type=jnp.float32)              # [G, BS]
+    # Successor grid point (head-block fastest, then batch).
+    wrap = hb + 1 == num_head_blocks
+    nhb = jnp.where(wrap, 0, hb + 1)
+    nb = jnp.where(wrap, b + 1, b)
+    has_next = nb < batch_size
 
-        token_pos = w * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, dimension=1)
-        s = jnp.where(token_pos < ctx, s, _NEG_INF)
+    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
 
-        m_prev = m_ref[:, 0][:, None]                        # [G, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)            # [G, 1]
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)                      # [G, 1]
-        p = jnp.exp(s - m_new)                               # [G, BS]
+    q_all = q_ref[0].astype(jnp.float32) * scale         # [HP, G, D]
 
-        l_prev = l_ref[:, 0][:, None]
-        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    def body(g, carry):
+        buf = lax.rem(start_buf + g, 2)
+        nxt = lax.rem(buf + 1, 2)
 
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1, ), (0, )), ((), ())),
-            preferred_element_type=jnp.float32)
+        @pl.when(g + 1 < num_groups)
+        def _prefetch_own():
+            for c in copies(b, hb, g + 1, nxt):
+                c.start()
 
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        @pl.when((g + 1 == num_groups) & has_next)
+        def _prefetch_successor():
+            for c in copies(nb, nhb, 0, nxt):
+                c.start()
 
-    @pl.when(w == num_w - 1)
-    def _finalize():
-        l = l_ref[:, 0][:, None]                             # [G, 1]
-        m = m_ref[:, 0][:, None]
-        out = acc_ref[...] / jnp.where(l == 0.0, 1.0, l)
-        out_ref[0, 0] = out.astype(out_ref.dtype)
-        # logsumexp over all attended keys; -1e30 when nothing attended.
-        lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG_INF)
-        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
+        for c in copies(b, hb, g, buf):
+            c.wait()
+
+        token_pos = g * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (g_sz, pages_per_group * block_size), dimension=1)
+        valid = token_pos < ctx
+        pos_f = token_pos.astype(jnp.float32)
+        ctx_f = (ctx - 1).astype(jnp.float32)
+
+        for hi in range(hp):
+            k = k_buf[buf, :, hi].reshape(pages_per_group * block_size, -1)
+            v = v_buf[buf, :, hi].reshape(pages_per_group * block_size, -1)
+            s = jax.lax.dot_general(
+                q_all[hi], k.astype(jnp.float32), (((1, ), (1, )), ((), ())),
+                preferred_element_type=jnp.float32)      # [G, P*BS]
+            # ALiBi: score += slope * (key_pos - query_pos).
+            slope = slopes_ref[hi, :, 0].astype(jnp.float32)  # [G]
+            s = s + slope[:, None] * (pos_f - ctx_f)
+
+            lo, hi_ = hi * g_sz, (hi + 1) * g_sz
+            m_prev = m_scr[lo:hi_, 0][:, None]           # [G, 1]
+            m_cur = jnp.max(jnp.where(valid, s, _NEG_INF), axis=1,
+                            keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            # Mask AFTER the exp: with a fully-invalid group m_new == s ==
+            # -inf-ish and exp(0) would otherwise contribute 1s.
+            p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+
+            l_new = l_scr[lo:hi_, 0][:, None] * alpha + jnp.sum(
+                p, axis=1, keepdims=True)
+            acc_scr[lo:hi_] = acc_scr[lo:hi_] * alpha + jax.lax.dot_general(
+                p, v.astype(jnp.float32), (((1, ), (0, )), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[lo:hi_] = jnp.broadcast_to(m_new, (g_sz, 128))
+            l_scr[lo:hi_] = jnp.broadcast_to(l_new, (g_sz, 128))
+        return carry
+
+    lax.fori_loop(0, num_groups, body, 0, unroll=False)
+    buf_idx_ref[0] = lax.rem(start_buf + num_groups, 2)
+
+    l = l_scr[:, 0][:, None]                             # [HP*G, 1]
+    m = m_scr[:, 0][:, None]
+    o = acc_scr[...] / jnp.where(l == 0.0, 1.0, l)       # [HP*G, D]
+    o_ref[0] = o.reshape(hp, g_sz, -1).astype(o_ref.dtype)
+    lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG_INF)
+    lse_ref[0] = jnp.broadcast_to(
+        lse.reshape(hp, g_sz, 1), lse_ref[0].shape)
+
+
+def _largest_divisor(n: int, cap: int) -> int:
+    for p in range(min(cap, n), 0, -1):
+        if n % p == 0:
+            return p
+    return 1
 
 
 @functools.partial(
     jax.jit, static_argnames=("scale_static", ))
-def _paged_attention_call(q_grouped, k_cache, v_cache, block_tables,
+def _paged_attention_call(q_grouped, slopes, k_cache, v_cache, block_tables,
                           context_lens, *, scale_static: float):
     b, hkv, g, d = q_grouped.shape
     nb, _, bs, _ = k_cache.shape
     w = block_tables.shape[1]
+    ppg = _largest_divisor(w, 8)
+    hp = _largest_divisor(hkv, 8)
 
-    flat_tables = block_tables.reshape(-1)
-
-    def q_index_map(b_, h_, w_, tables, ctx):
-        return (b_, h_, 0, 0)
-
-    def kv_index_map(b_, h_, w_, tables, ctx):
-        # Clamp invalid windows to the last valid block: repeated index →
-        # DMA skipped by the pipeline.
-        last_valid = jnp.maximum(ctx[b_] - 1, 0) // bs
-        j = jnp.minimum(w_, last_valid)
-        return (tables[b_ * w + j], h_, 0, 0)
-
-    def out_index_map(b_, h_, w_, tables, ctx):
-        return (b_, h_, 0, 0)
+    # <8 sublanes in the q block: hint a f32 <1x128> layout (a bf16 <8x128>
+    # memref would be mis-tiled for tiny G).
+    q_kernel_dtype = q_grouped.dtype if g % 8 == 0 else jnp.float32
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, hkv, w),
+        num_scalar_prefetch=4,
+        grid=(b, hkv // hp),
         in_specs=[
-            pl.BlockSpec((1, 1, g, d), q_index_map),
-            pl.BlockSpec((1, 1, bs, d), kv_index_map),
-            pl.BlockSpec((1, 1, bs, d), kv_index_map),
+            pl.BlockSpec((1, hp, g, d), lambda b_, h_, *_: (b_, h_, 0, 0)),
+            pl.BlockSpec((hp, g, 128), lambda b_, h_, *_: (h_, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         out_specs=(
-            pl.BlockSpec((1, 1, g, d), out_index_map),
-            pl.BlockSpec((1, 1, g, 128), out_index_map),
+            pl.BlockSpec((1, hp, g, d), lambda b_, h_, *_: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, hp, g, 128), lambda b_, h_, *_: (b_, h_, 0, 0)),
         ),
         scratch_shapes=[
-            pltpu.VMEM((g, 128), jnp.float32),
-            pltpu.VMEM((g, 128), jnp.float32),
-            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((2, ppg, hp, bs, d), k_cache.dtype),
+            pltpu.VMEM((2, ppg, hp, bs, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, )),
+            pltpu.SemaphoreType.DMA((2, )),
+            pltpu.VMEM((hp * g, 128), jnp.float32),
+            pltpu.VMEM((hp * g, 128), jnp.float32),
+            pltpu.VMEM((hp * g, d), jnp.float32),
         ],
     )
 
-    kernel = functools.partial(_decode_kernel, block_size=bs,
-                               scale=scale_static)
+    kernel = functools.partial(
+        _decode_kernel,
+        batch_size=b,
+        num_head_blocks=hkv // hp,
+        heads_per_block=hp,
+        num_groups_g=g,
+        pages_per_group=ppg,
+        block_size=bs,
+        scale=scale_static,
+        w_max=w,
+    )
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -160,9 +262,18 @@ def _paged_attention_call(q_grouped, k_cache, v_cache, block_tables,
             jax.ShapeDtypeStruct((b, hkv, g, 128), jnp.float32),
         ),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(flat_tables, context_lens, q_grouped, k_cache, v_cache)
-    return out, lse[..., 0]
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(
+        context_lens,
+        block_tables.reshape(-1),
+        jnp.zeros((1, ), jnp.int32),
+        jnp.ones((1, ), jnp.int32),
+        q_grouped.astype(q_kernel_dtype),
+        jnp.broadcast_to(slopes[:, :, None], (hkv, g, 128)),
+        k_cache,
+        v_cache,
+    )
+    return out.astype(q_grouped.dtype), lse[..., 0]
 
 
 def paged_attention(
@@ -177,18 +288,23 @@ def paged_attention(
 ):
     """Decode-phase paged attention. Returns [B, 1, Hq, D] (and, with
     return_lse, the per-head logsumexp [B, Hq] for attention merging)."""
-    if alibi_slopes is not None:
-        # ALiBi biases need absolute key positions; handled by the jnp
-        # reference path until the biased kernel variant lands.
+    b, one, hq, d = q.shape
+    if d % 128 != 0:
+        # Mosaic DMA windows must be 128-aligned in the minor dimension, so
+        # head sizes like 64/80 cannot be sliced out of the HBM pool; use
+        # the jnp gather reference (these are the small-model head sizes).
         from intellillm_tpu.ops.attention import decode_attention_reference
         return decode_attention_reference(q, k_cache, v_cache, block_tables,
                                           context_lens, scale, alibi_slopes,
                                           return_lse=return_lse)
-    b, one, hq, d = q.shape
     hkv = k_cache.shape[1]
     g = hq // hkv
     q_grouped = q.reshape(b, hkv, g, d)
-    out, lse = _paged_attention_call(q_grouped, k_cache, v_cache,
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(hkv, g)
+    else:
+        slopes = jnp.zeros((hkv, g), jnp.float32)
+    out, lse = _paged_attention_call(q_grouped, slopes, k_cache, v_cache,
                                      block_tables, context_lens,
                                      scale_static=float(scale))
     out = out.reshape(b, 1, hq, d)

@@ -77,6 +77,8 @@ class ModelRunner:
 
         self.block_size = cache_config.block_size
         self.sliding_window = model_config.get_sliding_window()
+        from intellillm_tpu.layers.attention import model_uses_alibi
+        self._uses_alibi = model_uses_alibi(model)
         self.vocab_size = model_config.get_vocab_size()
         self.engine_seed = model_config.seed
         self.max_model_len = model_config.max_model_len
@@ -527,13 +529,14 @@ class ModelRunner:
             num_steps = 1
         else:
             num_steps = num_decode_steps
-            if self.sliding_window is not None:
-                num_steps = 1  # exact window semantics need the ring layout
-            if getattr(self.model, "uses_alibi", False):
-                # ALiBi bias needs the true query position; the staged scan
-                # holds context_lens constant across substeps, so fused
-                # multi-step decode would be off by k+1 per substep.
-                num_steps = 1
+            # The engine clamps num_decode_steps to 1 at init for sliding
+            # window (window semantics need the ring layout) and ALiBi
+            # (bias needs the true query position per substep); the staged
+            # decode program would be silently wrong for both.
+            assert num_steps == 1 or (self.sliding_window is None
+                                      and not self._uses_alibi), (
+                "fused multi-step decode requested for a sliding-window or "
+                "ALiBi model; the engine should have clamped K to 1")
             decode_args = (
                 self.params, kv_caches,
                 place(arrays["token_ids"]), place(arrays["positions"]),

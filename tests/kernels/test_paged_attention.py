@@ -71,3 +71,29 @@ def test_paged_attention_lse_matches_reference():
                                rtol=2e-2, atol=2e-2)
     np.testing.assert_allclose(np.asarray(lse_k), np.asarray(lse_r),
                                rtol=2e-2, atol=2e-2)
+
+
+@requires_tpu
+@pytest.mark.parametrize("hq,hkv", [(8, 8), (8, 2)])
+def test_paged_attention_alibi_matches_reference(hq, hkv):
+    """ALiBi bias is computed natively inside the kernel (v2); previously
+    this configuration fell back to the jnp gather path."""
+    from intellillm_tpu.layers.alibi import get_alibi_slopes
+    from intellillm_tpu.ops.pallas.paged_attention import paged_attention
+
+    rng = np.random.default_rng(3)
+    b, d, nb, bs, w = 4, 128, 64, 16, 8
+    k_cache, v_cache = make_cache(rng, nb, hkv, bs, d, np.float32)
+    q = jnp.asarray(rng.normal(size=(b, 1, hq, d)).astype(np.float32))
+    tables = rng.permutation(nb)[:b * w].reshape(b, w).astype(np.int32)
+    context_lens = jnp.asarray(np.asarray([1, 17, 63, 128], np.int32))
+    slopes = jnp.asarray(get_alibi_slopes(hq), jnp.float32)
+    scale = d**-0.5
+
+    out_k = paged_attention(q, k_cache, v_cache, jnp.asarray(tables),
+                            context_lens, scale, alibi_slopes=slopes)
+    out_r = decode_attention_reference(q, k_cache, v_cache,
+                                       jnp.asarray(tables), context_lens,
+                                       scale, alibi_slopes=slopes)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                               rtol=2e-2, atol=2e-2)
