@@ -32,7 +32,7 @@ SIZES = {
 
 
 def build_engine(size: str, max_num_seqs: int, max_model_len: int,
-                 num_blocks: int, quantization=None):
+                 num_blocks: int, quantization=None, cache_dtype="auto"):
     from transformers import LlamaConfig
 
     from intellillm_tpu.config import (CacheConfig, ModelConfig,
@@ -50,12 +50,13 @@ def build_engine(size: str, max_num_seqs: int, max_model_len: int,
         load_format="dummy", quantization=quantization)
     cache_config = CacheConfig(block_size=16,
                                num_device_blocks_override=num_blocks,
-                               swap_space_gib=0.05)
+                               swap_space_gib=0.05,
+                               cache_dtype=cache_dtype)
     scheduler_config = SchedulerConfig(
         max_num_batched_tokens=max(2048, max_model_len),
         max_num_seqs=max_num_seqs, max_model_len=max_model_len,
         max_paddings=4096,
-        num_decode_steps=int(os.environ.get("INTELLILLM_BENCH_K", "16")))
+        num_decode_steps=int(os.environ.get("INTELLILLM_BENCH_K", "32")))
     return LLMEngine(model_config, cache_config, ParallelConfig(),
                      scheduler_config, log_stats=False,
                      skip_tokenizer_init=True)
@@ -93,17 +94,22 @@ def main():
     quant = os.environ.get("INTELLILLM_BENCH_QUANT",
                            "int8" if size == "7b" else "none")
     quant = None if quant in ("none", "") else quant
-    default_bs = {"7b": 16, "1b": 32, "tiny": 64}[size]
+    # fp8 KV halves cache HBM vs bf16: the 7B config fits a 1024-block
+    # pool and a bs=32 decode batch on one 16 GiB chip.
+    kv_dtype = os.environ.get("INTELLILLM_BENCH_KV",
+                              "fp8_e5m2" if size == "7b" else "auto")
+    default_bs = {"7b": 32, "1b": 32, "tiny": 64}[size]
     batch_size = int(os.environ.get("INTELLILLM_BENCH_BS", default_bs))
     input_len = int(os.environ.get("INTELLILLM_BENCH_IN", "128"))
     output_len = int(os.environ.get("INTELLILLM_BENCH_OUT", "128"))
     max_model_len = 512
-    num_blocks = {"7b": 512, "1b": 2048, "tiny": 4096}[size]
+    num_blocks = {"7b": 1024 if kv_dtype.startswith("fp8") else 512,
+                  "1b": 2048, "tiny": 4096}[size]
     vocab = SIZES[size][5]
 
     try:
         engine = build_engine(size, batch_size, max_model_len, num_blocks,
-                              quantization=quant)
+                              quantization=quant, cache_dtype=kv_dtype)
     except Exception as e:
         print(json.dumps({"metric": "error", "value": 0, "unit": str(e),
                           "vs_baseline": 0.0}))
@@ -118,7 +124,8 @@ def main():
     print(json.dumps({
         "metric": f"llama2-{size}-dummy offline output tok/s/chip "
                   f"(bs={batch_size}, in={input_len}, out={output_len}, "
-                  f"greedy, {'int8-w' if quant else 'bf16'})",
+                  f"greedy, {'int8-w' if quant else 'bf16'}, "
+                  f"kv={kv_dtype})",
         "value": round(tok_s, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),

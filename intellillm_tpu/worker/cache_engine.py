@@ -87,11 +87,13 @@ class CacheEngine:
 
     def _allocate_cpu_cache(self):
         shape = self._block_shape(self.num_cpu_blocks)
-        np_dtype = np.dtype("float32") if self.dtype == jnp.float32 else None
-        if np_dtype is None:
+        if self.dtype in (jnp.float32, jnp.float16):
+            np_dtype = np.dtype(self.dtype.name)
+        else:
+            # bf16 / fp8 swap pools keep the device dtype bit-for-bit via
+            # ml_dtypes so swap in/out is lossless.
             import ml_dtypes
-            np_dtype = np.dtype(self.dtype.name) if self.dtype.name in (
-                "float16", ) else np.dtype(ml_dtypes.bfloat16)
+            np_dtype = np.dtype(getattr(ml_dtypes, self.dtype.name))
         return [(np.zeros(shape, dtype=np_dtype),
                  np.zeros(shape, dtype=np_dtype))
                 for _ in range(self.num_layers)]

@@ -201,10 +201,68 @@ class Worker:
                                         sharding=kv_sharding)
 
     def warm_up_model(self) -> None:
-        """Pre-compile the common decode buckets (CUDA-graph-capture
-        analogue, reference model_runner.py:629-698). Optional: jit compiles
-        lazily on first use anyway; this front-loads the latency."""
-        pass  # TODO(stage 2): precompile decode buckets eagerly
+        """Pre-compile the steady-state decode executables (CUDA-graph-
+        capture analogue, reference model_runner.py:629-698): the top batch
+        bucket at the two narrowest block-table widths, greedy sampling
+        flags, for both the single-step and fused-K decode programs.
+        Populates the (persistent) XLA compilation cache so the first real
+        decode hit doesn't pay compile latency mid-serving.
+
+        Skipped under enforce_eager and on CPU (tests): jit still compiles
+        lazily on first use, warm-up only front-loads the latency."""
+        if self.model_config.enforce_eager or jax.default_backend() == "cpu":
+            return
+        runner = self.model_runner
+        if runner is None or self.cache_engine is None:
+            return
+        import time as _time
+
+        from intellillm_tpu.utils import pad_to_bucket
+
+        start = _time.monotonic()
+        b = pad_to_bucket(self.scheduler_config.max_num_seqs,
+                          runner.batch_buckets)
+        place = runner._place_batch_array
+        # All-pad batch: context_lens == 0 rows map every KV slot to the
+        # out-of-bounds sentinel, so executing the real jitted programs
+        # leaves the (donated, reassigned) pool bit-identical while
+        # populating jit's dispatch cache with the exact runtime
+        # executables — shardings included.
+        zeros_i = place(np.zeros((b, 1), np.int32))
+        flags = dict(logprob_k=8, do_topk=False, do_topp=False,
+                     do_minp=False, do_penalties=False)
+        n = 0
+        try:
+            for w in runner.block_width_buckets[:2]:
+                args = (place(np.zeros((b, 1), np.int32)), zeros_i,
+                        place(np.zeros((b, w), np.int32)),
+                        place(np.zeros(b, np.int32)),
+                        place(np.zeros(b, np.float32)),
+                        place(np.full(b, -1, np.int32)),
+                        place(np.ones(b, np.float32)),
+                        place(np.zeros(b, np.float32)),
+                        place(np.zeros(b, np.uint32)),
+                        place(np.zeros(b, np.float32)),
+                        place(np.zeros(b, np.float32)),
+                        place(np.ones(b, np.float32)), None, None)
+                packed, caches = runner._jit_decode_single(
+                    self.params, self.cache_engine.device_cache, *args,
+                    **flags)
+                self.cache_engine.device_cache = caches
+                n += 1
+                k = self.scheduler_config.num_decode_steps
+                if k > 1:
+                    packed, caches = runner._jit_decode(
+                        self.params, self.cache_engine.device_cache, *args,
+                        num_steps=k, **flags)
+                    self.cache_engine.device_cache = caches
+                    n += 1
+                jax.block_until_ready(packed)
+            logger.info("Warm-up: compiled %d decode executables (bs=%d) "
+                        "in %.1fs", n, b, _time.monotonic() - start)
+        except Exception as e:  # warm-up is best-effort
+            logger.warning("Warm-up failed (%s); compiling lazily instead",
+                           e)
 
     # --- step ------------------------------------------------------------
 
