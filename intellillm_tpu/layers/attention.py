@@ -110,9 +110,10 @@ class PagedAttention:
                     new_lens, self.scale, self.alibi_slopes,
                     self.sliding_window)
             else:
-                out = prefill_attention_reference(
-                    query, key, value, attn_metadata.context_lens, self.scale,
-                    self.sliding_window, self.alibi_slopes)
+                out = _prefill_dispatch(query, key, value,
+                                        attn_metadata.context_lens,
+                                        self.scale, self.sliding_window,
+                                        self.alibi_slopes)
         else:
             out = _decode_dispatch(query, k_cache, v_cache,
                                    attn_metadata.block_tables,
@@ -170,6 +171,19 @@ def model_uses_alibi(model) -> bool:
         return any(walk(v, depth + 1) for v in d.values())
 
     return walk(model, 0)
+
+
+def _prefill_dispatch(query, key, value, context_lens, scale, sliding_window,
+                      alibi_slopes):
+    """Choose the prefill kernel: Pallas blockwise-causal flash attention
+    on TPU (O(L) HBM traffic), padded-dense jnp reference elsewhere."""
+    from intellillm_tpu.ops import dispatch
+    if dispatch.use_pallas():
+        from intellillm_tpu.ops.pallas.flash_attention import flash_attention
+        return flash_attention(query, key, value, context_lens, scale,
+                               sliding_window, alibi_slopes)
+    return prefill_attention_reference(query, key, value, context_lens,
+                                       scale, sliding_window, alibi_slopes)
 
 
 def _decode_dispatch(q, k_cache, v_cache, block_tables, context_lens, scale,

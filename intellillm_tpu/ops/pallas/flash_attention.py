@@ -1,0 +1,204 @@
+"""Pallas TPU flash-attention prefill kernel.
+
+Role parity: the reference's prompt-phase attention — xformers
+`memory_efficient_attention_forward` with `BlockDiagonalCausalMask`
+(`vllm/model_executor/layers/attention.py:151-161`) — reimagined as a
+blockwise causal flash kernel over the bucket-padded [B, L] prompt batch.
+
+Why it matters: the jnp reference materializes [B, Hkv, G, L, L] scores —
+at L=1k that is O(L^2) HBM traffic per layer and is the TTFT bottleneck.
+The kernel streams K/V blocks through VMEM with online-softmax
+accumulators, so scores never leave the core.
+
+Mechanics:
+- Grid (B, Hq, L/BQ, L/BK) with accumulators in VMEM scratch carried
+  across the (innermost, "arbitrary") KV-block axis; output written at
+  the last contributing KV block.
+- Causal blocks beyond the query block's frontier are skipped entirely
+  (`pl.when` on the grid step), so the wasted work of the padded-dense
+  reference (computing then masking the upper triangle) disappears.
+- GQA via the kv-head index map (kv_head = q_head // G) — no KV
+  expansion.
+- Per-sequence valid lengths, sliding window, and ALiBi bias are applied
+  inside the block mask, matching `prefill_attention_reference`.
+
+Numerics: f32 accumulation regardless of input dtype.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    # scalar prefetch (SMEM)
+    ctx_ref,            # [B] i32 — valid length per sequence
+    slopes_ref,         # [Hq] f32 — ALiBi slope per head (0 = none)
+    # inputs
+    q_ref,              # [1, 1, BQ, D]
+    k_ref,              # [1, 1, BK, D]
+    v_ref,              # [1, 1, BK, D]
+    # outputs
+    o_ref,              # [1, 1, BQ, D]
+    # scratch
+    m_scr,              # [BQ, 128] f32 running max
+    l_scr,              # [BQ, 128] f32 running denominator
+    acc_scr,            # [BQ, D] f32 running numerator
+    *,
+    block_q: int,
+    block_k: int,
+    scale: float,
+    sliding_window: Optional[int],
+    use_alibi: bool,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    ctx = ctx_ref[b]
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), dimension=0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), dimension=1)
+
+    # Skip blocks fully above the causal frontier or past the context.
+    @pl.when((ik * block_k <= iq * block_q + block_q - 1)
+             & (ik * block_k < ctx))
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale       # [BQ, D]
+        k = k_ref[0, 0].astype(jnp.float32)               # [BK, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1, ), (1, )), ((), ())),
+            preferred_element_type=jnp.float32)           # [BQ, BK]
+
+        mask = (q_pos >= k_pos) & (k_pos < ctx)
+        if sliding_window is not None:
+            mask &= k_pos > q_pos - sliding_window
+        if use_alibi:
+            s = s + slopes_ref[h] * (k_pos - q_pos).astype(jnp.float32)
+
+        m_prev = m_scr[:, 0][:, None]                     # [BQ, 1]
+        m_cur = jnp.max(jnp.where(mask, s, _NEG_INF), axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # Mask AFTER the exp: rows with no valid key this block would
+        # otherwise contribute exp(0)=1 per lane.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+
+        l_new = l_scr[:, 0][:, None] * alpha + jnp.sum(p, axis=1,
+                                                       keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1, ), (0, )), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    # The last KV block this query block consumes (causal frontier / end).
+    @pl.when((ik == num_k - 1)
+             | (ik == (iq * block_q + block_q - 1) // block_k))
+    def _finalize():
+        l = l_scr[:, 0][:, None]
+        o_ref[0, 0] = (acc_scr[...] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype)
+
+
+def _pick_block(l: int, cap: int = 128) -> int:
+    b = 1
+    while b * 2 <= min(l, cap) and l % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale_static", "sliding_window", "use_alibi"))
+def _flash_attention_call(q, k, v, context_lens, slopes, *,
+                          scale_static: float,
+                          sliding_window: Optional[int],
+                          use_alibi: bool):
+    b, hq, l, d = q.shape
+    hkv = k.shape[1]
+    g = hq // hkv
+    bq = _pick_block(l)
+    bk = _pick_block(l)
+    nq, nk = l // bq, l // bk
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda b_, h_, iq, ik, *_: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, iq, ik, *_: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, iq, ik, *_: (b_, h_ // g, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda b_, h_, iq, ik, *_: (b_, h_, iq, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _flash_kernel, block_q=bq, block_k=bk, scale=scale_static,
+        sliding_window=sliding_window, use_alibi=use_alibi)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, l, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+    )(context_lens, slopes, q, k, v)
+    return out
+
+
+def flash_attention(
+    q: jnp.ndarray,             # [B, L, Hq, D]
+    k: jnp.ndarray,             # [B, L, Hkv, D]
+    v: jnp.ndarray,             # [B, L, Hkv, D]
+    context_lens: jnp.ndarray,  # [B] i32 — valid (unpadded) lengths
+    scale: float,
+    sliding_window: Optional[int] = None,
+    alibi_slopes: Optional[jnp.ndarray] = None,   # [Hq]
+) -> jnp.ndarray:
+    """Blockwise causal prefill attention. Returns [B, L, Hq, D].
+
+    Rows past context_lens[b] produce zeros (cheap, ignored downstream) —
+    same contract as `prefill_attention_reference`."""
+    b, l, hq, d = q.shape
+    qt = jnp.swapaxes(q, 1, 2)           # [B, Hq, L, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32)
+        use_alibi = True
+    else:
+        slopes = jnp.zeros((hq, ), jnp.float32)
+        use_alibi = False
+    out = _flash_attention_call(
+        qt, kt, vt, context_lens.astype(jnp.int32), slopes,
+        scale_static=float(scale),
+        sliding_window=(int(sliding_window)
+                        if sliding_window is not None else None),
+        use_alibi=use_alibi)
+    return jnp.swapaxes(out, 1, 2)

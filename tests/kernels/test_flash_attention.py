@@ -1,0 +1,66 @@
+"""Pallas prefill flash-attention kernel vs the jnp reference over a
+GQA × head-size × length × feature grid (reference pattern:
+`tests/kernels/test_attention.py`). TPU-only; the engine uses the
+reference path on CPU."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from intellillm_tpu.ops.attention import prefill_attention_reference
+
+requires_tpu = pytest.mark.skipif(jax.default_backend() != "tpu",
+                                  reason="Pallas kernel requires TPU")
+
+
+def _run(hq, hkv, d, l, lens, sliding_window=None, use_alibi=False,
+         dtype=np.float32, seed=0):
+    from intellillm_tpu.layers.alibi import get_alibi_slopes
+    from intellillm_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.default_rng(seed)
+    b = len(lens)
+    q = jnp.asarray(rng.normal(size=(b, l, hq, d)).astype(dtype))
+    k = jnp.asarray(rng.normal(size=(b, l, hkv, d)).astype(dtype))
+    v = jnp.asarray(rng.normal(size=(b, l, hkv, d)).astype(dtype))
+    ctx = jnp.asarray(np.asarray(lens, np.int32))
+    slopes = (jnp.asarray(get_alibi_slopes(hq), jnp.float32)
+              if use_alibi else None)
+    scale = d**-0.5
+
+    out_k = flash_attention(q, k, v, ctx, scale, sliding_window, slopes)
+    out_r = prefill_attention_reference(q, k, v, ctx, scale, sliding_window,
+                                        slopes)
+    # Compare only valid rows: the reference computes (garbage) attention
+    # for padded rows, the kernel zeros them; both are ignored downstream.
+    for i, n in enumerate(lens):
+        np.testing.assert_allclose(np.asarray(out_k)[i, :n],
+                                   np.asarray(out_r)[i, :n],
+                                   rtol=2e-2, atol=2e-2)
+
+
+@requires_tpu
+@pytest.mark.parametrize("hq,hkv", [(8, 8), (8, 2)])
+@pytest.mark.parametrize("d", [64, 128])
+def test_flash_attention_matches_reference(hq, hkv, d):
+    _run(hq, hkv, d, 256, [256, 130, 17, 1])
+
+
+@requires_tpu
+def test_flash_attention_sliding_window():
+    _run(8, 2, 128, 256, [256, 100], sliding_window=64)
+
+
+@requires_tpu
+def test_flash_attention_alibi():
+    _run(8, 8, 128, 128, [128, 70], use_alibi=True)
+
+
+@requires_tpu
+def test_flash_attention_bf16():
+    _run(8, 2, 128, 128, [128, 90], dtype=jnp.bfloat16)
+
+
+@requires_tpu
+def test_flash_attention_small_length():
+    _run(4, 4, 128, 16, [16, 5])
