@@ -2,13 +2,23 @@
 
 Role parity: reference `vllm/model_executor/layers/fused_moe.py` (Triton
 grouped-GEMM over experts + CUDA `moe_align_block_size`,
-`csrc/moe_align_block_size_kernels.cu`). TPU redesign: the Triton
-sort-by-expert + grouped GEMM exists to keep GPU tiles dense; on TPU the
-idiomatic v0 is dense expert compute (every expert over every token,
-combined by routing weights) chunked over tokens so the [N_exp, chunk,
-inner] activations stay small — MXU utilization is perfect and there is
-no gather/scatter. A Pallas megablocks-style ragged GMM is the planned
-upgrade for high expert counts.
+`csrc/moe_align_block_size_kernels.cu`). TPU redesign, two paths:
+
+- `moe_ffn_grouped` — sort-based ragged grouped matmul with STATIC shapes
+  (the XLA-friendly equivalent of `moe_align_block_size` + grouped GEMM):
+  flatten the (token, k) assignments, stable-sort by expert, pad each
+  expert's group up to a block multiple, then scan over fixed-size token
+  blocks, each of which gathers exactly one expert's weights. Per-token
+  FLOPs are proportional to top_k (plus at most one padding block per
+  expert), not num_experts. No token dropping: the padded buffer is sized
+  T*K + N*block, an upper bound on the sum of per-expert padded groups.
+- `moe_ffn_dense` — every expert over every token, combined by routing
+  weights. For tiny decode batches (T*K << N*block) this is the faster
+  path: the step is bound by reading all expert weights from HBM either
+  way, and dense avoids the sort/scatter entirely.
+
+`moe_ffn` dispatches between them by comparing each path's FLOP model
+(dense: N*T products; grouped: T*K plus up to one padding block/expert).
 
 Routing matches HF Mixtral: softmax over ALL experts → top-k → renormalize
 the selected weights.
@@ -21,7 +31,15 @@ import jax.numpy as jnp
 from intellillm_tpu.utils import cdiv
 
 
-def moe_ffn(
+def _route(x: jnp.ndarray, gate_w: jnp.ndarray, top_k: int):
+    router_logits = (x.astype(jnp.float32) @ gate_w.astype(jnp.float32))
+    weights = jax.nn.softmax(router_logits, axis=-1)          # [T, N]
+    topw, topi = jax.lax.top_k(weights, top_k)                # [T, K]
+    topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+    return topw, topi
+
+
+def moe_ffn_dense(
     x: jnp.ndarray,        # [T, D]
     gate_w: jnp.ndarray,   # [D, N] router
     w1: jnp.ndarray,       # [N, D, I]  (gate proj per expert)
@@ -33,10 +51,7 @@ def moe_ffn(
     t, d = x.shape
     n = w1.shape[0]
 
-    router_logits = (x.astype(jnp.float32) @ gate_w.astype(jnp.float32))
-    weights = jax.nn.softmax(router_logits, axis=-1)          # [T, N]
-    topw, topi = jax.lax.top_k(weights, top_k)                # [T, K]
-    topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+    topw, topi = _route(x, gate_w, top_k)
     onehot = jax.nn.one_hot(topi, n, dtype=jnp.float32)       # [T, K, N]
     combine = (topw[..., None] * onehot).sum(axis=1)          # [T, N]
 
@@ -61,3 +76,82 @@ def moe_ffn(
 
     _, outs = jax.lax.scan(chunk_fn, None, (x_chunks, c_chunks))
     return outs.reshape(pad_t, d)[:t]
+
+
+def moe_ffn_grouped(
+    x: jnp.ndarray,        # [T, D]
+    gate_w: jnp.ndarray,   # [D, N] router
+    w1: jnp.ndarray,       # [N, D, I]
+    w2: jnp.ndarray,       # [N, I, D]
+    w3: jnp.ndarray,       # [N, D, I]
+    top_k: int,
+    block: int = 512,
+) -> jnp.ndarray:
+    t, d = x.shape
+    n = w1.shape[0]
+    tk = t * top_k
+
+    topw, topi = _route(x, gate_w, top_k)
+
+    flat_e = topi.reshape(-1)                                  # [T*K]
+    flat_w = topw.reshape(-1)                                  # [T*K]
+    sort_idx = jnp.argsort(flat_e, stable=True)                # [T*K]
+    sorted_e = flat_e[sort_idx]
+    token_idx = sort_idx // top_k                              # source token
+
+    counts = jnp.bincount(flat_e, length=n).astype(jnp.int32)
+    padded = cdiv(counts, block) * block                       # [N]
+    pad_cum = jnp.cumsum(padded)
+    starts = pad_cum - padded                                  # [N] slot base
+    grp_cum = jnp.cumsum(counts)
+    grp_start = grp_cum - counts                               # [N] in sorted
+    pos_in_grp = jnp.arange(tk) - grp_start[sorted_e]
+    slot = starts[sorted_e] + pos_in_grp                       # [T*K] dest
+
+    # Static upper bound on sum of padded group sizes (block multiple).
+    s = (cdiv(tk, block) + n) * block
+    nb = s // block
+    xb = jnp.zeros((s, d), x.dtype).at[slot].set(x[token_idx])
+
+    # Expert owning each block; blocks past the last padded group get a
+    # clipped id and compute on zeros (their output is never gathered).
+    blk_off = jnp.arange(nb) * block
+    blk_expert = jnp.clip(jnp.searchsorted(pad_cum, blk_off, side="right"),
+                          0, n - 1)
+
+    def body(carry, inp):
+        xc, e = inp                                            # [B, D], []
+        w1e = jax.lax.dynamic_index_in_dim(w1, e, 0, keepdims=False)
+        w3e = jax.lax.dynamic_index_in_dim(w3, e, 0, keepdims=False)
+        w2e = jax.lax.dynamic_index_in_dim(w2, e, 0, keepdims=False)
+        h1 = jnp.dot(xc, w1e, preferred_element_type=jnp.float32)
+        h3 = jnp.dot(xc, w3e, preferred_element_type=jnp.float32)
+        h = (jax.nn.silu(h1) * h3).astype(x.dtype)
+        return carry, jnp.dot(h, w2e, preferred_element_type=jnp.float32)
+
+    _, out_blocks = jax.lax.scan(body, None,
+                                 (xb.reshape(nb, block, d), blk_expert))
+    out = out_blocks.reshape(s, d)                             # f32
+
+    contrib = out[slot] * flat_w[sort_idx][:, None]            # [T*K, D]
+    y = jnp.zeros((t, d), jnp.float32).at[token_idx].add(contrib)
+    return y.astype(x.dtype)
+
+
+def moe_ffn(
+    x: jnp.ndarray,
+    gate_w: jnp.ndarray,
+    w1: jnp.ndarray,
+    w2: jnp.ndarray,
+    w3: jnp.ndarray,
+    top_k: int,
+    block: int = 512,
+) -> jnp.ndarray:
+    t = x.shape[0]
+    n = w1.shape[0]
+    # Dense computes n*t token-expert products; grouped computes t*top_k
+    # plus at most one padding block per expert. Require a 2x FLOP win to
+    # cover grouped's sort/scatter overhead before switching.
+    if n * t > 2 * (t * top_k + n * block):
+        return moe_ffn_grouped(x, gate_w, w1, w2, w3, top_k, block=block)
+    return moe_ffn_dense(x, gate_w, w1, w2, w3, top_k)
