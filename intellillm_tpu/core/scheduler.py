@@ -101,6 +101,22 @@ class Scheduler:
     def lora_enabled(self) -> bool:
         return self.lora_config is not None
 
+    def _running_loras(self) -> Optional[Set[int]]:
+        """Distinct adapter ids currently resident in the running batch
+        (None when LoRA is disabled)."""
+        if not self.lora_enabled:
+            return None
+        return set(sg.lora_int_id for sg in self.running
+                   if sg.lora_int_id > 0)
+
+    def _lora_cap_exceeded(self, curr_loras: Optional[Set[int]],
+                           lora_id: int) -> bool:
+        """Would admitting a group with this adapter exceed max_loras
+        concurrent adapters (reference scheduler.py:218-227)?"""
+        return (curr_loras is not None and lora_id > 0
+                and lora_id not in curr_loras
+                and len(curr_loras) >= self.lora_config.max_loras)
+
     def add_seq_group(self, seq_group: SequenceGroup) -> None:
         self.waiting.append(seq_group)
 
@@ -148,6 +164,8 @@ class Scheduler:
                                 for sg in self.running)
             num_batched_tokens = 0
             seq_lens: List[int] = []
+            curr_loras = self._running_loras()
+            lora_deferred: List[SequenceGroup] = []
 
             # SJF makes admission order policy-driven too: sort the waiting
             # queue by policy priority (FCFS degenerates to arrival order).
@@ -186,6 +204,14 @@ class Scheduler:
                     self.waiting.popleft()
                     continue
 
+                lora_id = seq_group.lora_int_id
+                if self._lora_cap_exceeded(curr_loras, lora_id):
+                    # Defer: admitting would exceed the concurrent-adapter
+                    # slots; later groups may still fit.
+                    self.waiting.popleft()
+                    lora_deferred.append(seq_group)
+                    continue
+
                 # Token budget counts the *padded* batch the runner will run
                 # (all prompts pad to the max in batch — same accounting as
                 # reference scheduler.py:230-245).
@@ -208,9 +234,15 @@ class Scheduler:
                 self._allocate(seq_group)
                 self.running.append(seq_group)
                 num_curr_seqs += num_new_seqs
+                if curr_loras is not None and lora_id > 0:
+                    curr_loras.add(lora_id)
                 scheduled.append(seq_group)
                 if seq_group.first_scheduled_time is None:
                     seq_group.first_scheduled_time = now
+
+            # Deferred-for-LoRA groups go back to the front (in order).
+            for sg in reversed(lora_deferred):
+                self.waiting.appendleft(sg)
 
             if scheduled or ignored_seq_groups:
                 return SchedulerOutputs(
@@ -269,10 +301,17 @@ class Scheduler:
         if not preempted:
             num_curr_seqs = sum(sg.get_max_num_running_seqs()
                                 for sg in self.running)
+            curr_loras = self._running_loras()
+            lora_deferred_swap: List[SequenceGroup] = []
             while self.swapped:
                 seq_group = self.swapped[0]
                 if not self.block_manager.can_swap_in(seq_group, num_steps):
                     break
+                lora_id = seq_group.lora_int_id
+                if self._lora_cap_exceeded(curr_loras, lora_id):
+                    self.swapped.popleft()
+                    lora_deferred_swap.append(seq_group)
+                    continue
                 num_new_seqs = seq_group.get_max_num_running_seqs()
                 if (num_curr_seqs + num_new_seqs
                         > self.scheduler_config.max_num_seqs):
@@ -281,7 +320,11 @@ class Scheduler:
                 self._swap_in(seq_group, blocks_to_swap_in)
                 self._append_slots(seq_group, num_steps, blocks_to_copy)
                 num_curr_seqs += num_new_seqs
+                if curr_loras is not None and lora_id > 0:
+                    curr_loras.add(lora_id)
                 self.running.append(seq_group)
+            for sg in reversed(lora_deferred_swap):
+                self.swapped.appendleft(sg)
 
         num_batched_tokens = sum(
             sg.num_seqs(status=SequenceStatus.RUNNING) for sg in self.running)

@@ -173,6 +173,13 @@ class LLMEngine:
     ) -> None:
         if arrival_time is None:
             arrival_time = time.monotonic()
+        if lora_request is not None and not self.lora_config:
+            raise ValueError(
+                f"Got lora_request {lora_request} but LoRA is not enabled "
+                "(set enable_lora=True / --enable-lora)")
+        if lora_request is not None and self.worker.lora_manager is not None:
+            # Fail a bad adapter at admission, not mid-step for the batch.
+            self.worker.lora_manager.validate_request(lora_request)
         self._validate_sampling_params(sampling_params)
         if prompt_token_ids is None:
             prompt_token_ids = self.tokenizer.encode(prompt, request_id,

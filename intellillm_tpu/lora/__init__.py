@@ -1,0 +1,3 @@
+from intellillm_tpu.lora.request import LoRARequest
+
+__all__ = ["LoRARequest"]

@@ -1,0 +1,253 @@
+"""LoRA adapter loading and device slot management.
+
+Role parity: reference `vllm/lora/models.py` (LoRAModel :136,
+LoRAModelManager :266, LRUCacheLoRAModelManager :579). TPU redesign: the
+manager owns ONE stacked device tensor per target module —
+`[num_layers, num_slots, dim_in, max_rank]` for A and
+`[num_layers, num_slots, max_rank, dim_out]` for B — so the jitted step
+takes the whole adapter set as two pytrees plus a per-row slot index, and
+activating/evicting an adapter is a functional `.at[:, slot].set(...)`
+update (rare, off the hot path). Slot 0 is reserved as the all-zero
+"no adapter" identity.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# HF PEFT target-module names → our param-tree keys.
+_PEFT_TARGET_MAP = {
+    "q_proj": "q",
+    "k_proj": "k",
+    "v_proj": "v",
+    "o_proj": "o",
+    "gate_proj": "gate",
+    "up_proj": "up",
+    "down_proj": "down",
+}
+_UNSUPPORTED_TARGETS = ("embed_tokens", "lm_head")
+
+
+class LoRAModel:
+    """One loaded adapter, host-side: per-layer, per-target (A, B) pairs.
+
+    A is [dim_in, r]; B is [r, dim_out] pre-scaled by lora_alpha/r.
+    """
+
+    def __init__(self, rank: int,
+                 layers: List[Dict[str, Tuple[np.ndarray, np.ndarray]]]):
+        self.rank = rank
+        self.layers = layers
+
+    @property
+    def targets(self) -> List[str]:
+        seen = []
+        for layer in self.layers:
+            for t in layer:
+                if t not in seen:
+                    seen.append(t)
+        return seen
+
+    @classmethod
+    def from_local_checkpoint(cls, path: str, num_layers: int) -> "LoRAModel":
+        """Load an HF PEFT adapter directory (adapter_config.json +
+        adapter_model.safetensors / .bin)."""
+        cfg_path = os.path.join(path, "adapter_config.json")
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        rank = int(cfg["r"])
+        alpha = float(cfg.get("lora_alpha", rank))
+        scaling = alpha / rank
+
+        st_path = os.path.join(path, "adapter_model.safetensors")
+        bin_path = os.path.join(path, "adapter_model.bin")
+        tensors: Dict[str, np.ndarray] = {}
+        if os.path.exists(st_path):
+            import safetensors.numpy
+            tensors = dict(safetensors.numpy.load_file(st_path))
+        elif os.path.exists(bin_path):
+            import torch
+            for k, v in torch.load(bin_path, map_location="cpu",
+                                   weights_only=True).items():
+                tensors[k] = v.float().numpy()
+        else:
+            raise ValueError(f"No adapter weights found under {path}")
+
+        layers: List[Dict[str, Tuple[np.ndarray, np.ndarray]]] = [
+            {} for _ in range(num_layers)
+        ]
+        pending: Dict[Tuple[int, str], Dict[str, np.ndarray]] = {}
+        for name, arr in tensors.items():
+            for bad in _UNSUPPORTED_TARGETS:
+                if f".{bad}." in name:
+                    raise ValueError(
+                        f"Adapter at {path} targets '{bad}'; embedding/"
+                        "lm_head LoRA is not supported")
+            if ".layers." not in name:
+                continue
+            li = int(name.split(".layers.")[1].split(".")[0])
+            target = None
+            for peft_name, key in _PEFT_TARGET_MAP.items():
+                if f".{peft_name}." in name:
+                    target = key
+                    break
+            if target is None:
+                raise ValueError(f"Unrecognized LoRA target in '{name}'")
+            ab = "a" if ".lora_A." in name else "b"
+            pending.setdefault((li, target), {})[ab] = np.asarray(
+                arr, np.float32)
+
+        for (li, target), ab in pending.items():
+            if "a" not in ab or "b" not in ab:
+                raise ValueError(
+                    f"Adapter layer {li} target {target} missing lora_A or "
+                    "lora_B")
+            # PEFT stores A [r, in], B [out, r]; ours are [in, r], [r, out].
+            a = ab["a"].T
+            b = ab["b"].T * scaling
+            layers[li][target] = (a, b)
+        return cls(rank, layers)
+
+
+class LoRAModelManager:
+    """Device slot manager: up to `max_loras` adapters resident, activated
+    into stacked tensors consumed by the jitted step; LRU eviction when the
+    slots are full (reference LRUCacheLoRAModelManager :579)."""
+
+    def __init__(
+        self,
+        num_layers: int,
+        target_dims: Dict[str, Tuple[int, int]],
+        max_loras: int,
+        max_lora_rank: int,
+        dtype,
+        mesh=None,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.num_layers = num_layers
+        self.target_dims = target_dims
+        self.max_loras = max_loras
+        self.max_rank = max_lora_rank
+        self.dtype = jnp.dtype(dtype)
+        self.num_slots = max_loras + 1   # slot 0 = no-adapter zeros
+        self.mesh = mesh
+
+        def alloc(shape, spec):
+            arr = jnp.zeros(shape, self.dtype)
+            if mesh is not None and any(s is not None for s in spec):
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                arr = jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+            return arr
+
+        self.a_stacks: Dict[str, "jnp.ndarray"] = {}
+        self.b_stacks: Dict[str, "jnp.ndarray"] = {}
+        for t, (din, dout) in target_dims.items():
+            # Column-parallel targets shard B's output dim like the base
+            # weight; row-parallel targets (o/down) shard A's input dim.
+            row_parallel = t in ("o", "down")
+            a_spec = (None, None, "model" if row_parallel else None, None)
+            b_spec = (None, None, None, None if row_parallel else "model")
+            self.a_stacks[t] = alloc(
+                (num_layers, self.num_slots, din, self.max_rank), a_spec)
+            self.b_stacks[t] = alloc(
+                (num_layers, self.num_slots, self.max_rank, dout), b_spec)
+
+        self._slot_by_id: Dict[int, int] = {}
+        self._free_slots = list(range(1, self.num_slots))
+        self._use_clock = 0
+        self._last_used: Dict[int, int] = {}
+        self._batch_clock = 0
+
+    def begin_batch(self) -> None:
+        """Mark the start of a batch: adapters touched after this point are
+        pinned — evicting them would corrupt rows already assigned their
+        slot in this batch."""
+        self._batch_clock = self._use_clock
+
+    # -- activation --------------------------------------------------------
+
+    def is_active(self, lora_id: int) -> bool:
+        return lora_id in self._slot_by_id
+
+    def activate(self, lora_id: int, lora: LoRAModel) -> int:
+        """Write the adapter into a device slot (evicting LRU if needed)
+        and return the slot index."""
+        if lora_id in self._slot_by_id:
+            return self._slot_by_id[lora_id]
+        if lora.rank > self.max_rank:
+            raise ValueError(
+                f"LoRA rank {lora.rank} > max_lora_rank {self.max_rank}")
+        for t in lora.targets:
+            if t not in self.target_dims:
+                raise ValueError(
+                    f"Adapter targets module '{t}' which this model does "
+                    f"not expose for LoRA (supported: "
+                    f"{sorted(self.target_dims)})")
+        if self._free_slots:
+            slot = self._free_slots.pop(0)
+        else:
+            victim = min(self._slot_by_id, key=lambda i: self._last_used[i])
+            if self._last_used[victim] > self._batch_clock:
+                # Every resident adapter is referenced by the current batch
+                # — the scheduler's admission cap should make this
+                # impossible; fail loudly rather than corrupt outputs.
+                raise RuntimeError(
+                    f"All {self.max_loras} LoRA slots are pinned by the "
+                    "current batch; cannot activate a new adapter")
+            slot = self._slot_by_id.pop(victim)
+            self._last_used.pop(victim, None)
+            logger.info("Evicting LoRA id=%d from slot %d (LRU)", victim,
+                        slot)
+
+        r = self.max_rank
+        for t, (din, dout) in self.target_dims.items():
+            a_host = np.zeros((self.num_layers, din, r), np.float32)
+            b_host = np.zeros((self.num_layers, r, dout), np.float32)
+            for li, layer in enumerate(lora.layers):
+                if t in layer:
+                    a, b = layer[t]
+                    a_host[li, :, :a.shape[1]] = a
+                    b_host[li, :b.shape[0], :] = b
+            self.a_stacks[t] = self.a_stacks[t].at[:, slot].set(
+                a_host.astype(self.dtype))
+            self.b_stacks[t] = self.b_stacks[t].at[:, slot].set(
+                b_host.astype(self.dtype))
+
+        self._slot_by_id[lora_id] = slot
+        self._touch(lora_id)
+        return slot
+
+    def deactivate(self, lora_id: int) -> None:
+        slot = self._slot_by_id.pop(lora_id, None)
+        self._last_used.pop(lora_id, None)
+        if slot is not None:
+            self._free_slots.insert(0, slot)
+
+    def _touch(self, lora_id: int) -> None:
+        self._use_clock += 1
+        self._last_used[lora_id] = self._use_clock
+
+    def slot_of(self, lora_id: int) -> int:
+        self._touch(lora_id)
+        return self._slot_by_id[lora_id]
+
+    # -- jit inputs ---------------------------------------------------------
+
+    def batch_state(self, row_slots: np.ndarray) -> Dict:
+        """The `lora` pytree passed into the jitted step: per-layer slices
+        are taken inside the traced function."""
+        import jax.numpy as jnp
+        return {
+            "row_slots": jnp.asarray(row_slots, jnp.int32),
+            "a": self.a_stacks,
+            "b": self.b_stacks,
+        }

@@ -1,0 +1,30 @@
+"""LoRA adapter request attached to generation requests.
+
+Role parity: reference `vllm/lora/request.py:5` (LoRARequest).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class LoRARequest:
+    """Names one adapter for a request.
+
+    lora_int_id must be > 0 (0 is reserved for "no adapter").
+    """
+    lora_name: str
+    lora_int_id: int
+    lora_local_path: str
+
+    def __post_init__(self):
+        if self.lora_int_id < 1:
+            raise ValueError(
+                f"lora_int_id must be > 0, got {self.lora_int_id}")
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, LoRARequest)
+                and self.lora_int_id == other.lora_int_id)
+
+    def __hash__(self) -> int:
+        return self.lora_int_id

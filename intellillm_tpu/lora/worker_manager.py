@@ -1,0 +1,112 @@
+"""Worker-side LoRA manager: host LRU cache of loaded adapters + device
+slot activation for the current batch.
+
+Role parity: reference `vllm/lora/worker_manager.py` (WorkerLoRAManager
+:66, LRUCacheWorkerLoRAManager :185). Single-controller: there is one
+worker, so this is the only manager instance.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from intellillm_tpu.config import LoRAConfig, ModelConfig
+from intellillm_tpu.logger import init_logger
+from intellillm_tpu.lora.models import LoRAModel, LoRAModelManager
+from intellillm_tpu.lora.request import LoRARequest
+
+logger = init_logger(__name__)
+
+
+class WorkerLoRAManager:
+
+    def __init__(
+        self,
+        model,
+        lora_config: LoRAConfig,
+        mesh=None,
+    ) -> None:
+        if not getattr(model, "supports_lora", False):
+            raise ValueError(
+                f"{type(model).__name__} does not support LoRA")
+        self.lora_config = lora_config
+        self.num_layers = model.num_layers
+        self._host_cache: "OrderedDict[int, LoRAModel]" = OrderedDict()
+        self.device_manager = LoRAModelManager(
+            num_layers=model.num_layers,
+            target_dims=model.lora_target_dims(),
+            max_loras=lora_config.max_loras,
+            max_lora_rank=lora_config.max_lora_rank,
+            dtype=lora_config.lora_dtype,
+            mesh=mesh,
+        )
+
+    def _get_lora(self, req: LoRARequest) -> LoRAModel:
+        lora = self._host_cache.get(req.lora_int_id)
+        if lora is None:
+            logger.info("Loading LoRA '%s' (id=%d) from %s", req.lora_name,
+                        req.lora_int_id, req.lora_local_path)
+            lora = LoRAModel.from_local_checkpoint(req.lora_local_path,
+                                                   self.num_layers)
+            self._host_cache[req.lora_int_id] = lora
+            while len(self._host_cache) > self.lora_config.max_cpu_loras:
+                # Host eviction drops only the host copy: an adapter already
+                # activated on device is self-sufficient (deactivating here
+                # could free a slot another row of the SAME batch recorded).
+                self._host_cache.popitem(last=False)
+        self._host_cache.move_to_end(req.lora_int_id)
+        return lora
+
+    def validate_request(self, req: LoRARequest) -> None:
+        """Admission-time validation so a bad adapter fails its own request
+        at add_request, not the whole engine step mid-batch."""
+        import json
+        import os
+        cfg_path = os.path.join(req.lora_local_path, "adapter_config.json")
+        if not os.path.isfile(cfg_path):
+            raise ValueError(
+                f"LoRA path {req.lora_local_path!r} has no "
+                "adapter_config.json")
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        rank = int(cfg.get("r", 0))
+        if rank > self.lora_config.max_lora_rank:
+            raise ValueError(
+                f"LoRA rank {rank} > max_lora_rank "
+                f"{self.lora_config.max_lora_rank}")
+        from intellillm_tpu.lora.models import _PEFT_TARGET_MAP
+        supported = set(self.device_manager.target_dims)
+        for mod in cfg.get("target_modules") or []:
+            key = _PEFT_TARGET_MAP.get(mod)
+            if key is None or key not in supported:
+                raise ValueError(
+                    f"Adapter targets unsupported module {mod!r} "
+                    f"(supported: {sorted(supported)})")
+
+    def set_active_loras(
+        self,
+        row_requests: List[Optional[LoRARequest]],
+        padded_len: int,
+    ) -> Optional[Dict]:
+        """Ensure every adapter named by the batch is resident on device;
+        return the `lora` pytree for the jitted step (None if the batch
+        uses no adapters)."""
+        if not any(r is not None for r in row_requests):
+            return None
+        self.device_manager.begin_batch()
+        row_slots = np.zeros(padded_len, np.int32)
+        for i, req in enumerate(row_requests):
+            if req is None:
+                continue
+            dm = self.device_manager
+            if dm.is_active(req.lora_int_id):
+                row_slots[i] = dm.slot_of(req.lora_int_id)
+            else:
+                row_slots[i] = dm.activate(req.lora_int_id,
+                                           self._get_lora(req))
+        return self.device_manager.batch_state(row_slots)
+
+    def list_loras(self) -> List[int]:
+        return list(self.device_manager._slot_by_id)

@@ -29,6 +29,17 @@ from intellillm_tpu.models.weight_utils import (cast_array,
 Params = Dict[str, Any]
 
 
+def _slice_lora(lora, layer_idx: int):
+    """Per-layer view of the stacked adapter tensors ([L, S, ...] → [S, ...])."""
+    if lora is None:
+        return None
+    return {
+        "row_slots": lora["row_slots"],
+        "a": {t: v[layer_idx] for t, v in lora["a"].items()},
+        "b": {t: v[layer_idx] for t, v in lora["b"].items()},
+    }
+
+
 class LlamaForCausalLM:
 
     supports_lora = True
@@ -71,6 +82,7 @@ class LlamaForCausalLM:
         positions: jnp.ndarray,   # [B, L]
         kv_caches: List[KVCache],
         attn_metadata: AttentionMetadata,
+        lora=None,
     ) -> Tuple[jnp.ndarray, List[KVCache]]:
         h = params["embed_tokens"][input_ids]
         residual = None
@@ -78,13 +90,34 @@ class LlamaForCausalLM:
         for i in range(self.num_layers):
             lp = params["layers"][i]
             h, residual, cache = self._layer(lp, h, residual, kv_caches[i],
-                                             attn_metadata, positions)
+                                             attn_metadata, positions,
+                                             lora=_slice_lora(lora, i))
             new_caches.append(cache)
         h, _ = fused_add_rms_norm(h, residual, params["norm"], self.rms_eps)
         return h, new_caches
 
+    def _proj(self, h, lp, lora, target):
+        """Base projection + multi-LoRA delta (reference
+        `vllm/lora/layers.py:32-101` _apply_lora, bgmv role)."""
+        out = qmatmul(h, lp[target])
+        if lora is not None and target in lora["a"]:
+            from intellillm_tpu.lora.layers import lora_delta
+            out = out + lora_delta(h, lora["a"][target], lora["b"][target],
+                                   lora["row_slots"])
+        return out
+
+    def lora_target_dims(self):
+        """Target module name → (dim_in, dim_out), consumed by
+        `lora.models.LoRAModelManager` to size the adapter stacks."""
+        e = self.hidden_size
+        hq = self.num_heads * self.head_size
+        hkv = self.num_kv_heads * self.head_size
+        inter = self.config.intermediate_size
+        return {"q": (e, hq), "k": (e, hkv), "v": (e, hkv), "o": (hq, e),
+                "gate": (e, inter), "up": (e, inter), "down": (inter, e)}
+
     def _layer(self, lp: Params, h, residual, kv_cache, attn_metadata,
-               positions):
+               positions, lora=None):
         b, l, e = h.shape
         if residual is None:
             residual = h
@@ -92,22 +125,23 @@ class LlamaForCausalLM:
         else:
             h, residual = fused_add_rms_norm(h, residual, lp["input_norm"],
                                              self.rms_eps)
-        q = qmatmul(h, lp["q"]).reshape(b, l, self.num_heads,
-                                        self.head_size)
-        k = qmatmul(h, lp["k"]).reshape(b, l, self.num_kv_heads,
-                                        self.head_size)
-        v = qmatmul(h, lp["v"]).reshape(b, l, self.num_kv_heads,
-                                        self.head_size)
+        q = self._proj(h, lp, lora, "q").reshape(b, l, self.num_heads,
+                                                 self.head_size)
+        k = self._proj(h, lp, lora, "k").reshape(b, l, self.num_kv_heads,
+                                                 self.head_size)
+        v = self._proj(h, lp, lora, "v").reshape(b, l, self.num_kv_heads,
+                                                 self.head_size)
         q, k = self.rope(positions, q, k)
         attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
-        h = qmatmul(attn_out.reshape(b, l, self.num_heads * self.head_size),
-                    lp["o"])
+        h = self._proj(attn_out.reshape(b, l,
+                                        self.num_heads * self.head_size),
+                       lp, lora, "o")
 
         h, residual = fused_add_rms_norm(h, residual, lp["post_attn_norm"],
                                          self.rms_eps)
-        gate = qmatmul(h, lp["gate"])
-        up = qmatmul(h, lp["up"])
-        h = qmatmul(self.act(gate) * up, lp["down"])
+        gate = self._proj(h, lp, lora, "gate")
+        up = self._proj(h, lp, lora, "up")
+        h = self._proj(self.act(gate) * up, lp, lora, "down")
         return h, residual, kv_cache
 
     def compute_logits(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:

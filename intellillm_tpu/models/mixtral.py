@@ -32,7 +32,15 @@ class MixtralForCausalLM(LlamaForCausalLM):
         self.top_k = cfg.num_experts_per_tok
         self.intermediate = cfg.intermediate_size
 
-    def _layer(self, lp, h, residual, kv_cache, attn_metadata, positions):
+    def lora_target_dims(self):
+        # Attention projections only: expert FFNs are not LoRA targets
+        # (matching common Mixtral PEFT configs; MoE-expert LoRA would need
+        # per-expert adapter stacks).
+        dims = super().lora_target_dims()
+        return {t: dims[t] for t in ("q", "k", "v", "o")}
+
+    def _layer(self, lp, h, residual, kv_cache, attn_metadata, positions,
+               lora=None):
         b, l, e = h.shape
         if residual is None:
             residual = h
@@ -40,15 +48,17 @@ class MixtralForCausalLM(LlamaForCausalLM):
         else:
             h, residual = fused_add_rms_norm(h, residual, lp["input_norm"],
                                              self.rms_eps)
-        q = qmatmul(h, lp["q"]).reshape(b, l, self.num_heads, self.head_size)
-        k = qmatmul(h, lp["k"]).reshape(b, l, self.num_kv_heads,
-                                        self.head_size)
-        v = qmatmul(h, lp["v"]).reshape(b, l, self.num_kv_heads,
-                                        self.head_size)
+        q = self._proj(h, lp, lora, "q").reshape(b, l, self.num_heads,
+                                                 self.head_size)
+        k = self._proj(h, lp, lora, "k").reshape(b, l, self.num_kv_heads,
+                                                 self.head_size)
+        v = self._proj(h, lp, lora, "v").reshape(b, l, self.num_kv_heads,
+                                                 self.head_size)
         q, k = self.rope(positions, q, k)
         attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
-        h = qmatmul(attn_out.reshape(b, l, self.num_heads * self.head_size),
-                    lp["o"])
+        h = self._proj(attn_out.reshape(b, l,
+                                        self.num_heads * self.head_size),
+                       lp, lora, "o")
 
         h, residual = fused_add_rms_norm(h, residual, lp["post_attn_norm"],
                                          self.rms_eps)

@@ -18,7 +18,8 @@ from intellillm_tpu.models.weight_utils import cast_array
 
 class Qwen2ForCausalLM(LlamaForCausalLM):
 
-    def _layer(self, lp, h, residual, kv_cache, attn_metadata, positions):
+    def _layer(self, lp, h, residual, kv_cache, attn_metadata, positions,
+               lora=None):
         b, l, e = h.shape
         from intellillm_tpu.layers.normalization import (fused_add_rms_norm,
                                                          rms_norm)
@@ -28,22 +29,23 @@ class Qwen2ForCausalLM(LlamaForCausalLM):
         else:
             h, residual = fused_add_rms_norm(h, residual, lp["input_norm"],
                                              self.rms_eps)
-        q = qmatmul(h, lp["q"]) + lp["q_bias"]
-        k = qmatmul(h, lp["k"]) + lp["k_bias"]
-        v = qmatmul(h, lp["v"]) + lp["v_bias"]
+        q = self._proj(h, lp, lora, "q") + lp["q_bias"]
+        k = self._proj(h, lp, lora, "k") + lp["k_bias"]
+        v = self._proj(h, lp, lora, "v") + lp["v_bias"]
         q = q.reshape(b, l, self.num_heads, self.head_size)
         k = k.reshape(b, l, self.num_kv_heads, self.head_size)
         v = v.reshape(b, l, self.num_kv_heads, self.head_size)
         q, k = self.rope(positions, q, k)
         attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
-        h = qmatmul(attn_out.reshape(b, l, self.num_heads * self.head_size),
-                    lp["o"])
+        h = self._proj(attn_out.reshape(b, l,
+                                        self.num_heads * self.head_size),
+                       lp, lora, "o")
 
         h, residual = fused_add_rms_norm(h, residual, lp["post_attn_norm"],
                                          self.rms_eps)
-        gate = qmatmul(h, lp["gate"])
-        up = qmatmul(h, lp["up"])
-        h = qmatmul(self.act(gate) * up, lp["down"])
+        gate = self._proj(h, lp, lora, "gate")
+        up = self._proj(h, lp, lora, "up")
+        h = self._proj(self.act(gate) * up, lp, lora, "down")
         return h, residual, kv_cache
 
     def partition_specs(self):

@@ -68,7 +68,12 @@ class TokenizerGroup:
             try:
                 tok = get_tokenizer(lora_request.lora_local_path,
                                     **self.tokenizer_config)
-            except OSError:
+            except Exception as e:
+                # No tokenizer shipped with the adapter → base tokenizer
+                # (reference tokenizer.py:120-130 behaves the same).
+                logger.warning(
+                    "No usable tokenizer at LoRA path %s (%s); using the "
+                    "base tokenizer", lora_request.lora_local_path, e)
                 tok = self.tokenizer
             self.lora_tokenizers[lora_id] = tok
         return self.lora_tokenizers[lora_id]

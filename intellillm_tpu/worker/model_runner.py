@@ -65,9 +65,11 @@ class ModelRunner:
         cache_config: CacheConfig,
         parallel_config: ParallelConfig,
         mesh=None,
+        lora_manager=None,
     ) -> None:
         self.model = model
         self.params = params
+        self.lora_manager = lora_manager
         self.model_config = model_config
         self.scheduler_config = scheduler_config
         self.cache_config = cache_config
@@ -142,6 +144,16 @@ class ModelRunner:
             -1, t2, kt)
         return sampled, sampled_lp, topk_ids, topk_lp
 
+    def _call_model(self, params, token_ids, positions, kv_caches,
+                    attn_metadata, lora):
+        """Models outside the llama family don't take a `lora` kwarg; only
+        pass it when a batch actually uses adapters."""
+        if lora is None:
+            return self.model(params, token_ids, positions, kv_caches,
+                              attn_metadata)
+        return self.model(params, token_ids, positions, kv_caches,
+                          attn_metadata, lora=lora)
+
     # --- jitted step functions -------------------------------------------
 
     def _compute_logits_and_sample(self, params, hidden_rows, temperatures,
@@ -161,10 +173,10 @@ class ModelRunner:
     def _prefill_fn(self, params, kv_caches, token_ids, positions,
                     attn_metadata, logits_indices, temperatures, top_ks,
                     top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
-                    prompt_mask, output_counts, *, num_samples, logprob_k,
-                    do_topk, do_topp, do_minp, do_penalties):
-        hidden, new_caches = self.model(params, token_ids, positions,
-                                        kv_caches, attn_metadata)
+                    prompt_mask, output_counts, lora=None, *, num_samples,
+                    logprob_k, do_topk, do_topp, do_minp, do_penalties):
+        hidden, new_caches = self._call_model(params, token_ids, positions,
+                                              kv_caches, attn_metadata, lora)
         b = token_ids.shape[0]
         sel = hidden[jnp.arange(b), logits_indices]          # [B, E]
         sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
@@ -178,8 +190,8 @@ class ModelRunner:
     def _decode_fn(self, params, kv_caches, token_ids, positions,
                    block_tables, context_lens, temperatures, top_ks, top_ps,
                    min_ps, seeds, pres_pen, freq_pen, rep_pen, prompt_mask,
-                   output_counts, *, num_steps, logprob_k, do_topk, do_topp,
-                   do_minp, do_penalties):
+                   output_counts, lora=None, *, num_steps, logprob_k,
+                   do_topk, do_topp, do_minp, do_penalties):
         """K fused decode iterations (staged).
 
         The paged pool stays loop-invariant (read-only) through the scan —
@@ -221,8 +233,9 @@ class ModelRunner:
             )
             caches4 = [(kp, vp, sk, sv)
                        for (kp, vp), (sk, sv) in zip(kv_caches, stages)]
-            hidden, caches4 = self.model(params, cur_tokens[:, None],
-                                         pos_k[:, None], caches4, meta)
+            hidden, caches4 = self._call_model(params, cur_tokens[:, None],
+                                               pos_k[:, None], caches4,
+                                               meta, lora)
             stages = [(c[2], c[3]) for c in caches4]
             seeds_k = seeds + k.astype(jnp.uint32) * _SEED_STRIDE
             sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
@@ -267,8 +280,9 @@ class ModelRunner:
     def _decode_fn_single(self, params, kv_caches, token_ids, positions,
                           block_tables, context_lens, temperatures, top_ks,
                           top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
-                          prompt_mask, output_counts, *, logprob_k, do_topk,
-                          do_topp, do_minp, do_penalties):
+                          prompt_mask, output_counts, lora=None, *,
+                          logprob_k, do_topk, do_topp, do_minp,
+                          do_penalties):
         """Unstaged single-step decode: writes KV to the pool before
         attention. Required for sliding-window models (exact window
         semantics need the ring layout) and used whenever K == 1."""
@@ -292,8 +306,9 @@ class ModelRunner:
             context_lens=ctx,
             block_tables=block_tables,
         )
-        hidden, new_caches = self.model(params, token_ids, pos[:, None],
-                                        kv_caches, meta)
+        hidden, new_caches = self._call_model(params, token_ids,
+                                              pos[:, None], kv_caches, meta,
+                                              lora)
         sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
             params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
             seeds, pres_pen, freq_pen, rep_pen, prompt_mask, output_counts,
@@ -494,6 +509,13 @@ class ModelRunner:
         st = SamplingTensors.build(row_params, row_seeds, row_tokens,
                                    self.vocab_size, padded_n)
 
+        lora_state = None
+        if self.lora_manager is not None:
+            row_loras = [meta_by_req[req_id].lora_request
+                         for req_id, _ in rows]
+            lora_state = self.lora_manager.set_active_loras(
+                row_loras, padded_n)
+
         num_samples = 1
         if is_prompt:
             for sp in row_params:
@@ -524,7 +546,8 @@ class ModelRunner:
                 self.params, kv_caches,
                 place(arrays["token_ids"]), place(arrays["positions"]),
                 attn_metadata, place(arrays["logits_indices"]),
-                *sampling_args, num_samples=num_samples, **common)
+                *sampling_args, lora_state, num_samples=num_samples,
+                **common)
             t1, t2 = num_samples, 1
             num_steps = 1
         else:
@@ -541,7 +564,7 @@ class ModelRunner:
                 self.params, kv_caches,
                 place(arrays["token_ids"]), place(arrays["positions"]),
                 place(arrays["block_tables"]), place(arrays["context_lens"]),
-                *sampling_args)
+                *sampling_args, lora_state)
             if num_steps == 1:
                 packed, new_caches = self._jit_decode_single(*decode_args,
                                                              **common)

@@ -67,12 +67,19 @@ class Worker:
     def load_model(self) -> None:
         self.model, host_params = get_model(self.model_config)
         self.params = shard_params(host_params, self.mesh, self.model)
+        self.lora_manager = None
+        if self.lora_config is not None:
+            from intellillm_tpu.lora.worker_manager import WorkerLoRAManager
+            self.lora_manager = WorkerLoRAManager(self.model,
+                                                  self.lora_config,
+                                                  mesh=self.mesh)
         self.model_runner = ModelRunner(self.model, self.params,
                                         self.model_config,
                                         self.scheduler_config,
                                         self.cache_config,
                                         self.parallel_config,
-                                        mesh=self.mesh)
+                                        mesh=self.mesh,
+                                        lora_manager=self.lora_manager)
 
     # --- memory profiling -------------------------------------------------
 

@@ -1,0 +1,308 @@
+"""Multi-LoRA subsystem tests.
+
+Reference test roles: `tests/lora/test_layers.py` (layer-level equivalence
+vs manually applied adapters), `test_lora_manager.py` (LRU behavior),
+`test_llama.py` (end-to-end llama + LoRA). Golden strategy here: an engine
+serving adapter X must emit the same greedy tokens as a plain engine
+serving a checkpoint with X *merged into the base weights* (W += s·BA).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from intellillm_tpu.lora.layers import lora_delta
+from intellillm_tpu.lora.models import LoRAModel, LoRAModelManager
+from intellillm_tpu.lora.request import LoRARequest
+from intellillm_tpu.sampling_params import SamplingParams
+
+TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj",
+           "down_proj")
+# tiny-llama dims (tests/conftest.py): hidden 64, 4 heads, 2 kv heads,
+# intermediate 128, 2 layers.
+_DIMS = {
+    "q_proj": (64, 64),
+    "k_proj": (64, 32),
+    "v_proj": (64, 32),
+    "o_proj": (64, 64),
+    "gate_proj": (64, 128),
+    "up_proj": (64, 128),
+    "down_proj": (128, 64),
+}
+_NUM_LAYERS = 2
+
+
+def make_adapter(out_dir: str, seed: int, rank: int, alpha: float,
+                 targets=TARGETS) -> str:
+    """Write an HF-PEFT-style adapter directory."""
+    import safetensors.numpy
+    rng = np.random.RandomState(seed)
+    tensors = {}
+    for li in range(_NUM_LAYERS):
+        for t in targets:
+            din, dout = _DIMS[t]
+            mod = "self_attn" if t.startswith(("q_", "k_", "v_", "o_")) \
+                else "mlp"
+            base = f"base_model.model.model.layers.{li}.{mod}.{t}"
+            tensors[f"{base}.lora_A.weight"] = rng.randn(
+                rank, din).astype(np.float32) * 0.1
+            tensors[f"{base}.lora_B.weight"] = rng.randn(
+                dout, rank).astype(np.float32) * 0.1
+    os.makedirs(out_dir, exist_ok=True)
+    safetensors.numpy.save_file(tensors,
+                                os.path.join(out_dir,
+                                             "adapter_model.safetensors"))
+    with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
+        json.dump({"r": rank, "lora_alpha": alpha,
+                   "target_modules": list(targets)}, f)
+    return out_dir
+
+
+def make_merged_checkpoint(base_dir: str, adapter_dir: str,
+                           out_dir: str) -> str:
+    """Base checkpoint with the adapter merged: W += (alpha/r)·B@A."""
+    import torch
+    from transformers import AutoModelForCausalLM, AutoTokenizer
+    import safetensors.numpy
+
+    model = AutoModelForCausalLM.from_pretrained(base_dir,
+                                                 torch_dtype=torch.float32)
+    with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
+        cfg = json.load(f)
+    scaling = cfg["lora_alpha"] / cfg["r"]
+    tensors = safetensors.numpy.load_file(
+        os.path.join(adapter_dir, "adapter_model.safetensors"))
+
+    sd = model.state_dict()
+    for name, arr in tensors.items():
+        if ".lora_A." not in name:
+            continue
+        b_arr = tensors[name.replace(".lora_A.", ".lora_B.")]
+        target = name.replace("base_model.model.", "").replace(
+            ".lora_A.weight", ".weight")
+        sd[target] += torch.from_numpy(
+            (scaling * (b_arr @ arr)).astype(np.float32))
+    model.load_state_dict(sd)
+    model.save_pretrained(out_dir, safe_serialization=True)
+    AutoTokenizer.from_pretrained(base_dir).save_pretrained(out_dir)
+    return out_dir
+
+
+# --- unit: the bgmv-equivalent op ---------------------------------------
+
+
+def test_lora_delta_matches_per_row_loop():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    s, din, r, dout, b, l = 3, 16, 4, 24, 5, 7
+    a = rng.randn(s, din, r).astype(np.float32)
+    bb = rng.randn(s, r, dout).astype(np.float32)
+    a[0] = 0.0
+    bb[0] = 0.0
+    x = rng.randn(b, l, din).astype(np.float32)
+    slots = np.array([0, 1, 2, 1, 0], np.int32)
+
+    out = np.asarray(lora_delta(jnp.asarray(x), jnp.asarray(a),
+                                jnp.asarray(bb), jnp.asarray(slots)))
+    for i in range(b):
+        ref = x[i] @ a[slots[i]] @ bb[slots[i]]
+        np.testing.assert_allclose(out[i], ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out[0], 0.0, atol=0)
+    np.testing.assert_allclose(out[4], 0.0, atol=0)
+
+
+# --- unit: checkpoint loading + manager ----------------------------------
+
+
+def test_lora_model_from_checkpoint(tmp_path):
+    d = make_adapter(str(tmp_path / "ad"), seed=0, rank=4, alpha=8.0)
+    lora = LoRAModel.from_local_checkpoint(d, num_layers=_NUM_LAYERS)
+    assert lora.rank == 4
+    assert set(lora.targets) == {"q", "k", "v", "o", "gate", "up", "down"}
+    a, b = lora.layers[0]["q"]
+    assert a.shape == (64, 4) and b.shape == (4, 64)
+    # B pre-scaled by alpha/r = 2.
+    raw = np.asarray(
+        __import__("safetensors.numpy", fromlist=["numpy"]).load_file(
+            os.path.join(d, "adapter_model.safetensors"))
+        ["base_model.model.model.layers.0.self_attn.q_proj.lora_B.weight"])
+    np.testing.assert_allclose(b, raw.T * 2.0, rtol=1e-6)
+
+
+def test_manager_lru_eviction(tmp_path):
+    dims = {"q": (64, 64), "v": (64, 32)}
+    mgr = LoRAModelManager(num_layers=_NUM_LAYERS, target_dims=dims,
+                           max_loras=2, max_lora_rank=8, dtype="float32")
+    loras = {}
+    for i in (1, 2, 3):
+        d = make_adapter(str(tmp_path / f"ad{i}"), seed=i, rank=4,
+                         alpha=4.0, targets=("q_proj", "v_proj"))
+        loras[i] = LoRAModel.from_local_checkpoint(d, _NUM_LAYERS)
+
+    mgr.begin_batch()
+    s1 = mgr.activate(1, loras[1])
+    s2 = mgr.activate(2, loras[2])
+    assert {s1, s2} == {1, 2}
+    # Touch 1 so 2 becomes LRU; activating 3 (in a later batch) must evict 2.
+    mgr.slot_of(1)
+    mgr.begin_batch()
+    s3 = mgr.activate(3, loras[3])
+    assert s3 == s2
+    assert mgr.is_active(1) and mgr.is_active(3) and not mgr.is_active(2)
+    # Slot content: stack row equals padded adapter weights.
+    a_dev = np.asarray(mgr.a_stacks["q"][0, s3])
+    np.testing.assert_allclose(a_dev[:, :4], loras[3].layers[0]["q"][0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(a_dev[:, 4:], 0.0, atol=0)
+    # Slot 0 stays all-zero.
+    np.testing.assert_allclose(np.asarray(mgr.a_stacks["q"][:, 0]), 0.0,
+                               atol=0)
+
+
+def test_manager_rejects_oversize_rank(tmp_path):
+    d = make_adapter(str(tmp_path / "ad"), seed=0, rank=16, alpha=16.0,
+                     targets=("q_proj", ))
+    lora = LoRAModel.from_local_checkpoint(d, _NUM_LAYERS)
+    mgr = LoRAModelManager(num_layers=_NUM_LAYERS,
+                           target_dims={"q": (64, 64)}, max_loras=1,
+                           max_lora_rank=8, dtype="float32")
+    with pytest.raises(ValueError, match="max_lora_rank"):
+        mgr.activate(1, lora)
+
+
+# --- end-to-end: engine + adapters vs merged checkpoints -----------------
+
+
+@pytest.fixture(scope="module")
+def lora_setup(tmp_path_factory):
+    """Base tiny llama + two adapters + their merged golden checkpoints."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    root = tmp_path_factory.mktemp("lora-e2e")
+    base = str(root / "base")
+    from tests.conftest import _build_word_tokenizer
+    _, vocab_size = _build_word_tokenizer(base)
+    torch.manual_seed(0)
+    config = LlamaConfig(
+        vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6, pad_token_id=0,
+        eos_token_id=1, bos_token_id=1, tie_word_embeddings=False,
+        torch_dtype=torch.float32)
+    LlamaForCausalLM(config).eval().save_pretrained(
+        base, safe_serialization=True)
+
+    ad1 = make_adapter(str(root / "ad1"), seed=11, rank=4, alpha=8.0)
+    ad2 = make_adapter(str(root / "ad2"), seed=22, rank=8, alpha=8.0,
+                       targets=("q_proj", "v_proj"))
+    merged1 = make_merged_checkpoint(base, ad1, str(root / "merged1"))
+    merged2 = make_merged_checkpoint(base, ad2, str(root / "merged2"))
+    return dict(base=base, ad1=ad1, ad2=ad2, merged1=merged1,
+                merged2=merged2)
+
+
+def _greedy_tokens(model_dir, prompts, max_tokens=8, **llm_kwargs):
+    from intellillm_tpu.entrypoints.llm import LLM
+    llm = LLM(model=model_dir, max_model_len=64,
+              num_device_blocks_override=64, **llm_kwargs)
+    params = SamplingParams(temperature=0.0, max_tokens=max_tokens)
+    outs = llm.generate(prompts, params)
+    return [o.outputs[0].token_ids for o in outs]
+
+
+def test_engine_multi_lora_concurrent(lora_setup, example_prompts):
+    """Rows with adapter 1, adapter 2, and no adapter run in the SAME
+    batch; each must match its merged-checkpoint golden."""
+    from intellillm_tpu.entrypoints.llm import LLM
+
+    prompts = example_prompts[:3]
+    golden_base = _greedy_tokens(lora_setup["base"], prompts)
+    golden_1 = _greedy_tokens(lora_setup["merged1"], prompts)
+    golden_2 = _greedy_tokens(lora_setup["merged2"], prompts)
+
+    llm = LLM(model=lora_setup["base"], max_model_len=64,
+              num_device_blocks_override=64, enable_lora=True, max_loras=2,
+              max_lora_rank=8)
+    reqs = [
+        LoRARequest("ad1", 1, lora_setup["ad1"]),
+        LoRARequest("ad2", 2, lora_setup["ad2"]),
+        None,
+    ]
+    params = SamplingParams(temperature=0.0, max_tokens=8)
+    engine = llm.llm_engine
+    for i, prompt in enumerate(prompts):
+        for j, req in enumerate(reqs):
+            engine.add_request(str(i * 10 + j), prompt, params,
+                               lora_request=req)
+    outputs = {o.request_id: o for o in llm._run_engine(use_tqdm=False)}
+
+    for i in range(len(prompts)):
+        assert outputs[str(i * 10)].outputs[0].token_ids == golden_1[i]
+        assert outputs[str(i * 10 + 1)].outputs[0].token_ids == golden_2[i]
+        assert outputs[str(i * 10 + 2)].outputs[0].token_ids == golden_base[i]
+
+
+def test_engine_lora_lru_two_adapters_one_slot(lora_setup, example_prompts):
+    """max_loras=1: serving adapter 1 then adapter 2 forces activation →
+    eviction → activation; outputs stay correct for both."""
+    from intellillm_tpu.entrypoints.llm import LLM
+
+    prompt = example_prompts[0]
+    golden_1 = _greedy_tokens(lora_setup["merged1"], [prompt])[0]
+    golden_2 = _greedy_tokens(lora_setup["merged2"], [prompt])[0]
+
+    llm = LLM(model=lora_setup["base"], max_model_len=64,
+              num_device_blocks_override=64, enable_lora=True, max_loras=1,
+              max_lora_rank=8)
+    params = SamplingParams(temperature=0.0, max_tokens=8)
+    out1 = llm.generate([prompt], params,
+                        lora_request=LoRARequest("ad1", 1,
+                                                 lora_setup["ad1"]))
+    out2 = llm.generate([prompt], params,
+                        lora_request=LoRARequest("ad2", 2,
+                                                 lora_setup["ad2"]))
+    assert out1[0].outputs[0].token_ids == golden_1
+    assert out2[0].outputs[0].token_ids == golden_2
+    mgr = llm.llm_engine.worker.lora_manager.device_manager
+    assert mgr.is_active(2) and not mgr.is_active(1)
+
+
+def test_scheduler_lora_admission_cap(lora_setup, example_prompts):
+    """With max_loras=1, requests naming 2 distinct adapters still all
+    complete (the scheduler defers, never starves)."""
+    from intellillm_tpu.entrypoints.llm import LLM
+
+    llm = LLM(model=lora_setup["base"], max_model_len=64,
+              num_device_blocks_override=64, enable_lora=True, max_loras=1,
+              max_lora_rank=8)
+    params = SamplingParams(temperature=0.0, max_tokens=4)
+    engine = llm.llm_engine
+    reqs = [LoRARequest("ad1", 1, lora_setup["ad1"]),
+            LoRARequest("ad2", 2, lora_setup["ad2"])]
+    for i, prompt in enumerate(example_prompts):
+        engine.add_request(str(i), prompt, params,
+                           lora_request=reqs[i % 2])
+    outputs = llm._run_engine(use_tqdm=False)
+    assert len(outputs) == len(example_prompts)
+    assert all(o.finished for o in outputs)
+
+
+def test_lora_request_rejected_when_disabled(lora_setup, example_prompts):
+    from intellillm_tpu.entrypoints.llm import LLM
+
+    llm = LLM(model=lora_setup["base"], max_model_len=64,
+              num_device_blocks_override=64)
+    with pytest.raises(ValueError, match="LoRA is not enabled"):
+        llm.llm_engine.add_request(
+            "0", example_prompts[0], SamplingParams(max_tokens=4),
+            lora_request=LoRARequest("ad1", 1, lora_setup["ad1"]))
+
+
+def test_lora_unsupported_model(tiny_opt_dir):
+    from intellillm_tpu.entrypoints.llm import LLM
+
+    with pytest.raises(ValueError, match="does not support LoRA"):
+        LLM(model=tiny_opt_dir, max_model_len=64,
+            num_device_blocks_override=64, enable_lora=True)
