@@ -1,0 +1,158 @@
+"""Online serving benchmark: Poisson arrivals against a live server.
+
+Role parity: reference `benchmarks/benchmark_serving.py` (async request
+generator with exponential inter-arrival gaps, per-request latency + TTFT
+percentiles, request/token throughput). Start the server first, e.g.:
+
+    python -m intellillm_tpu.entrypoints.openai.api_server --model ... &
+    python benchmarks/benchmark_serving.py --backend openai \
+        --model <model> --num-prompts 100 --request-rate 4
+
+    python -m intellillm_tpu.entrypoints.api_server --model ... &
+    python benchmarks/benchmark_serving.py --backend generate ...
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import time
+from typing import List, Optional, Tuple
+
+import aiohttp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from benchmarks.common import percentiles, sample_requests  # noqa: E402
+
+# (prompt, prompt_len, output_len) → (e2e_latency, ttft, n_chunks)
+REQUEST_LATENCIES: List[Tuple[int, int, float, float, int]] = []
+
+
+async def get_request(requests, request_rate: float):
+    for req in requests:
+        yield req
+        if request_rate == float("inf"):
+            continue
+        await asyncio.sleep(np.random.exponential(1.0 / request_rate))
+
+
+async def send_request(session: aiohttp.ClientSession, backend: str,
+                       api_url: str, model: str, prompt: str,
+                       prompt_len: int, output_len: int,
+                       best_of: int) -> None:
+    if backend == "openai":
+        payload = {
+            "model": model,
+            "prompt": prompt,
+            "max_tokens": output_len,
+            "temperature": 0.0 if best_of > 1 else 1.0,
+            "best_of": best_of,
+            "ignore_eos": True,
+            "stream": True,
+        }
+    else:  # simple /generate server
+        payload = {
+            "prompt": prompt,
+            "max_tokens": output_len,
+            "temperature": 0.0 if best_of > 1 else 1.0,
+            "best_of": best_of,
+            "ignore_eos": True,
+            "stream": True,
+        }
+    start = time.perf_counter()
+    ttft = None
+    n_chunks = 0
+    async with session.post(api_url, json=payload) as resp:
+        resp.raise_for_status()
+        async for line in resp.content:
+            if not line.strip():
+                continue
+            if ttft is None:
+                ttft = time.perf_counter() - start
+            n_chunks += 1
+    latency = time.perf_counter() - start
+    REQUEST_LATENCIES.append((prompt_len, output_len, latency, ttft or
+                              latency, n_chunks))
+
+
+async def benchmark(args, requests) -> float:
+    api_url = (f"http://{args.host}:{args.port}/v1/completions"
+               if args.backend == "openai" else
+               f"http://{args.host}:{args.port}/generate")
+    conn = aiohttp.TCPConnector(limit=0)
+    timeout = aiohttp.ClientTimeout(total=6 * 3600)
+    start = time.perf_counter()
+    async with aiohttp.ClientSession(connector=conn,
+                                     timeout=timeout) as session:
+        tasks = []
+        async for prompt, prompt_len, output_len in get_request(
+                requests, args.request_rate):
+            tasks.append(asyncio.create_task(
+                send_request(session, args.backend, api_url, args.model,
+                             prompt, prompt_len, output_len, args.best_of)))
+        await asyncio.gather(*tasks)
+    return time.perf_counter() - start
+
+
+def main(args):
+    random.seed(args.seed)
+    np.random.seed(args.seed)
+
+    from transformers import AutoTokenizer
+    tokenizer = AutoTokenizer.from_pretrained(args.tokenizer or args.model)
+
+    raw = sample_requests(args.dataset, args.num_prompts, tokenizer,
+                          args.input_len, args.output_len, len(tokenizer),
+                          args.seed)
+    requests = []
+    for prompt_ids, output_len in raw:
+        prompt = tokenizer.decode(prompt_ids, skip_special_tokens=True)
+        requests.append((prompt, len(prompt_ids), output_len))
+
+    elapsed = asyncio.run(benchmark(args, requests))
+
+    total_output = sum(o for _, _, o in requests)
+    lat = [r[2] for r in REQUEST_LATENCIES]
+    ttft = [r[3] for r in REQUEST_LATENCIES]
+    per_tok = [r[2] / max(r[1], 1) for r in REQUEST_LATENCIES]
+
+    print(f"Completed {len(REQUEST_LATENCIES)}/{len(requests)} requests "
+          f"in {elapsed:.2f} s")
+    print(f"Request throughput: {len(REQUEST_LATENCIES) / elapsed:.2f} "
+          "requests/s")
+    print(f"Output token throughput: {total_output / elapsed:.1f} tok/s")
+    print(f"Mean latency: {np.mean(lat):.3f} s  "
+          + "  ".join(f"{k}={v:.3f}s"
+                      for k, v in percentiles(lat).items()))
+    print(f"Mean TTFT: {np.mean(ttft) * 1e3:.1f} ms  "
+          + "  ".join(f"{k}={v * 1e3:.1f}ms"
+                      for k, v in percentiles(ttft).items()))
+    print(f"Mean per-output-token latency: "
+          f"{np.mean(per_tok) * 1e3:.1f} ms/tok")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="Benchmark online serving throughput/latency.")
+    parser.add_argument("--backend", type=str, default="openai",
+                        choices=["openai", "generate"])
+    parser.add_argument("--host", type=str, default="localhost")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--model", type=str, required=True,
+                        help="model name for the openai endpoint / "
+                        "tokenizer source")
+    parser.add_argument("--tokenizer", type=str, default=None)
+    parser.add_argument("--dataset", type=str, default=None)
+    parser.add_argument("--num-prompts", type=int, default=100)
+    parser.add_argument("--input-len", type=int, default=128)
+    parser.add_argument("--output-len", type=int, default=128)
+    parser.add_argument("--best-of", type=int, default=1)
+    parser.add_argument("--request-rate", type=float, default=float("inf"),
+                        help="requests/s Poisson rate; inf = send all at "
+                        "once")
+    parser.add_argument("--seed", type=int, default=0)
+    main(parser.parse_args())

@@ -1,0 +1,103 @@
+"""Paged-attention decode kernel microbenchmark.
+
+Role parity: reference `benchmarks/kernels/benchmark_paged_attention.py`
+(per-call μs over a shape grid). Compares the Pallas kernel against the
+jnp block-table-gather reference on the same inputs.
+
+Usage:
+    python benchmarks/kernels/benchmark_paged_attention.py \
+        --batch-size 32 --context-len 1024 --num-query-heads 32 \
+        --num-kv-heads 32 --head-size 128
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from intellillm_tpu.ops.attention import decode_attention_reference
+from intellillm_tpu.ops.pallas.paged_attention import paged_attention
+from intellillm_tpu.utils import cdiv
+
+
+def build_inputs(args, seed=0):
+    rng = np.random.default_rng(seed)
+    bs = args.block_size
+    max_blocks = cdiv(args.context_len, bs)
+    num_blocks = max(args.batch_size * max_blocks + 1, 128)
+
+    dt = jnp.dtype(args.dtype)
+    q = jnp.asarray(rng.standard_normal(
+        (args.batch_size, 1, args.num_query_heads, args.head_size)), dt)
+    k_cache = jnp.asarray(rng.standard_normal(
+        (num_blocks, args.num_kv_heads, bs, args.head_size)), dt)
+    v_cache = jnp.asarray(rng.standard_normal(
+        (num_blocks, args.num_kv_heads, bs, args.head_size)), dt)
+    tables = jnp.asarray(
+        rng.permutation(args.batch_size * max_blocks).reshape(
+            args.batch_size, max_blocks).astype(np.int32))
+    ctx = jnp.full((args.batch_size, ), args.context_len, jnp.int32)
+    slopes = None
+    if args.use_alibi:
+        slopes = jnp.asarray(
+            rng.standard_normal(args.num_query_heads).astype(np.float32))
+    return q, k_cache, v_cache, tables, ctx, slopes
+
+
+def timeit(fn, *args, n=50, warmup=5):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    start = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - start) / n
+
+
+def main(args):
+    q, k_cache, v_cache, tables, ctx, slopes = build_inputs(args)
+    scale = args.head_size**-0.5
+
+    pallas_fn = jax.jit(lambda *a: paged_attention(*a, scale, slopes))
+    ref_fn = jax.jit(
+        lambda *a: decode_attention_reference(*a, scale, slopes))
+
+    # Numerics check first.
+    out_p = np.asarray(pallas_fn(q, k_cache, v_cache, tables, ctx),
+                       np.float32)
+    out_r = np.asarray(ref_fn(q, k_cache, v_cache, tables, ctx), np.float32)
+    err = np.abs(out_p - out_r).max()
+    print(f"max |pallas - reference| = {err:.3e}")
+
+    t_pallas = timeit(pallas_fn, q, k_cache, v_cache, tables, ctx)
+    t_ref = timeit(ref_fn, q, k_cache, v_cache, tables, ctx)
+
+    kv_bytes = (2 * args.batch_size * cdiv(args.context_len, args.block_size)
+                * args.block_size * args.num_kv_heads * args.head_size
+                * jnp.dtype(args.dtype).itemsize)
+    print(f"pallas   : {t_pallas * 1e6:9.1f} us  "
+          f"({kv_bytes / t_pallas / 1e9:6.1f} GB/s KV read)")
+    print(f"reference: {t_ref * 1e6:9.1f} us  "
+          f"({kv_bytes / t_ref / 1e9:6.1f} GB/s KV read)")
+    print(f"speedup  : {t_ref / t_pallas:.2f}x")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="Benchmark the paged-attention decode kernel.")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--context-len", type=int, default=1024)
+    parser.add_argument("--num-query-heads", type=int, default=32)
+    parser.add_argument("--num-kv-heads", type=int, default=32)
+    parser.add_argument("--head-size", type=int, default=128)
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--dtype", type=str, default="bfloat16")
+    parser.add_argument("--use-alibi", action="store_true")
+    main(parser.parse_args())

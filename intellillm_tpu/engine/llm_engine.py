@@ -202,7 +202,8 @@ class LLMEngine:
                     "Prefix caching (prefix_pos) is not supported for "
                     "sliding-window models.")
             prefix = self.scheduler.prefix_pool.add_or_get_prefix(
-                prompt_token_ids[:prefix_pos])
+                prompt_token_ids[:prefix_pos],
+                lora_request.lora_int_id if lora_request else 0)
 
         if predicted_len is None and self.length_predictor is not None:
             try:

@@ -64,7 +64,14 @@ class LoRAModel:
             cfg = json.load(f)
         rank = int(cfg["r"])
         alpha = float(cfg.get("lora_alpha", rank))
-        scaling = alpha / rank
+        if cfg.get("alpha_pattern"):
+            raise ValueError(
+                "PEFT alpha_pattern (per-module alpha) is not supported")
+        # rsLoRA scales by alpha/sqrt(r) instead of alpha/r.
+        if cfg.get("use_rslora"):
+            scaling = alpha / (rank ** 0.5)
+        else:
+            scaling = alpha / rank
 
         st_path = os.path.join(path, "adapter_model.safetensors")
         bin_path = os.path.join(path, "adapter_model.bin")

@@ -34,6 +34,7 @@ class WorkerLoRAManager:
         self.lora_config = lora_config
         self.num_layers = model.num_layers
         self._host_cache: "OrderedDict[int, LoRAModel]" = OrderedDict()
+        self._validated_ids: set = set()
         self.device_manager = LoRAModelManager(
             num_layers=model.num_layers,
             target_dims=model.lora_target_dims(),
@@ -64,11 +65,19 @@ class WorkerLoRAManager:
         at add_request, not the whole engine step mid-batch."""
         import json
         import os
+        if req.lora_int_id in self._validated_ids:
+            return
         cfg_path = os.path.join(req.lora_local_path, "adapter_config.json")
         if not os.path.isfile(cfg_path):
             raise ValueError(
                 f"LoRA path {req.lora_local_path!r} has no "
                 "adapter_config.json")
+        if not any(
+                os.path.isfile(os.path.join(req.lora_local_path, f))
+                for f in ("adapter_model.safetensors", "adapter_model.bin")):
+            raise ValueError(
+                f"LoRA path {req.lora_local_path!r} has no adapter weights "
+                "(adapter_model.safetensors / adapter_model.bin)")
         with open(cfg_path) as f:
             cfg = json.load(f)
         rank = int(cfg.get("r", 0))
@@ -84,6 +93,7 @@ class WorkerLoRAManager:
                 raise ValueError(
                     f"Adapter targets unsupported module {mod!r} "
                     f"(supported: {sorted(supported)})")
+        self._validated_ids.add(req.lora_int_id)
 
     def set_active_loras(
         self,

@@ -13,13 +13,20 @@ from intellillm_tpu.block import BlockTable
 
 
 class Prefix:
-    """A block-aligned shared prefix of token ids."""
+    """A block-aligned shared prefix of token ids.
 
-    def __init__(self, token_ids: Sequence[int], block_size: int) -> None:
+    Keyed by (token_ids, lora_int_id): prefix KV computed under a LoRA
+    adapter carries that adapter's q/k/v deltas and must not be shared
+    with other adapters (reference keys its pool the same way).
+    """
+
+    def __init__(self, token_ids: Sequence[int], block_size: int,
+                 lora_int_id: int = 0) -> None:
         self.token_ids = tuple(token_ids)
         self.block_size = block_size
         self.length = len(token_ids)
-        self.hash = hash(self.token_ids)
+        self.lora_int_id = lora_int_id
+        self.hash = hash((self.token_ids, lora_int_id))
         assert self.length % block_size == 0
         self.block_table: Optional[BlockTable] = None
         self.computed = False
@@ -56,9 +63,10 @@ class PrefixPool:
         n = len(token_ids) // self.block_size * self.block_size
         return tuple(token_ids[:n])
 
-    def add_or_get_prefix(self, token_ids: Sequence[int]) -> Optional[Prefix]:
+    def add_or_get_prefix(self, token_ids: Sequence[int],
+                          lora_int_id: int = 0) -> Optional[Prefix]:
         token_ids = self._truncate_to_block(token_ids)
         if len(token_ids) == 0:
             return None
-        prefix = Prefix(token_ids, self.block_size)
+        prefix = Prefix(token_ids, self.block_size, lora_int_id)
         return self.prefixes.setdefault(prefix.hash, prefix)

@@ -65,15 +65,20 @@ class TokenizerGroup:
             return self.tokenizer
         lora_id = lora_request.lora_int_id
         if lora_id not in self.lora_tokenizers:
-            try:
+            import os
+            ships_tokenizer = any(
+                os.path.isfile(os.path.join(lora_request.lora_local_path, f))
+                for f in ("tokenizer.json", "tokenizer_config.json",
+                          "tokenizer.model"))
+            if ships_tokenizer:
+                # The adapter ships its own tokenizer: load it, and let a
+                # corrupt one fail loudly rather than silently mis-tokenize
+                # with the base vocab.
                 tok = get_tokenizer(lora_request.lora_local_path,
                                     **self.tokenizer_config)
-            except Exception as e:
+            else:
                 # No tokenizer shipped with the adapter → base tokenizer
                 # (reference tokenizer.py:120-130 behaves the same).
-                logger.warning(
-                    "No usable tokenizer at LoRA path %s (%s); using the "
-                    "base tokenizer", lora_request.lora_local_path, e)
                 tok = self.tokenizer
             self.lora_tokenizers[lora_id] = tok
         return self.lora_tokenizers[lora_id]

@@ -171,6 +171,34 @@ def test_manager_rejects_oversize_rank(tmp_path):
         mgr.activate(1, lora)
 
 
+def test_rslora_scaling(tmp_path):
+    d = make_adapter(str(tmp_path / "ad"), seed=0, rank=4, alpha=8.0,
+                     targets=("q_proj", ))
+    with open(os.path.join(d, "adapter_config.json")) as f:
+        cfg = json.load(f)
+    cfg["use_rslora"] = True
+    with open(os.path.join(d, "adapter_config.json"), "w") as f:
+        json.dump(cfg, f)
+    lora = LoRAModel.from_local_checkpoint(d, _NUM_LAYERS)
+    import safetensors.numpy
+    raw = safetensors.numpy.load_file(
+        os.path.join(d, "adapter_model.safetensors"))
+    b_raw = raw["base_model.model.model.layers.0.self_attn.q_proj"
+                ".lora_B.weight"]
+    np.testing.assert_allclose(lora.layers[0]["q"][1],
+                               b_raw.T * (8.0 / 2.0), rtol=1e-6)
+
+
+def test_prefix_pool_keyed_by_lora_id():
+    from intellillm_tpu.prefix import PrefixPool
+    pool = PrefixPool(block_size=4)
+    p_base = pool.add_or_get_prefix([1, 2, 3, 4], 0)
+    p_lora = pool.add_or_get_prefix([1, 2, 3, 4], 1)
+    assert p_base is not p_lora
+    assert pool.add_or_get_prefix([1, 2, 3, 4], 0) is p_base
+    assert pool.add_or_get_prefix([1, 2, 3, 4], 1) is p_lora
+
+
 # --- end-to-end: engine + adapters vs merged checkpoints -----------------
 
 
