@@ -59,6 +59,8 @@ def build_llm(args):
             kv_cache_dtype=getattr(args, "kv_cache_dtype", "auto"),
             trust_remote_code=getattr(args, "trust_remote_code", False),
             max_num_seqs=getattr(args, "max_num_seqs", 256),
+            num_device_blocks_override=getattr(args, "num_device_blocks",
+                                               None),
         )
 
     model_config = ModelConfig.from_hf_config(

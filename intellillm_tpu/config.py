@@ -120,6 +120,17 @@ class ModelConfig:
         return self.hf_config.hidden_size // self.hf_config.num_attention_heads
 
     def get_total_num_kv_heads(self) -> int:
+        # Falcon (reference config.py:235-255): the old decoder arch stores
+        # num_kv_heads == num_attention_heads in the config while the model
+        # actually runs multi-query (1 shared KV head); only the new arch
+        # honors num_kv_heads / n_head_kv.
+        if getattr(self.hf_config, "model_type", "") in (
+                "falcon", "RefinedWeb", "RefinedWebModel"):
+            if (not getattr(self.hf_config, "new_decoder_architecture",
+                            False)
+                    and getattr(self.hf_config, "multi_query", False)):
+                return 1
+            # else fall through: GQA configs carry num_kv_heads/n_head_kv.
         attrs = ("num_key_value_heads", "n_head_kv", "num_kv_heads",
                  "multi_query_group_num")
         for attr in attrs:

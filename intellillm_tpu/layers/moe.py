@@ -31,11 +31,13 @@ import jax.numpy as jnp
 from intellillm_tpu.utils import cdiv
 
 
-def _route(x: jnp.ndarray, gate_w: jnp.ndarray, top_k: int):
+def _route(x: jnp.ndarray, gate_w: jnp.ndarray, top_k: int,
+           renormalize: bool = True):
     router_logits = (x.astype(jnp.float32) @ gate_w.astype(jnp.float32))
     weights = jax.nn.softmax(router_logits, axis=-1)          # [T, N]
     topw, topi = jax.lax.top_k(weights, top_k)                # [T, K]
-    topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+    if renormalize:
+        topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
     return topw, topi
 
 
@@ -47,11 +49,12 @@ def moe_ffn_dense(
     w3: jnp.ndarray,       # [N, D, I]  (up proj per expert)
     top_k: int,
     chunk_size: int = 256,
+    renormalize: bool = True,
 ) -> jnp.ndarray:
     t, d = x.shape
     n = w1.shape[0]
 
-    topw, topi = _route(x, gate_w, top_k)
+    topw, topi = _route(x, gate_w, top_k, renormalize)
     onehot = jax.nn.one_hot(topi, n, dtype=jnp.float32)       # [T, K, N]
     combine = (topw[..., None] * onehot).sum(axis=1)          # [T, N]
 
@@ -86,12 +89,13 @@ def moe_ffn_grouped(
     w3: jnp.ndarray,       # [N, D, I]
     top_k: int,
     block: int = 512,
+    renormalize: bool = True,
 ) -> jnp.ndarray:
     t, d = x.shape
     n = w1.shape[0]
     tk = t * top_k
 
-    topw, topi = _route(x, gate_w, top_k)
+    topw, topi = _route(x, gate_w, top_k, renormalize)
 
     flat_e = topi.reshape(-1)                                  # [T*K]
     flat_w = topw.reshape(-1)                                  # [T*K]
@@ -146,6 +150,7 @@ def moe_ffn(
     w3: jnp.ndarray,
     top_k: int,
     block: int = 512,
+    renormalize: bool = True,
 ) -> jnp.ndarray:
     t = x.shape[0]
     n = w1.shape[0]
@@ -153,5 +158,7 @@ def moe_ffn(
     # plus at most one padding block per expert. Require a 2x FLOP win to
     # cover grouped's sort/scatter overhead before switching.
     if n * t > 2 * (t * top_k + n * block):
-        return moe_ffn_grouped(x, gate_w, w1, w2, w3, top_k, block=block)
-    return moe_ffn_dense(x, gate_w, w1, w2, w3, top_k)
+        return moe_ffn_grouped(x, gate_w, w1, w2, w3, top_k, block=block,
+                               renormalize=renormalize)
+    return moe_ffn_dense(x, gate_w, w1, w2, w3, top_k,
+                         renormalize=renormalize)

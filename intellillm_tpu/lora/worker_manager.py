@@ -85,6 +85,9 @@ class WorkerLoRAManager:
             raise ValueError(
                 f"LoRA rank {rank} > max_lora_rank "
                 f"{self.lora_config.max_lora_rank}")
+        if cfg.get("alpha_pattern"):
+            raise ValueError(
+                "PEFT alpha_pattern (per-module alpha) is not supported")
         from intellillm_tpu.lora.models import _PEFT_TARGET_MAP
         supported = set(self.device_manager.target_dims)
         for mod in cfg.get("target_modules") or []:

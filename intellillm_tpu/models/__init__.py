@@ -7,15 +7,24 @@ via HF config introspection.
 """
 from typing import Dict, Type
 
+from intellillm_tpu.models.baichuan import (BaiChuanForCausalLM,
+                                            BaichuanForCausalLM)
 from intellillm_tpu.models.bloom import BloomForCausalLM
+from intellillm_tpu.models.chatglm import ChatGLMForCausalLM
+from intellillm_tpu.models.deepseek import DeepseekForCausalLM
+from intellillm_tpu.models.falcon import FalconForCausalLM
 from intellillm_tpu.models.gpt2 import GPT2LMHeadModel
+from intellillm_tpu.models.gpt_bigcode import GPTBigCodeForCausalLM
 from intellillm_tpu.models.gpt_neox import GPTNeoXForCausalLM
 from intellillm_tpu.models.gptj import GPTJForCausalLM
 from intellillm_tpu.models.llama import LlamaForCausalLM
 from intellillm_tpu.models.mixtral import MixtralForCausalLM
+from intellillm_tpu.models.mpt import MPTForCausalLM
 from intellillm_tpu.models.opt import OPTForCausalLM
 from intellillm_tpu.models.phi import PhiForCausalLM
+from intellillm_tpu.models.qwen import QWenLMHeadModel
 from intellillm_tpu.models.qwen2 import Qwen2ForCausalLM
+from intellillm_tpu.models.stablelm import StableLMForCausalLM
 
 _MODEL_REGISTRY: Dict[str, Type] = {
     "LlamaForCausalLM": LlamaForCausalLM,
@@ -32,7 +41,21 @@ _MODEL_REGISTRY: Dict[str, Type] = {
     "GPTNeoXForCausalLM": GPTNeoXForCausalLM,
     "GPTJForCausalLM": GPTJForCausalLM,
     "PhiForCausalLM": PhiForCausalLM,
-    "StableLMEpochForCausalLM": LlamaForCausalLM,
+    "FalconForCausalLM": FalconForCausalLM,
+    "RWForCausalLM": FalconForCausalLM,
+    "GPTBigCodeForCausalLM": GPTBigCodeForCausalLM,
+    "MPTForCausalLM": MPTForCausalLM,
+    "MptForCausalLM": MPTForCausalLM,
+    "StableLmForCausalLM": StableLMForCausalLM,
+    "StableLMEpochForCausalLM": StableLMForCausalLM,
+    "AquilaForCausalLM": LlamaForCausalLM,      # llama recipe + naming
+    "AquilaModel": LlamaForCausalLM,
+    "BaiChuanForCausalLM": BaiChuanForCausalLM,  # 7B (rope)
+    "BaichuanForCausalLM": BaichuanForCausalLM,  # 13B (ALiBi) / Baichuan2
+    "QWenLMHeadModel": QWenLMHeadModel,
+    "ChatGLMModel": ChatGLMForCausalLM,
+    "ChatGLMForConditionalGeneration": ChatGLMForCausalLM,
+    "DeepseekForCausalLM": DeepseekForCausalLM,
 }
 
 

@@ -1,0 +1,109 @@
+"""Baichuan family (Baichuan-7B/13B, Baichuan2-7B/13B).
+
+Role parity: reference `vllm/model_executor/models/baichuan.py`
+(BaiChuanForCausalLM = 7B rope; BaichuanForCausalLM = 13B ALiBi /
+Baichuan2, selected by hidden_size) + `transformers_utils/configs/
+baichuan.py`. Llama layer recipe with a fused W_pack QKV projection,
+split into the llama q/k/v tree at load time so the whole llama compute
+path (and its sharding specs) is reused. Baichuan2's NormHead is folded
+in by normalizing lm_head rows at load (detected via its 125,696 vocab).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.alibi import get_alibi_slopes
+from intellillm_tpu.layers.attention import PagedAttention
+from intellillm_tpu.models.llama import LlamaForCausalLM, Params
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+_BAICHUAN2_VOCAB = 125696
+
+
+class BaiChuanBaseForCausalLM(LlamaForCausalLM):
+
+    # Baichuan PEFT adapters target the fused W_pack, which does not map
+    # onto the split q/k/v stacks.
+    supports_lora = False
+
+    def __init__(self, model_config: ModelConfig,
+                 position_embedding: str = "ROPE") -> None:
+        super().__init__(model_config)
+        self.position_embedding = position_embedding
+        if position_embedding == "ALIBI":
+            # No rope; ALiBi bias inside paged attention.
+            self.rope = lambda positions, q, k: (q, k)
+            self.attn = PagedAttention(
+                num_heads=self.num_heads,
+                head_size=self.head_size,
+                scale=self.head_size**-0.5,
+                num_kv_heads=self.num_kv_heads,
+                alibi_slopes=get_alibi_slopes(self.num_heads),
+            )
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if "rotary_emb.inv_freq" in name:
+                continue
+            raw[name] = arr
+
+        def W(key):
+            return cast_array(raw[key].T, self.dtype)
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        lm_head = raw["lm_head.weight"]
+        if self.config.vocab_size == _BAICHUAN2_VOCAB:
+            # Baichuan2 NormHead: inference uses the row-normalized head.
+            lm_head = lm_head / np.linalg.norm(
+                lm_head, axis=1, keepdims=True).clip(min=1e-12)
+
+        params: Params = {
+            "embed_tokens": V("model.embed_tokens.weight"),
+            "norm": V("model.norm.weight"),
+            "lm_head": cast_array(lm_head.T, self.dtype),
+            "layers": [],
+        }
+        e = self.hidden_size
+        for i in range(self.num_layers):
+            p = f"model.layers.{i}."
+            w_pack = W(p + "self_attn.W_pack.weight")   # [e, 3e]
+            params["layers"].append({
+                "input_norm": V(p + "input_layernorm.weight"),
+                "post_attn_norm": V(p + "post_attention_layernorm.weight"),
+                "q": w_pack[:, :e],
+                "k": w_pack[:, e:2 * e],
+                "v": w_pack[:, 2 * e:],
+                "o": W(p + "self_attn.o_proj.weight"),
+                "gate": W(p + "mlp.gate_proj.weight"),
+                "up": W(p + "mlp.up_proj.weight"),
+                "down": W(p + "mlp.down_proj.weight"),
+            })
+        return params
+
+
+class BaiChuanForCausalLM(BaiChuanBaseForCausalLM):
+    """Baichuan-7B (rope)."""
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        super().__init__(model_config, "ROPE")
+
+
+class BaichuanForCausalLM(BaiChuanBaseForCausalLM):
+    """Baichuan-13B and Baichuan2: hidden 4096 (7B shape) → rope, else
+    ALiBi (reference baichuan.py:306-317)."""
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        if model_config.hf_config.hidden_size == 4096:
+            super().__init__(model_config, "ROPE")
+        else:
+            super().__init__(model_config, "ALIBI")

@@ -1,0 +1,198 @@
+"""ChatGLM2/3 family (chatglm2-6b, chatglm3-6b).
+
+Role parity: reference `vllm/model_executor/models/chatglm.py` +
+`transformers_utils/configs/chatglm.py`. GLM block: RMSNorm, fused QKV
+with bias (`add_qkv_bias`) and multi-query grouping
+(`multi_query_group_num` KV heads), interleaved rotary over HALF the head
+dim (is_neox_style=False), biasless dense, SwiGLU MLP fused as
+dense_h_to_4h → [gate ++ up]. Untied output_layer.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
+                                             PagedAttention)
+from intellillm_tpu.layers.normalization import fused_add_rms_norm, rms_norm
+from intellillm_tpu.layers.rotary_embedding import get_rope
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+class ChatGLMForCausalLM:
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.num_layers
+        self.num_heads = cfg.num_attention_heads
+        self.hidden_size = cfg.hidden_size
+        self.head_size = getattr(cfg, "kv_channels",
+                                 self.hidden_size // self.num_heads)
+        self.num_kv_heads = (cfg.multi_query_group_num
+                             if getattr(cfg, "multi_query_attention", False)
+                             else self.num_heads)
+        self.ffn_hidden = cfg.ffn_hidden_size
+        self.rms_eps = getattr(cfg, "layernorm_epsilon", 1e-5)
+        self.add_qkv_bias = getattr(cfg, "add_qkv_bias", True)
+        self.post_layer_norm = getattr(cfg, "post_layer_norm", True)
+        rope_ratio = getattr(cfg, "rope_ratio", 1.0)
+        max_pos = getattr(cfg, "seq_length", 8192)
+        # GLM rotates the first half of the head dim with interleaved
+        # (GPT-J style) pairs.
+        self.rope = get_rope(self.head_size, self.head_size // 2, max_pos,
+                             10000.0 * rope_ratio, is_neox_style=False)
+        self.attn = PagedAttention(
+            num_heads=self.num_heads,
+            head_size=self.head_size,
+            scale=self.head_size**-0.5,
+            num_kv_heads=self.num_kv_heads,
+        )
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 attn_metadata):
+        h = params["embed"][input_ids]
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, cache = self._layer(lp, h, kv_caches[i], attn_metadata,
+                                   positions)
+            new_caches.append(cache)
+        if self.post_layer_norm:
+            h = rms_norm(h, params["final_norm"], self.rms_eps)
+        return h, new_caches
+
+    def _layer(self, lp, h, kv_cache, attn_metadata, positions):
+        b, l, e = h.shape
+        hq = self.num_heads * self.head_size
+        hkv = self.num_kv_heads * self.head_size
+        residual = h
+        x = rms_norm(h, lp["input_norm"], self.rms_eps)
+        qkv = x @ lp["qkv_w"]
+        if lp["qkv_b"] is not None:
+            qkv = qkv + lp["qkv_b"]
+        q = qkv[..., :hq].reshape(b, l, self.num_heads, self.head_size)
+        k = qkv[..., hq:hq + hkv].reshape(b, l, self.num_kv_heads,
+                                          self.head_size)
+        v = qkv[..., hq + hkv:].reshape(b, l, self.num_kv_heads,
+                                        self.head_size)
+        q, k = self.rope(positions, q, k)
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        h = residual + attn_out.reshape(b, l, hq) @ lp["dense"]
+
+        residual = h
+        x = rms_norm(h, lp["post_attn_norm"], self.rms_eps)
+        gate_up = x @ lp["h_to_4h"]                   # [.., 2*ffn]
+        gate, up = jnp.split(gate_up, 2, axis=-1)
+        h = residual + (_silu(gate) * up) @ lp["4h_to_h"]
+        return h, kv_cache
+
+    def compute_logits(self, params, hidden):
+        return hidden @ params["output_layer"]
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        layer = {
+            "input_norm": P(), "post_attn_norm": P(),
+            # Grouped fused QKV: replicate (KV groups don't split evenly
+            # over arbitrary tp); MLP carries the TP sharding.
+            "qkv_w": P(), "qkv_b": P(),
+            "dense": P("model", None),
+            "h_to_4h": P(None, "model"),
+            "4h_to_h": P("model", None),
+        }
+        import copy as _copy
+        return {
+            "embed": P("model", None),
+            "final_norm": P(),
+            "output_layer": P(None, "model"),
+            "layers": [_copy.deepcopy(layer)
+                       for _ in range(self.num_layers)],
+        }
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        import jax
+        dtype = jnp.dtype(self.dtype)
+        e = self.hidden_size
+        hq = self.num_heads * self.head_size
+        hkv = self.num_kv_heads * self.head_size
+        ffn = self.ffn_hidden
+        v = self.config.vocab_size
+        key = jax.random.PRNGKey(seed)
+
+        def rand(k, shape):
+            return (jax.random.normal(k, shape, jnp.float32) *
+                    0.02).astype(dtype)
+
+        keys = jax.random.split(key, self.num_layers + 2)
+        layers = []
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 4)
+            layers.append({
+                "input_norm": jnp.ones((e, ), dtype),
+                "post_attn_norm": jnp.ones((e, ), dtype),
+                "qkv_w": rand(lk[0], (e, hq + 2 * hkv)),
+                "qkv_b": (jnp.zeros((hq + 2 * hkv, ), dtype)
+                          if self.add_qkv_bias else None),
+                "dense": rand(lk[1], (hq, e)),
+                "h_to_4h": rand(lk[2], (e, 2 * ffn)),
+                "4h_to_h": rand(lk[3], (ffn, e)),
+            })
+        return {
+            "embed": rand(keys[-2], (v, e)),
+            "final_norm": jnp.ones((e, ), dtype),
+            "output_layer": rand(keys[-1], (e, v)),
+            "layers": layers,
+        }
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if "rotary_pos_emb" in name:
+                continue
+            if name.startswith("transformer."):
+                name = name[len("transformer."):]
+            raw[name] = arr
+
+        def W(key):
+            return cast_array(raw[key].T, self.dtype)
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        params: Params = {
+            "embed": V("embedding.word_embeddings.weight"),
+            "final_norm": (V("encoder.final_layernorm.weight")
+                           if self.post_layer_norm else None),
+            "output_layer": W("output_layer.weight"),
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            p = f"encoder.layers.{i}."
+            qkv_b_key = p + "self_attention.query_key_value.bias"
+            params["layers"].append({
+                "input_norm": V(p + "input_layernorm.weight"),
+                "post_attn_norm": V(p + "post_attention_layernorm.weight"),
+                "qkv_w": W(p + "self_attention.query_key_value.weight"),
+                "qkv_b": (V(qkv_b_key) if qkv_b_key in raw else None),
+                "dense": W(p + "self_attention.dense.weight"),
+                "h_to_4h": W(p + "mlp.dense_h_to_4h.weight"),
+                "4h_to_h": W(p + "mlp.dense_4h_to_h.weight"),
+            })
+        return params
+
+
+def _silu(x: jnp.ndarray) -> jnp.ndarray:
+    import jax
+    return jax.nn.silu(x)

@@ -1,0 +1,290 @@
+"""Falcon family (falcon-7b/40b/180b, falcon-rw).
+
+Role parity: reference `vllm/model_executor/models/falcon.py` +
+`transformers_utils/configs/falcon.py` (RWConfig). Three decoder
+variants, selected by config flags:
+
+- new_decoder_architecture (40b/180b): GQA; TWO input layernorms
+  (ln_attn / ln_mlp) both applied to the block input; fully parallel
+  residual out = x + attn + mlp.
+- multi_query + parallel_attn (7b): one shared KV head; single input
+  layernorm feeds both attn and mlp; parallel residual.
+- neither (falcon-rw): sequential GPT-2-style block with ALiBi.
+
+Fused QKV layouts differ per variant (per-kv-group [q·g, k, v] for the
+new arch; [q_all ++ k ++ v] for multi-query; per-head [q,k,v] interleave
+otherwise) — normalized at load/compute below.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.activation import gelu_new
+from intellillm_tpu.layers.alibi import get_alibi_slopes
+from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
+                                             PagedAttention)
+from intellillm_tpu.layers.normalization import layer_norm
+from intellillm_tpu.layers.rotary_embedding import get_rope
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+class FalconForCausalLM:
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.num_hidden_layers
+        self.num_heads = cfg.num_attention_heads
+        self.hidden_size = cfg.hidden_size
+        self.head_size = self.hidden_size // self.num_heads
+        self.new_arch = getattr(cfg, "new_decoder_architecture", False)
+        self.multi_query = getattr(cfg, "multi_query", False)
+        self.parallel_attn = getattr(cfg, "parallel_attn", True)
+        self.use_alibi = getattr(cfg, "alibi", False)
+        self.bias = getattr(cfg, "bias", False)
+        self.ln_eps = getattr(cfg, "layer_norm_epsilon", 1e-5)
+
+        if self.new_arch:
+            self.num_kv_heads = getattr(cfg, "num_kv_heads", None) or \
+                getattr(cfg, "n_head_kv", None) or self.num_heads
+        elif self.multi_query:
+            self.num_kv_heads = 1
+        else:
+            # Old RefinedWeb GQA configs carry n_head_kv without the
+            # new_decoder_architecture flag; they use the grouped layout.
+            n_head_kv = getattr(cfg, "n_head_kv", None)
+            if n_head_kv:
+                self.num_kv_heads = n_head_kv
+                self.new_arch = True
+            else:
+                self.num_kv_heads = self.num_heads
+
+        self.rope = None
+        alibi_slopes = None
+        if self.use_alibi:
+            alibi_slopes = get_alibi_slopes(self.num_heads)
+        else:
+            theta = getattr(cfg, "rope_theta", 10000.0)
+            max_pos = getattr(cfg, "max_position_embeddings", 8192)
+            self.rope = get_rope(self.head_size, self.head_size, max_pos,
+                                 theta, is_neox_style=True)
+        self.attn = PagedAttention(
+            num_heads=self.num_heads,
+            head_size=self.head_size,
+            scale=self.head_size**-0.5,
+            num_kv_heads=self.num_kv_heads,
+            alibi_slopes=alibi_slopes,
+        )
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 attn_metadata):
+        h = params["word_embeddings"][input_ids]
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, cache = self._layer(lp, h, kv_caches[i], attn_metadata,
+                                   positions)
+            new_caches.append(cache)
+        h = layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
+                       self.ln_eps)
+        return h, new_caches
+
+    def _attention(self, lp, x, kv_cache, attn_metadata, positions):
+        b, l, e = x.shape
+        qkv = x @ lp["qkv"]["w"]
+        if lp["qkv"]["b"] is not None:
+            qkv = qkv + lp["qkv"]["b"]
+        hq, hkv, d = self.num_heads, self.num_kv_heads, self.head_size
+        if self.new_arch:
+            # Per-kv-group layout [q·g, k, v].
+            g = hq // hkv
+            qkv = qkv.reshape(b, l, hkv, g + 2, d)
+            q = qkv[:, :, :, :g].reshape(b, l, hq, d)
+            k = qkv[:, :, :, g]
+            v = qkv[:, :, :, g + 1]
+        elif self.multi_query:
+            q = qkv[..., :e].reshape(b, l, hq, d)
+            k = qkv[..., e:e + d].reshape(b, l, 1, d)
+            v = qkv[..., e + d:].reshape(b, l, 1, d)
+        else:
+            # Per-head [q, k, v] interleave (bloom-style).
+            qkv = qkv.reshape(b, l, hq, 3, d)
+            q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        if self.rope is not None:
+            q, k = self.rope(positions, q, k)
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        out = attn_out.reshape(b, l, e) @ lp["dense"]["w"]
+        if lp["dense"]["b"] is not None:
+            out = out + lp["dense"]["b"]
+        return out, kv_cache
+
+    def _mlp(self, lp, x):
+        h = x @ lp["up"]["w"]
+        if lp["up"]["b"] is not None:
+            h = h + lp["up"]["b"]
+        h = gelu_new(h) @ lp["down"]["w"]
+        if lp["down"]["b"] is not None:
+            h = h + lp["down"]["b"]
+        return h
+
+    def _layer(self, lp, h, kv_cache, attn_metadata, positions):
+        residual = h
+        if self.new_arch:
+            attn_in = layer_norm(h, lp["ln_attn"]["w"], lp["ln_attn"]["b"],
+                                 self.ln_eps)
+            mlp_in = layer_norm(h, lp["ln_mlp"]["w"], lp["ln_mlp"]["b"],
+                                self.ln_eps)
+        else:
+            attn_in = layer_norm(h, lp["input_ln"]["w"], lp["input_ln"]["b"],
+                                 self.ln_eps)
+            mlp_in = attn_in  # parallel_attn; sequential overrides below
+        attn_out, kv_cache = self._attention(lp, attn_in, kv_cache,
+                                             attn_metadata, positions)
+        if not self.new_arch and not self.parallel_attn:
+            residual = residual + attn_out
+            mlp_in = layer_norm(residual, lp["post_attn_ln"]["w"],
+                                lp["post_attn_ln"]["b"], self.ln_eps)
+        mlp_out = self._mlp(lp, mlp_in)
+        if self.new_arch or self.parallel_attn:
+            mlp_out = mlp_out + attn_out
+        return residual + mlp_out, kv_cache
+
+    def compute_logits(self, params, hidden):
+        lm_head = params.get("lm_head")
+        if lm_head is None:
+            return hidden @ params["word_embeddings"].T
+        return hidden @ lm_head
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        norm = {"w": P(), "b": P()}
+        col = {"w": P(None, "model"), "b": P("model")}
+        row = {"w": P("model", None), "b": P()}
+        layer: Dict[str, Any] = {
+            # QKV: new-arch GQA shards by kv group; MQ replicates (single
+            # KV head can't split).
+            "qkv": ({"w": P(None, "model"), "b": P("model")}
+                    if self.new_arch else {"w": P(), "b": P()}),
+            "dense": dict(row),
+            "up": dict(col),
+            "down": dict(row),
+        }
+        if self.new_arch:
+            layer["ln_attn"] = dict(norm)
+            layer["ln_mlp"] = dict(norm)
+        else:
+            layer["input_ln"] = dict(norm)
+            if not self.parallel_attn:
+                layer["post_attn_ln"] = dict(norm)
+        return {
+            "word_embeddings": P("model", None),
+            "lm_head": P(None, "model"),
+            "ln_f": dict(norm),
+            "layers": [dict(layer) for _ in range(self.num_layers)],
+        }
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        import jax
+        dtype = jnp.dtype(self.dtype)
+        cfg = self.config
+        e = self.hidden_size
+        d = self.head_size
+        qkv_out = (self.num_kv_heads * (self.num_heads // self.num_kv_heads
+                                        + 2) * d if self.new_arch else
+                   (e + 2 * d if self.multi_query else 3 * e))
+        key = jax.random.PRNGKey(seed)
+
+        def rand(k, shape):
+            return (jax.random.normal(k, shape, jnp.float32) *
+                    0.02).astype(dtype)
+
+        def norm():
+            return {"w": jnp.ones((e, ), dtype), "b": jnp.zeros((e, ), dtype)}
+
+        def lin(k, din, dout):
+            return {"w": rand(k, (din, dout)),
+                    "b": jnp.zeros((dout, ), dtype) if self.bias else None}
+
+        keys = jax.random.split(key, self.num_layers + 2)
+        layers = []
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 4)
+            layer = {
+                "qkv": lin(lk[0], e, qkv_out),
+                "dense": lin(lk[1], e, e),
+                "up": lin(lk[2], e, 4 * e),
+                "down": lin(lk[3], 4 * e, e),
+            }
+            if self.new_arch:
+                layer["ln_attn"] = norm()
+                layer["ln_mlp"] = norm()
+            else:
+                layer["input_ln"] = norm()
+                if not self.parallel_attn:
+                    layer["post_attn_ln"] = norm()
+            layers.append(layer)
+        return {
+            "word_embeddings": rand(keys[-2], (cfg.vocab_size, e)),
+            "lm_head": rand(keys[-1], (e, cfg.vocab_size)),
+            "ln_f": norm(),
+            "layers": layers,
+        }
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if name.startswith("transformer."):
+                name = name[len("transformer."):]
+            raw[name] = arr
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        def norm(prefix):
+            return {"w": V(prefix + ".weight"), "b": V(prefix + ".bias")}
+
+        def lin(prefix):
+            return {"w": cast_array(raw[prefix + ".weight"].T, self.dtype),
+                    "b": (V(prefix + ".bias")
+                          if prefix + ".bias" in raw else None)}
+
+        tied = getattr(self.config, "tie_word_embeddings", True)
+        params: Params = {
+            "word_embeddings": V("word_embeddings.weight"),
+            "lm_head": (cast_array(raw["lm_head.weight"].T, self.dtype)
+                        if "lm_head.weight" in raw and not tied else None),
+            "ln_f": norm("ln_f"),
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            p = f"h.{i}."
+            layer = {
+                "qkv": lin(p + "self_attention.query_key_value"),
+                "dense": lin(p + "self_attention.dense"),
+                "up": lin(p + "mlp.dense_h_to_4h"),
+                "down": lin(p + "mlp.dense_4h_to_h"),
+            }
+            if self.new_arch:
+                layer["ln_attn"] = norm(p + "ln_attn")
+                layer["ln_mlp"] = norm(p + "ln_mlp")
+            else:
+                layer["input_ln"] = norm(p + "input_layernorm")
+                if not self.parallel_attn:
+                    layer["post_attn_ln"] = norm(
+                        p + "post_attention_layernorm")
+            layers = params["layers"]
+            layers.append(layer)
+        return params

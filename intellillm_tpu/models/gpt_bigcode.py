@@ -1,0 +1,194 @@
+"""GPT-BigCode family (starcoder, santacoder).
+
+Role parity: reference `vllm/model_executor/models/gpt_bigcode.py`.
+GPT-2-style block with multi-query attention (one shared K/V head when
+`multi_query`), learned positions, fused c_attn emitting
+[q(all heads) ++ k(1 head) ++ v(1 head)], gelu tanh MLP. Weights are
+plain Linear [out, in] (unlike GPT-2's Conv1D) — transposed on load.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.activation import get_act_fn
+from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
+                                             PagedAttention)
+from intellillm_tpu.layers.normalization import layer_norm
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+class GPTBigCodeForCausalLM:
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.n_layer
+        self.num_heads = cfg.n_head
+        self.hidden_size = cfg.n_embd
+        self.head_size = self.hidden_size // self.num_heads
+        self.multi_query = getattr(cfg, "multi_query", True)
+        self.num_kv_heads = 1 if self.multi_query else self.num_heads
+        self.ln_eps = getattr(cfg, "layer_norm_epsilon", 1e-5)
+        self.act = get_act_fn(getattr(cfg, "activation_function",
+                                      "gelu_pytorch_tanh"))
+        self.attn = PagedAttention(
+            num_heads=self.num_heads,
+            head_size=self.head_size,
+            scale=self.head_size**-0.5,
+            num_kv_heads=self.num_kv_heads,
+        )
+
+    def __call__(
+        self,
+        params: Params,
+        input_ids: jnp.ndarray,
+        positions: jnp.ndarray,
+        kv_caches: List[KVCache],
+        attn_metadata: AttentionMetadata,
+    ) -> Tuple[jnp.ndarray, List[KVCache]]:
+        h = params["wte"][input_ids] + params["wpe"][positions]
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, cache = self._layer(lp, h, kv_caches[i], attn_metadata)
+            new_caches.append(cache)
+        h = layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
+                       self.ln_eps)
+        return h, new_caches
+
+    def _layer(self, lp, h, kv_cache, attn_metadata):
+        b, l, e = h.shape
+        kvd = self.num_kv_heads * self.head_size
+        residual = h
+        h = layer_norm(h, lp["ln_1"]["w"], lp["ln_1"]["b"], self.ln_eps)
+        qkv = h @ lp["c_attn"]["w"] + lp["c_attn"]["b"]
+        if self.multi_query:
+            q = qkv[..., :e].reshape(b, l, self.num_heads, self.head_size)
+            k = qkv[..., e:e + kvd].reshape(b, l, self.num_kv_heads,
+                                            self.head_size)
+            v = qkv[..., e + kvd:].reshape(b, l, self.num_kv_heads,
+                                           self.head_size)
+        else:
+            # Non-MQ checkpoints store c_attn per-head interleaved [q,k,v]
+            # (HF modeling_gpt_bigcode: view(num_heads, 3*head_dim)).
+            qkv = qkv.reshape(b, l, self.num_heads, 3, self.head_size)
+            q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        h = attn_out.reshape(b, l, e) @ lp["c_proj"]["w"] + lp["c_proj"]["b"]
+        h = residual + h
+
+        residual = h
+        h = layer_norm(h, lp["ln_2"]["w"], lp["ln_2"]["b"], self.ln_eps)
+        h = self.act(h @ lp["c_fc"]["w"] + lp["c_fc"]["b"])
+        h = h @ lp["mlp_proj"]["w"] + lp["mlp_proj"]["b"]
+        return residual + h, kv_cache
+
+    def compute_logits(self, params: Params, hidden: jnp.ndarray):
+        return hidden @ params["wte"].T  # tied lm head
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        col = {"w": P(None, "model"), "b": P("model")}
+        row = {"w": P("model", None), "b": P()}
+        norm = {"w": P(), "b": P()}
+        layer = {
+            "ln_1": dict(norm), "ln_2": dict(norm),
+            # MQA c_attn: the single K/V head cannot shard over heads —
+            # replicate the fused projection (K/V tail is tiny), shard MLP.
+            "c_attn": {"w": P(), "b": P()},
+            "c_proj": dict(row),
+            "c_fc": dict(col), "mlp_proj": dict(row),
+        }
+        return {
+            "wte": P("model", None), "wpe": P(),
+            "ln_f": dict(norm),
+            "layers": [dict(layer) for _ in range(self.num_layers)],
+        }
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        import jax
+        dtype = jnp.dtype(self.dtype)
+        cfg = self.config
+        e = self.hidden_size
+        kvd = self.num_kv_heads * self.head_size
+        inner = getattr(cfg, "n_inner", None) or 4 * e
+        key = jax.random.PRNGKey(seed)
+
+        def rand(k, shape):
+            return (jax.random.normal(k, shape, jnp.float32) *
+                    0.02).astype(dtype)
+
+        def norm():
+            return {"w": jnp.ones((e, ), dtype), "b": jnp.zeros((e, ), dtype)}
+
+        def lin(k, din, dout):
+            return {"w": rand(k, (din, dout)),
+                    "b": jnp.zeros((dout, ), dtype)}
+
+        keys = jax.random.split(key, self.num_layers + 2)
+        layers = []
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 4)
+            layers.append({
+                "ln_1": norm(), "ln_2": norm(),
+                "c_attn": lin(lk[0], e, e + 2 * kvd),
+                "c_proj": lin(lk[1], e, e),
+                "c_fc": lin(lk[2], e, inner),
+                "mlp_proj": lin(lk[3], inner, e),
+            })
+        return {
+            "wte": rand(keys[-2], (cfg.vocab_size, e)),
+            "wpe": rand(keys[-1], (cfg.n_positions, e)),
+            "ln_f": norm(),
+            "layers": layers,
+        }
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if name.startswith("transformer."):
+                name = name[len("transformer."):]
+            if name == "lm_head.weight" or ".attn.bias" in name:
+                continue
+            raw[name] = arr
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        def norm(prefix):
+            return {"w": V(prefix + ".weight"), "b": V(prefix + ".bias")}
+
+        def lin(prefix):
+            # Plain nn.Linear [out, in] → [in, out].
+            return {"w": cast_array(raw[prefix + ".weight"].T, self.dtype),
+                    "b": V(prefix + ".bias")}
+
+        params: Params = {
+            "wte": V("wte.weight"),
+            "wpe": V("wpe.weight"),
+            "ln_f": norm("ln_f"),
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            p = f"h.{i}."
+            params["layers"].append({
+                "ln_1": norm(p + "ln_1"),
+                "ln_2": norm(p + "ln_2"),
+                "c_attn": lin(p + "attn.c_attn"),
+                "c_proj": lin(p + "attn.c_proj"),
+                "c_fc": lin(p + "mlp.c_fc"),
+                "mlp_proj": lin(p + "mlp.c_proj"),
+            })
+        return params

@@ -1,0 +1,211 @@
+"""MPT family (mpt-7b/30b, storywriter).
+
+Role parity: reference `vllm/model_executor/models/mpt.py` +
+`transformers_utils/configs/mpt.py`. ALiBi attention (no positional
+embeddings), fused Wqkv with optional clip_qkv clamp, pre-LN sequential
+block, GELU MLP with expansion_ratio, usually bias-free (`no_bias`).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
+                                             PagedAttention)
+from intellillm_tpu.layers.normalization import layer_norm
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+def mpt_alibi_slopes(num_heads: int, alibi_bias_max: int = 8) -> np.ndarray:
+    """MPT slope schedule (HF build_mpt_alibi_tensor): 2^(-i·max/P) over
+    the next power of two P, de-interleaved when P != num_heads."""
+    p2 = 2**math.ceil(math.log2(num_heads))
+    base = np.arange(1, p2 + 1, dtype=np.float64) * alibi_bias_max / p2
+    slopes = 1.0 / 2.0**base
+    if p2 != num_heads:
+        slopes = np.concatenate([slopes[1::2], slopes[::2]])[:num_heads]
+    return slopes.astype(np.float32)
+
+
+class MPTForCausalLM:
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.n_layers
+        self.num_heads = cfg.n_heads
+        self.hidden_size = cfg.d_model
+        self.head_size = self.hidden_size // self.num_heads
+        self.expansion = getattr(cfg, "expansion_ratio", 4)
+        self.no_bias = getattr(cfg, "no_bias", True)
+        attn_cfg = getattr(cfg, "attn_config", None)
+        get = (attn_cfg.get if isinstance(attn_cfg, dict)
+               else lambda k, d=None: getattr(attn_cfg, k, d))
+        self.clip_qkv = get("clip_qkv", None) if attn_cfg else None
+        if attn_cfg and get("qk_ln", False):
+            raise NotImplementedError("MPT qk_ln is not supported")
+        alibi_bias_max = (get("alibi_bias_max", 8) if attn_cfg else 8)
+        softmax_scale = (get("softmax_scale", None) if attn_cfg else None)
+        self.attn = PagedAttention(
+            num_heads=self.num_heads,
+            head_size=self.head_size,
+            scale=softmax_scale or self.head_size**-0.5,
+            num_kv_heads=self.num_heads,
+            alibi_slopes=mpt_alibi_slopes(self.num_heads, alibi_bias_max),
+        )
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 attn_metadata):
+        h = params["wte"][input_ids]
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, cache = self._layer(lp, h, kv_caches[i], attn_metadata)
+            new_caches.append(cache)
+        h = layer_norm(h, params["norm_f"]["w"], params["norm_f"]["b"],
+                       1e-5)
+        return h, new_caches
+
+    def _layer(self, lp, h, kv_cache, attn_metadata):
+        b, l, e = h.shape
+        residual = h
+        h = layer_norm(h, lp["norm_1"]["w"], lp["norm_1"]["b"], 1e-5)
+        qkv = h @ lp["wqkv"]["w"]
+        if lp["wqkv"]["b"] is not None:
+            qkv = qkv + lp["wqkv"]["b"]
+        if self.clip_qkv is not None:
+            qkv = jnp.clip(qkv, -self.clip_qkv, self.clip_qkv)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, l, self.num_heads, self.head_size)
+        k = k.reshape(b, l, self.num_heads, self.head_size)
+        v = v.reshape(b, l, self.num_heads, self.head_size)
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        h = attn_out.reshape(b, l, e) @ lp["out_proj"]["w"]
+        if lp["out_proj"]["b"] is not None:
+            h = h + lp["out_proj"]["b"]
+        h = residual + h
+
+        residual = h
+        h = layer_norm(h, lp["norm_2"]["w"], lp["norm_2"]["b"], 1e-5)
+        h = h @ lp["up"]["w"]
+        if lp["up"]["b"] is not None:
+            h = h + lp["up"]["b"]
+        h = _gelu_exact(h)
+        h = h @ lp["down"]["w"]
+        if lp["down"]["b"] is not None:
+            h = h + lp["down"]["b"]
+        return residual + h, kv_cache
+
+    def compute_logits(self, params, hidden):
+        return hidden @ params["wte"].T  # tied lm head
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        norm = {"w": P(), "b": P()}
+        col = {"w": P(None, "model"), "b": P("model")}
+        row = {"w": P("model", None), "b": P()}
+        layer = {
+            "norm_1": dict(norm), "norm_2": dict(norm),
+            "wqkv": dict(col), "out_proj": dict(row),
+            "up": dict(col), "down": dict(row),
+        }
+        return {
+            "wte": P("model", None),
+            "norm_f": dict(norm),
+            "layers": [dict(layer) for _ in range(self.num_layers)],
+        }
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        import jax
+        dtype = jnp.dtype(self.dtype)
+        cfg = self.config
+        e = self.hidden_size
+        inner = int(self.expansion * e)
+        key = jax.random.PRNGKey(seed)
+
+        def rand(k, shape):
+            return (jax.random.normal(k, shape, jnp.float32) *
+                    0.02).astype(dtype)
+
+        def norm():
+            return {"w": jnp.ones((e, ), dtype),
+                    "b": None if self.no_bias else jnp.zeros((e, ), dtype)}
+
+        def lin(k, din, dout):
+            return {"w": rand(k, (din, dout)),
+                    "b": None if self.no_bias else jnp.zeros((dout, ),
+                                                             dtype)}
+
+        keys = jax.random.split(key, self.num_layers + 1)
+        layers = []
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 4)
+            layers.append({
+                "norm_1": norm(), "norm_2": norm(),
+                "wqkv": lin(lk[0], e, 3 * e),
+                "out_proj": lin(lk[1], e, e),
+                "up": lin(lk[2], e, inner),
+                "down": lin(lk[3], inner, e),
+            })
+        return {
+            "wte": rand(keys[-1], (cfg.vocab_size, e)),
+            "norm_f": norm(),
+            "layers": layers,
+        }
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if name.startswith("transformer."):
+                name = name[len("transformer."):]
+            if name == "lm_head.weight":
+                continue
+            raw[name] = arr
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        def norm(prefix):
+            return {"w": V(prefix + ".weight"),
+                    "b": (V(prefix + ".bias")
+                          if prefix + ".bias" in raw else None)}
+
+        def lin(prefix):
+            return {"w": cast_array(raw[prefix + ".weight"].T, self.dtype),
+                    "b": (V(prefix + ".bias")
+                          if prefix + ".bias" in raw else None)}
+
+        params: Params = {
+            "wte": V("wte.weight"),
+            "norm_f": norm("norm_f"),
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            p = f"blocks.{i}."
+            params["layers"].append({
+                "norm_1": norm(p + "norm_1"),
+                "norm_2": norm(p + "norm_2"),
+                "wqkv": lin(p + "attn.Wqkv"),
+                "out_proj": lin(p + "attn.out_proj"),
+                "up": lin(p + "ffn.up_proj"),
+                "down": lin(p + "ffn.down_proj"),
+            })
+        return params
+
+
+def _gelu_exact(x: jnp.ndarray) -> jnp.ndarray:
+    """HF MptMLP uses nn.GELU(approximate='none')."""
+    import jax
+    return jax.nn.gelu(x, approximate=False)

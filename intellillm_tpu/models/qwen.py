@@ -1,0 +1,86 @@
+"""QWen v1 family (Qwen-7B/14B/72B).
+
+Role parity: reference `vllm/model_executor/models/qwen.py` +
+`transformers_utils/configs/qwen.py`. The block is the Qwen2 recipe
+(llama + QKV biases) with different naming: RMSNorms ln_1/ln_2, fused
+biased c_attn, biasless c_proj, SwiGLU mlp stored as w2 (gate) / w1 (up),
+and `config.intermediate_size` holding TWICE the actual ffn width.
+Reuses the Qwen2 compute path by splitting c_attn at load.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.models.qwen2 import Qwen2ForCausalLM
+from intellillm_tpu.models.llama import Params
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+
+class QWenLMHeadModel(Qwen2ForCausalLM):
+
+    # PEFT QWen adapters target the fused c_attn, not split q/k/v.
+    supports_lora = False
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        # Normalize the QWen-v1 config onto the Qwen2 field names the
+        # shared path reads.
+        cfg = copy.deepcopy(model_config.hf_config)
+        cfg.intermediate_size = cfg.intermediate_size // 2
+        cfg.rms_norm_eps = getattr(cfg, "layer_norm_epsilon", 1e-6)
+        cfg.num_key_value_heads = cfg.num_attention_heads
+        cfg.rope_theta = getattr(cfg, "rotary_emb_base", 10000.0)
+        mc = copy.copy(model_config)
+        mc.hf_config = cfg
+        super().__init__(mc)
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if "rotary_emb.inv_freq" in name:
+                continue
+            if name.startswith("transformer."):
+                name = name[len("transformer."):]
+            raw[name] = arr
+
+        def W(key):
+            return cast_array(raw[key].T, self.dtype)
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        params: Params = {
+            "embed_tokens": V("wte.weight"),
+            "norm": V("ln_f.weight"),
+            "lm_head": W("lm_head.weight"),
+            "layers": [],
+        }
+        e = self.hidden_size
+        for i in range(self.num_layers):
+            p = f"h.{i}."
+            c_attn_w = W(p + "attn.c_attn.weight")      # [e, 3e]
+            c_attn_b = cast_array(raw[p + "attn.c_attn.bias"], self.dtype)
+            params["layers"].append({
+                "input_norm": V(p + "ln_1.weight"),
+                "post_attn_norm": V(p + "ln_2.weight"),
+                "q": c_attn_w[:, :e],
+                "k": c_attn_w[:, e:2 * e],
+                "v": c_attn_w[:, 2 * e:],
+                "q_bias": c_attn_b[:e],
+                "k_bias": c_attn_b[e:2 * e],
+                "v_bias": c_attn_b[2 * e:],
+                "o": W(p + "attn.c_proj.weight"),
+                # QWen naming: w2 is the gate, w1 is the up projection.
+                "gate": W(p + "mlp.w2.weight"),
+                "up": W(p + "mlp.w1.weight"),
+                "down": W(p + "mlp.c_proj.weight"),
+            })
+        return params

@@ -1,0 +1,195 @@
+"""StableLM family (stablelm-2, stablelm-3b/zephyr; stablelm-epoch).
+
+Role parity: reference `vllm/model_executor/models/stablelm.py`.
+Llama-shaped block but with LayerNorm (weight+bias) instead of RMSNorm,
+partial rotary (`partial_rotary_factor` / `rope_pct`), optional QKV
+biases, SwiGLU MLP. Covers both the HF-native `StableLmForCausalLM` and
+the older trust-remote-code `StableLMEpochForCausalLM` naming.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.activation import get_act_fn
+from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
+                                             PagedAttention)
+from intellillm_tpu.layers.normalization import layer_norm
+from intellillm_tpu.layers.rotary_embedding import get_rope
+from intellillm_tpu.models.weight_utils import (cast_array,
+                                                hf_model_weights_iterator)
+
+Params = Dict[str, Any]
+
+
+class StableLMForCausalLM:
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        self.config = cfg
+        self.model_config = model_config
+        self.dtype = model_config.dtype
+        self.num_layers = cfg.num_hidden_layers
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = getattr(cfg, "num_key_value_heads",
+                                    None) or self.num_heads
+        self.hidden_size = cfg.hidden_size
+        self.head_size = self.hidden_size // self.num_heads
+        self.ln_eps = getattr(cfg, "layer_norm_eps", 1e-5)
+        self.act = get_act_fn(getattr(cfg, "hidden_act", "silu"))
+        self.use_qkv_bias = getattr(cfg, "use_qkv_bias", False)
+        rope_pct = (getattr(cfg, "partial_rotary_factor", None)
+                    or getattr(cfg, "rope_pct", 0.25))
+        rotary_dim = int(self.head_size * rope_pct)
+        self.rope = get_rope(self.head_size, rotary_dim,
+                             cfg.max_position_embeddings,
+                             getattr(cfg, "rope_theta", 10000.0),
+                             is_neox_style=True)
+        self.attn = PagedAttention(self.num_heads, self.head_size,
+                                   self.head_size**-0.5, self.num_kv_heads)
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 attn_metadata):
+        h = params["embed_tokens"][input_ids]
+        new_caches: List[KVCache] = []
+        for i in range(self.num_layers):
+            lp = params["layers"][i]
+            h, cache = self._layer(lp, h, kv_caches[i], attn_metadata,
+                                   positions)
+            new_caches.append(cache)
+        h = layer_norm(h, params["norm"]["w"], params["norm"]["b"],
+                       self.ln_eps)
+        return h, new_caches
+
+    def _proj(self, x, p):
+        out = x @ p["w"]
+        if p.get("b") is not None:
+            out = out + p["b"]
+        return out
+
+    def _layer(self, lp, h, kv_cache, attn_metadata, positions):
+        b, l, e = h.shape
+        residual = h
+        x = layer_norm(h, lp["input_ln"]["w"], lp["input_ln"]["b"],
+                       self.ln_eps)
+        q = self._proj(x, lp["q"]).reshape(b, l, self.num_heads,
+                                           self.head_size)
+        k = self._proj(x, lp["k"]).reshape(b, l, self.num_kv_heads,
+                                           self.head_size)
+        v = self._proj(x, lp["v"]).reshape(b, l, self.num_kv_heads,
+                                           self.head_size)
+        q, k = self.rope(positions, q, k)
+        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
+        h = residual + self._proj(attn_out.reshape(b, l, e), lp["o"])
+
+        residual = h
+        x = layer_norm(h, lp["post_attn_ln"]["w"], lp["post_attn_ln"]["b"],
+                       self.ln_eps)
+        gate = self._proj(x, lp["gate"])
+        up = self._proj(x, lp["up"])
+        h = residual + self._proj(self.act(gate) * up, lp["down"])
+        return h, kv_cache
+
+    def compute_logits(self, params, hidden):
+        return hidden @ params["lm_head"]
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        col = {"w": P(None, "model"), "b": P("model")}
+        row = {"w": P("model", None), "b": P()}
+        norm = {"w": P(), "b": P()}
+        layer = {"input_ln": dict(norm), "post_attn_ln": dict(norm),
+                 "q": dict(col), "k": dict(col), "v": dict(col),
+                 "o": dict(row), "gate": dict(col), "up": dict(col),
+                 "down": dict(row)}
+        return {"embed_tokens": P("model", None), "norm": dict(norm),
+                "lm_head": P(None, "model"),
+                "layers": [dict(layer) for _ in range(self.num_layers)]}
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        import jax
+        dtype = jnp.dtype(self.dtype)
+        e = self.hidden_size
+        inter = self.config.intermediate_size
+        hkv = self.num_kv_heads * self.head_size
+        v = self.config.vocab_size
+        key = jax.random.PRNGKey(seed)
+
+        def rand(k, shape):
+            return (jax.random.normal(k, shape, jnp.float32) *
+                    0.02).astype(dtype)
+
+        def norm():
+            return {"w": jnp.ones((e, ), dtype), "b": jnp.zeros((e, ), dtype)}
+
+        def lin(k, din, dout, bias=False):
+            return {"w": rand(k, (din, dout)),
+                    "b": jnp.zeros((dout, ), dtype) if bias else None}
+
+        keys = jax.random.split(key, self.num_layers + 2)
+        layers = []
+        qb = self.use_qkv_bias
+        for i in range(self.num_layers):
+            lk = jax.random.split(keys[i], 7)
+            layers.append({
+                "input_ln": norm(), "post_attn_ln": norm(),
+                "q": lin(lk[0], e, e, qb), "k": lin(lk[1], e, hkv, qb),
+                "v": lin(lk[2], e, hkv, qb), "o": lin(lk[3], e, e),
+                "gate": lin(lk[4], e, inter), "up": lin(lk[5], e, inter),
+                "down": lin(lk[6], inter, e)})
+        return {"embed_tokens": rand(keys[-2], (v, e)),
+                "norm": norm(),
+                "lm_head": rand(keys[-1], (e, v)),
+                "layers": layers}
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        raw: Dict[str, np.ndarray] = {}
+        for name, arr in hf_model_weights_iterator(model_name_or_path,
+                                                   load_format, revision):
+            if "rotary_emb" in name:
+                continue
+            raw[name] = arr
+
+        def W(key):
+            return cast_array(raw[key].T, self.dtype)
+
+        def V(key):
+            return cast_array(raw[key], self.dtype)
+
+        def norm(prefix):
+            return {"w": V(prefix + ".weight"), "b": V(prefix + ".bias")}
+
+        def lin(prefix):
+            return {"w": W(prefix + ".weight"),
+                    "b": (V(prefix + ".bias")
+                          if prefix + ".bias" in raw else None)}
+
+        tied = getattr(self.config, "tie_word_embeddings", False)
+        embed = V("model.embed_tokens.weight")
+        params: Params = {
+            "embed_tokens": embed,
+            "norm": norm("model.norm"),
+            "lm_head": (W("lm_head.weight")
+                        if "lm_head.weight" in raw and not tied
+                        else embed.T),
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            p = f"model.layers.{i}."
+            params["layers"].append({
+                "input_ln": norm(p + "input_layernorm"),
+                "post_attn_ln": norm(p + "post_attention_layernorm"),
+                "q": lin(p + "self_attn.q_proj"),
+                "k": lin(p + "self_attn.k_proj"),
+                "v": lin(p + "self_attn.v_proj"),
+                "o": lin(p + "self_attn.o_proj"),
+                "gate": lin(p + "mlp.gate_proj"),
+                "up": lin(p + "mlp.up_proj"),
+                "down": lin(p + "mlp.down_proj"),
+            })
+        return params

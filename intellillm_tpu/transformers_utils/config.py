@@ -20,6 +20,22 @@ def get_hf_config(
         return AutoConfig.from_pretrained(
             model, trust_remote_code=trust_remote_code, revision=revision)
     except ValueError as e:
+        # Trust-remote-code checkpoints (baichuan, chatglm, qwen, aquila,
+        # yi, deepseek): parse with our config shims instead of executing
+        # the checkpoint's custom code (reference configs/ registry).
+        from intellillm_tpu.transformers_utils.configs import _CONFIG_REGISTRY
+        try:
+            cfg_dict, _ = PretrainedConfig.get_config_dict(
+                model, revision=revision)
+        except Exception:
+            raise e
+        model_type = cfg_dict.get("model_type")
+        if model_type in _CONFIG_REGISTRY:
+            cls = _CONFIG_REGISTRY[model_type]
+            config, _ = cls.from_dict(
+                {k: v for k, v in cfg_dict.items() if k != "auto_map"},
+                return_unused_kwargs=True)
+            return config
         if "trust_remote_code" in str(e):
             raise RuntimeError(
                 f"Loading {model} requires trust_remote_code=True.") from e

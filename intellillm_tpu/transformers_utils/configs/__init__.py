@@ -1,0 +1,29 @@
+"""Config shims for trust-remote-code model families.
+
+Role parity: reference `vllm/transformers_utils/configs/` (aquila,
+baichuan, chatglm, falcon/RW, mpt, qwen, yi). These checkpoints ship
+their config class via `auto_map` custom code; the shims let the engine
+load them without executing remote code. Falcon/MPT need no shim here —
+current transformers versions parse them natively.
+"""
+from intellillm_tpu.transformers_utils.configs.aquila import AquilaConfig
+from intellillm_tpu.transformers_utils.configs.baichuan import BaichuanConfig
+from intellillm_tpu.transformers_utils.configs.chatglm import ChatGLMConfig
+from intellillm_tpu.transformers_utils.configs.deepseek import DeepseekConfig
+from intellillm_tpu.transformers_utils.configs.qwen import QWenConfig
+from intellillm_tpu.transformers_utils.configs.yi import YiConfig
+
+_CONFIG_REGISTRY = {
+    "aquila": AquilaConfig,
+    "baichuan": BaichuanConfig,
+    "chatglm": ChatGLMConfig,
+    "deepseek": DeepseekConfig,
+    "qwen": QWenConfig,
+    "Yi": YiConfig,
+    "yi": YiConfig,
+}
+
+__all__ = [
+    "AquilaConfig", "BaichuanConfig", "ChatGLMConfig", "DeepseekConfig",
+    "QWenConfig", "YiConfig", "_CONFIG_REGISTRY",
+]

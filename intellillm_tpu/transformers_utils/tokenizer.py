@@ -66,10 +66,11 @@ class TokenizerGroup:
         lora_id = lora_request.lora_int_id
         if lora_id not in self.lora_tokenizers:
             import os
+            # Only actual vocab files count: tokenizer_config.json alone
+            # (metadata-only commits) is not a loadable tokenizer.
             ships_tokenizer = any(
                 os.path.isfile(os.path.join(lora_request.lora_local_path, f))
-                for f in ("tokenizer.json", "tokenizer_config.json",
-                          "tokenizer.model"))
+                for f in ("tokenizer.json", "tokenizer.model", "vocab.json"))
             if ships_tokenizer:
                 # The adapter ships its own tokenizer: load it, and let a
                 # corrupt one fail loudly rather than silently mis-tokenize

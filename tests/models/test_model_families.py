@@ -49,6 +49,106 @@ def tiny_mixtral_dir(tmp_path_factory):
                   eos_token_id=1)
 
 
+@pytest.fixture(scope="session")
+def tiny_bloom_dir(tmp_path_factory):
+    from transformers import BloomConfig, BloomForCausalLM
+    return _build(tmp_path_factory, "tiny-bloom", BloomConfig,
+                  BloomForCausalLM, hidden_size=64, n_layer=2, n_head=4,
+                  bos_token_id=1, eos_token_id=1, pad_token_id=0)
+
+
+@pytest.fixture(scope="session")
+def tiny_gpt_neox_dir(tmp_path_factory):
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+    return _build(tmp_path_factory, "tiny-neox", GPTNeoXConfig,
+                  GPTNeoXForCausalLM, hidden_size=64, num_hidden_layers=2,
+                  num_attention_heads=4, intermediate_size=128,
+                  rotary_pct=0.25, max_position_embeddings=128,
+                  bos_token_id=1, eos_token_id=1)
+
+
+@pytest.fixture(scope="session")
+def tiny_gptj_dir(tmp_path_factory):
+    from transformers import GPTJConfig, GPTJForCausalLM
+    return _build(tmp_path_factory, "tiny-gptj", GPTJConfig, GPTJForCausalLM,
+                  n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
+                  n_positions=128, bos_token_id=1, eos_token_id=1)
+
+
+@pytest.fixture(scope="session")
+def tiny_phi_dir(tmp_path_factory):
+    from transformers import PhiConfig, PhiForCausalLM
+    return _build(tmp_path_factory, "tiny-phi", PhiConfig, PhiForCausalLM,
+                  hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                  num_attention_heads=4, partial_rotary_factor=0.5,
+                  max_position_embeddings=128, bos_token_id=1,
+                  eos_token_id=1, pad_token_id=0)
+
+
+@pytest.fixture(scope="session")
+def tiny_falcon_new_dir(tmp_path_factory):
+    """Falcon 40b-style: new decoder arch, GQA, parallel residual."""
+    from transformers import FalconConfig, FalconForCausalLM
+    return _build(tmp_path_factory, "tiny-falcon-new", FalconConfig,
+                  FalconForCausalLM, hidden_size=64, num_hidden_layers=2,
+                  num_attention_heads=4, num_kv_heads=2,
+                  new_decoder_architecture=True, bias=False, alibi=False,
+                  parallel_attn=True, max_position_embeddings=128,
+                  bos_token_id=1, eos_token_id=1)
+
+
+@pytest.fixture(scope="session")
+def tiny_falcon_mq_dir(tmp_path_factory):
+    """Falcon 7b-style: multi-query, single shared layernorm."""
+    from transformers import FalconConfig, FalconForCausalLM
+    return _build(tmp_path_factory, "tiny-falcon-mq", FalconConfig,
+                  FalconForCausalLM, hidden_size=64, num_hidden_layers=2,
+                  num_attention_heads=4, new_decoder_architecture=False,
+                  multi_query=True, parallel_attn=True, bias=False,
+                  alibi=False, max_position_embeddings=128,
+                  bos_token_id=1, eos_token_id=1)
+
+
+@pytest.fixture(scope="session")
+def tiny_mpt_dir(tmp_path_factory):
+    from transformers import MptConfig, MptForCausalLM
+    return _build(tmp_path_factory, "tiny-mpt", MptConfig, MptForCausalLM,
+                  d_model=64, n_heads=4, n_layers=2, expansion_ratio=4,
+                  max_seq_len=128, no_bias=True, eos_token_id=1,
+                  bos_token_id=1, pad_token_id=0)
+
+
+@pytest.fixture(scope="session")
+def tiny_gpt_bigcode_dir(tmp_path_factory):
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM
+    return _build(tmp_path_factory, "tiny-bigcode", GPTBigCodeConfig,
+                  GPTBigCodeForCausalLM, n_embd=64, n_layer=2, n_head=4,
+                  n_positions=128, multi_query=True, bos_token_id=1,
+                  eos_token_id=1, pad_token_id=0)
+
+
+@pytest.fixture(scope="session")
+def tiny_gpt_bigcode_mha_dir(tmp_path_factory):
+    """multi_query=False: c_attn is per-head [q,k,v] interleaved."""
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM
+    return _build(tmp_path_factory, "tiny-bigcode-mha", GPTBigCodeConfig,
+                  GPTBigCodeForCausalLM, n_embd=64, n_layer=2, n_head=4,
+                  n_positions=128, multi_query=False, bos_token_id=1,
+                  eos_token_id=1, pad_token_id=0)
+
+
+@pytest.fixture(scope="session")
+def tiny_stablelm_dir(tmp_path_factory):
+    from transformers import StableLmConfig, StableLmForCausalLM
+    return _build(tmp_path_factory, "tiny-stablelm", StableLmConfig,
+                  StableLmForCausalLM, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, partial_rotary_factor=0.25,
+                  max_position_embeddings=128, use_qkv_bias=True,
+                  tie_word_embeddings=False, bos_token_id=1, eos_token_id=1,
+                  pad_token_id=0)
+
+
 def _engine_generate_greedy(model_dir, prompts, max_tokens):
     from intellillm_tpu import LLM, SamplingParams
     llm = LLM(model=model_dir, dtype="float32",
@@ -87,3 +187,47 @@ def test_qwen2_matches_hf(tiny_qwen2_dir, example_prompts, hf_runner):
 
 def test_mixtral_matches_hf(tiny_mixtral_dir, example_prompts, hf_runner):
     _check_family(tiny_mixtral_dir, example_prompts, hf_runner)
+
+
+def test_bloom_matches_hf(tiny_bloom_dir, example_prompts, hf_runner):
+    _check_family(tiny_bloom_dir, example_prompts, hf_runner)
+
+
+def test_gpt_neox_matches_hf(tiny_gpt_neox_dir, example_prompts, hf_runner):
+    _check_family(tiny_gpt_neox_dir, example_prompts, hf_runner)
+
+
+def test_gptj_matches_hf(tiny_gptj_dir, example_prompts, hf_runner):
+    _check_family(tiny_gptj_dir, example_prompts, hf_runner)
+
+
+def test_phi_matches_hf(tiny_phi_dir, example_prompts, hf_runner):
+    _check_family(tiny_phi_dir, example_prompts, hf_runner)
+
+
+def test_falcon_new_arch_matches_hf(tiny_falcon_new_dir, example_prompts,
+                                    hf_runner):
+    _check_family(tiny_falcon_new_dir, example_prompts, hf_runner)
+
+
+def test_falcon_multi_query_matches_hf(tiny_falcon_mq_dir, example_prompts,
+                                       hf_runner):
+    _check_family(tiny_falcon_mq_dir, example_prompts, hf_runner)
+
+
+def test_mpt_matches_hf(tiny_mpt_dir, example_prompts, hf_runner):
+    _check_family(tiny_mpt_dir, example_prompts, hf_runner)
+
+
+def test_gpt_bigcode_matches_hf(tiny_gpt_bigcode_dir, example_prompts,
+                                hf_runner):
+    _check_family(tiny_gpt_bigcode_dir, example_prompts, hf_runner)
+
+
+def test_stablelm_matches_hf(tiny_stablelm_dir, example_prompts, hf_runner):
+    _check_family(tiny_stablelm_dir, example_prompts, hf_runner)
+
+
+def test_gpt_bigcode_mha_matches_hf(tiny_gpt_bigcode_mha_dir,
+                                    example_prompts, hf_runner):
+    _check_family(tiny_gpt_bigcode_mha_dir, example_prompts, hf_runner)
