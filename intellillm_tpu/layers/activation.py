@@ -36,7 +36,9 @@ def gelu_fast(x: jnp.ndarray) -> jnp.ndarray:
 
 
 _ACT_REGISTRY = {
-    "gelu": jax.nn.gelu,
+    # HF "gelu" is the exact erf form (torch nn.GELU default); jax's
+    # default is the tanh approximation, so pin approximate=False.
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
     "gelu_fast": gelu_fast,
     "gelu_new": gelu_new,
     "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),

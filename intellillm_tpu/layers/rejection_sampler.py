@@ -1,0 +1,103 @@
+"""Modified rejection sampling for speculative decoding.
+
+Role parity: reference `vllm/model_executor/layers/rejection_sampler.py:9`
+(RejectionSampler, 392 LoC). Algorithm (Leviathan et al. / vLLM):
+for each drafted position t with draft distribution q and target
+distribution p, accept the drafted token x_t with probability
+min(1, p(x_t)/q(x_t)); at the first rejection, sample a replacement from
+the *recovered* distribution norm(max(p - q, 0)) and stop; if all K
+drafts are accepted, append the bonus token sampled from the target
+model's K+1-th distribution. The output marginal is exactly p.
+
+TPU redesign: a pure-functional jnp implementation over the whole batch
+at once — no per-sequence host loop. All shapes static: [B, K(+1)]
+outputs with -1 marking rejected tail positions. Randomness is
+`jax.random` threefry keyed per call so the engine's seeded-sampling
+determinism story carries over. Acceptance counts are returned (not
+stored) so the engine can aggregate metrics.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-10
+
+
+def rejection_sample(
+    key: jax.Array,
+    target_probs: jnp.ndarray,     # [B, K, V] p from the target model
+    draft_probs: jnp.ndarray,      # [B, K, V] q from the draft model
+    draft_token_ids: jnp.ndarray,  # [B, K] drafted tokens
+    bonus_token_ids: jnp.ndarray,  # [B] target sample for position K
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (output_token_ids [B, K+1] int32 with -1 padding,
+    num_accepted [B] int32 — drafted tokens kept, excluding the
+    recovered/bonus token)."""
+    b, k, v = target_probs.shape
+    key_u, key_r = jax.random.split(key)
+
+    p_tok = jnp.take_along_axis(target_probs, draft_token_ids[..., None],
+                                axis=-1)[..., 0]           # [B, K]
+    q_tok = jnp.take_along_axis(draft_probs, draft_token_ids[..., None],
+                                axis=-1)[..., 0]           # [B, K]
+    u = jax.random.uniform(key_u, (b, k))
+    # u < p/q  ⇔  u*q < p (no div-by-zero; q=0 ⇒ accept iff p>0).
+    accept = u * q_tok < p_tok                              # [B, K]
+    accepted_prefix = jnp.cumprod(accept.astype(jnp.int32), axis=-1)
+    num_accepted = accepted_prefix.sum(axis=-1)             # [B]
+
+    # Recovered distribution at the first rejected position.
+    pos = jnp.minimum(num_accepted, k - 1)                  # [B]
+    p_pos = jnp.take_along_axis(target_probs, pos[:, None, None],
+                                axis=1)[:, 0]               # [B, V]
+    q_pos = jnp.take_along_axis(draft_probs, pos[:, None, None],
+                                axis=1)[:, 0]               # [B, V]
+    recovered = jnp.maximum(p_pos - q_pos, 0.0)
+    norm = recovered.sum(axis=-1, keepdims=True)
+    # Degenerate q >= p everywhere can only happen when q == p; then any
+    # sample from p is correct.
+    recovered = jnp.where(norm > _EPS, recovered / jnp.maximum(norm, _EPS),
+                          p_pos)
+    recovered_tok = jax.random.categorical(
+        key_r, jnp.log(jnp.maximum(recovered, _EPS)), axis=-1)  # [B]
+
+    # Assemble [B, K+1]: drafted prefix, then recovered-or-bonus, then -1.
+    idx = jnp.arange(k + 1)[None, :]                        # [1, K+1]
+    out = jnp.full((b, k + 1), -1, jnp.int32)
+    draft_part = jnp.pad(draft_token_ids.astype(jnp.int32), ((0, 0), (0, 1)))
+    out = jnp.where(idx < num_accepted[:, None], draft_part, out)
+    all_accepted = num_accepted == k
+    next_tok = jnp.where(all_accepted, bonus_token_ids.astype(jnp.int32),
+                         recovered_tok.astype(jnp.int32))
+    out = jnp.where(idx == num_accepted[:, None], next_tok[:, None], out)
+    return out, num_accepted
+
+
+class RejectionSampler:
+    """Thin stateful wrapper matching the reference class surface:
+    aggregates acceptance metrics across calls."""
+
+    def __init__(self) -> None:
+        self.num_draft_tokens = 0
+        self.num_accepted_tokens = 0
+        self.num_emitted_tokens = 0
+        self._jit = jax.jit(rejection_sample)
+
+    def __call__(self, key, target_probs, draft_probs, draft_token_ids,
+                 bonus_token_ids):
+        out, num_accepted = self._jit(key, target_probs, draft_probs,
+                                      draft_token_ids, bonus_token_ids)
+        k = draft_token_ids.shape[1]
+        self.num_draft_tokens += draft_token_ids.size
+        self.num_accepted_tokens += int(num_accepted.sum())
+        self.num_emitted_tokens += int((num_accepted + 1).sum())
+        return out, num_accepted
+
+    @property
+    def acceptance_rate(self) -> float:
+        if self.num_draft_tokens == 0:
+            return 0.0
+        return self.num_accepted_tokens / self.num_draft_tokens

@@ -50,6 +50,7 @@ _MODEL_REGISTRY: Dict[str, Type] = {
     "StableLMEpochForCausalLM": StableLMForCausalLM,
     "AquilaForCausalLM": LlamaForCausalLM,      # llama recipe + naming
     "AquilaModel": LlamaForCausalLM,
+    "YiForCausalLM": LlamaForCausalLM,          # llama recipe + naming
     "BaiChuanForCausalLM": BaiChuanForCausalLM,  # 7B (rope)
     "BaichuanForCausalLM": BaichuanForCausalLM,  # 13B (ALiBi) / Baichuan2
     "QWenLMHeadModel": QWenLMHeadModel,

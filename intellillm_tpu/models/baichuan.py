@@ -17,6 +17,7 @@ import numpy as np
 from intellillm_tpu.config import ModelConfig
 from intellillm_tpu.layers.alibi import get_alibi_slopes
 from intellillm_tpu.layers.attention import PagedAttention
+from intellillm_tpu.layers.quantization import quantize_int8
 from intellillm_tpu.models.llama import LlamaForCausalLM, Params
 from intellillm_tpu.models.weight_utils import (cast_array,
                                                 hf_model_weights_iterator)
@@ -55,8 +56,15 @@ class BaiChuanBaseForCausalLM(LlamaForCausalLM):
                 continue
             raw[name] = arr
 
+        def Q(w):
+            # Match llama's loader: int8-quantize matmul weights so the
+            # inherited partition_specs/qmatmul see the same {q, s} tree.
+            if self.quantization == "int8":
+                return quantize_int8(w)
+            return w
+
         def W(key):
-            return cast_array(raw[key].T, self.dtype)
+            return Q(cast_array(raw[key].T, self.dtype))
 
         def V(key):
             return cast_array(raw[key], self.dtype)
@@ -70,19 +78,20 @@ class BaiChuanBaseForCausalLM(LlamaForCausalLM):
         params: Params = {
             "embed_tokens": V("model.embed_tokens.weight"),
             "norm": V("model.norm.weight"),
-            "lm_head": cast_array(lm_head.T, self.dtype),
+            "lm_head": Q(cast_array(lm_head.T, self.dtype)),
             "layers": [],
         }
         e = self.hidden_size
         for i in range(self.num_layers):
             p = f"model.layers.{i}."
-            w_pack = W(p + "self_attn.W_pack.weight")   # [e, 3e]
+            w_pack = cast_array(raw[p + "self_attn.W_pack.weight"].T,
+                                self.dtype)                # [e, 3e]
             params["layers"].append({
                 "input_norm": V(p + "input_layernorm.weight"),
                 "post_attn_norm": V(p + "post_attention_layernorm.weight"),
-                "q": w_pack[:, :e],
-                "k": w_pack[:, e:2 * e],
-                "v": w_pack[:, 2 * e:],
+                "q": Q(w_pack[:, :e]),
+                "k": Q(w_pack[:, e:2 * e]),
+                "v": Q(w_pack[:, 2 * e:]),
                 "o": W(p + "self_attn.o_proj.weight"),
                 "gate": W(p + "mlp.gate_proj.weight"),
                 "up": W(p + "mlp.up_proj.weight"),

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.layers.activation import silu_and_mul
 from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
                                              PagedAttention)
 from intellillm_tpu.layers.normalization import fused_add_rms_norm, rms_norm
@@ -91,8 +92,7 @@ class ChatGLMForCausalLM:
         residual = h
         x = rms_norm(h, lp["post_attn_norm"], self.rms_eps)
         gate_up = x @ lp["h_to_4h"]                   # [.., 2*ffn]
-        gate, up = jnp.split(gate_up, 2, axis=-1)
-        h = residual + (_silu(gate) * up) @ lp["4h_to_h"]
+        h = residual + silu_and_mul(gate_up) @ lp["4h_to_h"]
         return h, kv_cache
 
     def compute_logits(self, params, hidden):
@@ -191,8 +191,3 @@ class ChatGLMForCausalLM:
                 "4h_to_h": W(p + "mlp.dense_4h_to_h.weight"),
             })
         return params
-
-
-def _silu(x: jnp.ndarray) -> jnp.ndarray:
-    import jax
-    return jax.nn.silu(x)

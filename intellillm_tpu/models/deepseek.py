@@ -130,8 +130,18 @@ class DeepseekForCausalLM(LlamaForCausalLM):
                 continue
             raw[name] = arr
 
-        def W(key):
+        from intellillm_tpu.layers.quantization import quantize_int8
+
+        def E(key):
+            # Expert/shared-expert weights stay full precision, matching
+            # the fp partition specs in partition_specs above.
             return cast_array(raw[key].T, self.dtype)
+
+        def W(key):
+            w = cast_array(raw[key].T, self.dtype)
+            if self.quantization == "int8":
+                return quantize_int8(w)
+            return w
 
         def V(key):
             return cast_array(raw[key], self.dtype)
@@ -158,20 +168,20 @@ class DeepseekForCausalLM(LlamaForCausalLM):
                 layer["gate_router"] = cast_array(
                     raw[m + "gate.weight"].T, "float32")
                 layer["w1"] = np.stack(
-                    [W(f"{m}experts.{j}.gate_proj.weight")
+                    [E(f"{m}experts.{j}.gate_proj.weight")
                      for j in range(n)])
                 layer["w2"] = np.stack(
-                    [W(f"{m}experts.{j}.down_proj.weight")
+                    [E(f"{m}experts.{j}.down_proj.weight")
                      for j in range(n)])
                 layer["w3"] = np.stack(
-                    [W(f"{m}experts.{j}.up_proj.weight")
+                    [E(f"{m}experts.{j}.up_proj.weight")
                      for j in range(n)])
                 if self.n_shared:
-                    layer["shared_gate"] = W(
+                    layer["shared_gate"] = E(
                         m + "shared_experts.gate_proj.weight")
-                    layer["shared_up"] = W(
+                    layer["shared_up"] = E(
                         m + "shared_experts.up_proj.weight")
-                    layer["shared_down"] = W(
+                    layer["shared_down"] = E(
                         m + "shared_experts.down_proj.weight")
             else:
                 layer["gate"] = W(p + "mlp.gate_proj.weight")

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from intellillm_tpu.config import ModelConfig
-from intellillm_tpu.layers.activation import gelu_new
+from intellillm_tpu.layers.activation import get_act_fn
 from intellillm_tpu.layers.alibi import get_alibi_slopes
 from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
                                              PagedAttention)
@@ -53,6 +53,8 @@ class FalconForCausalLM:
         self.use_alibi = getattr(cfg, "alibi", False)
         self.bias = getattr(cfg, "bias", False)
         self.ln_eps = getattr(cfg, "layer_norm_epsilon", 1e-5)
+        # HF Falcon uses exact-erf GELU (config.activation default "gelu").
+        self.act = get_act_fn(getattr(cfg, "activation", "gelu"))
 
         if self.new_arch:
             self.num_kv_heads = getattr(cfg, "num_kv_heads", None) or \
@@ -132,7 +134,7 @@ class FalconForCausalLM:
         h = x @ lp["up"]["w"]
         if lp["up"]["b"] is not None:
             h = h + lp["up"]["b"]
-        h = gelu_new(h) @ lp["down"]["w"]
+        h = self.act(h) @ lp["down"]["w"]
         if lp["down"]["b"] is not None:
             h = h + lp["down"]["b"]
         return h

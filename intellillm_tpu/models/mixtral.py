@@ -17,7 +17,7 @@ from intellillm_tpu.config import ModelConfig
 from intellillm_tpu.layers.attention import KVCache
 from intellillm_tpu.layers.moe import moe_ffn
 from intellillm_tpu.layers.normalization import fused_add_rms_norm, rms_norm
-from intellillm_tpu.layers.quantization import qmatmul
+from intellillm_tpu.layers.quantization import qmatmul, quantize_int8
 from intellillm_tpu.models.llama import LlamaForCausalLM, Params
 from intellillm_tpu.models.weight_utils import (cast_array,
                                                 hf_model_weights_iterator)
@@ -112,8 +112,17 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 continue
             raw[name] = arr
 
-        def W(key):
+        def E(key):
+            # Expert weights stay full precision (stacked 3D; int8 MoE
+            # expert quantization is a follow-up) — matches the fp
+            # partition specs set in partition_specs above.
             return cast_array(raw[key].T, self.dtype)
+
+        def W(key):
+            w = cast_array(raw[key].T, self.dtype)
+            if self.quantization == "int8":
+                return quantize_int8(w)
+            return w
 
         def V(key):
             return cast_array(raw[key], self.dtype)
@@ -137,11 +146,11 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 "o": W(lp + "self_attn.o_proj.weight"),
                 "gate_router": cast_array(raw[moe + "gate.weight"].T,
                                           "float32"),
-                "w1": np.stack([W(f"{moe}experts.{j}.w1.weight")
+                "w1": np.stack([E(f"{moe}experts.{j}.w1.weight")
                                 for j in range(n)]),
-                "w2": np.stack([W(f"{moe}experts.{j}.w2.weight")
+                "w2": np.stack([E(f"{moe}experts.{j}.w2.weight")
                                 for j in range(n)]),
-                "w3": np.stack([W(f"{moe}experts.{j}.w3.weight")
+                "w3": np.stack([E(f"{moe}experts.{j}.w3.weight")
                                 for j in range(n)]),
             }
             params["layers"].append(layer)

@@ -51,8 +51,15 @@ class QWenLMHeadModel(Qwen2ForCausalLM):
                 name = name[len("transformer."):]
             raw[name] = arr
 
+        from intellillm_tpu.layers.quantization import quantize_int8
+
+        def Q(w):
+            if self.quantization == "int8":
+                return quantize_int8(w)
+            return w
+
         def W(key):
-            return cast_array(raw[key].T, self.dtype)
+            return Q(cast_array(raw[key].T, self.dtype))
 
         def V(key):
             return cast_array(raw[key], self.dtype)
@@ -66,14 +73,15 @@ class QWenLMHeadModel(Qwen2ForCausalLM):
         e = self.hidden_size
         for i in range(self.num_layers):
             p = f"h.{i}."
-            c_attn_w = W(p + "attn.c_attn.weight")      # [e, 3e]
+            c_attn_w = cast_array(raw[p + "attn.c_attn.weight"].T,
+                                  self.dtype)           # [e, 3e]
             c_attn_b = cast_array(raw[p + "attn.c_attn.bias"], self.dtype)
             params["layers"].append({
                 "input_norm": V(p + "ln_1.weight"),
                 "post_attn_norm": V(p + "ln_2.weight"),
-                "q": c_attn_w[:, :e],
-                "k": c_attn_w[:, e:2 * e],
-                "v": c_attn_w[:, 2 * e:],
+                "q": Q(c_attn_w[:, :e]),
+                "k": Q(c_attn_w[:, e:2 * e]),
+                "v": Q(c_attn_w[:, 2 * e:]),
                 "q_bias": c_attn_b[:e],
                 "k_bias": c_attn_b[e:2 * e],
                 "v_bias": c_attn_b[2 * e:],

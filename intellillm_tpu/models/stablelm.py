@@ -41,6 +41,12 @@ class StableLMForCausalLM:
         self.ln_eps = getattr(cfg, "layer_norm_eps", 1e-5)
         self.act = get_act_fn(getattr(cfg, "hidden_act", "silu"))
         self.use_qkv_bias = getattr(cfg, "use_qkv_bias", False)
+        if getattr(cfg, "qk_layernorm", False):
+            raise NotImplementedError(
+                "StableLM qk_layernorm is not supported yet")
+        if getattr(cfg, "use_parallel_residual", False):
+            raise NotImplementedError(
+                "StableLM use_parallel_residual is not supported yet")
         rope_pct = (getattr(cfg, "partial_rotary_factor", None)
                     or getattr(cfg, "rope_pct", 0.25))
         rotary_dim = int(self.head_size * rope_pct)

@@ -1,0 +1,4 @@
+from intellillm_tpu.worker.spec_decode.multi_step_worker import (
+    MultiStepWorker)
+
+__all__ = ["MultiStepWorker"]

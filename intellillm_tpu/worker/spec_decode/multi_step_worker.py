@@ -1,0 +1,89 @@
+"""Draft-model multi-step worker (speculative-decoding scaffold).
+
+Role parity: reference `vllm/worker/spec_decode/multi_step_worker.py:22`
+(MultiStepWorker: run the draft model N steps per call, appending the
+sampled tokens locally; no scheduler integration yet — same scaffold
+status as the reference). TPU twist: the reference loops N single-step
+model calls on host; here the fused K-step decode program
+(`ModelRunner._decode_fn`) produces all N draft tokens in ONE device
+call — the scan feeds each sampled token into the next substep on
+device, which is exactly the draft-model inner loop.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List
+
+from intellillm_tpu.sequence import (SamplerOutput, SequenceGroupMetadata)
+from intellillm_tpu.worker.worker import Worker
+
+
+class MultiStepWorker(Worker):
+
+    def execute_model_multi_step(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        blocks_to_swap_in: Dict[int, int],
+        blocks_to_swap_out: Dict[int, int],
+        blocks_to_copy: Dict[int, List[int]],
+        num_steps: int,
+    ) -> List[SamplerOutput]:
+        """Run the model `num_steps` decode steps, locally appending each
+        step's sampled token. Returns one SamplerOutput per step."""
+        self._assert_all_decode(seq_group_metadata_list)
+        self._assert_enough_kv_space(seq_group_metadata_list, num_steps)
+        # Shallow-copy the metadata so local appends can't corrupt the
+        # scheduler's sequence state (reference _shallow_copy_inputs :82).
+        copied = self._shallow_copy_inputs(seq_group_metadata_list)
+
+        outputs = self.execute_model(copied, blocks_to_swap_in,
+                                     blocks_to_swap_out, blocks_to_copy,
+                                     num_decode_steps=num_steps)
+        assert len(outputs) == num_steps
+        # Mirror the device-side appends into the copied host state so the
+        # caller can read the drafted continuations.
+        for step_output in outputs:
+            for meta, group_output in zip(copied, step_output):
+                for sample in group_output.samples:
+                    data = meta.seq_data[sample.parent_seq_id]
+                    data.append_token_id(sample.output_token,
+                                         sample.logprobs.get(
+                                             sample.output_token, 0.0))
+        return outputs
+
+    @staticmethod
+    def _assert_all_decode(
+            seq_group_metadata_list: List[SequenceGroupMetadata]) -> None:
+        for meta in seq_group_metadata_list:
+            assert not meta.is_prompt, (
+                "MultiStepWorker only supports decode steps")
+
+    @staticmethod
+    def _shallow_copy_inputs(
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+    ) -> List[SequenceGroupMetadata]:
+        copied: List[SequenceGroupMetadata] = []
+        for meta in seq_group_metadata_list:
+            meta = copy.copy(meta)
+            meta.seq_data = {seq_id: copy.deepcopy(data)
+                             for seq_id, data in meta.seq_data.items()}
+            copied.append(meta)
+        return copied
+
+    def _assert_enough_kv_space(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        num_steps: int,
+    ) -> None:
+        """Every sequence's block table must already cover its length plus
+        num_steps new tokens (reference :125 — the scheduler/caller is
+        responsible for reserving the slots)."""
+        block_size = self.cache_config.block_size
+        for meta in seq_group_metadata_list:
+            for seq_id, data in meta.seq_data.items():
+                table = meta.block_tables[seq_id]
+                needed = (data.get_len() + num_steps + block_size -
+                          1) // block_size
+                assert len(table) >= needed, (
+                    f"seq {seq_id}: block table covers {len(table)} blocks,"
+                    f" needs {needed} for {num_steps} draft steps")

@@ -1,0 +1,116 @@
+"""Rejection-sampler distribution tests.
+
+Reference: `tests/samplers/test_rejection_sampler.py` (distribution-level
+property tests). The key property (Leviathan et al.): the marginal of the
+emitted token at each position equals the target distribution p,
+regardless of the draft q; the expected acceptance rate per position is
+sum_x min(p(x), q(x)).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from intellillm_tpu.layers.rejection_sampler import (RejectionSampler,
+                                                     rejection_sample)
+
+
+def _rand_dist(rng, shape):
+    logits = rng.standard_normal(shape) * 1.5
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    return (e / e.sum(-1, keepdims=True)).astype(np.float32)
+
+
+def _run_many(target, draft, draft_ids_sampler, n_trials, k, v, seed=0):
+    """Vectorize trials through the batch dimension."""
+    rng = np.random.default_rng(seed)
+    tp = jnp.asarray(np.broadcast_to(target, (n_trials, k, v)))
+    # Draft tokens sampled fresh from q per trial.
+    draft_ids = np.stack(
+        [draft_ids_sampler(rng) for _ in range(n_trials)])      # [N, K]
+    dp = jnp.asarray(np.broadcast_to(draft, (n_trials, k, v)))
+    bonus = jnp.asarray(
+        rng.integers(0, v, size=n_trials).astype(np.int32))
+    out, num_accepted = jax.jit(rejection_sample)(
+        jax.random.PRNGKey(seed), tp, dp,
+        jnp.asarray(draft_ids.astype(np.int32)), bonus)
+    return np.asarray(out), np.asarray(num_accepted)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_first_position_marginal_matches_target(seed):
+    """Empirical distribution of the first emitted token ≈ p[0]."""
+    rng = np.random.default_rng(seed)
+    k, v, n = 3, 8, 60000
+    target = _rand_dist(rng, (k, v))
+    draft = _rand_dist(rng, (k, v))
+
+    def sample_draft(r):
+        return np.array([r.choice(v, p=draft[t]) for t in range(k)])
+
+    out, _ = _run_many(target, draft, sample_draft, n, k, v, seed)
+    first = out[:, 0]
+    assert (first >= 0).all()
+    emp = np.bincount(first, minlength=v) / n
+    np.testing.assert_allclose(emp, target[0], atol=0.015)
+
+
+def test_acceptance_rate_matches_theory():
+    rng = np.random.default_rng(3)
+    k, v, n = 1, 16, 60000
+    target = _rand_dist(rng, (k, v))
+    draft = _rand_dist(rng, (k, v))
+
+    def sample_draft(r):
+        return np.array([r.choice(v, p=draft[0])])
+
+    _, num_accepted = _run_many(target, draft, sample_draft, n, k, v)
+    expected = np.minimum(target[0], draft[0]).sum()
+    assert abs(num_accepted.mean() - expected) < 0.01
+
+
+def test_identical_distributions_accept_everything():
+    rng = np.random.default_rng(5)
+    k, v, n = 4, 8, 2000
+    target = _rand_dist(rng, (k, v))
+
+    def sample_draft(r):
+        return np.array([r.choice(v, p=target[t]) for t in range(k)])
+
+    out, num_accepted = _run_many(target, target, sample_draft, n, k, v)
+    assert (num_accepted == k).all()
+    # Bonus token present at position k, no -1 anywhere.
+    assert (out >= 0).all()
+
+
+def test_disjoint_support_rejects_and_recovers_target():
+    """Draft mass entirely where p = 0 → always reject at position 0 and
+    the replacement is drawn exactly from p."""
+    k, v, n = 2, 8, 60000
+    target = np.zeros((k, v), np.float32)
+    target[:, :4] = 0.25
+    draft = np.zeros((k, v), np.float32)
+    draft[:, 4:] = 0.25
+
+    def sample_draft(r):
+        return r.integers(4, 8, size=k)
+
+    out, num_accepted = _run_many(target, draft, sample_draft, n, k, v)
+    assert (num_accepted == 0).all()
+    assert (out[:, 1:] == -1).all()
+    emp = np.bincount(out[:, 0], minlength=v) / n
+    np.testing.assert_allclose(emp, target[0], atol=0.015)
+
+
+def test_sampler_wrapper_metrics():
+    rng = np.random.default_rng(7)
+    b, k, v = 32, 4, 8
+    sampler = RejectionSampler()
+    tp = jnp.asarray(_rand_dist(rng, (b, k, v)))
+    dp = jnp.asarray(_rand_dist(rng, (b, k, v)))
+    ids = jnp.asarray(rng.integers(0, v, size=(b, k)).astype(np.int32))
+    bonus = jnp.asarray(rng.integers(0, v, size=b).astype(np.int32))
+    out, num_accepted = sampler(jax.random.PRNGKey(0), tp, dp, ids, bonus)
+    assert sampler.num_draft_tokens == b * k
+    assert 0.0 <= sampler.acceptance_rate <= 1.0
+    assert sampler.num_emitted_tokens == int((num_accepted + 1).sum())

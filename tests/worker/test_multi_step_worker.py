@@ -1,0 +1,116 @@
+"""MultiStepWorker equivalence test.
+
+Reference: `tests/worker/spec_decode/test_multi_step_worker.py` — N fused
+draft steps must produce exactly the tokens that N successive single-step
+calls produce, and must not mutate the caller's sequence state.
+"""
+import copy
+
+import numpy as np
+import pytest
+
+from intellillm_tpu.config import (CacheConfig, ModelConfig, ParallelConfig,
+                                   SchedulerConfig)
+from intellillm_tpu.sampling_params import SamplingParams
+from intellillm_tpu.sequence import SequenceData, SequenceGroupMetadata
+from intellillm_tpu.worker.spec_decode import MultiStepWorker
+
+NUM_STEPS = 4
+PROMPTS = [[5, 9, 2, 7, 1, 3], [11, 4, 8]]
+
+
+def _make_worker():
+    from transformers import LlamaConfig
+
+    hf = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, max_position_embeddings=128,
+                     tie_word_embeddings=False)
+    model_config = ModelConfig.from_hf_config(hf, dtype="float32",
+                                              max_model_len=128,
+                                              load_format="dummy")
+    cache_config = CacheConfig(block_size=16,
+                               num_device_blocks_override=64,
+                               swap_space_gib=0.01)
+    cache_config.num_device_blocks = 64
+    cache_config.num_cpu_blocks = 4
+    scheduler_config = SchedulerConfig(max_num_batched_tokens=2048,
+                                       max_num_seqs=8, max_model_len=128,
+                                       max_paddings=512,
+                                       num_decode_steps=NUM_STEPS)
+    worker = MultiStepWorker(model_config, ParallelConfig(),
+                             scheduler_config, cache_config)
+    worker.init_model()
+    worker.load_model()
+    worker.init_cache_engine(cache_config)
+    return worker
+
+
+def _metadata(prompts_out, is_prompt):
+    """prompts_out: list of (prompt_ids, output_ids)."""
+    params = SamplingParams(temperature=0.0, max_tokens=32, ignore_eos=True)
+    metas = []
+    for i, (prompt, out) in enumerate(prompts_out):
+        data = SequenceData(list(prompt))
+        for t in out:
+            data.append_token_id(t, 0.0)
+        metas.append(SequenceGroupMetadata(
+            request_id=str(i), is_prompt=is_prompt, seq_data={i: data},
+            sampling_params=params,
+            block_tables={i: [2 * i, 2 * i + 1]}))
+    return metas
+
+
+def _prefill(worker):
+    outs = worker.execute_model(_metadata([(p, []) for p in PROMPTS], True),
+                                {}, {}, {})
+    return [out.samples[0].output_token for out in outs[0]]
+
+
+def test_multi_step_matches_single_steps():
+    worker = _make_worker()
+    first = _prefill(worker)
+    state = [(p, [t]) for p, t in zip(PROMPTS, first)]
+
+    # N successive single-step decodes.
+    single_state = copy.deepcopy(state)
+    for _ in range(NUM_STEPS):
+        outs = worker.execute_model(_metadata(single_state, False),
+                                    {}, {}, {}, num_decode_steps=1)
+        for i, group in enumerate(outs[0]):
+            single_state[i][1].append(group.samples[0].output_token)
+
+    # Fresh worker (fresh KV pool) replaying prefill, then one fused call.
+    worker2 = _make_worker()
+    first2 = _prefill(worker2)
+    assert first2 == first
+    metas = _metadata(state, False)
+    outs = worker2.execute_model_multi_step(metas, {}, {}, {},
+                                            num_steps=NUM_STEPS)
+    assert len(outs) == NUM_STEPS
+    multi_tokens = [[step[i].samples[0].output_token for step in outs]
+                    for i in range(len(PROMPTS))]
+    single_tokens = [s[1][1:] for s in single_state]
+    assert multi_tokens == single_tokens
+
+    # execute_model_multi_step appends into its internal copies only; the
+    # caller's sequence state must be untouched.
+    for i, meta in enumerate(metas):
+        assert meta.seq_data[i].get_output_len() == 1
+
+
+def test_multi_step_rejects_prompt_batches():
+    worker = _make_worker()
+    with pytest.raises(AssertionError, match="decode"):
+        worker.execute_model_multi_step(
+            _metadata([(p, []) for p in PROMPTS], True), {}, {}, {},
+            num_steps=2)
+
+
+def test_multi_step_asserts_kv_space():
+    worker = _make_worker()
+    first = _prefill(worker)
+    state = [(p, [t]) for p, t in zip(PROMPTS, first)]
+    metas = _metadata(state, False)
+    with pytest.raises(AssertionError, match="block table"):
+        worker.execute_model_multi_step(metas, {}, {}, {}, num_steps=30)
