@@ -83,19 +83,32 @@ class ModelConfig:
                 "must be 'auto' or 'slow'.")
 
     _SUPPORTED_QUANT = ("awq", "gptq", "squeezellm", "int8")
-    # Methods with a working TPU checkpoint loader; the rest are recognized
-    # (reference parity) but rejected with a clear error until their loader
-    # lands. Single source of truth: extend THIS tuple when adding a loader.
-    _LOADABLE_QUANT = ("int8", )
+    # Methods with a working TPU checkpoint loader (weight_utils.load_linear):
+    # AWQ converts losslessly to the device int4 representation; GPTQ and
+    # SqueezeLLM dequantize-on-load to per-channel int8.
+    _LOADABLE_QUANT = ("int8", "awq", "gptq", "squeezellm")
 
     def _verify_quantization(self) -> None:
         if self.quantization is None:
             # Auto-detect from checkpoint config (reference config.py:166-184).
             hf_q = getattr(self.hf_config, "quantization_config", None)
             if hf_q is not None:
-                method = hf_q.get("quant_method", None) if isinstance(hf_q, dict) else None
+                if isinstance(hf_q, dict):
+                    method = hf_q.get("quant_method", None)
+                else:  # transformers may parse it into a *QuantConfig object
+                    method = getattr(hf_q, "quant_method", None)
+                # QuantizationMethod enum: use .value, not str(enum).
+                method = getattr(method, "value", method)
                 if method is not None:
                     self.quantization = str(method).lower()
+                bits = (hf_q.get("bits", hf_q.get("w_bit"))
+                        if isinstance(hf_q, dict) else
+                        getattr(hf_q, "bits", getattr(hf_q, "w_bit", None)))
+                if (self.quantization in ("awq", "gptq", "squeezellm")
+                        and bits is not None and int(bits) != 4):
+                    raise NotImplementedError(
+                        f"{self.quantization} with {bits}-bit weights is "
+                        "not supported (only 4-bit)")
         if self.quantization is not None and self.quantization not in self._SUPPORTED_QUANT:
             raise ValueError(
                 f"Unknown quantization method: {self.quantization}; "

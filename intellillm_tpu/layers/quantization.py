@@ -1,25 +1,36 @@
 """Weight quantization for TPU.
 
 Role parity: reference `vllm/model_executor/layers/quantization/` (AWQ
-:12 / GPTQ / SqueezeLLM int4-LUT CUDA kernels, `csrc/quantization/*`).
-TPU redesign: the CUDA packing formats are GPU-layout-specific; the
-TPU-native scheme is per-output-channel symmetric int8 ("int8" method)
-computed at load time from any fp checkpoint. The mixed-precision
-`lax.dot_general(bf16, int8)` lets XLA feed int8 weight tiles straight to
-the MXU without materializing a dequantized copy in HBM — weights take
-half the space of bf16, which is what fits Llama-2-7B on a single 16 GiB
-v5e chip. AWQ/GPTQ checkpoint *loading* (dequantize-on-load to this
-representation) plugs in at weight_utils level.
+awq.py:12 / GPTQ gptq.py / SqueezeLLM squeezellm.py + CUDA kernels under
+`csrc/quantization/*`). TPU redesign — two device representations:
+
+- "int8": per-output-channel symmetric int8 computed at load from any fp
+  checkpoint. Mixed-precision `lax.dot_general(bf16, int8)` feeds int8
+  weight tiles straight to the MXU without a dequantized HBM copy.
+- int4 ({"q4","s4","z4"}): group-wise asymmetric 4-bit along the input
+  dim, two nibbles per uint8 — the SAME affine scheme AWQ/GPTQ
+  checkpoints store, so their tensors convert losslessly (no re-rounding)
+  at load; dequant happens inside the matmul's operand fusion.
+
+Checkpoint converters (`awq_unpack` / `gptq_unpack` /
+`squeezellm_dequantize`) replace the reference's CUDA dequant kernels
+(`csrc/quantization/awq/gemm_kernels.cu`, `gptq/q_gemm.cu`,
+`squeezellm/quant_cuda_kernel.cu`): AWQ loads to int4 exactly; GPTQ
+dequantizes then requantizes to int8 (uniform handling of act-order
+g_idx); SqueezeLLM's non-uniform LUT dequantizes to int8.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Union
+from typing import Any, Dict, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 QuantizedWeight = Dict[str, jnp.ndarray]  # {"q": int8 [in,out], "s": f32 [out]}
+
+# AWQ nibble order within each packed int32 (AWQ repo pack order).
+_AWQ_ORDER = (0, 2, 4, 6, 1, 3, 5, 7)
 
 
 def quantize_int8(w: np.ndarray) -> QuantizedWeight:
@@ -41,20 +52,140 @@ def quantize_int8_jax(w: jnp.ndarray) -> QuantizedWeight:
 
 
 def is_quantized(w: Any) -> bool:
-    return isinstance(w, dict) and "q" in w and "s" in w
+    return isinstance(w, dict) and (("q" in w and "s" in w) or "q4" in w)
+
+
+# --- int4 (group-wise asymmetric, AWQ/GPTQ-compatible) -------------------
+
+
+def pack_int4(q: np.ndarray, zeros: np.ndarray,
+              scales: np.ndarray) -> QuantizedWeight:
+    """q uint4-valued [in, out], zeros/scales [in/group, out] →
+    {"q4": uint8 [in/2, out], "s4": f32, "z4": f32}. Row 2i is the low
+    nibble of packed row i."""
+    in_, out = q.shape
+    assert in_ % 2 == 0
+    q = q.astype(np.uint8)
+    q4 = (q[0::2] | (q[1::2] << 4)).astype(np.uint8)
+    return {"q4": q4, "s4": scales.astype(np.float32),
+            "z4": zeros.astype(np.float32)}
+
+
+def quantize_int4(w: np.ndarray, group_size: int = 128) -> QuantizedWeight:
+    """Group-wise asymmetric int4 quantization of a fp [in, out] weight
+    (for dummy weights / fp checkpoints served with an int4 method)."""
+    wf = np.asarray(w, np.float32)
+    in_, out = wf.shape
+    if in_ % group_size != 0:
+        group_size = in_
+    g = in_ // group_size
+    wg = wf.reshape(g, group_size, out)
+    wmin = wg.min(axis=1)                               # [g, out]
+    wmax = wg.max(axis=1)
+    scale = np.maximum((wmax - wmin) / 15.0, 1e-8)
+    zeros = np.round(-wmin / scale).clip(0, 15)
+    q = np.clip(np.round(wg / scale[:, None] + zeros[:, None]), 0,
+                15).astype(np.uint8)
+    return pack_int4(q.reshape(in_, out), zeros, scale)
+
+
+def _dequant_int4(w: QuantizedWeight, dtype) -> jnp.ndarray:
+    q4 = w["q4"]
+    in2, out = q4.shape
+    lo = (q4 & 0xF)
+    hi = (q4 >> 4)
+    q = jnp.stack([lo, hi], axis=1).reshape(2 * in2, out)
+    g = w["s4"].shape[0]
+    qg = q.astype(jnp.float32).reshape(g, (2 * in2) // g, out)
+    wf = (qg - w["z4"][:, None]) * w["s4"][:, None]
+    return wf.reshape(2 * in2, out).astype(dtype)
 
 
 def qmatmul(x: jnp.ndarray, w: Union[jnp.ndarray, QuantizedWeight]
             ) -> jnp.ndarray:
-    """x @ w for plain or int8-quantized weights.
+    """x @ w for plain, int8-quantized, or int4-quantized weights.
 
-    Mixed-dtype dot_general keeps the int8 weight un-dequantized in HBM;
-    the per-channel scale applies to the f32 accumulator.
+    int8: mixed-dtype dot_general keeps the weight un-dequantized in HBM;
+    the per-channel scale applies to the f32 accumulator. int4: nibble
+    unpack + affine dequant fuse into the dot's operand producer, so HBM
+    stores only the packed bytes + group scales/zeros.
     """
     if not is_quantized(w):
         return x @ w
+    if "q4" in w:
+        return x @ _dequant_int4(w, x.dtype)
     out = jax.lax.dot_general(
         x, w["q"],
         dimension_numbers=(((x.ndim - 1, ), (0, )), ((), ())),
         preferred_element_type=jnp.float32)
     return (out * w["s"]).astype(x.dtype)
+
+
+# --- checkpoint converters ------------------------------------------------
+
+
+def _unpack_int32_nibbles(packed: np.ndarray, order=None) -> np.ndarray:
+    """[R, C] int32 → [R, C*8] uint8 nibbles; `order` maps nibble position
+    → channel offset within each pack group of 8."""
+    r, c = packed.shape
+    u = packed.astype(np.uint32)
+    out = np.empty((r, c * 8), np.uint8)
+    for i in range(8):
+        chan = order[i] if order is not None else i
+        out[:, chan::8] = ((u >> (4 * i)) & 0xF).astype(np.uint8)
+    return out
+
+
+def awq_unpack(qweight: np.ndarray, qzeros: np.ndarray,
+               scales: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
+                                            np.ndarray]:
+    """AWQ GEMM-format tensors → (q [in, out], zeros [g, out],
+    scales [g, out]); w = (q - z) * s. qweight/qzeros are int32 with 8
+    nibbles in AWQ order; scales fp16 [g, out]."""
+    q = _unpack_int32_nibbles(qweight, _AWQ_ORDER)       # [in, out]
+    z = _unpack_int32_nibbles(qzeros, _AWQ_ORDER)        # [g, out]
+    return q, z.astype(np.float32), np.asarray(scales, np.float32)
+
+
+def awq_to_int4(qweight, qzeros, scales) -> QuantizedWeight:
+    """Lossless AWQ → device int4 (same affine scheme)."""
+    q, z, s = awq_unpack(qweight, qzeros, scales)
+    return pack_int4(q, z, s)
+
+
+def gptq_dequantize(qweight: np.ndarray, qzeros: np.ndarray,
+                    scales: np.ndarray,
+                    g_idx: np.ndarray = None,
+                    bits: int = 4) -> np.ndarray:
+    """GPTQ tensors → fp32 [in, out]. qweight int32 [in*bits/32, out]
+    sequential nibbles along the INPUT dim; qzeros int32 [g, out*bits/32]
+    sequential along out, storing z-1; g_idx [in] group per row
+    (act-order)."""
+    assert bits == 4, "only 4-bit GPTQ is supported"
+    rows, out = qweight.shape
+    in_ = rows * 8
+    u = qweight.astype(np.uint32)
+    q = np.empty((in_, out), np.uint8)
+    for i in range(8):
+        q[i::8] = ((u >> (4 * i)) & 0xF).astype(np.uint8)
+    z = _unpack_int32_nibbles(qzeros) + 1                # [g, out]
+    s = np.asarray(scales, np.float32)                   # [g, out]
+    if g_idx is None or len(g_idx) == 0:
+        group = in_ // s.shape[0]
+        g_idx = np.arange(in_) // group
+    g_idx = np.asarray(g_idx, np.int64)
+    return (q.astype(np.float32) - z[g_idx].astype(np.float32)) * s[g_idx]
+
+
+def squeezellm_dequantize(qweight: np.ndarray,
+                          lookup_table: np.ndarray) -> np.ndarray:
+    """SqueezeLLM: qweight int32 [in/8, out] sequential nibbles,
+    lookup_table [out, 16] per-channel codebook → fp32 [in, out]."""
+    rows, out = qweight.shape
+    in_ = rows * 8
+    u = qweight.astype(np.uint32)
+    q = np.empty((in_, out), np.uint8)
+    for i in range(8):
+        q[i::8] = ((u >> (4 * i)) & 0xF).astype(np.uint8)
+    lut = np.asarray(lookup_table, np.float32)           # [out, 16]
+    return lut[np.arange(out)[None, :], q]               # [in, out]

@@ -30,6 +30,7 @@ class BaiChuanBaseForCausalLM(LlamaForCausalLM):
     # Baichuan PEFT adapters target the fused W_pack, which does not map
     # onto the split q/k/v stacks.
     supports_lora = False
+    supported_quantization = ("int8", )
 
     def __init__(self, model_config: ModelConfig,
                  position_embedding: str = "ROPE") -> None:

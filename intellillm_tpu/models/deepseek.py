@@ -26,6 +26,7 @@ from intellillm_tpu.models.weight_utils import (cast_array,
 class DeepseekForCausalLM(LlamaForCausalLM):
 
     supports_lora = False
+    supported_quantization = ("int8", )
 
     def __init__(self, model_config: ModelConfig) -> None:
         super().__init__(model_config)

@@ -20,13 +20,17 @@ from intellillm_tpu.layers.attention import (AttentionMetadata, KVCache,
                                              PagedAttention)
 from intellillm_tpu.layers.normalization import fused_add_rms_norm, rms_norm
 from intellillm_tpu.layers.quantization import (is_quantized, qmatmul,
-                                                quantize_int8,
                                                 quantize_int8_jax)
 from intellillm_tpu.layers.rotary_embedding import get_rope
 from intellillm_tpu.models.weight_utils import (cast_array,
-                                                hf_model_weights_iterator)
+                                                hf_model_weights_iterator,
+                                                load_linear)
 
 Params = Dict[str, Any]
+
+# Methods that use the int8 {"q","s"} device representation (GPTQ and
+# SqueezeLLM dequantize-on-load into it); AWQ uses int4 {"q4","s4","z4"}.
+_INT8_REPR_METHODS = ("int8", "gptq", "squeezellm")
 
 
 def _slice_lora(lora, layer_idx: int):
@@ -43,6 +47,7 @@ def _slice_lora(lora, layer_idx: int):
 class LlamaForCausalLM:
 
     supports_lora = True
+    supported_quantization = ("int8", "awq", "gptq", "squeezellm")
 
     def __init__(self, model_config: ModelConfig) -> None:
         cfg = model_config.hf_config
@@ -160,11 +165,14 @@ class LlamaForCausalLM:
         from jax.sharding import PartitionSpec as P
 
         def w(spec):
-            """Quantized weights shard q on the same dims; scales follow
-            the output dim."""
-            if self.quantization != "int8":
-                return spec
-            return {"q": spec, "s": P(spec[1])}
+            """Quantized weights shard q on the same dims; int8 scales
+            follow the output dim; int4 group scales/zeros are [g, out]
+            and shard like the weight."""
+            if self.quantization in _INT8_REPR_METHODS:
+                return {"q": spec, "s": P(spec[1])}
+            if self.quantization == "awq":     # device int4
+                return {"q4": spec, "s4": spec, "z4": spec}
+            return spec
 
         layer = {
             "input_norm": P(),
@@ -178,10 +186,14 @@ class LlamaForCausalLM:
             "down": w(P("model", None)),
         }
         import copy as _copy
+        # AWQ/GPTQ checkpoints keep lm_head full precision (only int8
+        # quantizes it at load).
+        head = (w(P(None, "model")) if self.quantization == "int8"
+                else P(None, "model"))
         return {
             "embed_tokens": P("model", None),
             "norm": P(),
-            "lm_head": w(P(None, "model")),
+            "lm_head": head,
             "layers": [_copy.deepcopy(layer) for _ in range(self.num_layers)],
         }
 
@@ -203,11 +215,17 @@ class LlamaForCausalLM:
         hkv = self.num_kv_heads * self.head_size
         key = jax.random.PRNGKey(seed)
 
-        def rand(key, shape, scale=0.02):
+        def rand(key, shape, scale=0.02, quantize=True):
             w = (jax.random.normal(key, shape, jnp.float32) *
                  scale).astype(dtype)
-            if self.quantization == "int8" and len(shape) == 2:
+            if len(shape) != 2 or not quantize:
+                return w
+            if self.quantization in _INT8_REPR_METHODS:
                 return quantize_int8_jax(w)
+            if self.quantization == "awq":
+                from intellillm_tpu.layers.quantization import quantize_int4
+                qw = quantize_int4(np.asarray(w, np.float32))
+                return {k: jnp.asarray(v) for k, v in qw.items()}
             return w
 
         keys = jax.random.split(key, self.num_layers + 3)
@@ -231,7 +249,8 @@ class LlamaForCausalLM:
         return {
             "embed_tokens": embed,
             "norm": jnp.ones((e, ), dtype),
-            "lm_head": rand(keys[-2], (e, v)),
+            "lm_head": rand(keys[-2], (e, v),
+                            quantize=self.quantization == "int8"),
             "layers": layers,
         }
 
@@ -245,11 +264,9 @@ class LlamaForCausalLM:
                 continue
             raw[name] = arr
 
-        def W(key: str):
-            w = cast_array(raw[key].T, self.dtype)
-            if self.quantization == "int8":
-                return quantize_int8(w)
-            return w
+        def L(prefix: str, fp_ok: bool = False):
+            return load_linear(raw, prefix, self.dtype, self.quantization,
+                               fp_ok=fp_ok)
 
         def V(key: str) -> np.ndarray:
             return cast_array(raw[key], self.dtype)
@@ -257,8 +274,9 @@ class LlamaForCausalLM:
         params: Params = {
             "embed_tokens": V("model.embed_tokens.weight"),
             "norm": V("model.norm.weight"),
-            "lm_head": (W("lm_head.weight")
-                        if ("lm_head.weight" in raw
+            "lm_head": (L("lm_head", fp_ok=self.quantization != "int8")
+                        if (("lm_head.weight" in raw
+                             or "lm_head.qweight" in raw)
                             and not self.tie_word_embeddings) else None),
             "layers": [],
         }
@@ -267,12 +285,12 @@ class LlamaForCausalLM:
             params["layers"].append({
                 "input_norm": V(lp + "input_layernorm.weight"),
                 "post_attn_norm": V(lp + "post_attention_layernorm.weight"),
-                "q": W(lp + "self_attn.q_proj.weight"),
-                "k": W(lp + "self_attn.k_proj.weight"),
-                "v": W(lp + "self_attn.v_proj.weight"),
-                "o": W(lp + "self_attn.o_proj.weight"),
-                "gate": W(lp + "mlp.gate_proj.weight"),
-                "up": W(lp + "mlp.up_proj.weight"),
-                "down": W(lp + "mlp.down_proj.weight"),
+                "q": L(lp + "self_attn.q_proj"),
+                "k": L(lp + "self_attn.k_proj"),
+                "v": L(lp + "self_attn.v_proj"),
+                "o": L(lp + "self_attn.o_proj"),
+                "gate": L(lp + "mlp.gate_proj"),
+                "up": L(lp + "mlp.up_proj"),
+                "down": L(lp + "mlp.down_proj"),
             })
         return params

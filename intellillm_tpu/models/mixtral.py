@@ -25,6 +25,9 @@ from intellillm_tpu.models.weight_utils import (cast_array,
 
 class MixtralForCausalLM(LlamaForCausalLM):
 
+    # Expert stacks load fp; only int8 attention quantization is wired.
+    supported_quantization = ("int8", )
+
     def __init__(self, model_config: ModelConfig) -> None:
         super().__init__(model_config)
         cfg = model_config.hf_config

@@ -25,6 +25,13 @@ def get_model(model_config: ModelConfig,
         architectures = [type(model_config.hf_config).__name__.replace(
             "Config", "ForCausalLM")]
     model_class = get_model_class(architectures)
+    if model_config.quantization is not None:
+        supported = getattr(model_class, "supported_quantization", ())
+        if model_config.quantization not in supported:
+            raise NotImplementedError(
+                f"{model_class.__name__} does not support "
+                f"quantization={model_config.quantization!r} "
+                f"(supported: {supported or 'none'})")
     model = model_class(model_config)
     load_format = (model_config.load_format
                    if model_config.load_format != "auto" else load_format)

@@ -26,6 +26,7 @@ class QWenLMHeadModel(Qwen2ForCausalLM):
 
     # PEFT QWen adapters target the fused c_attn, not split q/k/v.
     supports_lora = False
+    supported_quantization = ("int8", )
 
     def __init__(self, model_config: ModelConfig) -> None:
         # Normalize the QWen-v1 config onto the Qwen2 field names the

@@ -93,3 +93,67 @@ def cast_array(arr: np.ndarray, dtype_str: str) -> "np.ndarray":
     if arr.dtype == target:
         return arr
     return arr.astype(target)
+
+
+def load_linear(raw, prefix: str, dtype: str, quantization=None,
+                fp_ok: bool = False):
+    """Resolve one linear layer's weight from a checkpoint dict, handling
+    fp and quantized (AWQ / GPTQ / SqueezeLLM) storage.
+
+    Role parity: reference `layers/quantization/{awq,gptq,squeezellm}.py`
+    create_weights/apply_weights pairs — here the conversion happens once
+    at load: AWQ converts losslessly to the device int4 representation;
+    GPTQ (incl. act-order g_idx) and SqueezeLLM dequantize to fp and
+    requantize to per-channel int8; fp checkpoints follow `quantization`
+    ("int8"/"awq" etc. → quantize; None → plain [in, out] cast).
+    Returns either a plain array or a QuantizedWeight dict.
+    """
+    from intellillm_tpu.layers.quantization import (awq_to_int4,
+                                                    gptq_dequantize,
+                                                    quantize_int4,
+                                                    quantize_int8,
+                                                    squeezellm_dequantize)
+
+    if prefix + ".weight" in raw:
+        w = cast_array(raw[prefix + ".weight"].T, dtype)
+        if quantization == "int8":
+            return quantize_int8(w)
+        if fp_ok:
+            # AWQ/GPTQ/SqueezeLLM checkpoints intentionally keep some
+            # linears (lm_head) full precision — serve them as-is.
+            return w
+        if quantization == "awq":
+            return quantize_int4(w)
+        if quantization in ("gptq", "squeezellm"):
+            return quantize_int8(w)
+        return w
+
+    if prefix + ".qweight" not in raw:
+        raise KeyError(f"No weight found for {prefix!r} "
+                       "(.weight / .qweight missing)")
+    if quantization == "awq":
+        from intellillm_tpu.layers.quantization import awq_unpack
+        if fp_ok:
+            q, z, s = awq_unpack(raw[prefix + ".qweight"],
+                                 raw[prefix + ".qzeros"],
+                                 raw[prefix + ".scales"])
+            g = s.shape[0]
+            in_, out = q.shape
+            w = ((q.astype(np.float32).reshape(g, in_ // g, out) -
+                  z[:, None]) * s[:, None]).reshape(in_, out)
+            return cast_array(w, dtype)
+        return awq_to_int4(raw[prefix + ".qweight"],
+                           raw[prefix + ".qzeros"],
+                           raw[prefix + ".scales"])
+    if quantization == "gptq":
+        w = gptq_dequantize(raw[prefix + ".qweight"],
+                            raw[prefix + ".qzeros"],
+                            raw[prefix + ".scales"],
+                            raw.get(prefix + ".g_idx"))
+        return cast_array(w, dtype) if fp_ok else quantize_int8(w)
+    if quantization == "squeezellm":
+        w = squeezellm_dequantize(raw[prefix + ".qweight"],
+                                  raw[prefix + ".lookup_table"])
+        return cast_array(w, dtype) if fp_ok else quantize_int8(w)
+    raise ValueError(
+        f"{prefix!r} is stored quantized but quantization={quantization!r}")
