@@ -101,14 +101,6 @@ class ModelConfig:
                 method = getattr(method, "value", method)
                 if method is not None:
                     self.quantization = str(method).lower()
-                bits = (hf_q.get("bits", hf_q.get("w_bit"))
-                        if isinstance(hf_q, dict) else
-                        getattr(hf_q, "bits", getattr(hf_q, "w_bit", None)))
-                if (self.quantization in ("awq", "gptq", "squeezellm")
-                        and bits is not None and int(bits) != 4):
-                    raise NotImplementedError(
-                        f"{self.quantization} with {bits}-bit weights is "
-                        "not supported (only 4-bit)")
         if self.quantization is not None and self.quantization not in self._SUPPORTED_QUANT:
             raise ValueError(
                 f"Unknown quantization method: {self.quantization}; "
@@ -121,6 +113,19 @@ class ModelConfig:
                 f"Quantization method '{self.quantization}' is not yet "
                 "supported on TPU (no checkpoint loader). Supported today: "
                 f"{self._LOADABLE_QUANT}.")
+        # Bit-width check applies whether the method was auto-detected or
+        # passed explicitly — only 4-bit AWQ/GPTQ/SqueezeLLM loads.
+        if self.quantization in ("awq", "gptq", "squeezellm"):
+            hf_q = getattr(self.hf_config, "quantization_config", None)
+            bits = None
+            if isinstance(hf_q, dict):
+                bits = hf_q.get("bits", hf_q.get("w_bit"))
+            elif hf_q is not None:
+                bits = getattr(hf_q, "bits", getattr(hf_q, "w_bit", None))
+            if bits is not None and int(bits) != 4:
+                raise NotImplementedError(
+                    f"{self.quantization} with {bits}-bit weights is not "
+                    "supported (only 4-bit)")
 
     # --- HF config introspection (reference config.py:222-268) ---
 

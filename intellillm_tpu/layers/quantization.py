@@ -136,6 +136,17 @@ def _unpack_int32_nibbles(packed: np.ndarray, order=None) -> np.ndarray:
     return out
 
 
+def _unpack_int32_nibbles_rows(packed: np.ndarray) -> np.ndarray:
+    """[R, C] int32 → [R*8, C] uint8 nibbles, sequential along rows (the
+    GPTQ/SqueezeLLM qweight layout)."""
+    rows, c = packed.shape
+    u = packed.astype(np.uint32)
+    out = np.empty((rows * 8, c), np.uint8)
+    for i in range(8):
+        out[i::8] = ((u >> (4 * i)) & 0xF).astype(np.uint8)
+    return out
+
+
 def awq_unpack(qweight: np.ndarray, qzeros: np.ndarray,
                scales: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
                                             np.ndarray]:
@@ -162,12 +173,8 @@ def gptq_dequantize(qweight: np.ndarray, qzeros: np.ndarray,
     sequential along out, storing z-1; g_idx [in] group per row
     (act-order)."""
     assert bits == 4, "only 4-bit GPTQ is supported"
-    rows, out = qweight.shape
-    in_ = rows * 8
-    u = qweight.astype(np.uint32)
-    q = np.empty((in_, out), np.uint8)
-    for i in range(8):
-        q[i::8] = ((u >> (4 * i)) & 0xF).astype(np.uint8)
+    q = _unpack_int32_nibbles_rows(qweight)              # [in, out]
+    in_ = q.shape[0]
     z = _unpack_int32_nibbles(qzeros) + 1                # [g, out]
     s = np.asarray(scales, np.float32)                   # [g, out]
     if g_idx is None or len(g_idx) == 0:
@@ -181,11 +188,7 @@ def squeezellm_dequantize(qweight: np.ndarray,
                           lookup_table: np.ndarray) -> np.ndarray:
     """SqueezeLLM: qweight int32 [in/8, out] sequential nibbles,
     lookup_table [out, 16] per-channel codebook → fp32 [in, out]."""
-    rows, out = qweight.shape
-    in_ = rows * 8
-    u = qweight.astype(np.uint32)
-    q = np.empty((in_, out), np.uint8)
-    for i in range(8):
-        q[i::8] = ((u >> (4 * i)) & 0xF).astype(np.uint8)
+    q = _unpack_int32_nibbles_rows(qweight)              # [in, out]
+    out = q.shape[1]
     lut = np.asarray(lookup_table, np.float32)           # [out, 16]
     return lut[np.arange(out)[None, :], q]               # [in, out]

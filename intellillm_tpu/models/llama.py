@@ -171,7 +171,10 @@ class LlamaForCausalLM:
             if self.quantization in _INT8_REPR_METHODS:
                 return {"q": spec, "s": P(spec[1])}
             if self.quantization == "awq":     # device int4
-                return {"q4": spec, "s4": spec, "z4": spec}
+                # s4/z4 are [groups, out]: shard only the out dim — group
+                # counts rarely divide the mesh (in/128 on row-parallel).
+                return {"q4": spec, "s4": P(None, spec[1]),
+                        "z4": P(None, spec[1])}
             return spec
 
         layer = {

@@ -133,3 +133,32 @@ def test_kv_pool_sharded_when_divisible(tiny_llama_dir):
     assert shard_shape[1] == k0.shape[1] // 2, (
         f"kv pool not sharded by head: global={k0.shape} "
         f"shard={shard_shape}")
+
+
+@requires_8_devices
+@pytest.mark.parametrize("tp", [2, 4])
+def test_awq_tp_runs_and_matches_tp1(tmp_path_factory, example_prompts, tp):
+    """AWQ int4 params shard over TP (s4/z4 replicate the group dim) and
+    produce the same greedy tokens as the tp=1 AWQ run."""
+    import sys
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+    from tests.conftest import _build_word_tokenizer
+    sys.path.insert(0, "tests")
+    from tests.kernels.test_quant_checkpoints import _awqify_checkpoint
+
+    base = str(tmp_path_factory.mktemp("awq-tp") / "base")
+    _, vocab_size = _build_word_tokenizer(base)
+    torch.manual_seed(0)
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=128, pad_token_id=0, bos_token_id=1,
+        eos_token_id=1, tie_word_embeddings=False,
+        torch_dtype=torch.float32)).eval().save_pretrained(
+            base, safe_serialization=True)
+    awq_dir, _ = _awqify_checkpoint(base, base + "-ck", group=16)
+
+    ref, _ = _generate_greedy(awq_dir, example_prompts, 8)
+    got, _ = _generate_greedy(awq_dir, example_prompts, 8, tp=tp)
+    assert got == ref

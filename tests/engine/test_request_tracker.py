@@ -1,0 +1,87 @@
+"""RequestTracker unit tests (reference
+`tests/async_engine/test_request_tracker.py`)."""
+import asyncio
+
+import pytest
+
+from intellillm_tpu.engine.async_llm_engine import (AsyncEngineDeadError,
+                                                    AsyncStream,
+                                                    RequestTracker)
+from intellillm_tpu.outputs import RequestOutput
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _output(request_id, finished=False):
+    return RequestOutput(request_id=request_id, prompt="p",
+                         prompt_token_ids=[1], prompt_logprobs=None,
+                         outputs=[], finished=finished)
+
+
+def test_add_and_collect_requests():
+    async def run():
+        tracker = RequestTracker()
+        tracker.init_event()
+        stream = tracker.add_request("1", prompt="x")
+        assert tracker.new_requests_event.is_set()
+        new, finished = tracker.get_new_and_finished_requests()
+        assert len(new) == 1 and new[0]["request_id"] == "1"
+        assert not finished
+        assert "1" in tracker
+        assert not tracker.new_requests_event.is_set()
+        with pytest.raises(KeyError):
+            tracker.add_request("1", prompt="dup")
+    _run(run())
+
+
+def test_abort_before_scheduling_drops_request():
+    async def run():
+        tracker = RequestTracker()
+        tracker.init_event()
+        tracker.add_request("1", prompt="x")
+        tracker.abort_request("1")
+        new, finished = tracker.get_new_and_finished_requests()
+        assert new == []
+        assert finished == {"1"}
+        assert "1" not in tracker
+    _run(run())
+
+
+def test_finished_output_finishes_stream():
+    async def run():
+        tracker = RequestTracker()
+        tracker.init_event()
+        stream = tracker.add_request("1", prompt="x")
+        tracker.get_new_and_finished_requests()
+        tracker.process_request_output(_output("1", finished=True))
+        assert stream.finished
+        got = [out async for out in stream]
+        assert len(got) == 1 and got[0].finished
+    _run(run())
+
+
+def test_propagate_exception_reaches_streams():
+    async def run():
+        tracker = RequestTracker()
+        tracker.init_event()
+        stream = tracker.add_request("1", prompt="x")
+        tracker.get_new_and_finished_requests()
+        tracker.propagate_exception(AsyncEngineDeadError("boom"))
+        with pytest.raises(AsyncEngineDeadError):
+            async for _ in stream:
+                pass
+    _run(run())
+
+
+def test_output_for_aborted_request_is_dropped():
+    async def run():
+        tracker = RequestTracker()
+        tracker.init_event()
+        tracker.add_request("1", prompt="x")
+        tracker.get_new_and_finished_requests()
+        tracker.abort_request("1")
+        # Late output from the engine loop must be ignored, not crash.
+        tracker.process_request_output(_output("1"))
+    _run(run())

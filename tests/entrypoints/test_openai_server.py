@@ -1,0 +1,204 @@
+"""OpenAI-compatible server integration tests over real HTTP.
+
+Reference: `tests/entrypoints/test_openai_server.py` (254 LoC — boots the
+server and drives it with a client) and
+`tests/async_engine/test_api_server.py`. The server runs as a subprocess
+(inheriting the CPU-forcing env from conftest) against a tiny local
+checkpoint; requests go through aiohttp.
+"""
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import aiohttp
+import pytest
+
+PORT = 8731
+BASE = f"http://127.0.0.1:{PORT}"
+
+
+@pytest.fixture(scope="module")
+def openai_server(tmp_path_factory):
+    # Build the tiny checkpoint in-process (module-scoped tmp dir).
+    import torch
+    from transformers import OPTConfig, OPTForCausalLM
+    from tests.conftest import _build_word_tokenizer
+
+    d = str(tmp_path_factory.mktemp("srv-opt"))
+    _, vocab_size = _build_word_tokenizer(d)
+    torch.manual_seed(0)
+    OPTForCausalLM(OPTConfig(
+        vocab_size=vocab_size, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, ffn_dim=128, max_position_embeddings=128,
+        do_layer_norm_before=True, pad_token_id=0, eos_token_id=1,
+        bos_token_id=1, word_embed_proj_dim=64,
+        torch_dtype=torch.float32)).eval().save_pretrained(
+            d, safe_serialization=True)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "intellillm_tpu.entrypoints.openai.api_server",
+         "--model", d, "--dtype", "float32", "--max-model-len", "128",
+         "--num-device-blocks-override", "128", "--port", str(PORT),
+         "--served-model-name", "tiny-opt",
+         "--chat-template", "{% for m in messages %}{{ m['content'] }} "
+         "{% endfor %}"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode()
+                raise RuntimeError(f"server died:\n{out[-3000:]}")
+            try:
+                import urllib.request
+                urllib.request.urlopen(BASE + "/health", timeout=1)
+                break
+            except Exception:
+                time.sleep(1.0)
+        else:
+            raise TimeoutError("server did not become healthy")
+        yield d
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+
+async def _post(path, payload):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(BASE + path, json=payload) as resp:
+            return resp.status, await resp.json()
+
+
+async def _get(path):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(BASE + path) as resp:
+            return resp.status, await resp.json()
+
+
+def test_models_endpoint(openai_server):
+    status, body = asyncio.run(_get("/v1/models"))
+    assert status == 200
+    assert body["data"][0]["id"] == "tiny-opt"
+
+
+def test_completion(openai_server):
+    status, body = asyncio.run(_post("/v1/completions", {
+        "model": "tiny-opt",
+        "prompt": "hello my name is",
+        "max_tokens": 8,
+        "temperature": 0.0,
+    }))
+    assert status == 200
+    assert body["object"] == "text_completion"
+    assert len(body["choices"]) == 1
+    assert body["choices"][0]["finish_reason"] in ("length", "stop")
+    assert body["usage"]["completion_tokens"] >= 1
+
+
+def test_completion_streaming(openai_server):
+    async def run():
+        chunks = []
+        async with aiohttp.ClientSession() as s:
+            async with s.post(BASE + "/v1/completions", json={
+                "model": "tiny-opt",
+                "prompt": "the capital of france is",
+                "max_tokens": 8,
+                "temperature": 0.0,
+                "stream": True,
+            }) as resp:
+                assert resp.status == 200
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data:"):
+                        continue
+                    data = line[len("data:"):].strip()
+                    if data == "[DONE]":
+                        break
+                    chunks.append(json.loads(data))
+        return chunks
+
+    chunks = asyncio.run(run())
+    assert chunks, "no SSE chunks received"
+    text = "".join(c["choices"][0]["text"] for c in chunks)
+    assert isinstance(text, str)
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+
+
+def test_streaming_matches_nonstreaming(openai_server):
+    payload = {"model": "tiny-opt", "prompt": "the cat runs fast",
+               "max_tokens": 8, "temperature": 0.0}
+    _, body = asyncio.run(_post("/v1/completions", payload))
+    full = body["choices"][0]["text"]
+
+    async def run():
+        parts = []
+        async with aiohttp.ClientSession() as s:
+            async with s.post(BASE + "/v1/completions",
+                              json={**payload, "stream": True}) as resp:
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data:"):
+                        continue
+                    data = line[len("data:"):].strip()
+                    if data == "[DONE]":
+                        break
+                    parts.append(
+                        json.loads(data)["choices"][0]["text"])
+        return "".join(parts)
+
+    assert asyncio.run(run()) == full
+
+
+def test_chat_completion(openai_server):
+    status, body = asyncio.run(_post("/v1/chat/completions", {
+        "model": "tiny-opt",
+        "messages": [{"role": "user", "content": "hello my name is"}],
+        "max_tokens": 8,
+        "temperature": 0.0,
+    }))
+    assert status == 200
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["message"]["role"] == "assistant"
+
+
+def test_bad_request_returns_error(openai_server):
+    status, body = asyncio.run(_post("/v1/completions", {
+        "model": "tiny-opt",
+        "prompt": "hello",
+        "max_tokens": 8,
+        "temperature": -1.0,       # invalid
+    }))
+    assert status >= 400
+    assert "error" in body or body.get("object") == "error"
+
+
+def test_client_disconnect_aborts_request(openai_server):
+    """Dropping a streaming connection must abort the request server-side
+    (reference async_llm_engine abort-on-disconnect); the server must keep
+    serving afterwards."""
+    async def run():
+        async with aiohttp.ClientSession() as s:
+            resp = await s.post(BASE + "/v1/completions", json={
+                "model": "tiny-opt", "prompt": "hello my name is",
+                "max_tokens": 10000, "temperature": 1.0,
+                "ignore_eos": True, "stream": True})
+            # Read one chunk then hard-drop the connection.
+            await resp.content.readany()
+            resp.close()
+        await asyncio.sleep(1.0)
+        # Server still alive and serving.
+        async with aiohttp.ClientSession() as s:
+            async with s.post(BASE + "/v1/completions", json={
+                "model": "tiny-opt", "prompt": "hello",
+                "max_tokens": 4, "temperature": 0.0}) as resp:
+                assert resp.status == 200
+                return await resp.json()
+
+    body = asyncio.run(run())
+    assert body["choices"][0]["text"] is not None

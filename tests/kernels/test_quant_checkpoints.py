@@ -133,7 +133,7 @@ def test_int4_qmatmul_matches_dequant():
 def test_quantize_int4_error_bound():
     rng = np.random.default_rng(5)
     w = rng.standard_normal((64, 24)).astype(np.float32)
-    packed = pack_int4_roundtrip = quantize_int4(w, group_size=16)
+    packed = quantize_int4(w, group_size=16)
     packed = {k: jnp.asarray(v) for k, v in packed.items()}
     x = jnp.eye(64, dtype=jnp.float32)
     wd = np.asarray(qmatmul(x, packed))
