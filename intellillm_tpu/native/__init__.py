@@ -1,0 +1,139 @@
+"""ctypes bindings for the native host-side batch-prep kernels.
+
+Role parity: the reference builds its native runtime pieces (`csrc/`)
+at install time via setup.py; here the single C++ translation unit
+(`native/batch_prep.cc`) is compiled lazily with g++ on first use and
+cached next to the source. Everything degrades to the pure-Python paths
+when no toolchain/.so is available (`is_available()` returns False), so
+the engine never hard-depends on the native build.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "batch_prep.cc")
+_LIB = os.path.join(_REPO_ROOT, "native", "libbatch_prep.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("INTELLILLM_DISABLE_NATIVE") == "1":
+        return None
+    try:
+        if (not os.path.exists(_LIB)
+                or (os.path.exists(_SRC) and
+                    os.path.getmtime(_SRC) > os.path.getmtime(_LIB))):
+            if not os.path.exists(_SRC):
+                return None
+            # Build to a per-pid temp path and rename: concurrent
+            # processes must never dlopen a half-written .so.
+            tmp = f"{_LIB}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                check=True, capture_output=True)
+            os.replace(tmp, _LIB)
+            logger.info("Built native batch-prep library at %s", _LIB)
+        lib = ctypes.CDLL(_LIB)
+        lib.build_decode_batch.argtypes = [
+            _i32p, _i64p, _i32p, _i32p, _i32p,
+            ctypes.c_int64, ctypes.c_int64,
+            _i32p, _i32p, _i32p, _i32p,
+        ]
+        lib.build_prompt_slots.argtypes = [
+            _i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32, _i32p,
+        ]
+        _lib = lib
+    except Exception as e:  # no compiler / load failure → Python fallback
+        logger.warning("Native batch-prep unavailable (%s); using the "
+                       "pure-Python path", e)
+        _lib = None
+    return _lib
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+def build_decode_batch(tables, tokens, positions, ctx, padded_n: int,
+                       width: int):
+    """tables: list of per-seq block-table lists; tokens/positions/ctx:
+    per-seq int lists. Returns (token_ids [P,1], positions [P,1],
+    context_lens [P], block_tables [P,W]) padded arrays."""
+    lib = _load()
+    n = len(tables)
+    out_tokens = np.zeros((padded_n, 1), np.int32)
+    out_positions = np.zeros((padded_n, 1), np.int32)
+    out_ctx = np.zeros(padded_n, np.int32)
+    out_tables = np.zeros((padded_n, width), np.int32)
+    if lib is None:
+        for i in range(n):
+            out_tokens[i, 0] = tokens[i]
+            out_positions[i, 0] = positions[i]
+            out_ctx[i] = ctx[i]
+            out_tables[i, :len(tables[i])] = tables[i]
+        return out_tokens, out_positions, out_ctx, out_tables
+
+    # Marshal the Python lists in single C-level passes (fromiter/chain),
+    # then the C++ kernel does the padded 2D fills.
+    import itertools
+    lens = np.fromiter((len(t) for t in tables), np.int64, count=n)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    flat = np.fromiter(itertools.chain.from_iterable(tables), np.int32,
+                       count=int(offsets[-1]))
+    lib.build_decode_batch(flat, offsets,
+                           np.asarray(tokens, np.int32),
+                           np.asarray(positions, np.int32),
+                           np.asarray(ctx, np.int32),
+                           n, width,
+                           out_tokens.reshape(-1), out_positions.reshape(-1),
+                           out_ctx, out_tables.reshape(-1))
+    return out_tokens, out_positions, out_ctx, out_tables
+
+
+def build_prompt_slots(table, prefix_len: int, seq_len: int,
+                       block_size: int, window_blocks: Optional[int],
+                       pad_slot: int) -> np.ndarray:
+    """Slot mapping for tokens [prefix_len, seq_len) of one prompt."""
+    lib = _load()
+    n_new = seq_len - prefix_len
+    if lib is None:
+        slots = np.empty(n_new, np.int32)
+        k = 0
+        for t in range(prefix_len, seq_len):
+            logical = t // block_size
+            if window_blocks:
+                if t < seq_len - window_blocks * block_size:
+                    slots[k] = pad_slot
+                    k += 1
+                    continue
+                logical %= window_blocks
+            slots[k] = table[logical] * block_size + t % block_size
+            k += 1
+        return slots
+    out = np.empty(n_new, np.int32)
+    lib.build_prompt_slots(np.asarray(table, np.int32), prefix_len,
+                           seq_len, block_size, window_blocks or 0,
+                           pad_slot, out)
+    return out

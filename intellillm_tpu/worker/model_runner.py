@@ -38,6 +38,7 @@ from intellillm_tpu.layers.attention import AttentionMetadata
 from intellillm_tpu.layers.sampler import (SamplingTensors, apply_penalties,
                                            sample)
 from intellillm_tpu.logger import init_logger
+from intellillm_tpu.native import build_decode_batch, build_prompt_slots
 from intellillm_tpu.ops.kv_cache import PAD_SLOT_ID
 from intellillm_tpu.sampling_params import SamplingParams, SamplingType
 from intellillm_tpu.sequence import (SamplerOutput, SequenceGroupMetadata,
@@ -352,19 +353,13 @@ class ModelRunner:
             # Slot for token i: physical block for logical block i//bs.
             # Sliding window: ring reuse means later tokens overwrite early
             # slots; suppress writes for tokens that would be overwritten in
-            # this same prefill (scatter order is unspecified).
-            slots = []
+            # this same prefill (scatter order is unspecified). Computed by
+            # the native batch-prep kernel (native/batch_prep.cc) with a
+            # pure-Python fallback.
             wb = (self.sliding_window // self.block_size
                   if self.sliding_window else None)
-            for i in range(prefix_len, n):
-                li = i // self.block_size
-                if wb is not None:
-                    if i < n - wb * self.block_size:
-                        slots.append(PAD_SLOT_ID)
-                        continue
-                    li = li % wb
-                slots.append(table[li] * self.block_size +
-                             i % self.block_size)
+            slots = build_prompt_slots(table, prefix_len, n,
+                                       self.block_size, wb, PAD_SLOT_ID)
 
             rows.append((meta.request_id, seq_id))
             token_rows.append(list(tokens[prefix_len:]))
@@ -438,16 +433,8 @@ class ModelRunner:
                               _MIN_BLOCK_TABLE_WIDTH),
                           self.block_width_buckets)
 
-        token_ids = np.zeros((b, 1), np.int32)
-        positions = np.zeros((b, 1), np.int32)
-        context_lens = np.zeros(b, np.int32)
-        block_tables = np.zeros((b, w), np.int32)
-
-        for i in range(len(rows)):
-            token_ids[i, 0] = tokens[i]
-            positions[i, 0] = poss[i]
-            context_lens[i] = ctxs[i]
-            block_tables[i, :len(tables[i])] = tables[i]
+        token_ids, positions, context_lens, block_tables = \
+            build_decode_batch(tables, tokens, poss, ctxs, b, w)
 
         arrays = {"token_ids": token_ids, "positions": positions,
                   "context_lens": context_lens, "block_tables": block_tables}

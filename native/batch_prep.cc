@@ -1,0 +1,76 @@
+// Host-side batch-preparation kernels for the TPU engine.
+//
+// Role parity: the reference keeps its runtime hot paths native
+// (csrc/ + the CUDA-graph-paired CPU batch prep in
+// vllm/worker/model_runner.py:95-358 is the per-step host bottleneck its
+// CUDA graphs exist to hide). On TPU the device step is one fused jit
+// call, so the remaining per-step host work IS this: filling the padded
+// (bucketed) batch arrays and computing KV slot mappings. These loops are
+// O(batch * table_width) Python work per step; here they run as plain
+// C++ over int32 buffers, called via ctypes (no pybind11 in the image).
+//
+// Build: g++ -O3 -shared -fPIC -o libbatch_prep.so batch_prep.cc
+// (intellillm_tpu/native/__init__.py builds lazily and falls back to the
+// pure-Python implementations if no compiler is available.)
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Fill the padded decode batch arrays from per-sequence values.
+//   tables_flat/table_offsets: concatenated block tables (CSR-style),
+//     offsets has n+1 entries.
+//   out_* are preallocated [padded_n(, width)] arrays, zero-filled by the
+//     caller for rows >= n.
+void build_decode_batch(const int32_t* tables_flat,
+                        const int64_t* table_offsets,
+                        const int32_t* tokens,
+                        const int32_t* positions,
+                        const int32_t* ctx,
+                        int64_t n,
+                        int64_t width,
+                        int32_t* out_tokens,
+                        int32_t* out_positions,
+                        int32_t* out_ctx,
+                        int32_t* out_tables) {
+  for (int64_t i = 0; i < n; ++i) {
+    out_tokens[i] = tokens[i];
+    out_positions[i] = positions[i];
+    out_ctx[i] = ctx[i];
+    const int64_t start = table_offsets[i];
+    const int64_t len = table_offsets[i + 1] - start;
+    std::memcpy(out_tables + i * width, tables_flat + start,
+                sizeof(int32_t) * static_cast<size_t>(len));
+  }
+}
+
+// KV slot mapping for one prompt sequence (reference
+// model_runner.py:157-179 incl. the sliding-window suppression at
+// :160-170): slot for token t is table[t / block_size] * block_size +
+// t % block_size; with a window, logical blocks wrap modulo
+// window_blocks and tokens that would be overwritten within this same
+// prefill emit pad_slot (scatter order is unspecified).
+void build_prompt_slots(const int32_t* table,
+                        int64_t prefix_len,
+                        int64_t seq_len,
+                        int64_t block_size,
+                        int64_t window_blocks,  // 0 = no sliding window
+                        int32_t pad_slot,
+                        int32_t* out_slots) {
+  int64_t k = 0;
+  for (int64_t t = prefix_len; t < seq_len; ++t, ++k) {
+    int64_t logical = t / block_size;
+    if (window_blocks > 0) {
+      if (t < seq_len - window_blocks * block_size) {
+        out_slots[k] = pad_slot;
+        continue;
+      }
+      logical %= window_blocks;
+    }
+    out_slots[k] = table[logical] * static_cast<int32_t>(block_size) +
+                   static_cast<int32_t>(t % block_size);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,65 @@
+"""Native C++ batch-prep kernels vs the pure-Python fallback."""
+import numpy as np
+import pytest
+
+import intellillm_tpu.native as native
+
+
+def _python_fallback(monkeypatch):
+    """Force the Python path regardless of the built library."""
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+
+
+def test_native_library_builds():
+    assert native.is_available(), (
+        "g++ is in the image; the native batch-prep library must build")
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_decode_batch_native_matches_python(monkeypatch, seed):
+    rng = np.random.default_rng(seed)
+    n, padded_n, width = 5, 8, 6
+    tables = [list(rng.integers(0, 100, size=rng.integers(1, width + 1)))
+              for _ in range(n)]
+    tokens = list(rng.integers(0, 1000, size=n))
+    poss = list(rng.integers(0, 100, size=n))
+    ctxs = [p + 1 for p in poss]
+
+    got = native.build_decode_batch(tables, tokens, poss, ctxs, padded_n,
+                                    width)
+    _python_fallback(monkeypatch)
+    ref = native.build_decode_batch(tables, tokens, poss, ctxs, padded_n,
+                                    width)
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(g, r)
+
+
+@pytest.mark.parametrize("window_blocks,prefix_len", [
+    (None, 0), (None, 16), (2, 0),
+])
+def test_prompt_slots_native_matches_python(monkeypatch, window_blocks,
+                                            prefix_len):
+    rng = np.random.default_rng(3)
+    block_size, seq_len = 16, 70
+    table = list(rng.integers(0, 100, size=8))
+    got = native.build_prompt_slots(table, prefix_len, seq_len, block_size,
+                                    window_blocks, -1)
+    _python_fallback(monkeypatch)
+    ref = native.build_prompt_slots(table, prefix_len, seq_len, block_size,
+                                    window_blocks, -1)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_prompt_slots_semantics():
+    """Direct check of the slot formula and window suppression."""
+    table = [7, 3, 9]
+    slots = native.build_prompt_slots(table, 0, 40, 16, None, -1)
+    assert slots[0] == 7 * 16 + 0
+    assert slots[17] == 3 * 16 + 1
+    assert slots[39] == 9 * 16 + 7
+    # Window of 1 block over 40 tokens: everything before the last 16
+    # tokens is suppressed; the rest wraps modulo 1 block.
+    slots = native.build_prompt_slots(table, 0, 40, 16, 1, -1)
+    assert (slots[:24] == -1).all()
+    assert slots[24] == 7 * 16 + 8    # token 24 → logical 1 % 1 = 0
