@@ -46,9 +46,14 @@ class SamplingTensors:
     frequency_penalties: np.ndarray  # [N] f32
     repetition_penalties: np.ndarray  # [N] f32
     seeds: np.ndarray               # [N] u32
-    # Only populated when do_penalties (O(N*V) host cost gated off hot path):
-    prompt_mask: Optional[np.ndarray]    # [N, V] bool
-    output_counts: Optional[np.ndarray]  # [N, V] i32
+    # Only populated when do_penalties: padded token-id lists (pad =
+    # vocab_size, dropped by the device scatter). The [N, V] mask/count
+    # tensors are built ON DEVICE (penalty_tensors_from_tokens) — host
+    # cost is O(N*len), not O(N*vocab) (reference keeps incremental
+    # device tensors in sampling_metadata.py; this is the stateless
+    # equivalent).
+    prompt_tokens: Optional[np.ndarray]   # [N, Lp] i32
+    output_tokens: Optional[np.ndarray]   # [N, Lo] i32
     do_penalties: bool
     do_topk: bool
     do_topp: bool
@@ -102,15 +107,24 @@ class SamplingTensors:
             if sp.use_beam_search:
                 max_logprobs = max(max_logprobs, 2 * sp.best_of)
 
-        prompt_mask = None
-        output_counts = None
+        prompt_tokens = None
+        output_tokens = None
         if do_penalties and row_token_ids is not None:
-            prompt_mask = np.zeros((padded_n, vocab_size), np.bool_)
-            output_counts = np.zeros((padded_n, vocab_size), np.int32)
+            from intellillm_tpu.utils import next_power_of_2
+
+            def pad_len(m):
+                # Power-of-two length buckets bound the jit shape count.
+                return max(16, next_power_of_2(m))
+
+            lp = pad_len(max(len(p) for p, _ in row_token_ids))
+            lo = pad_len(max((len(o) for _, o in row_token_ids),
+                             default=1) or 1)
+            prompt_tokens = np.full((padded_n, lp), vocab_size, np.int32)
+            output_tokens = np.full((padded_n, lo), vocab_size, np.int32)
             for i, (prompt_ids, output_ids) in enumerate(row_token_ids):
-                prompt_mask[i, np.asarray(prompt_ids, np.int64)] = True
+                prompt_tokens[i, :len(prompt_ids)] = prompt_ids
                 if output_ids:
-                    np.add.at(output_counts[i], np.asarray(output_ids, np.int64), 1)
+                    output_tokens[i, :len(output_ids)] = output_ids
 
         logprob_k = LOGPROB_K_BUCKETS[-1]
         for b in LOGPROB_K_BUCKETS:
@@ -119,8 +133,25 @@ class SamplingTensors:
                 break
 
         return cls(temps, top_ps, top_ks, min_ps, pres, freq, rep, seeds,
-                   prompt_mask, output_counts, do_penalties, do_topk,
+                   prompt_tokens, output_tokens, do_penalties, do_topk,
                    do_topp, do_minp, logprob_k)
+
+
+def penalty_tensors_from_tokens(
+    prompt_tokens: jnp.ndarray,   # [N, Lp] i32, pad = vocab (dropped)
+    output_tokens: jnp.ndarray,   # [N, Lo] i32, pad = vocab (dropped)
+    vocab_size: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Device-side scatter of the token histories into the [N, V] mask /
+    count tensors consumed by apply_penalties."""
+    n = prompt_tokens.shape[0]
+    rows_p = jnp.broadcast_to(jnp.arange(n)[:, None], prompt_tokens.shape)
+    rows_o = jnp.broadcast_to(jnp.arange(n)[:, None], output_tokens.shape)
+    prompt_mask = jnp.zeros((n, vocab_size), jnp.bool_).at[
+        rows_p, prompt_tokens].set(True, mode="drop")
+    output_counts = jnp.zeros((n, vocab_size), jnp.int32).at[
+        rows_o, output_tokens].add(1, mode="drop")
+    return prompt_mask, output_counts
 
 
 def apply_penalties(

@@ -12,10 +12,16 @@ admission path, so latency matters: reference logs per-prompt BERT
 latency for exactly this reason), and it shares the serving tokenizer, so
 no second vocabulary is shipped.
 
-Tasks (reference parity):
-- "regression":      predict log1p(response_len) directly
-- "classification":  percentile-bucket classes (e.g. p50/p99 thresholds,
-                     reference gen_predictor_dataset.py:54-57)
+Tasks (reference parity — predictor.py:320-326's five task types):
+- "regression":      predict log1p(response_len) directly (type 0);
+                     loss "mse" or "l1" (reference FLAG_L1_LOSS)
+- "classification":  percentile-bucket classes with inverse-frequency
+                     class weights (types 1 and 2 — binary is just one
+                     threshold; reference uses weighted NLL)
+- "ordinal":         regression onto the class INDEX, rounded at predict
+                     time (types 3 and 4 — ordinal multi/bi-class;
+                     reference trains BertRegressionModel on the label
+                     with L1/MSE)
 """
 from __future__ import annotations
 
@@ -42,7 +48,8 @@ class PredictorConfig:
     hidden_dim: int = 256
     max_prompt_tokens: int = 512     # truncate keeping the TAIL (reference
                                      # gen_predictor_dataset.py:7-13)
-    task: str = "regression"         # or "classification"
+    task: str = "regression"         # "classification" / "ordinal"
+    loss: str = "mse"                # "l1" (regression/ordinal only)
     class_thresholds: Tuple[int, ...] = ()   # bucket upper bounds
     lr: float = 1e-3
     batch_size: int = 64
@@ -62,10 +69,14 @@ class LengthPredictor:
         self.latencies_ms: List[float] = []
 
     @property
+    def num_classes(self) -> int:
+        return len(self.config.class_thresholds) + 1
+
+    @property
     def num_outputs(self) -> int:
         if self.config.task == "classification":
-            return len(self.config.class_thresholds) + 1
-        return 1
+            return self.num_classes
+        return 1  # regression and ordinal share the scalar head
 
     def _init_params(self, key):
         c = self.config
@@ -112,14 +123,20 @@ class LengthPredictor:
             out[i, :len(r)] = np.clip(r, 0, c.vocab_size - 1)
         return out, lengths
 
+    def _classes(self, y: np.ndarray) -> np.ndarray:
+        classes = np.zeros(len(y), np.int32)
+        for th in self.config.class_thresholds:
+            classes += (y > th).astype(np.int32)
+        return classes
+
     def _targets(self, response_lens: Sequence[int]) -> np.ndarray:
         c = self.config
         y = np.asarray(response_lens, np.float32)
         if c.task == "classification":
-            classes = np.zeros(len(y), np.int32)
-            for th in c.class_thresholds:
-                classes += (y > th).astype(np.int32)
-            return classes
+            return self._classes(y)
+        if c.task == "ordinal":
+            # Regress onto the class index (reference types 3/4).
+            return self._classes(y).astype(np.float32)
         return np.log1p(y)
 
     # --- training --------------------------------------------------------
@@ -143,11 +160,24 @@ class LengthPredictor:
         tx = optax.adamw(schedule)
         opt_state = tx.init(self.params)
 
+        # Inverse-frequency class weights (reference weighted NLL,
+        # predictor.py:374-377).
+        class_weights = None
+        if c.task == "classification":
+            counts = np.bincount(y[train_idx].astype(np.int64),
+                                 minlength=self.num_outputs).astype(
+                                     np.float32)
+            w = len(train_idx) / np.maximum(counts * self.num_outputs, 1.0)
+            class_weights = jnp.asarray(w)
+
         def loss_fn(params, xb, lb, yb):
             out = self._forward(params, xb, lb)
             if c.task == "classification":
-                return optax.softmax_cross_entropy_with_integer_labels(
-                    out, yb).mean()
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    out, yb)
+                return (ce * class_weights[yb]).mean()
+            if c.loss == "l1":
+                return jnp.mean(jnp.abs(out[:, 0] - yb))
             return jnp.mean((out[:, 0] - yb)**2)
 
         @jax.jit
@@ -179,6 +209,16 @@ class LengthPredictor:
     def evaluate(self, x, xlen, y) -> Dict[str, float]:
         out = np.asarray(self._predict_jit(self.params, jnp.asarray(x),
                                            jnp.asarray(xlen)))
+        if self.config.task == "ordinal":
+            # Round the regressed index to the nearest class (reference
+            # ordinal eval): accuracy + L1/MSE on the index.
+            pred = np.clip(np.round(out[:, 0]), 0,
+                           self.num_classes - 1).astype(np.int32)
+            return {
+                "accuracy": float((pred == y.astype(np.int32)).mean()),
+                "l1": float(np.abs(out[:, 0] - y).mean()),
+                "mse": float(((out[:, 0] - y)**2).mean()),
+            }
         if self.config.task == "classification":
             pred = out.argmax(-1)
             acc = float((pred == y).mean())
@@ -208,10 +248,14 @@ class LengthPredictor:
         x, xlen = self._encode(src)
         out = np.asarray(self._predict_jit(self.params, jnp.asarray(x),
                                            jnp.asarray(xlen)))[0]
-        if self.config.task == "classification":
+        if self.config.task in ("classification", "ordinal"):
             # Midpoint of the predicted bucket; the open-ended top bucket
             # extrapolates to 4x the last threshold.
-            cls = int(out.argmax())
+            if self.config.task == "classification":
+                cls = int(out.argmax())
+            else:
+                cls = int(np.clip(np.round(out[0]), 0,
+                                  self.num_classes - 1))
             last = (self.config.class_thresholds[-1]
                     if self.config.class_thresholds else 128)
             edges = (0, ) + tuple(self.config.class_thresholds) + (4 * last, )

@@ -36,6 +36,7 @@ from intellillm_tpu.config import (CacheConfig, ModelConfig, ParallelConfig,
                                    SchedulerConfig)
 from intellillm_tpu.layers.attention import AttentionMetadata
 from intellillm_tpu.layers.sampler import (SamplingTensors, apply_penalties,
+                                           penalty_tensors_from_tokens,
                                            sample)
 from intellillm_tpu.logger import init_logger
 from intellillm_tpu.native import build_decode_batch, build_prompt_slots
@@ -159,12 +160,16 @@ class ModelRunner:
 
     def _compute_logits_and_sample(self, params, hidden_rows, temperatures,
                                    top_ks, top_ps, min_ps, seeds, pres_pen,
-                                   freq_pen, rep_pen, prompt_mask,
-                                   output_counts, *, num_samples, logprob_k,
+                                   freq_pen, rep_pen, prompt_tokens,
+                                   output_tokens, *, num_samples, logprob_k,
                                    do_topk, do_topp, do_minp, do_penalties):
         logits = self.model.compute_logits(params, hidden_rows)
         logits = logits.astype(jnp.float32)
         if do_penalties:
+            # Token histories scatter into [N, V] mask/counts ON DEVICE —
+            # the host ships only the padded id lists.
+            prompt_mask, output_counts = penalty_tensors_from_tokens(
+                prompt_tokens, output_tokens, logits.shape[-1])
             logits = apply_penalties(logits, prompt_mask, output_counts,
                                      pres_pen, freq_pen, rep_pen)
         return sample(logits, temperatures, top_ks, top_ps, min_ps, seeds,
@@ -174,7 +179,7 @@ class ModelRunner:
     def _prefill_fn(self, params, kv_caches, token_ids, positions,
                     attn_metadata, logits_indices, temperatures, top_ks,
                     top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
-                    prompt_mask, output_counts, lora=None, *, num_samples,
+                    prompt_tokens, output_tokens, lora=None, *, num_samples,
                     logprob_k, do_topk, do_topp, do_minp, do_penalties):
         hidden, new_caches = self._call_model(params, token_ids, positions,
                                               kv_caches, attn_metadata, lora)
@@ -182,7 +187,7 @@ class ModelRunner:
         sel = hidden[jnp.arange(b), logits_indices]          # [B, E]
         sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
             params, sel, temperatures, top_ks, top_ps, min_ps, seeds,
-            pres_pen, freq_pen, rep_pen, prompt_mask, output_counts,
+            pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens,
             num_samples=num_samples, logprob_k=logprob_k, do_topk=do_topk,
             do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties)
         packed = self._pack(sampled, lp, tk_ids[:, None, :], tk_lp[:, None, :])
@@ -190,8 +195,8 @@ class ModelRunner:
 
     def _decode_fn(self, params, kv_caches, token_ids, positions,
                    block_tables, context_lens, temperatures, top_ks, top_ps,
-                   min_ps, seeds, pres_pen, freq_pen, rep_pen, prompt_mask,
-                   output_counts, lora=None, *, num_steps, logprob_k,
+                   min_ps, seeds, pres_pen, freq_pen, rep_pen, prompt_tokens,
+                   output_tokens, lora=None, *, num_steps, logprob_k,
                    do_topk, do_topp, do_minp, do_penalties):
         """K fused decode iterations (staged).
 
@@ -241,8 +246,8 @@ class ModelRunner:
             seeds_k = seeds + k.astype(jnp.uint32) * _SEED_STRIDE
             sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
                 params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
-                seeds_k, pres_pen, freq_pen, rep_pen, prompt_mask,
-                output_counts, num_samples=1, logprob_k=logprob_k,
+                seeds_k, pres_pen, freq_pen, rep_pen, prompt_tokens,
+                output_tokens, num_samples=1, logprob_k=logprob_k,
                 do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
                 do_penalties=do_penalties)
             next_tokens = sampled[:, 0]
@@ -281,7 +286,7 @@ class ModelRunner:
     def _decode_fn_single(self, params, kv_caches, token_ids, positions,
                           block_tables, context_lens, temperatures, top_ks,
                           top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
-                          prompt_mask, output_counts, lora=None, *,
+                          prompt_tokens, output_tokens, lora=None, *,
                           logprob_k, do_topk, do_topp, do_minp,
                           do_penalties):
         """Unstaged single-step decode: writes KV to the pool before
@@ -312,7 +317,7 @@ class ModelRunner:
                                               lora)
         sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
             params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
-            seeds, pres_pen, freq_pen, rep_pen, prompt_mask, output_counts,
+            seeds, pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens,
             num_samples=1, logprob_k=logprob_k, do_topk=do_topk,
             do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties)
         packed = self._pack(sampled, lp, tk_ids[:, None, :],
@@ -524,8 +529,8 @@ class ModelRunner:
             place(st.frequency_penalties if st.do_penalties else zeros),
             place(st.repetition_penalties if st.do_penalties
                   else np.ones(padded_n, np.float32)),
-            place(st.prompt_mask) if st.do_penalties else None,
-            place(st.output_counts) if st.do_penalties else None,
+            place(st.prompt_tokens) if st.do_penalties else None,
+            place(st.output_tokens) if st.do_penalties else None,
         )
 
         if is_prompt:

@@ -39,7 +39,10 @@ void build_decode_batch(const int32_t* tables_flat,
     out_positions[i] = positions[i];
     out_ctx[i] = ctx[i];
     const int64_t start = table_offsets[i];
-    const int64_t len = table_offsets[i + 1] - start;
+    int64_t len = table_offsets[i + 1] - start;
+    // Clamp: a table longer than the padded width must never write past
+    // its row (the Python fallback raises here; heap corruption is worse).
+    if (len > width) len = width;
     std::memcpy(out_tables + i * width, tables_flat + start,
                 sizeof(int32_t) * static_cast<size_t>(len));
   }

@@ -107,3 +107,30 @@ def test_sampling_tensors_build_flags():
     assert st.temperatures[1] == np.float32(0.9)
     assert st.top_ks[0] == 100  # disabled → vocab
     assert st.top_ks[2] == 100  # padding rows
+
+
+def test_penalty_tensors_from_tokens_matches_host_scatter():
+    """Device-side [N,V] scatter == the old host construction."""
+    import jax.numpy as jnp
+    import numpy as np
+    from intellillm_tpu.layers.sampler import penalty_tensors_from_tokens
+
+    vocab = 12
+    rows = [([1, 3, 3, 5], [2, 2, 7]), ([0, 11], []), ([4], [4, 4, 4])]
+    lp = max(len(p) for p, _ in rows)
+    lo = max(len(o) for _, o in rows)
+    pt = np.full((4, lp), vocab, np.int32)     # padded row 3 = all-pad
+    ot = np.full((4, lo), vocab, np.int32)
+    for i, (p, o) in enumerate(rows):
+        pt[i, :len(p)] = p
+        ot[i, :len(o)] = o
+    pm, oc = penalty_tensors_from_tokens(jnp.asarray(pt), jnp.asarray(ot),
+                                         vocab)
+    pm, oc = np.asarray(pm), np.asarray(oc)
+    ref_pm = np.zeros((4, vocab), bool)
+    ref_oc = np.zeros((4, vocab), np.int32)
+    for i, (p, o) in enumerate(rows):
+        ref_pm[i, p] = True
+        np.add.at(ref_oc[i], o, 1)
+    np.testing.assert_array_equal(pm, ref_pm)
+    np.testing.assert_array_equal(oc, ref_oc)

@@ -80,3 +80,51 @@ def test_sjf_experiment_end_to_end(tiny_opt_dir):
                                     max_batch_size=4, max_tokens=8)
     assert res["num_jobs"] == 4
     assert res["avg_jct_ms"] > 0
+
+
+def test_predictor_ordinal_task():
+    """Ordinal variant (reference task types 3/4): regress onto the class
+    index, round at predict time."""
+    import numpy as np
+    from intellillm_tpu.research.predictor import (LengthPredictor,
+                                                   PredictorConfig)
+
+    rng = np.random.default_rng(0)
+    # Prompts whose leading token determines response length bucket.
+    prompts, lens = [], []
+    for _ in range(400):
+        cls = rng.integers(0, 3)
+        tok = [5, 50, 95][cls]
+        prompts.append([tok] * (3 + int(rng.integers(0, 4))))
+        lens.append([10, 40, 200][cls] + int(rng.integers(0, 5)))
+    cfg = PredictorConfig(vocab_size=128, embed_dim=16, hidden_dim=32,
+                          task="ordinal", loss="l1",
+                          class_thresholds=(24, 97), epochs=80,
+                          batch_size=32)
+    pred = LengthPredictor(cfg)
+    metrics = pred.train(prompts, lens)
+    assert metrics["accuracy"] > 0.7
+    short = pred.predict(None, [5, 5, 5])
+    long = pred.predict(None, [95, 95, 95])
+    assert short < long
+
+
+def test_predictor_classification_weighted():
+    """Weighted CE handles imbalanced classes (reference weighted NLL)."""
+    import numpy as np
+    from intellillm_tpu.research.predictor import (LengthPredictor,
+                                                   PredictorConfig)
+
+    rng = np.random.default_rng(1)
+    prompts, lens = [], []
+    for _ in range(300):
+        cls = int(rng.random() > 0.9)   # 10:1 imbalance
+        tok = 5 if cls == 0 else 95
+        prompts.append([tok] * 4)
+        lens.append(10 if cls == 0 else 200)
+    cfg = PredictorConfig(vocab_size=128, embed_dim=16, hidden_dim=32,
+                          task="classification", class_thresholds=(50, ),
+                          epochs=25, batch_size=32)
+    pred = LengthPredictor(cfg)
+    metrics = pred.train(prompts, lens)
+    assert metrics["macro_f1"] > 0.8
