@@ -32,6 +32,9 @@ _SAMPLING_EPS = 1e-5
 # Top-K panel buckets: K is padded to one of these so jit compiles a small
 # set of shapes (analogue of CUDA-graph size bucketing, but for sampling).
 LOGPROB_K_BUCKETS = (8, 16, 32, 64, 128)
+# Penalty token-history length buckets (coarse: each distinct (Lp, Lo)
+# pair compiles a separate model executable).
+_PENALTY_LEN_BUCKETS = (128, 512, 2048, 8192, 32768)
 
 
 @dataclass
@@ -110,11 +113,14 @@ class SamplingTensors:
         prompt_tokens = None
         output_tokens = None
         if do_penalties and row_token_ids is not None:
-            from intellillm_tpu.utils import next_power_of_2
-
             def pad_len(m):
-                # Power-of-two length buckets bound the jit shape count.
-                return max(16, next_power_of_2(m))
+                # COARSE length buckets: each (Lp, Lo) pair is a separate
+                # whole-model executable, so keep the variant count tiny
+                # (≤5 per axis) rather than power-of-two-per-length.
+                for b in _PENALTY_LEN_BUCKETS:
+                    if m <= b:
+                        return b
+                return _PENALTY_LEN_BUCKETS[-1]
 
             lp = pad_len(max(len(p) for p, _ in row_token_ids))
             lo = pad_len(max((len(o) for _, o in row_token_ids),

@@ -82,6 +82,15 @@ def build_decode_batch(tables, tokens, positions, ctx, padded_n: int,
     context_lens [P], block_tables [P,W]) padded arrays."""
     lib = _load()
     n = len(tables)
+    # Identical failure behavior in both paths: an oversized table means
+    # the width bucketing and the scheduler disagree — fail loudly rather
+    # than truncate the context (the C++ clamp is heap-safety defense
+    # only).
+    for t in tables:
+        if len(t) > width:
+            raise ValueError(
+                f"block table of {len(t)} blocks exceeds padded width "
+                f"{width}")
     out_tokens = np.zeros((padded_n, 1), np.int32)
     out_positions = np.zeros((padded_n, 1), np.int32)
     out_ctx = np.zeros(padded_n, np.int32)

@@ -7,6 +7,7 @@ reference's CUDA-graph capture sizes, `vllm/worker/model_runner.py:26-28`).
 from __future__ import annotations
 
 import enum
+import os
 import uuid
 from typing import Any, Iterable, List, Sequence
 
@@ -131,3 +132,17 @@ def in_test_cpu_mode() -> bool:
     import jax
 
     return jax.default_backend() == "cpu"
+
+
+def apply_platform_override() -> None:
+    """Honor INTELLILLM_JAX_PLATFORM before any backend initializes.
+
+    Plain JAX_PLATFORMS env is not reliable here: site customizations may
+    pre-import jax with a platform plugin already registered, so the
+    supported switch is jax.config.update before first device use (the
+    same approach as tests/conftest.py).
+    """
+    plat = os.environ.get("INTELLILLM_JAX_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)

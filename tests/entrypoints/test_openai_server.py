@@ -40,7 +40,10 @@ def openai_server(tmp_path_factory):
             d, safe_serialization=True)
 
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    # Plain JAX_PLATFORMS is not honored when a site customization
+    # pre-registers a TPU plugin; the server applies this override via
+    # jax.config before backend init.
+    env["INTELLILLM_JAX_PLATFORM"] = "cpu"
     proc = subprocess.Popen(
         [sys.executable, "-m", "intellillm_tpu.entrypoints.openai.api_server",
          "--model", d, "--dtype", "float32", "--max-model-len", "128",

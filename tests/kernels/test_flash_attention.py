@@ -1,16 +1,16 @@
 """Pallas prefill flash-attention kernel vs the jnp reference over a
 GQA × head-size × length × feature grid (reference pattern:
-`tests/kernels/test_attention.py`). TPU-only; the engine uses the
+`tests/kernels/test_attention.py`). run under interpret mode on CPU (conftest.py), natively on TPU.
 reference path on CPU."""
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from intellillm_tpu.ops.attention import prefill_attention_reference
 
-requires_tpu = pytest.mark.skipif(jax.default_backend() != "tpu",
-                                  reason="Pallas kernel requires TPU")
+# On CPU the kernels run in TPU interpret mode (see conftest.py);
+# the marker is kept as documentation of the native target.
+requires_tpu = pytest.mark.kernel
 
 
 def _run(hq, hkv, d, l, lens, sliding_window=None, use_alibi=False,

@@ -2,18 +2,19 @@
 grid (reference pattern: `tests/kernels/test_attention.py` sweeps dtypes ×
 head configs × block sizes against `ref_single_query_cached_kv_attention`).
 
-The kernel needs a real TPU; on CPU these tests are skipped (the engine
-itself uses the reference path there).
+On TPU the Mosaic kernel compiles natively; on CPU it runs under
+Pallas TPU interpret mode (tests/kernels/conftest.py), so the grid is
+exercised everywhere.
 """
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from intellillm_tpu.ops.attention import decode_attention_reference
 
-requires_tpu = pytest.mark.skipif(jax.default_backend() != "tpu",
-                                  reason="Pallas kernel requires TPU")
+# On CPU the kernels run in TPU interpret mode (see conftest.py);
+# the marker is kept as documentation of the native target.
+requires_tpu = pytest.mark.kernel
 
 
 def make_cache(rng, nb, hkv, bs, d, dtype):
