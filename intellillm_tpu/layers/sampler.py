@@ -113,14 +113,15 @@ class SamplingTensors:
         prompt_tokens = None
         output_tokens = None
         if do_penalties and row_token_ids is not None:
+            from intellillm_tpu.utils import pad_to_bucket
+
             def pad_len(m):
                 # COARSE length buckets: each (Lp, Lo) pair is a separate
                 # whole-model executable, so keep the variant count tiny
                 # (≤5 per axis) rather than power-of-two-per-length.
-                for b in _PENALTY_LEN_BUCKETS:
-                    if m <= b:
-                        return b
-                return _PENALTY_LEN_BUCKETS[-1]
+                # Histories beyond the top bucket still get full length
+                # (never truncate — that would silently drop penalties).
+                return max(pad_to_bucket(m, _PENALTY_LEN_BUCKETS), m)
 
             lp = pad_len(max(len(p) for p, _ in row_token_ids))
             lo = pad_len(max((len(o) for _, o in row_token_ids),

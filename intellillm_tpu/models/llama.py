@@ -111,6 +111,15 @@ class LlamaForCausalLM:
                                    lora["row_slots"])
         return out
 
+    def tp_pad_paths(self):
+        """(param path → dim) pairs that `shard_params` may zero-pad to a
+        64*tp multiple when the vocab doesn't divide the TP degree
+        (reference `vocab_parallel_embedding.py:39-111`). Padded embedding
+        rows are never gathered (ids < vocab); padded logit columns are
+        masked to -inf by the runner before sampling."""
+        return {"['embed_tokens']": 0, "['lm_head']": 1,
+                "['lm_head']['q']": 1, "['lm_head']['s']": 0}
+
     def lora_target_dims(self):
         """Target module name → (dim_in, dim_out), consumed by
         `lora.models.LoRAModelManager` to size the adapter stacks."""

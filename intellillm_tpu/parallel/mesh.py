@@ -78,10 +78,19 @@ def shard_params(host_params: Any, mesh: Mesh, model) -> Any:
         for path, spec in jax.tree_util.tree_flatten_with_path(
             specs, is_leaf=lambda x: isinstance(x, P))[0]
     }
+    # Vocab-sized dims may be ZERO-PADDED to a shardable multiple instead
+    # of replicating (reference pads the vocab to 64*tp,
+    # `vocab_parallel_embedding.py:39-111`); the model names which
+    # (path, dim) pairs are safe to pad — padding is only valid where
+    # extra rows/cols are inert (embedding rows never gathered; logit
+    # columns masked to -inf by the runner).
+    pad_eligible = {}
+    if hasattr(model, "tp_pad_paths"):
+        pad_eligible = model.tp_pad_paths()
 
     def place(path, leaf):
-        spec = spec_by_path.get(jax.tree_util.keystr(path)) or P()
-        # Validate divisibility; replicate non-dividing dims.
+        key = jax.tree_util.keystr(path)
+        spec = spec_by_path.get(key) or P()
         fixed = []
         for dim, axis in enumerate(spec):
             if axis is None:
@@ -89,10 +98,22 @@ def shard_params(host_params: Any, mesh: Mesh, model) -> Any:
                 continue
             axis_size = mesh.shape[axis]
             if leaf.shape[dim] % axis_size != 0:
+                if pad_eligible.get(key) == dim:
+                    pad_to = 64 * axis_size
+                    target = -(-leaf.shape[dim] // pad_to) * pad_to
+                    widths = [(0, 0)] * leaf.ndim
+                    widths[dim] = (0, target - leaf.shape[dim])
+                    leaf = np.pad(np.asarray(leaf), widths)
+                    logger.info(
+                        "Param %s dim %d padded %d -> %d for %s=%d.",
+                        key, dim, target - widths[dim][1], target, axis,
+                        axis_size)
+                    fixed.append(axis)
+                    continue
                 logger.warning(
                     "Param %s dim %d (%d) not divisible by %s=%d; "
-                    "replicating.", jax.tree_util.keystr(path), dim,
-                    leaf.shape[dim], axis, axis_size)
+                    "replicating.", key, dim, leaf.shape[dim], axis,
+                    axis_size)
                 fixed.append(None)
             else:
                 fixed.append(axis)

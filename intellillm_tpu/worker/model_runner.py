@@ -165,6 +165,12 @@ class ModelRunner:
                                    do_topk, do_topp, do_minp, do_penalties):
         logits = self.model.compute_logits(params, hidden_rows)
         logits = logits.astype(jnp.float32)
+        if logits.shape[-1] > self.vocab_size:
+            # TP vocab padding (parallel/mesh.py): the padded columns hold
+            # zeros from the padded weights — mask them so they can never
+            # win greedy argmax or receive sampling mass.
+            pad = jnp.arange(logits.shape[-1]) >= self.vocab_size
+            logits = jnp.where(pad[None, :], -1e30, logits)
         if do_penalties:
             # Token histories scatter into [N, V] mask/counts ON DEVICE —
             # the host ships only the padded id lists.

@@ -162,3 +162,67 @@ def test_awq_tp_runs_and_matches_tp1(tmp_path_factory, example_prompts, tp):
     ref, _ = _generate_greedy(awq_dir, example_prompts, 8)
     got, _ = _generate_greedy(awq_dir, example_prompts, 8, tp=tp)
     assert got == ref
+
+
+def _dummy_llama_engine(vocab, tp):
+    from transformers import LlamaConfig
+    from intellillm_tpu.config import (CacheConfig, ModelConfig,
+                                       ParallelConfig, SchedulerConfig)
+    from intellillm_tpu.engine.llm_engine import LLMEngine
+
+    hf = LlamaConfig(vocab_size=vocab, hidden_size=64,
+                     intermediate_size=128, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=4,
+                     max_position_embeddings=128, tie_word_embeddings=False)
+    model_config = ModelConfig.from_hf_config(hf, dtype="float32",
+                                              max_model_len=128,
+                                              load_format="dummy")
+    cache_config = CacheConfig(block_size=16, num_device_blocks_override=64,
+                               swap_space_gib=0.01)
+    scheduler_config = SchedulerConfig(max_num_batched_tokens=2048,
+                                       max_num_seqs=8, max_model_len=128,
+                                       max_paddings=512)
+    return LLMEngine(model_config, cache_config,
+                     ParallelConfig(tensor_parallel_size=tp),
+                     scheduler_config, log_stats=False,
+                     skip_tokenizer_init=True)
+
+
+@requires_8_devices
+def test_vocab_padding_shards_odd_vocab(example_prompts):
+    """A vocab of 121 does not divide tp=4: embeddings and lm_head must be
+    PADDED to 64*tp and sharded (reference pads the same way,
+    `vocab_parallel_embedding.py:39`), not silently replicated — and
+    greedy outputs must still match tp=1 exactly."""
+    from intellillm_tpu.sampling_params import SamplingParams
+
+    vocab = 121
+    prompts = [[5, 9, 2, 7], [101, 3, 18], [120, 120, 1, 4, 6]]
+
+    def run(tp):
+        engine = _dummy_llama_engine(vocab, tp)
+        params = SamplingParams(temperature=0.0, max_tokens=8,
+                                ignore_eos=True)
+        for i, ids in enumerate(prompts):
+            engine.add_request(str(i), None, params,
+                               prompt_token_ids=list(ids))
+        results = {}
+        while engine.has_unfinished_requests():
+            for out in engine.step():
+                if out.finished:
+                    results[out.request_id] = out.outputs[0].token_ids
+        return [results[str(i)] for i in range(len(prompts))], engine
+
+    ref, _ = run(1)
+    got, engine = run(4)
+    assert got == ref
+    assert all(all(t < vocab for t in ids) for ids in got)
+
+    params = engine.worker.params
+    embed = params["embed_tokens"]
+    assert embed.shape[0] == 256                  # 121 → 64*tp multiple
+    # Actually sharded over "model": each shard holds 1/4 of the rows.
+    assert embed.sharding.shard_shape(embed.shape)[0] == 64
+    head = params["lm_head"]
+    assert head.shape[1] == 256
+    assert head.sharding.shard_shape(head.shape)[1] == 64

@@ -1,7 +1,7 @@
 """Pallas prefill flash-attention kernel vs the jnp reference over a
 GQA × head-size × length × feature grid (reference pattern:
-`tests/kernels/test_attention.py`). run under interpret mode on CPU (conftest.py), natively on TPU.
-reference path on CPU."""
+`tests/kernels/test_attention.py`). Runs under interpret mode on CPU
+(see conftest.py) and natively on TPU."""
 import jax.numpy as jnp
 import numpy as np
 import pytest
