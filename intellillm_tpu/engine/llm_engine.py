@@ -191,6 +191,11 @@ class LLMEngine:
                        lora_request)
 
         prefix = None
+        if (prefix_pos is not None
+                and sampling_params.prompt_logprobs is not None):
+            # Cached-prefix positions have no hidden states in the prefill.
+            raise ValueError(
+                "prompt_logprobs cannot be combined with prefix_pos.")
         if prefix_pos is not None:
             if self.model_config.get_sliding_window() is not None:
                 # The ring block layout stores only the last `window` tokens
@@ -238,9 +243,12 @@ class LLMEngine:
                 "logits_processors are not supported yet: sampling runs "
                 "inside the jitted TPU step and has no per-request Python "
                 "hook. (Planned: device-side processor vocabulary masks.)")
-        if sp.prompt_logprobs is not None:
-            raise NotImplementedError(
-                "prompt_logprobs is not supported yet.")
+        from intellillm_tpu.layers.sampler import LOGPROB_K_BUCKETS
+        if (sp.prompt_logprobs is not None
+                and sp.prompt_logprobs > LOGPROB_K_BUCKETS[-1]):
+            raise ValueError(
+                f"prompt_logprobs must be <= {LOGPROB_K_BUCKETS[-1]} "
+                "(sampler panel buckets).")
 
     def abort_request(self, request_id: Union[str, Iterable[str]]) -> None:
         self.scheduler.abort_seq_group(request_id)
@@ -312,6 +320,8 @@ class LLMEngine:
         outputs: SequenceGroupOutput,
     ) -> None:
         sampling_params = seq_group.sampling_params
+        if outputs.prompt_logprobs is not None:
+            seq_group.prompt_logprobs = outputs.prompt_logprobs
         parent_seqs = seq_group.get_seqs(status=SequenceStatus.RUNNING)
         existing_finished = seq_group.get_finished_seqs()
 

@@ -35,7 +35,8 @@ import numpy as np
 from intellillm_tpu.config import (CacheConfig, ModelConfig, ParallelConfig,
                                    SchedulerConfig)
 from intellillm_tpu.layers.attention import AttentionMetadata
-from intellillm_tpu.layers.sampler import (SamplingTensors, apply_penalties,
+from intellillm_tpu.layers.sampler import (LOGPROB_K_BUCKETS,
+                                           SamplingTensors, apply_penalties,
                                            penalty_tensors_from_tokens,
                                            sample)
 from intellillm_tpu.logger import init_logger
@@ -99,7 +100,7 @@ class ModelRunner:
         self._jit_prefill = jax.jit(
             self._prefill_fn,
             static_argnames=("num_samples", "logprob_k", "do_topk", "do_topp",
-                             "do_minp", "do_penalties"),
+                             "do_minp", "do_penalties", "prompt_logprob_k"),
             donate_argnames=("kv_caches", ),
         )
         self._jit_decode = jax.jit(
@@ -182,11 +183,54 @@ class ModelRunner:
                       logprob_k=logprob_k, num_samples=num_samples,
                       do_topk=do_topk, do_topp=do_topp, do_minp=do_minp)
 
+    def _prompt_logprobs(self, params, hidden, token_ids, *, k: int):
+        """Per-position prompt logprobs (reference sampler.py prompt-
+        logprob path): position t's logits predict token t+1. Logits are
+        computed in 128-position chunks via scan so [B, C, V] — not
+        [B, L, V] — is the peak memory."""
+        b, l, e = hidden.shape
+        chunk = 128
+        pad_l = ((l + chunk - 1) // chunk) * chunk
+        h = jnp.pad(hidden, ((0, 0), (0, pad_l - l), (0, 0)))
+        targets = jnp.pad(token_ids[:, 1:], ((0, 0), (0, pad_l - l + 1)))
+        nc = pad_l // chunk
+        h = h.reshape(b, nc, chunk, e).swapaxes(0, 1)        # [nc, B, C, E]
+        tg = targets.reshape(b, nc, chunk).swapaxes(0, 1)    # [nc, B, C]
+
+        def body(carry, inp):
+            h_c, t_c = inp
+            logits = self.model.compute_logits(params, h_c)
+            logits = logits.astype(jnp.float32)
+            if logits.shape[-1] > self.vocab_size:
+                # TP vocab padding: exclude padded columns (same mask as
+                # the sampling path) so log_softmax normalizes over the
+                # real vocab and top_k can't emit out-of-vocab ids.
+                pad = jnp.arange(logits.shape[-1]) >= self.vocab_size
+                logits = jnp.where(pad, -1e30, logits)
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            tgt_lp = jnp.take_along_axis(lp, t_c[..., None],
+                                         axis=-1)[..., 0]   # [B, C]
+            top_lp, top_ids = jax.lax.top_k(lp, k)           # [B, C, K]
+            return carry, (tgt_lp, top_ids.astype(jnp.int32), top_lp)
+
+        _, (tgt_lp, top_ids, top_lp) = jax.lax.scan(body, None, (h, tg))
+        # [nc, B, C, ...] → [B, L, ...]
+        tgt_lp = tgt_lp.swapaxes(0, 1).reshape(b, pad_l)[:, :l]
+        top_ids = top_ids.swapaxes(0, 1).reshape(b, pad_l, k)[:, :l]
+        top_lp = top_lp.swapaxes(0, 1).reshape(b, pad_l, k)[:, :l]
+        # Pack [B, L, 1 + 2K] int32 for the single D2H fetch.
+        return jnp.concatenate([
+            jax.lax.bitcast_convert_type(tgt_lp, jnp.int32)[..., None],
+            top_ids,
+            jax.lax.bitcast_convert_type(top_lp, jnp.int32),
+        ], axis=-1)
+
     def _prefill_fn(self, params, kv_caches, token_ids, positions,
                     attn_metadata, logits_indices, temperatures, top_ks,
                     top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
                     prompt_tokens, output_tokens, lora=None, *, num_samples,
-                    logprob_k, do_topk, do_topp, do_minp, do_penalties):
+                    logprob_k, do_topk, do_topp, do_minp, do_penalties,
+                    prompt_logprob_k=0):
         hidden, new_caches = self._call_model(params, token_ids, positions,
                                               kv_caches, attn_metadata, lora)
         b = token_ids.shape[0]
@@ -197,6 +241,10 @@ class ModelRunner:
             num_samples=num_samples, logprob_k=logprob_k, do_topk=do_topk,
             do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties)
         packed = self._pack(sampled, lp, tk_ids[:, None, :], tk_lp[:, None, :])
+        if prompt_logprob_k:
+            plp = self._prompt_logprobs(params, hidden, token_ids,
+                                        k=prompt_logprob_k)
+            return packed, plp, new_caches
         return packed, new_caches
 
     def _decode_fn(self, params, kv_caches, token_ids, positions,
@@ -540,12 +588,26 @@ class ModelRunner:
         )
 
         if is_prompt:
-            packed, new_caches = self._jit_prefill(
+            # prompt_logprobs: bucketed panel width, 0 = not requested.
+            plp_k = 0
+            for sp in row_params:
+                if sp.prompt_logprobs is not None:
+                    plp_k = max(plp_k, sp.prompt_logprobs, 1)
+            if plp_k:
+                plp_k = pad_to_bucket(plp_k, LOGPROB_K_BUCKETS)
+            result = self._jit_prefill(
                 self.params, kv_caches,
                 place(arrays["token_ids"]), place(arrays["positions"]),
                 attn_metadata, place(arrays["logits_indices"]),
                 *sampling_args, lora_state, num_samples=num_samples,
-                **common)
+                prompt_logprob_k=plp_k, **common)
+            if plp_k:
+                packed, plp_packed, new_caches = result
+                self._attach_prompt_logprobs(
+                    np.asarray(plp_packed), plp_k, seq_group_metadata_list,
+                    rows, row_params)
+            else:
+                packed, new_caches = result
             t1, t2 = num_samples, 1
             num_steps = 1
         else:
@@ -581,6 +643,34 @@ class ModelRunner:
                                          sampled, sampled_lp, topk_ids,
                                          topk_lp, is_prompt, num_steps)
         return outputs, new_caches
+
+    def _attach_prompt_logprobs(self, plp_packed, k, metas, rows,
+                                row_params):
+        """Unpack [B, L, 1+2K] and store the reference-format
+        PromptLogprobs list (None for token 0, then {token_id: logprob}
+        with the top-k panel) onto each requesting metadata object; the
+        engine copies it to the SequenceGroup."""
+        meta_by_req = {m.request_id: m for m in metas}
+        for i, (req_id, seq_id) in enumerate(rows):
+            sp = row_params[i]
+            if sp.prompt_logprobs is None:
+                continue
+            meta = meta_by_req[req_id]
+            data = meta.seq_data[seq_id]
+            n = data.get_prompt_len()
+            tokens = data.prompt_token_ids
+            tgt_lp = plp_packed[i, :, 0].view(np.float32)
+            top_ids = plp_packed[i, :, 1:1 + k]
+            top_lp = plp_packed[i, :, 1 + k:].view(np.float32)
+            out = [None]
+            for t in range(1, n):
+                # Position t-1's logits predict token t.
+                d = {int(tokens[t]): float(tgt_lp[t - 1])}
+                for tt, lpv in zip(top_ids[t - 1, :sp.prompt_logprobs],
+                                   top_lp[t - 1, :sp.prompt_logprobs]):
+                    d.setdefault(int(tt), float(lpv))
+                out.append(d)
+            meta.computed_prompt_logprobs = out
 
     # --- sampler post-processing -----------------------------------------
 
@@ -655,7 +745,11 @@ class ModelRunner:
                             seq_id, tok,
                             logprob_dict(row, tok, sampled_lp[row, k])))
 
-                output.append(SequenceGroupOutput(samples,
-                                                  prompt_logprobs=None))
+                output.append(SequenceGroupOutput(
+                    samples,
+                    prompt_logprobs=(getattr(meta,
+                                             "computed_prompt_logprobs",
+                                             None)
+                                     if meta.is_prompt else None)))
             outputs_per_step.append(output)
         return outputs_per_step

@@ -134,3 +134,67 @@ def test_penalty_tensors_from_tokens_matches_host_scatter():
         np.add.at(ref_oc[i], o, 1)
     np.testing.assert_array_equal(pm, ref_pm)
     np.testing.assert_array_equal(oc, ref_oc)
+
+
+def test_prompt_logprobs_match_hf(tiny_opt_dir):
+    """prompt_logprobs golden vs HF transformers per-token log-softmax
+    (reference format: entry 0 is None; entry t maps token t (and the
+    top-k panel) to log P(token_t | tokens_<t))."""
+    import numpy as np
+    import torch
+    from intellillm_tpu import LLM, SamplingParams
+
+    prompt = "the capital of france is the capital of france"
+    llm = LLM(model=tiny_opt_dir, dtype="float32",
+              num_device_blocks_override=128, max_model_len=128,
+              max_num_seqs=8, swap_space=0.01)
+    out = llm.generate([prompt],
+                       SamplingParams(temperature=0.0, max_tokens=1,
+                                      prompt_logprobs=3))[0]
+    plp = out.prompt_logprobs
+    token_ids = out.prompt_token_ids
+    n = len(token_ids)
+    assert plp is not None and len(plp) == n
+    assert plp[0] is None
+
+    from transformers import AutoModelForCausalLM
+    model = AutoModelForCausalLM.from_pretrained(tiny_opt_dir,
+                                                 torch_dtype=torch.float32)
+    with torch.no_grad():
+        logits = model(torch.tensor([token_ids])).logits[0]
+    ref_lp = torch.log_softmax(logits.float(), dim=-1).numpy()
+
+    for t in range(1, n):
+        d = plp[t]
+        assert token_ids[t] in d
+        np.testing.assert_allclose(d[token_ids[t]],
+                                   ref_lp[t - 1, token_ids[t]],
+                                   rtol=2e-3, atol=2e-3)
+        # Top-k panel entries also match HF.
+        for tok, lp in d.items():
+            np.testing.assert_allclose(lp, ref_lp[t - 1, tok], rtol=2e-3,
+                                       atol=2e-3)
+        assert len(d) >= 3
+
+
+def test_prompt_logprobs_mixed_batch(tiny_opt_dir, example_prompts):
+    """A batch mixing prompt_logprobs and plain requests: only the
+    requesting ones get the list; generations are unaffected."""
+    from intellillm_tpu import LLM, SamplingParams
+
+    llm = LLM(model=tiny_opt_dir, dtype="float32",
+              num_device_blocks_override=128, max_model_len=128,
+              max_num_seqs=8, swap_space=0.01)
+    plain = llm.generate(example_prompts[:2],
+                         SamplingParams(temperature=0.0, max_tokens=6))
+    engine = llm.llm_engine
+    engine.add_request("0", example_prompts[0],
+                       SamplingParams(temperature=0.0, max_tokens=6,
+                                      prompt_logprobs=2))
+    engine.add_request("1", example_prompts[1],
+                       SamplingParams(temperature=0.0, max_tokens=6))
+    outs = {o.request_id: o for o in llm._run_engine(use_tqdm=False)}
+    assert outs["0"].prompt_logprobs is not None
+    assert outs["1"].prompt_logprobs is None
+    assert outs["0"].outputs[0].token_ids == plain[0].outputs[0].token_ids
+    assert outs["1"].outputs[0].token_ids == plain[1].outputs[0].token_ids
