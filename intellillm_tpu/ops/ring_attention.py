@@ -1,0 +1,121 @@
+"""Ring attention: sequence-parallel (context-parallel) exact attention
+over a mesh axis.
+
+Role: the long-context scaling mechanism the reference lacks entirely
+(SURVEY §2.6: "SP / EP / CP / ring-attention: absent") — sequences longer
+than one chip's HBM shard over a mesh axis; K/V shards rotate around the
+ring via `lax.ppermute` while each device accumulates its queries'
+attention with an online softmax, overlapping the ICI transfer of the
+next shard with compute on the current one (Liu et al., Ring Attention
+with Blockwise Transformers — PAPERS.md).
+
+TPU mapping: the ring IS the ICI torus — `ppermute` between ring
+neighbors rides a single ICI hop per step; per-step compute is a
+[Lq_local, D] x [Lkv_local, D] block matmul that XLA tiles onto the MXU.
+N-1 hops move each K/V shard once; peak memory per chip is O(L/N).
+
+Causal masking uses ABSOLUTE positions (shard_index * shard_len +
+offset), so the result is exactly standard causal attention on the
+gathered sequence.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
+                          causal: bool):
+    """Per-shard body (runs inside shard_map).
+
+    q/k/v: [B, C, H, D] — this device's sequence shard. Returns the
+    attended output for the local queries over the FULL sequence.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, c, h, d = q.shape
+
+    qf = q.astype(jnp.float32) * scale
+    q_pos = idx * c + jnp.arange(c)                      # absolute [C]
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(s, carry):
+        m, l, acc, k_cur, v_cur = carry
+        # K/V currently held arrived from shard (idx - s) mod n.
+        src = lax.rem(idx - s + n, n)
+        k_pos = src * c + jnp.arange(c)                  # [C]
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]      # [Cq, Ck]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)  # [B, H, Cq, 1]
+        m_new = jnp.maximum(m, m_cur)
+        # exp(-inf - -inf) guard: fully-masked rows keep p == 0.
+        p = jnp.exp(jnp.maximum(scores - m_new, -80.0)) * (scores > _NEG_INF)
+        alpha = jnp.exp(jnp.maximum(m - m_new, -80.0)) * (m > _NEG_INF)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * alpha + pv
+
+        # Rotate K/V one hop around the ring (skipped after the last use).
+        k_nxt = lax.cond(s + 1 < n,
+                         lambda: lax.ppermute(k_cur, axis_name, perm),
+                         lambda: k_cur)
+        v_nxt = lax.cond(s + 1 < n,
+                         lambda: lax.ppermute(v_cur, axis_name, perm),
+                         lambda: v_cur)
+        return m_new, l_new, acc_new, k_nxt, v_nxt
+
+    m0 = jnp.full((b, h, c, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, c, 1), jnp.float32)
+    a0 = jnp.zeros((b, h, c, d), jnp.float32)
+    m, l, acc, _, _ = lax.fori_loop(0, n, step, (m0, l0, a0, k, v))
+
+    out = acc / jnp.where(l == 0.0, 1.0, l)              # [B, H, C, D]
+    return out.swapaxes(1, 2).astype(q.dtype)            # [B, C, H, D]
+
+
+def ring_attention(
+    q: jnp.ndarray,          # [B, L, H, D], L sharded over `axis`
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str,
+    scale: Optional[float] = None,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact (ring) attention with the sequence dim sharded over `axis`.
+
+    GQA: pass K/V with fewer heads and pre-expand, or equal heads; the
+    local body assumes matching head counts (expansion is one repeat on
+    the small KV shard).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if k.shape[2] != q.shape[2]:
+        g = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis,
+                          scale=float(scale), causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
