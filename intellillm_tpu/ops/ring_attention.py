@@ -35,12 +35,14 @@ def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
                           causal: bool):
     """Per-shard body (runs inside shard_map).
 
-    q/k/v: [B, C, H, D] — this device's sequence shard. Returns the
-    attended output for the local queries over the FULL sequence.
+    q: [B, C, Hkv, G, D] grouped queries; k/v: [B, C, Hkv, D] — this
+    device's sequence shard. Only the SMALL KV shards rotate (GQA never
+    materializes repeated heads), and in causal mode ring steps whose
+    held shard lies entirely in the future skip their compute.
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
-    b, c, h, d = q.shape
+    b, c, hkv, g, d = q.shape
 
     qf = q.astype(jnp.float32) * scale
     q_pos = idx * c + jnp.arange(c)                      # absolute [C]
@@ -53,21 +55,35 @@ def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
         src = lax.rem(idx - s + n, n)
         k_pos = src * c + jnp.arange(c)                  # [C]
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+        def compute(state):
+            m, l, acc = state
+            scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf,
+                                k_cur.astype(jnp.float32),
+                                preferred_element_type=jnp.float32)
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]  # [Cq, Ck]
+                scores = jnp.where(mask[None, None, None], scores,
+                                   _NEG_INF)
+            m_cur = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            # exp(-inf - -inf) guard: fully-masked rows keep p == 0.
+            p = (jnp.exp(jnp.maximum(scores - m_new, -80.0)) *
+                 (scores > _NEG_INF))
+            alpha = jnp.exp(jnp.maximum(m - m_new, -80.0)) * (m > _NEG_INF)
+            l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+            pv = jnp.einsum("bhgqk,bkhd->bhgqd", p,
+                            v_cur.astype(jnp.float32),
                             preferred_element_type=jnp.float32)
-        if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]      # [Cq, Ck]
-            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+            return m_new, l_new, acc * alpha + pv
 
-        m_cur = jnp.max(scores, axis=-1, keepdims=True)  # [B, H, Cq, 1]
-        m_new = jnp.maximum(m, m_cur)
-        # exp(-inf - -inf) guard: fully-masked rows keep p == 0.
-        p = jnp.exp(jnp.maximum(scores - m_new, -80.0)) * (scores > _NEG_INF)
-        alpha = jnp.exp(jnp.maximum(m - m_new, -80.0)) * (m > _NEG_INF)
-        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32),
-                        preferred_element_type=jnp.float32)
-        acc_new = acc * alpha + pv
+        if causal:
+            # A shard strictly in the future contributes nothing — skip
+            # the block matmuls, keep the rotation (per-device cond; no
+            # collectives inside the branches).
+            m, l, acc = lax.cond(src <= idx, compute, lambda s_: s_,
+                                 (m, l, acc))
+        else:
+            m, l, acc = compute((m, l, acc))
 
         # Rotate K/V one hop around the ring (skipped after the last use).
         k_nxt = lax.cond(s + 1 < n,
@@ -76,15 +92,16 @@ def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
         v_nxt = lax.cond(s + 1 < n,
                          lambda: lax.ppermute(v_cur, axis_name, perm),
                          lambda: v_cur)
-        return m_new, l_new, acc_new, k_nxt, v_nxt
+        return m, l, acc, k_nxt, v_nxt
 
-    m0 = jnp.full((b, h, c, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, c, 1), jnp.float32)
-    a0 = jnp.zeros((b, h, c, d), jnp.float32)
+    m0 = jnp.full((b, hkv, g, c, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, c, 1), jnp.float32)
+    a0 = jnp.zeros((b, hkv, g, c, d), jnp.float32)
     m, l, acc, _, _ = lax.fori_loop(0, n, step, (m0, l0, a0, k, v))
 
-    out = acc / jnp.where(l == 0.0, 1.0, l)              # [B, H, C, D]
-    return out.swapaxes(1, 2).astype(q.dtype)            # [B, C, H, D]
+    out = acc / jnp.where(l == 0.0, 1.0, l)              # [B, Hkv, G, C, D]
+    out = out.transpose(0, 3, 1, 2, 4)                   # [B, C, Hkv, G, D]
+    return out.astype(q.dtype)
 
 
 def ring_attention(
@@ -98,24 +115,25 @@ def ring_attention(
 ) -> jnp.ndarray:
     """Exact (ring) attention with the sequence dim sharded over `axis`.
 
-    GQA: pass K/V with fewer heads and pre-expand, or equal heads; the
-    local body assumes matching head counts (expansion is one repeat on
-    the small KV shard).
+    GQA: K/V keep their (smaller) head count end to end — queries are
+    grouped [.., Hkv, G, D] and the grouped einsum attends each query
+    group against its kv head, so the rotating shards stay O(Hkv).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    if k.shape[2] != q.shape[2]:
-        g = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, g, axis=2)
-        v = jnp.repeat(v, g, axis=2)
+    b, l, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    q_grouped = q.reshape(b, l, hkv, g, d)
 
-    spec = P(None, axis, None, None)
+    qspec = P(None, axis, None, None, None)
+    kvspec = P(None, axis, None, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis,
                           scale=float(scale), causal=causal),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        in_specs=(qspec, kvspec, kvspec),
+        out_specs=qspec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q_grouped, k, v).reshape(b, l, hq, d)
