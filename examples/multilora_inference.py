@@ -1,0 +1,42 @@
+"""Serve several LoRA adapters concurrently (reference
+`examples/multilora_inference.py` role).
+
+    python examples/multilora_inference.py --model <base> \
+        --lora name1=/path/to/adapter1 --lora name2=/path/to/adapter2
+"""
+import argparse
+
+from intellillm_tpu import LLM, SamplingParams
+from intellillm_tpu.lora.request import LoRARequest
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--lora", action="append", default=[],
+                    help="name=/local/path (repeatable)")
+    ap.add_argument("--prompt", default="Hello, my name is")
+    ap.add_argument("--max-loras", type=int, default=4)
+    ap.add_argument("--max-lora-rank", type=int, default=16)
+    args = ap.parse_args()
+
+    llm = LLM(model=args.model, enable_lora=True,
+              max_loras=args.max_loras, max_lora_rank=args.max_lora_rank)
+    params = SamplingParams(temperature=0.0, max_tokens=32)
+    engine = llm.llm_engine
+
+    requests = [(None, "base")]
+    for i, spec in enumerate(args.lora, start=1):
+        name, path = spec.split("=", 1)
+        requests.append((LoRARequest(name, i, path), name))
+
+    # All adapters decode in the SAME continuous batch.
+    for i, (req, _) in enumerate(requests):
+        engine.add_request(str(i), args.prompt, params, lora_request=req)
+    outputs = {o.request_id: o for o in llm._run_engine(use_tqdm=False)}
+    for i, (_, name) in enumerate(requests):
+        print(f"[{name}] {outputs[str(i)].outputs[0].text!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Query a running OpenAI-compatible server (reference
+`examples/openai_completion_client.py` / `openai_chatcompletion_client.py`
+roles, without requiring the `openai` package).
+
+Start the server first:
+    python -m intellillm_tpu.entrypoints.openai.api_server --model ...
+"""
+import argparse
+import json
+import urllib.request
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="localhost")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--model", default=None,
+                    help="defaults to the server's served model")
+    ap.add_argument("--prompt", default="Hello, my name is")
+    args = ap.parse_args()
+    base = f"http://{args.host}:{args.port}"
+
+    models = json.loads(urllib.request.urlopen(base + "/v1/models").read())
+    model = args.model or models["data"][0]["id"]
+    print("Serving model:", model)
+
+    out = post(base + "/v1/completions", {
+        "model": model, "prompt": args.prompt,
+        "max_tokens": 32, "temperature": 0.8})
+    print("completion:", out["choices"][0]["text"])
+
+    out = post(base + "/v1/chat/completions", {
+        "model": model,
+        "messages": [{"role": "user", "content": args.prompt}],
+        "max_tokens": 32, "temperature": 0.8})
+    print("chat:", out["choices"][0]["message"]["content"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Predicted-length SJF scheduling experiment (the IntelliLLM research
+layer, reference `scheduler/run_exp_scheduling.py` / `auto_eval.py`
+roles, with the policy actually wired into the engine scheduler).
+
+    python examples/research_scheduling.py --model <dir-or-hub-id> \
+        --prompts-csv responses.csv          # prompt,response_length rows
+"""
+import argparse
+import csv
+
+from intellillm_tpu import LLM
+from intellillm_tpu.research.experiments import (auto_eval,
+                                                 run_scheduling_experiment)
+
+_DEFAULT_PROMPTS = [
+    ("Summarize the history of France in one word.", 2),
+    ("Write a long story about a cat.", 200),
+    ("Say yes or no.", 2),
+    ("Explain transformers in detail.", 200),
+] * 5
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--prompts-csv", default=None,
+                    help="CSV with prompt,response_length columns")
+    ap.add_argument("--methods", nargs="+",
+                    default=["fcfs", "sjf", "sjf_predicted"])
+    ap.add_argument("--batch-size", type=int, default=5)
+    ap.add_argument("--max-tokens", type=int, default=256)
+    ap.add_argument("--sweep", action="store_true",
+                    help="auto_eval sweep over methods x batch sizes "
+                    "(writes results.csv)")
+    args = ap.parse_args()
+
+    if args.prompts_csv:
+        rows = list(csv.DictReader(open(args.prompts_csv)))
+        prompts = [r["prompt"] for r in rows]
+        oracle = [int(r["response_length"]) for r in rows]
+    else:
+        prompts = [p for p, _ in _DEFAULT_PROMPTS]
+        oracle = [n for _, n in _DEFAULT_PROMPTS]
+
+    def make_llm(policy):
+        return LLM(model=args.model, scheduling_policy=policy)
+
+    if args.sweep:
+        auto_eval(make_llm, prompts, oracle, methods=args.methods,
+                  max_tokens=args.max_tokens)
+        print("wrote results.csv")
+        return
+
+    for method in args.methods:
+        llm = make_llm("sjf" if method != "fcfs" else "fcfs")
+        res = run_scheduling_experiment(llm, prompts, oracle, method=method,
+                                        max_batch_size=args.batch_size,
+                                        max_tokens=args.max_tokens)
+        print(f"{method}: {res}")
+
+
+if __name__ == "__main__":
+    main()

@@ -256,6 +256,29 @@ class LLMEngine:
     def get_model_config(self) -> ModelConfig:
         return self.model_config
 
+    # --- profiling (SURVEY §5: jax.profiler trace hooks — an improvement
+    # over the reference, which has no tracer) ----------------------------
+
+    def start_profile(self, trace_dir: str = "/tmp/intellillm-trace") -> str:
+        """Begin a jax.profiler trace covering subsequent engine steps.
+        View with TensorBoard or xprof. Returns the trace directory.
+        No-op if a trace is already running (jax allows only one)."""
+        import jax
+        if getattr(self, "_profiling", False):
+            logger.warning("Profiling already running; ignoring start.")
+            return trace_dir
+        jax.profiler.start_trace(trace_dir)
+        self._profiling = True
+        logger.info("Profiling started; trace dir: %s", trace_dir)
+        return trace_dir
+
+    def stop_profile(self) -> None:
+        import jax
+        if getattr(self, "_profiling", False):
+            jax.profiler.stop_trace()
+            self._profiling = False
+            logger.info("Profiling stopped.")
+
     def get_num_unfinished_requests(self) -> int:
         return self.scheduler.get_num_unfinished_seq_groups()
 

@@ -101,7 +101,21 @@ async def auth_middleware(request: web.Request, handler):
     return await handler(request)
 
 
-def build_app(api_key: Optional[str] = None) -> web.Application:
+async def start_profile(request: web.Request) -> web.Response:
+    """Begin a jax.profiler trace of the serving loop (view in
+    TensorBoard/xprof) — admin endpoint; protect with --api-key."""
+    trace_dir = request.query.get("dir", "/tmp/intellillm-trace")
+    openai_serving_completion.engine.engine.start_profile(trace_dir)
+    return web.json_response({"trace_dir": trace_dir})
+
+
+async def stop_profile(request: web.Request) -> web.Response:
+    openai_serving_completion.engine.engine.stop_profile()
+    return web.json_response({"ok": True})
+
+
+def build_app(api_key: Optional[str] = None,
+              enable_profiling: bool = False) -> web.Application:
     app = web.Application(middlewares=[auth_middleware])
     app["api_key"] = api_key
     app.router.add_get("/health", health)
@@ -109,6 +123,11 @@ def build_app(api_key: Optional[str] = None) -> web.Application:
     app.router.add_get("/v1/models", show_available_models)
     app.router.add_post("/v1/chat/completions", create_chat_completion)
     app.router.add_post("/v1/completions", create_completion)
+    if enable_profiling:
+        # Admin endpoints: explicit opt-in (profiling degrades serving and
+        # writes trace files to a caller-chosen directory).
+        app.router.add_post("/start_profile", start_profile)
+        app.router.add_post("/stop_profile", stop_profile)
     return app
 
 
@@ -121,6 +140,9 @@ def make_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--api-key", type=str, default=None)
     parser.add_argument("--chat-template", type=str, default=None)
     parser.add_argument("--response-role", type=str, default="assistant")
+    parser.add_argument("--enable-profiling", action="store_true",
+                        help="expose /start_profile and /stop_profile "
+                        "admin endpoints (jax.profiler traces)")
     parser = AsyncEngineArgs.add_cli_args(parser)
     return parser
 
@@ -150,7 +172,7 @@ def main():
     loop.run_until_complete(
         init_serving(engine, served_model, args.response_role,
                      args.chat_template))
-    app = build_app(args.api_key)
+    app = build_app(args.api_key, enable_profiling=args.enable_profiling)
     web.run_app(app, host=args.host, port=args.port, loop=loop)
 
 

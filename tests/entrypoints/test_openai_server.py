@@ -48,7 +48,7 @@ def openai_server(tmp_path_factory):
         [sys.executable, "-m", "intellillm_tpu.entrypoints.openai.api_server",
          "--model", d, "--dtype", "float32", "--max-model-len", "128",
          "--num-device-blocks-override", "128", "--port", str(PORT),
-         "--served-model-name", "tiny-opt",
+         "--served-model-name", "tiny-opt", "--enable-profiling",
          "--chat-template", "{% for m in messages %}{{ m['content'] }} "
          "{% endfor %}"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
@@ -179,6 +179,29 @@ def test_bad_request_returns_error(openai_server):
     }))
     assert status >= 400
     assert "error" in body or body.get("object") == "error"
+
+
+def test_profile_endpoints(openai_server, tmp_path):
+    """/start_profile + /stop_profile wrap the serving loop in a
+    jax.profiler trace (SURVEY §5 tracing hook)."""
+    trace_dir = str(tmp_path / "trace")
+
+    async def run():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(BASE + f"/start_profile?dir={trace_dir}") as r:
+                assert r.status == 200
+            async with s.post(BASE + "/v1/completions", json={
+                "model": "tiny-opt", "prompt": "hello",
+                "max_tokens": 4, "temperature": 0.0}) as r:
+                assert r.status == 200
+            async with s.post(BASE + "/stop_profile") as r:
+                assert r.status == 200
+
+    asyncio.run(run())
+    # A real trace was produced (server shares the test filesystem).
+    import glob
+    assert glob.glob(trace_dir + "/**/*", recursive=True), (
+        "no trace files written")
 
 
 def test_client_disconnect_aborts_request(openai_server):
