@@ -42,8 +42,29 @@ def main():
         prompts = [p for p, _ in _DEFAULT_PROMPTS]
         oracle = [n for _, n in _DEFAULT_PROMPTS]
 
+    # sjf_predicted needs a trained length predictor wired into the
+    # engine (otherwise SJF falls back to FCFS ordering on unknowns).
+    predictor = None
+    if any(m == "sjf_predicted" for m in args.methods):
+        from intellillm_tpu.research.predictor import (LengthPredictor,
+                                                       PredictorConfig)
+        from transformers import AutoTokenizer
+        tok = AutoTokenizer.from_pretrained(args.model)
+        predictor = LengthPredictor(
+            PredictorConfig(vocab_size=len(tok), task="regression",
+                            epochs=20), tokenizer=tok)
+        predictor.train(prompts, oracle)
+
+    llm_cache = {}
+
     def make_llm(policy):
-        return LLM(model=args.model, scheduling_policy=policy)
+        # One engine per resolved policy: model load + compile are the
+        # expensive parts, and both sjf methods share the "sjf" engine.
+        if policy not in llm_cache:
+            llm_cache[policy] = LLM(model=args.model,
+                                    scheduling_policy=policy,
+                                    length_predictor=predictor)
+        return llm_cache[policy]
 
     if args.sweep:
         auto_eval(make_llm, prompts, oracle, methods=args.methods,

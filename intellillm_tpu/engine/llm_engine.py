@@ -259,14 +259,16 @@ class LLMEngine:
     # --- profiling (SURVEY §5: jax.profiler trace hooks — an improvement
     # over the reference, which has no tracer) ----------------------------
 
-    def start_profile(self, trace_dir: str = "/tmp/intellillm-trace") -> str:
+    def start_profile(self,
+                      trace_dir: str = "/tmp/intellillm-trace"
+                      ) -> Optional[str]:
         """Begin a jax.profiler trace covering subsequent engine steps.
-        View with TensorBoard or xprof. Returns the trace directory.
-        No-op if a trace is already running (jax allows only one)."""
+        View with TensorBoard or xprof. Returns the trace directory, or
+        None if a trace is already running (jax allows only one)."""
         import jax
         if getattr(self, "_profiling", False):
             logger.warning("Profiling already running; ignoring start.")
-            return trace_dir
+            return None
         jax.profiler.start_trace(trace_dir)
         self._profiling = True
         logger.info("Profiling started; trace dir: %s", trace_dir)

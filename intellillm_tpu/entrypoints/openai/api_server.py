@@ -105,12 +105,20 @@ async def start_profile(request: web.Request) -> web.Response:
     """Begin a jax.profiler trace of the serving loop (view in
     TensorBoard/xprof) — admin endpoint; protect with --api-key."""
     trace_dir = request.query.get("dir", "/tmp/intellillm-trace")
-    openai_serving_completion.engine.engine.start_profile(trace_dir)
-    return web.json_response({"trace_dir": trace_dir})
+    started = openai_serving_completion.engine.engine.start_profile(
+        trace_dir)
+    if started is None:
+        return web.json_response(
+            {"error": "a trace is already running"}, status=409)
+    return web.json_response({"trace_dir": started})
 
 
 async def stop_profile(request: web.Request) -> web.Response:
-    openai_serving_completion.engine.engine.stop_profile()
+    # stop_trace serializes the whole trace to disk — keep it off the
+    # event loop so in-flight requests/streams don't stall.
+    loop = asyncio.get_event_loop()
+    await loop.run_in_executor(
+        None, openai_serving_completion.engine.engine.stop_profile)
     return web.json_response({"ok": True})
 
 
