@@ -45,7 +45,7 @@ def main():
     # sjf_predicted needs a trained length predictor wired into the
     # engine (otherwise SJF falls back to FCFS ordering on unknowns).
     predictor = None
-    if any(m == "sjf_predicted" for m in args.methods):
+    if "sjf_predicted" in args.methods:
         from intellillm_tpu.research.predictor import (LengthPredictor,
                                                        PredictorConfig)
         from transformers import AutoTokenizer
@@ -58,8 +58,14 @@ def main():
     llm_cache = {}
 
     def make_llm(policy):
-        # One engine per resolved policy: model load + compile are the
-        # expensive parts, and both sjf methods share the "sjf" engine.
+        # Both sjf methods share one "sjf" engine (model load + compile
+        # are the expensive parts); the fcfs engine is NOT cached so at
+        # most one non-shared engine is resident at a time, and the
+        # predictor is wired only where it participates — the FCFS
+        # baseline must not pay prediction overhead per request.
+        if policy == "fcfs":
+            llm_cache.clear()   # free any previous engine before loading
+            return LLM(model=args.model, scheduling_policy="fcfs")
         if policy not in llm_cache:
             llm_cache[policy] = LLM(model=args.model,
                                     scheduling_policy=policy,

@@ -266,20 +266,31 @@ class LLMEngine:
         View with TensorBoard or xprof. Returns the trace directory, or
         None if a trace is already running (jax allows only one)."""
         import jax
-        if getattr(self, "_profiling", False):
-            logger.warning("Profiling already running; ignoring start.")
-            return None
-        jax.profiler.start_trace(trace_dir)
-        self._profiling = True
+        import threading
+        if not hasattr(self, "_profile_lock"):
+            self._profile_lock = threading.Lock()
+        with self._profile_lock:
+            if getattr(self, "_profiling", False):
+                logger.warning("Profiling already running; ignoring start.")
+                return None
+            jax.profiler.start_trace(trace_dir)
+            self._profiling = True
         logger.info("Profiling started; trace dir: %s", trace_dir)
         return trace_dir
 
     def stop_profile(self) -> None:
         import jax
-        if getattr(self, "_profiling", False):
-            jax.profiler.stop_trace()
+        import threading
+        if not hasattr(self, "_profile_lock"):
+            self._profile_lock = threading.Lock()
+        # Serialize start/stop: stop_trace runs for seconds (it writes the
+        # whole trace) and may be called from an executor thread.
+        with self._profile_lock:
+            if not getattr(self, "_profiling", False):
+                return
             self._profiling = False
-            logger.info("Profiling stopped.")
+            jax.profiler.stop_trace()
+        logger.info("Profiling stopped.")
 
     def get_num_unfinished_requests(self) -> int:
         return self.scheduler.get_num_unfinished_seq_groups()

@@ -1,0 +1,103 @@
+"""Ulysses (DeepSpeed-style) all-to-all sequence parallelism.
+
+Role: the "all-to-all sequence/context parallelism" alternative to ring
+attention (`ops/ring_attention.py`). Instead of rotating K/V shards N-1
+hops, ONE `lax.all_to_all` re-shards activations from sequence-sharded
+[B, L/N, H, D] to head-sharded [B, L, H/N, D]; each device then runs
+ordinary full (causal) attention for its head subset over the WHOLE
+sequence, and a second all-to-all restores sequence sharding.
+
+Trade-off vs ring: 2 all-to-alls of activation size (cheap on an ICI
+torus) instead of N-1 K/V hops, and the per-device attention is a single
+dense block (best MXU shape) — but each device must hold the full
+sequence's K/V for its heads, so peak memory is O(L·H/N) rather than
+ring's O(L/N·H): Ulysses wins while H >= N and sequences fit; ring wins
+at extreme lengths. Requires num_heads % shards == 0 (on the KV head
+count for GQA).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, scale: float, causal: bool):
+    """Per-shard body. q: [B, C, Hkv, G, D]; k/v: [B, C, Hkv, D] with the
+    sequence dim sharded (C = L/N)."""
+    n = lax.psum(1, axis_name)
+    b, c, hkv, g, d = q.shape
+    hl = hkv // n                        # kv heads per device after a2a
+
+    # seq-shard → head-shard: split heads into N chunks, all_to_all swaps
+    # the chunk axis with the sequence-shard axis.
+    def to_heads(x):
+        # [B, C, Hkv, ...] → [B, N, C, Hl, ...] → a2a over axis 1.
+        parts = x.reshape(b, c, n, hl, *x.shape[3:]).swapaxes(1, 2)
+        gathered = lax.all_to_all(parts, axis_name, split_axis=1,
+                                  concat_axis=1, tiled=False)
+        # gathered: [B, N, C, Hl, ...] where axis 1 is now sequence chunks
+        return gathered.reshape(b, n * c, hl, *x.shape[3:])
+
+    qh = to_heads(q)                     # [B, L, Hl, G, D]
+    kh = to_heads(k)                     # [B, L, Hl, D]
+    vh = to_heads(v)
+
+    l_full = n * c
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qh.astype(jnp.float32) * scale,
+                   kh.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    if causal:
+        mask = (jnp.arange(l_full)[:, None] >= jnp.arange(l_full)[None, :])
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, vh.astype(jnp.float32))
+    out = out.astype(q.dtype)            # [B, L, Hl, G, D]
+
+    # head-shard → seq-shard (inverse all_to_all).
+    parts = out.reshape(b, n, c, hl, g, d)
+    scattered = lax.all_to_all(parts, axis_name, split_axis=1,
+                               concat_axis=1, tiled=False)
+    return scattered.swapaxes(1, 2).reshape(b, c, hkv, g, d)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,          # [B, L, Hq, D], L sharded over `axis`
+    k: jnp.ndarray,          # [B, L, Hkv, D]
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str,
+    scale: Optional[float] = None,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """All-to-all sequence-parallel exact attention. Requires the KV head
+    count to divide the shard count."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, l, hq, d = q.shape
+    hkv = k.shape[2]
+    n = mesh.shape[axis]
+    if hkv % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs kv heads ({hkv}) divisible by the "
+            f"'{axis}' shard count ({n}); use ring_attention instead")
+    g = hq // hkv
+    q_grouped = q.reshape(b, l, hkv, g, d)
+
+    qspec = P(None, axis, None, None, None)
+    kvspec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis,
+                          scale=float(scale), causal=causal),
+        mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec),
+        out_specs=qspec,
+        check_vma=False,
+    )
+    return fn(q_grouped, k, v).reshape(b, l, hq, d)

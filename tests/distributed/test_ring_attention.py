@@ -71,3 +71,49 @@ def test_ring_attention_output_stays_sharded():
     mesh = Mesh(np.asarray(jax.devices()[:8]), axis_names=("seq", ))
     out = ring_attention(q, q, q, mesh, "seq")
     assert out.sharding.shard_shape(out.shape)[1] == l // 8
+
+
+@requires_8_devices
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_full(causal):
+    from intellillm_tpu.ops.ulysses_attention import ulysses_attention
+
+    rng = np.random.default_rng(3)
+    b, l, h, d, n = 2, 64, 8, 32, 4
+    q = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.float32)
+
+    mesh = Mesh(np.asarray(jax.devices()[:n]), axis_names=("seq", ))
+    out = ulysses_attention(q, k, v, mesh, "seq", causal=causal)
+    ref = _full_attention(q, k, v, d**-0.5, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@requires_8_devices
+def test_ulysses_gqa_and_ring_agree():
+    from intellillm_tpu.ops.ring_attention import ring_attention
+    from intellillm_tpu.ops.ulysses_attention import ulysses_attention
+
+    rng = np.random.default_rng(4)
+    b, l, hq, hkv, d, n = 1, 64, 8, 4, 32, 4
+    q = jnp.asarray(rng.standard_normal((b, l, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, l, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, l, hkv, d)), jnp.float32)
+
+    mesh = Mesh(np.asarray(jax.devices()[:n]), axis_names=("seq", ))
+    out_u = ulysses_attention(q, k, v, mesh, "seq")
+    out_r = ring_attention(q, k, v, mesh, "seq")
+    np.testing.assert_allclose(np.asarray(out_u), np.asarray(out_r),
+                               rtol=2e-4, atol=2e-4)
+
+
+@requires_8_devices
+def test_ulysses_rejects_indivisible_heads():
+    from intellillm_tpu.ops.ulysses_attention import ulysses_attention
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), axis_names=("seq", ))
+    q = jnp.zeros((1, 64, 4, 32), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, q, q, mesh, "seq")
