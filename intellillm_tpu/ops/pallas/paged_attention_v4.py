@@ -1,4 +1,9 @@
-"""Pallas TPU paged-attention decode kernel.
+"""Pallas TPU paged-attention decode kernel — v4 (head-block-vectorized).
+
+OPT-IN via INTELLILLM_PAGED_V4=1 (see ops/pallas/paged_attention.py
+dispatch): validated in interpret mode on CPU; flip the default after a
+real-TPU run confirms Mosaic compiles it cleanly (the earlier batched-
+dot variant wedged the device — see the round-2 session notes).
 
 Role parity: reference `csrc/attention/attention_kernels.cu` (951 LoC —
 `paged_attention_v1/v2` block-table gather + online softmax, V2 adds
@@ -12,11 +17,18 @@ Architecture (v3 — evolved against device-time traces):
 - v2 gridded (batch, kv_head) with an inline page walk and double-buffered
   multi-page DMA groups: ~0.65 ms/layer — still 4x off the HBM roofline
   because each page DMA is one head = 4 KiB.
-- v3 (this file) additionally blocks over kv heads: each grid step owns
+- v3 additionally blocks over kv heads: each grid step owns
   (sequence, HP kv heads) and every page DMA moves a contiguous
   [HP, block_size, head_size] slab (32 KiB at HP=8/bf16/D=128). The last
   page group prefetches the NEXT grid step's first group so the DMA
   pipeline never drains across grid steps.
+- v4 (this file) vectorizes the per-group math across the whole head
+  block: ONE batched dot computes all HP heads' scores ([HP, G, P·BS]
+  instead of HP unrolled [G, P·BS] matmuls) and the online-softmax
+  update runs on [HP·G, P·BS] tiles. For MHA (G=1) this turns ~30 VPU
+  ops on <1x128> vectors per head into single ops on full 8x128+ tiles —
+  the v3 profile showed op-issue overhead, not DMA bandwidth, dominating
+  at 40 GB/s effective KV read.
 - The paged pools stay in HBM (`memory_space=ANY`); the kernel issues
   explicit `pltpu.make_async_copy`s against `k_hbm.at[page].at[head
   slice]` — the block table (scalar-prefetched to SMEM) is read at
@@ -58,7 +70,8 @@ def _group_copies(k_hbm_ref, v_hbm_ref, k_buf, v_buf, k_sem, v_sem,
         idx = jnp.minimum(g * pages_per_group + j, w_max - 1)
         page = tables_ref[b * w_max + idx]
         # Chained single-axis dynamic slices: Mosaic supports dynamic
-        # indexing one (leading) axis at a time.
+        # indexing one (leading) axis at a time; the dst window
+        # k_buf[buf, j] = [HP, BS, D] is contiguous.
         copies.append(pltpu.make_async_copy(
             k_hbm_ref.at[page].at[pl.ds(h0, heads_per_block)],
             k_buf.at[buf, j], k_sem.at[buf]))
@@ -132,7 +145,19 @@ def _decode_kernel(
     l_scr[...] = jnp.zeros_like(l_scr)
     acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q_all = q_ref[0].astype(jnp.float32) * scale         # [HP, G, D]
+    q_flat = (q_ref[0].astype(jnp.float32) *
+              scale).reshape(hp * g_sz, -1)              # [HP*G, D]
+    # Static masks for the flat [HP*G, P*HP*BS] score layout. The KV
+    # buffer flattens page-major: flat column c = (page*HP + head)*BS +
+    # tok, so head(c) = (c // BS) % HP and the in-sequence token index is
+    # page(c)*BS + tok(c).
+    ncols = pages_per_group * hp * block_size
+    rows_i = jax.lax.broadcasted_iota(jnp.int32, (hp * g_sz, ncols), 0)
+    cols_i = jax.lax.broadcasted_iota(jnp.int32, (hp * g_sz, ncols), 1)
+    col_head = lax.rem(lax.div(cols_i, block_size), hp)
+    block_mask = lax.div(rows_i, g_sz) == col_head
+    col_tok = (lax.div(cols_i, hp * block_size) * block_size +
+               lax.rem(cols_i, block_size))              # [HP*G, NC]
 
     def body(g, carry):
         buf = lax.rem(start_buf + g, 2)
@@ -151,39 +176,43 @@ def _decode_kernel(
         for c in copies(b, hb, g, buf):
             c.wait()
 
-        token_pos = g * bk + jax.lax.broadcasted_iota(
-            jnp.int32, (g_sz, pages_per_group * block_size), dimension=1)
-        valid = token_pos < ctx
+        # Token position of each FLAT column within the full sequence.
+        token_pos = g * bk + col_tok                     # [HP*G, NC]
+        mask = block_mask & (token_pos < ctx)
         pos_f = token_pos.astype(jnp.float32)
         ctx_f = (ctx - 1).astype(jnp.float32)
 
-        for hi in range(hp):
-            k = k_buf[buf, :, hi].reshape(pages_per_group * block_size, -1)
-            v = v_buf[buf, :, hi].reshape(pages_per_group * block_size, -1)
-            s = jax.lax.dot_general(
-                q_all[hi], k.astype(jnp.float32), (((1, ), (1, )), ((), ())),
-                preferred_element_type=jnp.float32)      # [G, P*BS]
-            # ALiBi: score += slope * (key_pos - query_pos).
-            slope = slopes_ref[hi, :, 0].astype(jnp.float32)  # [G]
-            s = s + slope[:, None] * (pos_f - ctx_f)
+        # ONE flat dot for all HP heads: [HP*G, D] x [P*HP*BS, D]^T. The
+        # cross-head scores are junk (masked by block_mask below); the
+        # extra FLOPs are ~2 MXU tiles — far cheaper than HP separate
+        # small dots or a (Mosaic-hostile) batched dot.
+        k = k_buf[buf].reshape(-1, k_buf.shape[-1]).astype(jnp.float32)
+        v = v_buf[buf].reshape(-1, v_buf.shape[-1]).astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q_flat, k, (((1, ), (1, )), ((), ())),
+            preferred_element_type=jnp.float32)          # [HP*G, HP*PBS]
+        # ALiBi: score += slope * (key_pos - query_pos).
+        slope = slopes_ref[:, :, 0].reshape(hp * g_sz, 1)
+        s = s + slope * (pos_f - ctx_f)
 
-            lo, hi_ = hi * g_sz, (hi + 1) * g_sz
-            m_prev = m_scr[lo:hi_, 0][:, None]           # [G, 1]
-            m_cur = jnp.max(jnp.where(valid, s, _NEG_INF), axis=1,
-                            keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)
-            # Mask AFTER the exp: with a fully-invalid group m_new == s ==
-            # -inf-ish and exp(0) would otherwise contribute 1s.
-            p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        m_prev = m_scr[:, 0][:, None]                    # [HP*G, 1]
+        m_cur = jnp.max(jnp.where(mask, s, _NEG_INF), axis=1,
+                        keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # Mask AFTER the exp: with a fully-invalid group m_new == s ==
+        # -inf-ish and exp(0) would otherwise contribute 1s; the mask also
+        # zeroes the cross-head columns so pv below stays block-diagonal.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)     # [HP*G, HP*PBS]
 
-            l_new = l_scr[lo:hi_, 0][:, None] * alpha + jnp.sum(
-                p, axis=1, keepdims=True)
-            acc_scr[lo:hi_] = acc_scr[lo:hi_] * alpha + jax.lax.dot_general(
-                p, v.astype(jnp.float32), (((1, ), (0, )), ((), ())),
-                preferred_element_type=jnp.float32)
-            m_scr[lo:hi_] = jnp.broadcast_to(m_new, (g_sz, 128))
-            l_scr[lo:hi_] = jnp.broadcast_to(l_new, (g_sz, 128))
+        l_new = l_scr[:, 0][:, None] * alpha + jnp.sum(p, axis=1,
+                                                       keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1, ), (0, )), ((), ())),
+            preferred_element_type=jnp.float32)          # [HP*G, D]
+        acc_scr[...] = acc_scr[...] * alpha + pv
+        m_scr[...] = jnp.broadcast_to(m_new, (hp * g_sz, 128))
+        l_scr[...] = jnp.broadcast_to(l_new, (hp * g_sz, 128))
         return carry
 
     lax.fori_loop(0, num_groups, body, 0, unroll=False)
@@ -207,12 +236,12 @@ def _largest_divisor(n: int, cap: int) -> int:
 
 @functools.partial(
     jax.jit, static_argnames=("scale_static", ))
-def _paged_attention_call(q_grouped, slopes, k_cache, v_cache, block_tables,
+def _paged_attention_call_v4(q_grouped, slopes, k_cache, v_cache, block_tables,
                           context_lens, *, scale_static: float):
     b, hkv, g, d = q_grouped.shape
     nb, _, bs, _ = k_cache.shape
     w = block_tables.shape[1]
-    ppg = _largest_divisor(w, 8)
+    ppg = _largest_divisor(w, 16)
     hp = _largest_divisor(hkv, 8)
 
     # <8 sublanes in the q block: hint a f32 <1x128> layout (a bf16 <8x128>
@@ -276,7 +305,7 @@ def _paged_attention_call(q_grouped, slopes, k_cache, v_cache, block_tables,
     return out.astype(q_grouped.dtype), lse[..., 0]
 
 
-def paged_attention(
+def paged_attention_v4(
     q: jnp.ndarray,             # [B, 1, Hq, D]
     k_cache: jnp.ndarray,       # [NB, Hkv, BS, D]
     v_cache: jnp.ndarray,
@@ -287,17 +316,7 @@ def paged_attention(
     return_lse: bool = False,
 ):
     """Decode-phase paged attention. Returns [B, 1, Hq, D] (and, with
-    return_lse, the per-head logsumexp [B, Hq] for attention merging).
-
-    INTELLILLM_PAGED_V4=1 switches to the head-block-vectorized v4 kernel
-    (`paged_attention_v4.py`) — opt-in until validated on real TPU."""
-    import os
-    if os.environ.get("INTELLILLM_PAGED_V4") == "1":
-        from intellillm_tpu.ops.pallas.paged_attention_v4 import (
-            paged_attention_v4)
-        return paged_attention_v4(q, k_cache, v_cache, block_tables,
-                                  context_lens, scale, alibi_slopes,
-                                  return_lse)
+    return_lse, the per-head logsumexp [B, Hq] for attention merging)."""
     b, one, hq, d = q.shape
     if d % 128 != 0:
         # Mosaic DMA windows must be 128-aligned in the minor dimension, so
@@ -314,7 +333,7 @@ def paged_attention(
         slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(hkv, g)
     else:
         slopes = jnp.zeros((hkv, g), jnp.float32)
-    out, lse = _paged_attention_call(q_grouped, slopes, k_cache, v_cache,
+    out, lse = _paged_attention_call_v4(q_grouped, slopes, k_cache, v_cache,
                                      block_tables, context_lens,
                                      scale_static=float(scale))
     out = out.reshape(b, 1, hq, d)
