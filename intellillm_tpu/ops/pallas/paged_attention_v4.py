@@ -58,27 +58,8 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _group_copies(k_hbm_ref, v_hbm_ref, k_buf, v_buf, k_sem, v_sem,
-                  tables_ref, b, hb, g, buf, *, heads_per_block,
-                  pages_per_group, w_max):
-    """The async copies moving page-group g of sequence b / kv-head block
-    hb into VMEM buffer `buf`. Identical descriptor lists are built at
-    start and wait time (a DMA is identified by its (src, dst, sem))."""
-    copies = []
-    h0 = hb * heads_per_block
-    for j in range(pages_per_group):
-        idx = jnp.minimum(g * pages_per_group + j, w_max - 1)
-        page = tables_ref[b * w_max + idx]
-        # Chained single-axis dynamic slices: Mosaic supports dynamic
-        # indexing one (leading) axis at a time; the dst window
-        # k_buf[buf, j] = [HP, BS, D] is contiguous.
-        copies.append(pltpu.make_async_copy(
-            k_hbm_ref.at[page].at[pl.ds(h0, heads_per_block)],
-            k_buf.at[buf, j], k_sem.at[buf]))
-        copies.append(pltpu.make_async_copy(
-            v_hbm_ref.at[page].at[pl.ds(h0, heads_per_block)],
-            v_buf.at[buf, j], v_sem.at[buf]))
-    return copies
+from intellillm_tpu.ops.pallas.paged_attention import (
+    _group_copies, _largest_divisor)
 
 
 def _decode_kernel(
@@ -225,13 +206,6 @@ def _decode_kernel(
     lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG_INF)
     lse_ref[0] = jnp.broadcast_to(
         lse.reshape(hp, g_sz, 1), lse_ref[0].shape)
-
-
-def _largest_divisor(n: int, cap: int) -> int:
-    for p in range(min(cap, n), 0, -1):
-        if n % p == 0:
-            return p
-    return 1
 
 
 @functools.partial(

@@ -102,21 +102,31 @@ def test_paged_attention_alibi_matches_reference(hq, hkv):
 
 @requires_tpu
 @pytest.mark.parametrize("hq,hkv", [(8, 8), (8, 2), (4, 1)])
-def test_paged_attention_v4_matches_reference(hq, hkv):
-    """The opt-in v4 (head-block-vectorized) kernel vs the jnp oracle."""
+@pytest.mark.parametrize("w", [8, 16])    # w=16 exercises ppg=16 groups
+@pytest.mark.parametrize("use_alibi", [False, True])
+def test_paged_attention_v4_matches_reference(hq, hkv, w, use_alibi):
+    """The opt-in v4 (head-block-vectorized) kernel vs the jnp oracle,
+    including ALiBi bias and the logsumexp output."""
     from intellillm_tpu.ops.pallas.paged_attention_v4 import (
         paged_attention_v4)
 
     rng = np.random.default_rng(0)
-    b, d, nb, bs, w = 4, 128, 64, 16, 8
+    b, d, bs = 4, 128, 16
+    nb = b * w + 8
     k_cache, v_cache = make_cache(rng, nb, hkv, bs, d, np.float32)
     q = jnp.asarray(rng.normal(size=(b, 1, hq, d)).astype(np.float32))
     tables = jnp.asarray(
         rng.permutation(nb)[:b * w].reshape(b, w).astype(np.int32))
-    ctx = jnp.asarray(np.asarray([1, 17, 63, 128], np.int32))
+    ctx = jnp.asarray(np.asarray([1, 17, 63, w * bs], np.int32))
+    slopes = (jnp.asarray(rng.random(hq).astype(np.float32))
+              if use_alibi else None)
 
-    out = paged_attention_v4(q, k_cache, v_cache, tables, ctx, d**-0.5)
-    ref = decode_attention_reference(q, k_cache, v_cache, tables, ctx,
-                                     d**-0.5)
+    out, lse = paged_attention_v4(q, k_cache, v_cache, tables, ctx,
+                                  d**-0.5, slopes, return_lse=True)
+    ref, ref_lse = decode_attention_reference(q, k_cache, v_cache, tables,
+                                              ctx, d**-0.5, slopes,
+                                              return_lse=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
                                rtol=2e-3, atol=2e-3)
