@@ -1,0 +1,32 @@
+"""Benchmark-harness smoke tests: the latency/throughput CLIs must run
+end to end on a tiny dummy engine (CPU via INTELLILLM_JAX_PLATFORM)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _run(args):
+    env = dict(os.environ)
+    env["INTELLILLM_JAX_PLATFORM"] = "cpu"
+    return subprocess.run([sys.executable] + args, env=env,
+                          capture_output=True, text=True, timeout=420)
+
+
+def test_benchmark_latency_smoke():
+    r = _run(["benchmarks/benchmark_latency.py", "--model", "dummy:tiny",
+              "--input-len", "8", "--output-len", "8", "--batch-size", "2",
+              "--num-iters", "1", "--num-iters-warmup", "1",
+              "--max-model-len", "64", "--num-device-blocks", "64"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "Avg latency" in r.stdout
+
+
+def test_benchmark_throughput_smoke():
+    r = _run(["benchmarks/benchmark_throughput.py", "--model", "dummy:tiny",
+              "--num-prompts", "4", "--input-len", "8", "--output-len", "8",
+              "--max-model-len", "64", "--num-device-blocks", "64",
+              "--no-tqdm"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "Throughput:" in r.stdout
