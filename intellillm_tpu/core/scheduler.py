@@ -261,19 +261,21 @@ class Scheduler:
         self.running = deque(self.policy.sort_by_priority(now, self.running))
 
         # Fused decode-step count for this batch: beam-search groups need
-        # host fork/prune after every token and penalty-bearing groups need
-        # fresh token counts, so their presence forces K=1. Stop strings /
-        # stop tokens / EOS do NOT: the engine checks stops per fused
-        # substep and discards the overshoot tokens (the same mechanism as
-        # max_tokens overshoot), so a chatty request no longer degrades the
-        # whole batch. Swapped groups are included since they may join this
-        # very batch via swap-in.
+        # host fork/prune after every token, penalty-bearing groups need
+        # fresh token counts, and logits_processors run on host between
+        # steps, so their presence forces K=1. Stop strings / stop tokens /
+        # EOS do NOT: the engine checks stops per fused substep and
+        # discards the overshoot tokens (the same mechanism as max_tokens
+        # overshoot), so a chatty request no longer degrades the whole
+        # batch. Swapped groups are included since they may join this very
+        # batch via swap-in.
         num_steps = self.scheduler_config.num_decode_steps
         for sg in list(self.running) + list(self.swapped):
             sp = sg.sampling_params
             if (sp.use_beam_search or sp.presence_penalty
                     or sp.frequency_penalty
-                    or sp.repetition_penalty != 1.0):
+                    or sp.repetition_penalty != 1.0
+                    or sp.logits_processors):
                 num_steps = 1
                 break
         # K is deliberately NOT clamped to remaining max_tokens: a varying K

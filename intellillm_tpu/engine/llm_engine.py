@@ -238,11 +238,12 @@ class LLMEngine:
             raise ValueError(
                 f"best_of {sp.best_of} exceeds the supported maximum of "
                 f"{self._MAX_BEST_OF_RANDOM}.")
-        if sp.logits_processors:
-            raise NotImplementedError(
-                "logits_processors are not supported yet: sampling runs "
-                "inside the jitted TPU step and has no per-request Python "
-                "hook. (Planned: device-side processor vocabulary masks.)")
+        for proc in sp.logits_processors:
+            if not callable(proc):
+                raise ValueError(
+                    "logits_processors must be callables taking "
+                    "(output_token_ids, logits_row numpy array) and "
+                    "returning a logits row.")
         from intellillm_tpu.layers.sampler import LOGPROB_K_BUCKETS
         if (sp.prompt_logprobs is not None
                 and sp.prompt_logprobs > LOGPROB_K_BUCKETS[-1]):

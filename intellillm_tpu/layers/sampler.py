@@ -213,6 +213,91 @@ def _apply_top_k_top_p_min_p(
     return logits
 
 
+# --- host escape path (logits_processors) ---------------------------------
+#
+# Arbitrary Python logits processors cannot run inside the jitted device
+# sampler, so rows that carry them are re-sampled ON HOST from fetched raw
+# logits (reference `sampler.py:_apply_logits_processors` runs them on the
+# driver too). The scheduler forces K=1 for such batches; the helpers below
+# mirror the device semantics (penalties -> temperature -> top-k/p/min-p ->
+# Gumbel argmax) in numpy.
+
+
+def apply_penalties_host(logits: np.ndarray, prompt_ids: List[int],
+                         output_ids: List[int], presence: float,
+                         frequency: float, repetition: float) -> np.ndarray:
+    """Numpy mirror of apply_penalties for a single [V] row."""
+    vocab = logits.shape[-1]
+    output_counts = np.zeros(vocab, np.int32)
+    ids = np.asarray(output_ids, np.int64)
+    ids = ids[(ids >= 0) & (ids < vocab)]
+    np.add.at(output_counts, ids, 1)
+    seen = output_counts > 0
+    pids = np.asarray(prompt_ids, np.int64)
+    pids = pids[(pids >= 0) & (pids < vocab)]
+    seen[pids] = True
+    logits = np.where(seen,
+                      np.where(logits > 0, logits / repetition,
+                               logits * repetition), logits)
+    logits = logits - frequency * output_counts
+    logits = logits - presence * (output_counts > 0)
+    return logits.astype(np.float32)
+
+
+def _log_softmax_host(x: np.ndarray) -> np.ndarray:
+    m = x.max(axis=-1, keepdims=True)
+    s = x - m
+    return s - np.log(np.exp(s).sum(axis=-1, keepdims=True))
+
+
+def sample_row_host(
+    logits: np.ndarray,           # [V] f32, post-processor post-penalty
+    sp: "SamplingParams",
+    seed: int,
+    *,
+    num_samples: int = 1,
+    logprob_k: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Sample one row on host; same contract as the device `sample` (raw
+    log-softmax panel, temperature/top-k/p/min-p filtered Gumbel argmax).
+    The Gumbel stream is numpy's (not threefry), so random draws differ
+    from the device path, but remain deterministic per (seed, row).
+
+    Returns (sampled [S], sampled_lp [S], topk_ids [K], topk_lp [K]).
+    """
+    logits = logits.astype(np.float32)
+    raw_lp = _log_softmax_host(logits)
+    order = np.argsort(-raw_lp, kind="stable")
+    topk_ids = order[:logprob_k].astype(np.int32)
+    topk_lp = raw_lp[topk_ids]
+
+    if sp.temperature < _SAMPLING_EPS:
+        sampled = np.full(num_samples, int(np.argmax(logits)), np.int32)
+    else:
+        scaled = logits / np.float32(sp.temperature)
+        vocab = logits.shape[-1]
+        sorted_desc = np.flip(np.sort(scaled))
+        if sp.top_k > 0:
+            kth = sorted_desc[min(sp.top_k, vocab) - 1]
+            scaled = np.where(scaled < kth, -np.inf, scaled)
+        if sp.top_p < 1.0 - _SAMPLING_EPS:
+            sprobs = np.exp(_log_softmax_host(sorted_desc))
+            cum = np.cumsum(sprobs)
+            keep = (cum - sprobs) < sp.top_p     # always keeps argmax
+            thr = sorted_desc[max(int(keep.sum()), 1) - 1]
+            scaled = np.where(scaled < thr, -np.inf, scaled)
+        if sp.min_p > _SAMPLING_EPS:
+            probs = np.exp(_log_softmax_host(scaled[None]))[0]
+            scaled = np.where(probs < sp.min_p * probs.max(), -np.inf,
+                              scaled)
+        rng = np.random.default_rng(seed)
+        gumbel = rng.gumbel(size=(num_samples, ) + scaled.shape)
+        sampled = np.argmax(scaled[None, :] + gumbel,
+                            axis=-1).astype(np.int32)
+    sampled_lp = raw_lp[sampled].astype(np.float32)
+    return sampled, sampled_lp, topk_ids, topk_lp
+
+
 def sample(
     logits: jnp.ndarray,     # [N, V] — pre-softmax model logits (f32)
     temperatures: jnp.ndarray,

@@ -36,9 +36,11 @@ from intellillm_tpu.config import (CacheConfig, ModelConfig, ParallelConfig,
                                    SchedulerConfig)
 from intellillm_tpu.layers.attention import AttentionMetadata
 from intellillm_tpu.layers.sampler import (LOGPROB_K_BUCKETS,
-                                           SamplingTensors, apply_penalties,
+                                           _SAMPLING_EPS, SamplingTensors,
+                                           apply_penalties,
+                                           apply_penalties_host,
                                            penalty_tensors_from_tokens,
-                                           sample)
+                                           sample, sample_row_host)
 from intellillm_tpu.logger import init_logger
 from intellillm_tpu.native import build_decode_batch, build_prompt_slots
 from intellillm_tpu.ops.kv_cache import PAD_SLOT_ID
@@ -163,7 +165,13 @@ class ModelRunner:
                                    top_ks, top_ps, min_ps, seeds, pres_pen,
                                    freq_pen, rep_pen, prompt_tokens,
                                    output_tokens, *, num_samples, logprob_k,
-                                   do_topk, do_topp, do_minp, do_penalties):
+                                   do_topk, do_topp, do_minp, do_penalties,
+                                   fetch_indices=None):
+        """fetch_indices: optional [M] row indices whose RAW (pre-penalty)
+        logits are additionally returned for the host logits_processors
+        escape path (reference sampler.py `_apply_logits_processors` runs
+        arbitrary Python callables on the driver; here such rows are
+        re-sampled on host — see execute_model)."""
         logits = self.model.compute_logits(params, hidden_rows)
         logits = logits.astype(jnp.float32)
         if logits.shape[-1] > self.vocab_size:
@@ -172,6 +180,8 @@ class ModelRunner:
             # win greedy argmax or receive sampling mass.
             pad = jnp.arange(logits.shape[-1]) >= self.vocab_size
             logits = jnp.where(pad[None, :], -1e30, logits)
+        fetched = (logits[fetch_indices]
+                   if fetch_indices is not None else None)
         if do_penalties:
             # Token histories scatter into [N, V] mask/counts ON DEVICE —
             # the host ships only the padded id lists.
@@ -179,9 +189,10 @@ class ModelRunner:
                 prompt_tokens, output_tokens, logits.shape[-1])
             logits = apply_penalties(logits, prompt_mask, output_counts,
                                      pres_pen, freq_pen, rep_pen)
-        return sample(logits, temperatures, top_ks, top_ps, min_ps, seeds,
-                      logprob_k=logprob_k, num_samples=num_samples,
-                      do_topk=do_topk, do_topp=do_topp, do_minp=do_minp)
+        out = sample(logits, temperatures, top_ks, top_ps, min_ps, seeds,
+                     logprob_k=logprob_k, num_samples=num_samples,
+                     do_topk=do_topk, do_topp=do_topp, do_minp=do_minp)
+        return out + (fetched, )
 
     def _prompt_logprobs(self, params, hidden, token_ids, *, k: int):
         """Per-position prompt logprobs (reference sampler.py prompt-
@@ -228,24 +239,28 @@ class ModelRunner:
     def _prefill_fn(self, params, kv_caches, token_ids, positions,
                     attn_metadata, logits_indices, temperatures, top_ks,
                     top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
-                    prompt_tokens, output_tokens, lora=None, *, num_samples,
+                    prompt_tokens, output_tokens, lora=None,
+                    fetch_indices=None, *, num_samples,
                     logprob_k, do_topk, do_topp, do_minp, do_penalties,
                     prompt_logprob_k=0):
         hidden, new_caches = self._call_model(params, token_ids, positions,
                                               kv_caches, attn_metadata, lora)
         b = token_ids.shape[0]
         sel = hidden[jnp.arange(b), logits_indices]          # [B, E]
-        sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
+        sampled, lp, tk_ids, tk_lp, fetched = self._compute_logits_and_sample(
             params, sel, temperatures, top_ks, top_ps, min_ps, seeds,
             pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens,
             num_samples=num_samples, logprob_k=logprob_k, do_topk=do_topk,
-            do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties)
+            do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties,
+            fetch_indices=fetch_indices)
         packed = self._pack(sampled, lp, tk_ids[:, None, :], tk_lp[:, None, :])
+        extras = ()
         if prompt_logprob_k:
-            plp = self._prompt_logprobs(params, hidden, token_ids,
-                                        k=prompt_logprob_k)
-            return packed, plp, new_caches
-        return packed, new_caches
+            extras += (self._prompt_logprobs(params, hidden, token_ids,
+                                             k=prompt_logprob_k), )
+        if fetched is not None:
+            extras += (fetched, )
+        return (packed, ) + extras + (new_caches, )
 
     def _decode_fn(self, params, kv_caches, token_ids, positions,
                    block_tables, context_lens, temperatures, top_ks, top_ps,
@@ -298,7 +313,7 @@ class ModelRunner:
                                                meta, lora)
             stages = [(c[2], c[3]) for c in caches4]
             seeds_k = seeds + k.astype(jnp.uint32) * _SEED_STRIDE
-            sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
+            sampled, lp, tk_ids, tk_lp, _ = self._compute_logits_and_sample(
                 params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
                 seeds_k, pres_pen, freq_pen, rep_pen, prompt_tokens,
                 output_tokens, num_samples=1, logprob_k=logprob_k,
@@ -340,7 +355,8 @@ class ModelRunner:
     def _decode_fn_single(self, params, kv_caches, token_ids, positions,
                           block_tables, context_lens, temperatures, top_ks,
                           top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
-                          prompt_tokens, output_tokens, lora=None, *,
+                          prompt_tokens, output_tokens, lora=None,
+                          fetch_indices=None, *,
                           logprob_k, do_topk, do_topp, do_minp,
                           do_penalties):
         """Unstaged single-step decode: writes KV to the pool before
@@ -369,13 +385,16 @@ class ModelRunner:
         hidden, new_caches = self._call_model(params, token_ids,
                                               pos[:, None], kv_caches, meta,
                                               lora)
-        sampled, lp, tk_ids, tk_lp = self._compute_logits_and_sample(
+        sampled, lp, tk_ids, tk_lp, fetched = self._compute_logits_and_sample(
             params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
             seeds, pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens,
             num_samples=1, logprob_k=logprob_k, do_topk=do_topk,
-            do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties)
+            do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties,
+            fetch_indices=fetch_indices)
         packed = self._pack(sampled, lp, tk_ids[:, None, :],
                             tk_lp[:, None, :])
+        if fetched is not None:
+            return packed, fetched, new_caches
         return packed, new_caches
 
     # --- batch prep -------------------------------------------------------
@@ -570,6 +589,17 @@ class ModelRunner:
                     num_samples = max(num_samples, sp.best_of)
             num_samples = pad_to_bucket(num_samples, _SAMPLE_BUCKETS)
 
+        # logits_processors escape path: rows carrying Python processors
+        # get their RAW logits fetched and are re-sampled on host (the
+        # scheduler forces K=1 for such batches; prefill is always 1 step).
+        proc_rows = [i for i, sp in enumerate(row_params)
+                     if sp.logits_processors]
+        fetch_indices = None
+        if proc_rows:
+            m = pad_to_bucket(len(proc_rows), self.batch_buckets)
+            fetch_indices = np.zeros(m, np.int32)
+            fetch_indices[:len(proc_rows)] = proc_rows
+
         zeros = np.zeros(padded_n, np.float32)
         common = dict(
             logprob_k=st.logprob_k,
@@ -599,15 +629,18 @@ class ModelRunner:
                 self.params, kv_caches,
                 place(arrays["token_ids"]), place(arrays["positions"]),
                 attn_metadata, place(arrays["logits_indices"]),
-                *sampling_args, lora_state, num_samples=num_samples,
+                *sampling_args, lora_state,
+                place(fetch_indices) if fetch_indices is not None else None,
+                num_samples=num_samples,
                 prompt_logprob_k=plp_k, **common)
+            result = list(result)
+            packed = result.pop(0)
             if plp_k:
-                packed, plp_packed, new_caches = result
                 self._attach_prompt_logprobs(
-                    np.asarray(plp_packed), plp_k, seq_group_metadata_list,
-                    rows, row_params)
-            else:
-                packed, new_caches = result
+                    np.asarray(result.pop(0)), plp_k,
+                    seq_group_metadata_list, rows, row_params)
+            fetched = np.asarray(result.pop(0)) if proc_rows else None
+            new_caches = result.pop(0)
             t1, t2 = num_samples, 1
             num_steps = 1
         else:
@@ -625,19 +658,37 @@ class ModelRunner:
                 place(arrays["token_ids"]), place(arrays["positions"]),
                 place(arrays["block_tables"]), place(arrays["context_lens"]),
                 *sampling_args, lora_state)
+            fetched = None
             if num_steps == 1:
-                packed, new_caches = self._jit_decode_single(*decode_args,
-                                                             **common)
+                result = self._jit_decode_single(
+                    *decode_args,
+                    place(fetch_indices) if fetch_indices is not None
+                    else None, **common)
+                if proc_rows:
+                    packed, fetched, new_caches = result
+                    fetched = np.asarray(fetched)
+                else:
+                    packed, new_caches = result
             else:
+                assert not proc_rows, (
+                    "logits_processors present in a fused K>1 decode batch; "
+                    "the scheduler should have forced K=1")
                 packed, new_caches = self._jit_decode(*decode_args,
                                                       num_steps=num_steps,
                                                       **common)
             t1 = t2 = num_steps
 
-        # ONE device→host transfer for everything.
-        packed = np.asarray(packed)
+        # ONE device→host transfer for everything. (np.array: the host
+        # resample below writes into the unpacked views, and jax device
+        # arrays convert to read-only numpy.)
+        packed = np.array(packed) if proc_rows else np.asarray(packed)
         sampled, sampled_lp, topk_ids, topk_lp = self._unpack(
             packed, t1, t2, st.logprob_k)
+
+        if proc_rows:
+            self._resample_processor_rows(
+                proc_rows, fetched, row_params, row_tokens, row_seeds,
+                sampled, sampled_lp, topk_ids, topk_lp, t1)
 
         outputs = self._process_sampling(seq_group_metadata_list, rows,
                                          sampled, sampled_lp, topk_ids,
@@ -673,6 +724,36 @@ class ModelRunner:
             meta.computed_prompt_logprobs = out
 
     # --- sampler post-processing -----------------------------------------
+
+    def _resample_processor_rows(self, proc_rows, fetched, row_params,
+                                 row_tokens, row_seeds, sampled, sampled_lp,
+                                 topk_ids, topk_lp, t1):
+        """Host escape path for `logits_processors` (reference
+        `sampler.py:_apply_logits_processors`): the callables run on the
+        fetched raw logits row, then penalties/temperature/top-k/p/min-p/
+        sampling mirror the device semantics in numpy. Writes the results
+        into the unpacked output views (single decode step or the prefill
+        sample; the scheduler forces K=1 for processor-bearing batches)."""
+        kt = topk_ids.shape[-1]
+        for j, row in enumerate(proc_rows):
+            sp = row_params[row]
+            prompt_ids, output_ids = row_tokens[row]
+            logits = np.array(fetched[j, :self.vocab_size], np.float32)
+            for proc in sp.logits_processors:
+                logits = np.asarray(proc(list(output_ids), logits),
+                                    np.float32)
+            if (abs(sp.presence_penalty) >= _SAMPLING_EPS
+                    or abs(sp.frequency_penalty) >= _SAMPLING_EPS
+                    or abs(sp.repetition_penalty - 1.0) >= _SAMPLING_EPS):
+                logits = apply_penalties_host(
+                    logits, prompt_ids, output_ids, sp.presence_penalty,
+                    sp.frequency_penalty, sp.repetition_penalty)
+            s, s_lp, tk_i, tk_l = sample_row_host(
+                logits, sp, row_seeds[row], num_samples=t1, logprob_k=kt)
+            sampled[row, :] = s
+            sampled_lp[row, :] = s_lp
+            topk_ids[row, 0, :] = tk_i
+            topk_lp[row, 0, :] = tk_l
 
     def _process_sampling(
         self,
