@@ -36,6 +36,12 @@ _MODEL_REGISTRY: Dict[str, Type] = {
     "OPTForCausalLM": OPTForCausalLM,
     "GPT2LMHeadModel": GPT2LMHeadModel,
     "MixtralForCausalLM": MixtralForCausalLM,
+    # Reference mixtral_quant.py arch name. Same graph; NOTE the loader
+    # only wires int8 weight-only quantization for Mixtral
+    # (supported_quantization), so GPTQ/AWQ QuantMixtral checkpoints are
+    # rejected at load with a clear NotImplementedError rather than
+    # being unrecognized.
+    "QuantMixtralForCausalLM": MixtralForCausalLM,
     "Qwen2ForCausalLM": Qwen2ForCausalLM,
     "BloomForCausalLM": BloomForCausalLM,
     "GPTNeoXForCausalLM": GPTNeoXForCausalLM,

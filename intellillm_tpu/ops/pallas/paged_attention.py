@@ -289,10 +289,12 @@ def paged_attention(
     """Decode-phase paged attention. Returns [B, 1, Hq, D] (and, with
     return_lse, the per-head logsumexp [B, Hq] for attention merging).
 
-    INTELLILLM_PAGED_V4=1 switches to the head-block-vectorized v4 kernel
-    (`paged_attention_v4.py`) — opt-in until validated on real TPU."""
+    Default kernel is v4 (head-block-vectorized, `paged_attention_v4.py`)
+    — validated on real TPU at +15% end-to-end decode throughput over v3
+    (935.8 vs 810.6 tok/s/chip, llama2-7b int8/fp8-KV bs=32).
+    INTELLILLM_PAGED_V4=0 falls back to the v3 kernel below."""
     import os
-    if os.environ.get("INTELLILLM_PAGED_V4") == "1":
+    if os.environ.get("INTELLILLM_PAGED_V4", "1") != "0":
         from intellillm_tpu.ops.pallas.paged_attention_v4 import (
             paged_attention_v4)
         return paged_attention_v4(q, k_cache, v_cache, block_tables,
