@@ -294,7 +294,8 @@ def paged_attention(
     (935.8 vs 810.6 tok/s/chip, llama2-7b int8/fp8-KV bs=32).
     INTELLILLM_PAGED_V4=0 falls back to the v3 kernel below."""
     import os
-    if os.environ.get("INTELLILLM_PAGED_V4", "1") != "0":
+    if os.environ.get("INTELLILLM_PAGED_V4",
+                      "1").lower() not in ("0", "", "false", "off", "no"):
         from intellillm_tpu.ops.pallas.paged_attention_v4 import (
             paged_attention_v4)
         return paged_attention_v4(q, k_cache, v_cache, block_tables,

@@ -151,3 +151,31 @@ def test_paged_attention_v4_matches_reference(hq, hkv, w, use_alibi):
                                rtol=tol, atol=tol)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
                                rtol=tol, atol=tol)
+
+@requires_tpu
+def test_paged_attention_v4_bf16_cache_wide_table():
+    """bf16 KV with a 32-wide block table (llama-7b decode shape at
+    max_model_len=512): ppg hits its 16-page cap, giving the largest
+    VMEM double-buffer the kernel ever allocates for 2-byte caches —
+    validated on real v5e (the f32 grid above is 2x larger still)."""
+    from intellillm_tpu.ops.pallas.paged_attention_v4 import (
+        paged_attention_v4)
+
+    rng = np.random.default_rng(7)
+    b, d, bs, hq, hkv, w = 4, 128, 16, 32, 32, 32
+    nb = b * w + 8
+    k_cache, v_cache = make_cache(rng, nb, hkv, bs, d, np.float32)
+    k_cache = k_cache.astype(jnp.bfloat16)
+    v_cache = v_cache.astype(jnp.bfloat16)
+    q = jnp.asarray(rng.normal(size=(b, 1, hq, d)).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    tables = jnp.asarray(
+        rng.permutation(nb)[:b * w].reshape(b, w).astype(np.int32))
+    ctx = jnp.asarray(np.asarray([1, 100, 300, w * bs], np.int32))
+
+    out = paged_attention_v4(q, k_cache, v_cache, tables, ctx, d**-0.5)
+    ref = decode_attention_reference(q, k_cache, v_cache, tables, ctx,
+                                     d**-0.5)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
