@@ -17,6 +17,8 @@ from intellillm_tpu.models.gpt2 import GPT2LMHeadModel
 from intellillm_tpu.models.gpt_bigcode import GPTBigCodeForCausalLM
 from intellillm_tpu.models.gpt_neox import GPTNeoXForCausalLM
 from intellillm_tpu.models.gptj import GPTJForCausalLM
+from intellillm_tpu.models.decilm import DeciLMForCausalLM
+from intellillm_tpu.models.internlm import InternLMForCausalLM
 from intellillm_tpu.models.llama import LlamaForCausalLM
 from intellillm_tpu.models.mixtral import MixtralForCausalLM
 from intellillm_tpu.models.mpt import MPTForCausalLM
@@ -31,8 +33,9 @@ _MODEL_REGISTRY: Dict[str, Type] = {
     "LLaMAForCausalLM": LlamaForCausalLM,
     "MistralForCausalLM": LlamaForCausalLM,
     "YiForCausalLM": LlamaForCausalLM,
-    "InternLMForCausalLM": LlamaForCausalLM,
-    "DeciLMForCausalLM": LlamaForCausalLM,
+    "InternLMForCausalLM": InternLMForCausalLM,  # llama + q/k/v/o biases
+    "DeciLMForCausalLM": DeciLMForCausalLM,      # variable GQA, degrouped
+
     "OPTForCausalLM": OPTForCausalLM,
     "GPT2LMHeadModel": GPT2LMHeadModel,
     "MixtralForCausalLM": MixtralForCausalLM,

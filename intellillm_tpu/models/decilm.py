@@ -1,0 +1,55 @@
+"""DeciLM: llama recipe with Variable Grouped Query Attention.
+
+Role parity: reference `vllm/model_executor/models/decilm.py:37-121` —
+DeciLM overrides the per-model constant `num_key_value_heads` with
+`config.num_key_value_heads_per_layer[i]`. Paged attention wants a
+uniform kv-head count across layers (one pool shape), so — like the
+reference (`decilm.py:50-52`) — the checkpoint is normalized at load:
+every layer's K/V projections are degrouped (kv heads repeated) up to
+the max per-layer count, which is exact because repeating a kv head
+for the query heads that already shared it leaves attention unchanged.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from intellillm_tpu.config import ModelConfig
+from intellillm_tpu.models.llama import LlamaForCausalLM
+
+
+class DeciLMForCausalLM(LlamaForCausalLM):
+
+    # Degrouping operates on fp checkpoint tensors; quantized DeciLM
+    # checkpoints would need degrouping in the packed domain.
+    supported_quantization = ("int8", )
+
+    def __init__(self, model_config: ModelConfig) -> None:
+        cfg = model_config.hf_config
+        per_layer = getattr(cfg, "num_key_value_heads_per_layer", None)
+        if per_layer is not None:
+            self._kv_heads_per_layer = list(per_layer)
+            cfg.num_key_value_heads = max(per_layer)
+        else:
+            self._kv_heads_per_layer = None
+        super().__init__(model_config)
+
+    def _postprocess_raw(self, raw: Dict[str, np.ndarray]) -> None:
+        if self._kv_heads_per_layer is None:
+            return
+        target = self.num_kv_heads
+        for name in list(raw):
+            if not (name.endswith("k_proj.weight")
+                    or name.endswith("v_proj.weight")):
+                continue
+            w = raw[name]                       # HF layout [kv_i*hs, e]
+            kv_i = w.shape[0] // self.head_size
+            if kv_i == target:
+                continue
+            assert target % kv_i == 0, (
+                f"{name}: cannot degroup {kv_i} kv heads to {target}")
+            rep = target // kv_i
+            w = w.reshape(kv_i, self.head_size, -1)
+            w = np.repeat(w, rep, axis=0)
+            raw[name] = w.reshape(target * self.head_size, -1)

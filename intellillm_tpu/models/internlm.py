@@ -1,0 +1,16 @@
+"""InternLM: llama recipe with optional attention biases.
+
+Role parity: reference `vllm/model_executor/models/internlm.py:60-96` —
+the llama layer stack, but `config.bias` adds bias terms to the QKV and
+output projections (InternLM-7B ships bias=True). Without these the
+bare llama alias would silently drop the bias tensors and produce wrong
+logits. All bias machinery lives in `models/proj_bias.py`.
+"""
+from __future__ import annotations
+
+from intellillm_tpu.models.proj_bias import ProjBiasMixin
+
+
+class InternLMForCausalLM(ProjBiasMixin):
+
+    bias_targets = ("q", "k", "v", "o")

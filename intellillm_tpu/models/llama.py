@@ -275,6 +275,7 @@ class LlamaForCausalLM:
             if "rotary_emb.inv_freq" in name:
                 continue
             raw[name] = arr
+        self._postprocess_raw(raw)
 
         def L(prefix: str, fp_ok: bool = False):
             return load_linear(raw, prefix, self.dtype, self.quantization,
@@ -306,3 +307,8 @@ class LlamaForCausalLM:
                 "down": L(lp + "mlp.down_proj"),
             })
         return params
+
+    def _postprocess_raw(self, raw: Dict[str, np.ndarray]) -> None:
+        """Hook for subclasses to normalize checkpoint tensors before the
+        param tree is built (DeciLM kv-head degrouping)."""
+        return None

@@ -1,0 +1,85 @@
+"""Shared projection-bias support for llama-recipe families.
+
+Qwen2 (q/k/v biases, reference `vllm/model_executor/models/qwen2.py`) and
+InternLM (q/k/v/o biases, reference `models/internlm.py:60-96`) are the
+llama stack plus bias terms on some attention projections. This mixin
+expresses the whole delta once, parameterized by `bias_targets`:
+`_proj` adds the bias when the param tree carries one, partition specs
+shard column-parallel biases over the model axis (row-parallel `o` bias
+is replicated — it applies after the GSPMD psum), and weight loading
+stashes the bias tensors from the same shard pass the base loader makes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from intellillm_tpu.models.llama import LlamaForCausalLM, Params
+from intellillm_tpu.models.weight_utils import cast_array
+
+
+class ProjBiasMixin(LlamaForCausalLM):
+
+    # Subclasses override: projections that carry a checkpoint bias.
+    bias_targets = ("q", "k", "v")
+
+    def _proj(self, h, lp, lora, target):
+        out = super()._proj(h, lp, lora, target)
+        bias = lp.get(f"{target}_bias")
+        return out if bias is None else out + bias
+
+    def _bias_shape(self, target):
+        hq = self.num_heads * self.head_size
+        hkv = self.num_kv_heads * self.head_size
+        return {"q": (hq, ), "k": (hkv, ), "v": (hkv, ),
+                "o": (self.hidden_size, )}[target]
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        specs = super().partition_specs()
+        for layer in specs["layers"]:
+            for t in self.bias_targets:
+                # Column-parallel outputs shard the bias; the row-parallel
+                # o bias applies to the (already psum-reduced) full output.
+                layer[f"{t}_bias"] = P() if t == "o" else P("model")
+        return specs
+
+    def _zero_biases(self, layer, as_jax: bool):
+        dtype = jnp.dtype(self.dtype)
+        for t in self.bias_targets:
+            z = np.zeros(self._bias_shape(t), dtype)
+            layer[f"{t}_bias"] = jnp.asarray(z) if as_jax else z
+
+    def init_random_params(self, seed: int = 0) -> Params:
+        params = super().init_random_params(seed)
+        for layer in params["layers"]:
+            self._zero_biases(layer, as_jax=True)
+        return params
+
+    def _postprocess_raw(self, raw) -> None:
+        # Stash the bias tensors the base loader ignores — avoids a second
+        # pass over multi-GB checkpoint shards.
+        self._raw_biases = {k: v for k, v in raw.items()
+                            if k.endswith("_proj.bias")
+                            and "self_attn" in k}
+
+    def load_weights(self, model_name_or_path: str,
+                     load_format: str = "auto",
+                     revision: Optional[str] = None) -> Params:
+        self._raw_biases = {}
+        params = super().load_weights(model_name_or_path, load_format,
+                                      revision)
+        for layer in params["layers"]:
+            self._zero_biases(layer, as_jax=False)
+        for name, arr in self._raw_biases.items():
+            # model.layers.{i}.self_attn.{q,k,v,o}_proj.bias
+            parts = name.split(".")
+            i = int(parts[2])
+            which = parts[4][0]
+            if which in self.bias_targets:
+                params["layers"][i][f"{which}_bias"] = cast_array(
+                    arr, self.dtype)
+        self._raw_biases = {}
+        return params

@@ -1,90 +1,14 @@
 """Qwen2 family (Qwen1.5/2/2.5 — llama recipe + QKV biases).
 
-Role parity: reference `vllm/model_executor/models/qwen2.py`. Delegates to
-the Llama implementation with per-projection bias support.
+Role parity: reference `vllm/model_executor/models/qwen2.py`. Delegates
+to the Llama implementation; the bias delta lives in
+`models/proj_bias.py` (shared with InternLM).
 """
 from __future__ import annotations
 
-from typing import Optional
-
-import jax.numpy as jnp
-import numpy as np
-
-from intellillm_tpu.config import ModelConfig
-from intellillm_tpu.layers.quantization import qmatmul
-from intellillm_tpu.models.llama import LlamaForCausalLM, Params
-from intellillm_tpu.models.weight_utils import cast_array
+from intellillm_tpu.models.proj_bias import ProjBiasMixin
 
 
-class Qwen2ForCausalLM(LlamaForCausalLM):
+class Qwen2ForCausalLM(ProjBiasMixin):
 
-    def _layer(self, lp, h, residual, kv_cache, attn_metadata, positions,
-               lora=None):
-        b, l, e = h.shape
-        from intellillm_tpu.layers.normalization import (fused_add_rms_norm,
-                                                         rms_norm)
-        if residual is None:
-            residual = h
-            h = rms_norm(h, lp["input_norm"], self.rms_eps)
-        else:
-            h, residual = fused_add_rms_norm(h, residual, lp["input_norm"],
-                                             self.rms_eps)
-        q = self._proj(h, lp, lora, "q") + lp["q_bias"]
-        k = self._proj(h, lp, lora, "k") + lp["k_bias"]
-        v = self._proj(h, lp, lora, "v") + lp["v_bias"]
-        q = q.reshape(b, l, self.num_heads, self.head_size)
-        k = k.reshape(b, l, self.num_kv_heads, self.head_size)
-        v = v.reshape(b, l, self.num_kv_heads, self.head_size)
-        q, k = self.rope(positions, q, k)
-        attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
-        h = self._proj(attn_out.reshape(b, l,
-                                        self.num_heads * self.head_size),
-                       lp, lora, "o")
-
-        h, residual = fused_add_rms_norm(h, residual, lp["post_attn_norm"],
-                                         self.rms_eps)
-        gate = self._proj(h, lp, lora, "gate")
-        up = self._proj(h, lp, lora, "up")
-        h = self._proj(self.act(gate) * up, lp, lora, "down")
-        return h, residual, kv_cache
-
-    def partition_specs(self):
-        from jax.sharding import PartitionSpec as P
-        specs = super().partition_specs()
-        for layer in specs["layers"]:
-            layer["q_bias"] = P("model")
-            layer["k_bias"] = P("model")
-            layer["v_bias"] = P("model")
-        return specs
-
-    def init_random_params(self, seed: int = 0) -> Params:
-        import jax.numpy as jnp
-        params = super().init_random_params(seed)
-        dtype = jnp.dtype(self.dtype)
-        hq = self.num_heads * self.head_size
-        hkv = self.num_kv_heads * self.head_size
-        for layer in params["layers"]:
-            layer["q_bias"] = jnp.zeros((hq, ), dtype)
-            layer["k_bias"] = jnp.zeros((hkv, ), dtype)
-            layer["v_bias"] = jnp.zeros((hkv, ), dtype)
-        return params
-
-    def load_weights(self, model_name_or_path: str,
-                     load_format: str = "auto",
-                     revision: Optional[str] = None) -> Params:
-        from intellillm_tpu.models.weight_utils import (
-            hf_model_weights_iterator)
-        params = super().load_weights(model_name_or_path, load_format,
-                                      revision)
-        # Second pass for the biases (cheap: shards are cached by the OS).
-        for name, arr in hf_model_weights_iterator(model_name_or_path,
-                                                   load_format, revision):
-            if not name.endswith("_proj.bias") or "self_attn" not in name:
-                continue
-            # model.layers.{i}.self_attn.{q,k,v}_proj.bias
-            parts = name.split(".")
-            i = int(parts[2])
-            which = parts[4][0]  # q/k/v
-            params["layers"][i][f"{which}_bias"] = cast_array(
-                arr, self.dtype)
-        return params
+    bias_targets = ("q", "k", "v")

@@ -9,7 +9,9 @@ current transformers versions parse them natively.
 from intellillm_tpu.transformers_utils.configs.aquila import AquilaConfig
 from intellillm_tpu.transformers_utils.configs.baichuan import BaichuanConfig
 from intellillm_tpu.transformers_utils.configs.chatglm import ChatGLMConfig
+from intellillm_tpu.transformers_utils.configs.decilm import DeciLMConfig
 from intellillm_tpu.transformers_utils.configs.deepseek import DeepseekConfig
+from intellillm_tpu.transformers_utils.configs.internlm import InternLMConfig
 from intellillm_tpu.transformers_utils.configs.qwen import QWenConfig
 from intellillm_tpu.transformers_utils.configs.yi import YiConfig
 
@@ -17,13 +19,16 @@ _CONFIG_REGISTRY = {
     "aquila": AquilaConfig,
     "baichuan": BaichuanConfig,
     "chatglm": ChatGLMConfig,
+    "deci": DeciLMConfig,
     "deepseek": DeepseekConfig,
+    "internlm": InternLMConfig,
     "qwen": QWenConfig,
     "Yi": YiConfig,
     "yi": YiConfig,
 }
 
 __all__ = [
-    "AquilaConfig", "BaichuanConfig", "ChatGLMConfig", "DeepseekConfig",
-    "QWenConfig", "YiConfig", "_CONFIG_REGISTRY",
+    "AquilaConfig", "BaichuanConfig", "ChatGLMConfig", "DeciLMConfig",
+    "DeepseekConfig", "InternLMConfig", "QWenConfig", "YiConfig",
+    "_CONFIG_REGISTRY",
 ]

@@ -1,0 +1,58 @@
+"""DeciLM config shim (reference loads DeciLM via trust_remote_code; the
+model itself is handled by `models/decilm.py`, reference
+`vllm/model_executor/models/decilm.py`). Llama-style fields plus
+`num_key_value_heads_per_layer` for Variable GQA."""
+from transformers import PretrainedConfig
+
+
+class DeciLMConfig(PretrainedConfig):
+    model_type = "deci"
+
+    def __init__(
+        self,
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=11008,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=None,
+        num_key_value_heads_per_layer=None,
+        hidden_act="silu",
+        max_position_embeddings=4096,
+        initializer_range=0.02,
+        rms_norm_eps=1e-6,
+        use_cache=True,
+        pad_token_id=0,
+        bos_token_id=1,
+        eos_token_id=2,
+        tie_word_embeddings=False,
+        rope_theta=10000.0,
+        rope_scaling=None,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        if num_key_value_heads_per_layer is not None:
+            self.num_key_value_heads_per_layer = num_key_value_heads_per_layer
+            # The KV pool is sized from num_key_value_heads (uniform across
+            # layers after degrouping) — set it here so cache sizing / TP
+            # validation see the degrouped count even before the model
+            # class normalizes the checkpoint (models/decilm.py).
+            self.num_key_value_heads = max(num_key_value_heads_per_layer)
+        else:
+            self.num_key_value_heads = (num_key_value_heads
+                                        or num_attention_heads)
+        self.hidden_act = hidden_act
+        self.max_position_embeddings = max_position_embeddings
+        self.initializer_range = initializer_range
+        self.rms_norm_eps = rms_norm_eps
+        self.use_cache = use_cache
+        self.rope_theta = rope_theta
+        self.rope_scaling = rope_scaling
+        super().__init__(pad_token_id=pad_token_id,
+                         bos_token_id=bos_token_id,
+                         eos_token_id=eos_token_id,
+                         tie_word_embeddings=tie_word_embeddings, **kwargs)

@@ -127,12 +127,38 @@ class CacheEngine:
         model_config: ModelConfig,
         parallel_config: ParallelConfig,
     ) -> int:
-        """Bytes per block across all layers (K + V), whole model."""
+        """PHYSICAL bytes per block across all layers (K + V), whole model.
+
+        TPU HBM arrays are tiled: the pool layout [NB, H, BS, D] pads the
+        minor dim to the 128-lane width. For D=128 models physical ==
+        logical (measured via XLA memory_analysis on v5e for
+        fp8/bf16/f32), but small-head models (gpt2 D=64, tiny test
+        models D=16) physically occupy up to 8x their logical bytes —
+        sizing the pool by logical bytes made the memory profile
+        allocate past HBM and OOM at engine init.
+        """
         head_size = model_config.get_head_size()
         num_kv_heads = model_config.get_total_num_kv_heads()
         num_layers = model_config.get_num_layers()
         if cache_dtype == "auto":
             cache_dtype = model_config.dtype
         itemsize = jnp.dtype(STR_DTYPE_TO_JNP[cache_dtype]).itemsize
-        per_token = num_kv_heads * head_size * itemsize
-        return 2 * num_layers * block_size * per_token
+        lanes = -(-head_size // 128) * 128             # minor: pad to 128
+        return 2 * num_layers * num_kv_heads * block_size * lanes * itemsize
+
+    @staticmethod
+    def get_logical_cache_block_size(
+        block_size: int,
+        cache_dtype: str,
+        model_config: ModelConfig,
+    ) -> int:
+        """Unpadded bytes per block across all layers (K + V) — sizes the
+        host (numpy) swap pool, which has no TPU tiling."""
+        head_size = model_config.get_head_size()
+        num_kv_heads = model_config.get_total_num_kv_heads()
+        num_layers = model_config.get_num_layers()
+        if cache_dtype == "auto":
+            cache_dtype = model_config.dtype
+        itemsize = jnp.dtype(STR_DTYPE_TO_JNP[cache_dtype]).itemsize
+        return (2 * num_layers * num_kv_heads * block_size * head_size *
+                itemsize)

@@ -90,6 +90,17 @@ class ModelRunner:
         self.engine_seed = model_config.seed
         self.max_model_len = model_config.max_model_len
 
+        # Fused-decode staging chunk size (see _decode_fn): parsed once so
+        # every trace of the decode program chunks consistently.
+        import os as _os
+        raw_chunk = _os.environ.get("INTELLILLM_DECODE_CHUNK", "").strip()
+        try:
+            self.decode_chunk = int(raw_chunk) if raw_chunk else 16
+        except ValueError:
+            logger.warning("INTELLILLM_DECODE_CHUNK=%r is not an integer; "
+                           "using the default (16)", raw_chunk)
+            self.decode_chunk = 16
+
         self.batch_buckets = default_batch_buckets(
             scheduler_config.max_num_seqs)
         self.len_buckets = default_len_buckets(scheduler_config.max_model_len)
@@ -267,83 +278,119 @@ class ModelRunner:
                    min_ps, seeds, pres_pen, freq_pen, rep_pen, prompt_tokens,
                    output_tokens, lora=None, *, num_steps, logprob_k,
                    do_topk, do_topp, do_minp, do_penalties):
-        """K fused decode iterations (staged).
+        """K fused decode iterations (staged, chunked).
 
-        The paged pool stays loop-invariant (read-only) through the scan —
+        The paged pool stays loop-invariant (read-only) through each scan —
         carrying it would make XLA double-buffer gigabytes. Each substep's
-        K/V land in small per-layer staging buffers [B, K, Hkv, D]; the
-        attention layer merges pool-part and stage-part by logsumexp, and
-        the staged tokens scatter into the pool ONCE after the scan.
+        K/V land in small per-layer staging buffers [B, C, Hkv, D]; the
+        attention layer merges pool-part and stage-part by logsumexp.
+
+        Chunking: every substep reads the FULL staging buffer (masked), so
+        a single K-wide scan pays O(K²·B·Hkv·D) HBM traffic — at K=128 the
+        stage-side reads cost as much as the pool kernel itself (measured
+        ~36% of the fused step on v5e). Instead the K steps run as
+        ceil(K/C) statically-unrolled chunks of C=INTELLILLM_DECODE_CHUNK
+        (default 16) substeps: scan over a C-wide stage, scatter the chunk
+        into the pool (the buffers are dead between chunks, so XLA reuses
+        them in place — no double buffering), advance the pool context,
+        repeat. Stage traffic drops K/C-fold; the extra scatters write the
+        same total bytes as the single post-scan scatter did.
         """
         assert self.sliding_window is None, (
             "sliding-window models use the unstaged single-step decode")
-        bs = self.block_size
         b = token_ids.shape[0]
         base_pos = positions[:, 0]              # [B] = n-1
         base_ctx = context_lens                 # [B] = n (0 for pad rows)
-        nb = kv_caches[0][0].shape[0]
-        oob_slot = nb * bs
-
         hkv = kv_caches[0][0].shape[1]
         d = kv_caches[0][0].shape[3]
         cache_dtype = kv_caches[0][0].dtype
-        stages = [(jnp.zeros((b, num_steps, hkv, d), cache_dtype),
-                   jnp.zeros((b, num_steps, hkv, d), cache_dtype))
-                  for _ in range(len(kv_caches))]
 
-        # Tokens already in the pool: everything before the fused batch's
-        # first input token (which goes to stage slot 0).
-        pool_ctx = jnp.maximum(base_ctx - 1, 0)
+        # Chunk schedule: full chunks plus a shorter tail when K is not a
+        # multiple (e.g. K=40, C=16 → [16, 16, 8]). decode_chunk <= 0
+        # disables chunking (one K-wide scan).
+        chunk = self.decode_chunk
+        if chunk <= 0:
+            chunk = num_steps
+        chunk_sizes = [chunk] * (num_steps // chunk)
+        if num_steps % chunk:
+            chunk_sizes.append(num_steps % chunk)
 
-        def substep(carry, k):
-            cur_tokens, stages = carry
-            pos_k = jnp.minimum(base_pos + k, self.max_model_len - 1)
-            meta = AttentionMetadata(
-                is_prompt=False,
-                slot_mapping=None,
-                context_lens=pool_ctx,
-                block_tables=block_tables,
-                staged=True,
-                stage_index=k,
-            )
-            caches4 = [(kp, vp, sk, sv)
-                       for (kp, vp), (sk, sv) in zip(kv_caches, stages)]
-            hidden, caches4 = self._call_model(params, cur_tokens[:, None],
-                                               pos_k[:, None], caches4,
-                                               meta, lora)
-            stages = [(c[2], c[3]) for c in caches4]
-            seeds_k = seeds + k.astype(jnp.uint32) * _SEED_STRIDE
-            sampled, lp, tk_ids, tk_lp, _ = self._compute_logits_and_sample(
-                params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
-                seeds_k, pres_pen, freq_pen, rep_pen, prompt_tokens,
-                output_tokens, num_samples=1, logprob_k=logprob_k,
-                do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
-                do_penalties=do_penalties)
-            next_tokens = sampled[:, 0]
-            return ((next_tokens, stages),
-                    (next_tokens, lp[:, 0], tk_ids, tk_lp))
+        from intellillm_tpu.ops.kv_cache import commit_staged_chunk
 
-        (final_tokens, stages), ys = jax.lax.scan(
-            substep, (token_ids[:, 0], stages),
-            jnp.arange(num_steps, dtype=jnp.int32))
+        def make_substep(pool_ctx, cur_caches, chunk_base):
+            def substep(carry, k):
+                cur_tokens, stages = carry
+                pos_k = jnp.minimum(base_pos + chunk_base + k,
+                                    self.max_model_len - 1)
+                meta = AttentionMetadata(
+                    is_prompt=False,
+                    slot_mapping=None,
+                    context_lens=pool_ctx,
+                    block_tables=block_tables,
+                    staged=True,
+                    stage_index=k,
+                )
+                caches4 = [(kp, vp, sk, sv)
+                           for (kp, vp), (sk, sv) in zip(cur_caches, stages)]
+                hidden, caches4 = self._call_model(params,
+                                                   cur_tokens[:, None],
+                                                   pos_k[:, None], caches4,
+                                                   meta, lora)
+                stages = [(c[2], c[3]) for c in caches4]
+                g = (chunk_base + k).astype(jnp.uint32)
+                seeds_k = seeds + g * _SEED_STRIDE
+                (sampled, lp, tk_ids,
+                 tk_lp, _) = self._compute_logits_and_sample(
+                    params, hidden[:, 0], temperatures, top_ks, top_ps,
+                    min_ps, seeds_k, pres_pen, freq_pen, rep_pen,
+                    prompt_tokens, output_tokens, num_samples=1,
+                    logprob_k=logprob_k, do_topk=do_topk, do_topp=do_topp,
+                    do_minp=do_minp, do_penalties=do_penalties)
+                next_tokens = sampled[:, 0]
+                return ((next_tokens, stages),
+                        (next_tokens, lp[:, 0], tk_ids, tk_lp))
+            return substep
 
-        # Scatter all staged tokens (positions n-1 .. n+K-2) into the pool.
-        pos_all = base_pos[:, None] + jnp.arange(num_steps)[None, :]
-        pos_all = jnp.minimum(pos_all, self.max_model_len - 1)
-        li = pos_all // bs                               # [B, K]
-        slot_all = (jnp.take_along_axis(block_tables, li, axis=1) * bs +
-                    pos_all % bs)
-        slot_all = jnp.where(base_ctx[:, None] > 0, slot_all, oob_slot)
-        flat_slots = slot_all.reshape(-1)
+        cur_caches = kv_caches
+        cur_tokens = token_ids[:, 0]
+        ys_chunks = []
+        chunk_base = 0
+        for csize in chunk_sizes:
+            # Tokens already in the pool: everything before this chunk's
+            # first input token (stage slot 0 = position
+            # base_pos+chunk_base).
+            pool_ctx = jnp.where(
+                base_ctx > 0,
+                jnp.minimum(base_ctx - 1 + chunk_base, self.max_model_len),
+                0)
+            stages = [(jnp.zeros((b, csize, hkv, d), cache_dtype),
+                       jnp.zeros((b, csize, hkv, d), cache_dtype))
+                      for _ in range(len(cur_caches))]
+            (cur_tokens, stages), ys = jax.lax.scan(
+                make_substep(pool_ctx, cur_caches, chunk_base),
+                (cur_tokens, stages),
+                jnp.arange(csize, dtype=jnp.int32))
+            ys_chunks.append(ys)
 
-        from intellillm_tpu.ops.kv_cache import reshape_and_cache
-        new_caches = []
-        for (kp, vp), (sk, sv) in zip(kv_caches, stages):
-            kp, vp = reshape_and_cache(sk.reshape(b * num_steps, hkv, d),
-                                       sv.reshape(b * num_steps, hkv, d),
-                                       kp, vp, flat_slots)
-            new_caches.append((kp, vp))
+            # Commit the chunk's staged tokens (positions
+            # base_pos+chunk_base .. +csize-1) into the pool,
+            # page-granular (see ops/kv_cache.py:commit_staged_chunk).
+            # Overshoot tokens past max_model_len are dropped, not
+            # clamped onto the last slot — the engine discards them.
+            start = base_pos + chunk_base
+            n_valid = jnp.where(
+                base_ctx > 0,
+                jnp.clip(self.max_model_len - start, 0, csize), 0)
+            cur_caches = [
+                commit_staged_chunk(sk, sv, kp, vp, start, n_valid,
+                                    block_tables)
+                for (kp, vp), (sk, sv) in zip(cur_caches, stages)]
+            chunk_base += csize
 
+        new_caches = cur_caches
+        # [K, B, ...] per ys leaf, chunks concatenated along the step axis.
+        ys = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0),
+                          *ys_chunks) if len(ys_chunks) > 1 else ys_chunks[0]
         sampled_k, lp_k, tk_ids_k, tk_lp_k = ys
         # [K, B, ...] → [B, K, ...]
         packed = self._pack(jnp.swapaxes(sampled_k, 0, 1),

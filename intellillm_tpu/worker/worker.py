@@ -99,7 +99,11 @@ class Worker:
         """
         block_bytes = CacheEngine.get_cache_block_size(
             block_size, cache_dtype, self.model_config, self.parallel_config)
-        num_cpu_blocks = int(cpu_swap_space // block_bytes)
+        # The host swap pool is plain numpy (unpadded): size it by logical
+        # bytes, not the lane-padded device bytes.
+        logical_block_bytes = CacheEngine.get_logical_cache_block_size(
+            block_size, cache_dtype, self.model_config)
+        num_cpu_blocks = int(cpu_swap_space // logical_block_bytes)
 
         # Everything is accounted per chip: params and the KV pool are
         # sharded over the mesh, so one chip holds only its shard.
@@ -125,17 +129,24 @@ class Worker:
                                 if tp > 1 and nkv % tp == 0 else block_bytes)
 
         temp_bytes = self._estimate_step_temp_bytes()
-        # Fused-decode staging buffers (2 per layer, [B, K, Hkv, D]) and
+        # Fused-decode staging buffers (2 per layer, [B, C, Hkv, D]) and
         # XLA weight-relayout copies for the in-loop matmuls are temps the
-        # prefill lowering can't see; account for them analytically.
+        # prefill lowering can't see; account for them analytically. With
+        # chunked staging (_decode_fn) the buffers are chunk-sized, not
+        # K-sized, and use the cache dtype.
         k_steps = self.scheduler_config.num_decode_steps
+        chunk = self.model_runner.decode_chunk
+        if chunk > 0:
+            k_steps = min(k_steps, chunk)
         import jax.numpy as _jnp
         from intellillm_tpu.utils import STR_DTYPE_TO_JNP as _M
+        stage_dtype = (self.model_config.dtype
+                       if cache_dtype == "auto" else cache_dtype)
         stage_bytes = (2 * self.model_config.get_num_layers() *
                        self.scheduler_config.max_num_seqs * k_steps *
                        self.model_config.get_total_num_kv_heads() *
                        self.model_config.get_head_size() *
-                       _jnp.dtype(_M[self.model_config.dtype]).itemsize)
+                       _jnp.dtype(_M[stage_dtype]).itemsize)
         temp_bytes += stage_bytes + int(0.10 * weights_bytes)
         available = int(total * hbm_utilization) - weights_bytes - temp_bytes
         num_device_blocks = max(available // block_bytes_per_chip, 0)
@@ -207,7 +218,7 @@ class Worker:
                                         self.parallel_config,
                                         sharding=kv_sharding)
 
-    def warm_up_model(self) -> None:
+    def warm_up_model(self):
         """Pre-compile the steady-state decode executables (CUDA-graph-
         capture analogue, reference model_runner.py:629-698): the top batch
         bucket at the two narrowest block-table widths, greedy sampling
@@ -257,6 +268,20 @@ class Worker:
                     **flags)
                 self.cache_engine.device_cache = caches
                 n += 1
+                if w == runner.block_width_buckets[0]:
+                    # Passing fetch_indices changes the jit arg pytree
+                    # (logits_processors escape path) — warm it too, so the
+                    # first processor-bearing request doesn't trigger a
+                    # full XLA compile mid-serving.
+                    m = pad_to_bucket(1, runner.batch_buckets)
+                    # args ends at output_tokens; fill lora=None, then
+                    # fetch_indices.
+                    fargs = args + (None, place(np.zeros(m, np.int32)))
+                    packed, _fetched, caches = runner._jit_decode_single(
+                        self.params, self.cache_engine.device_cache, *fargs,
+                        **flags)
+                    self.cache_engine.device_cache = caches
+                    n += 1
                 k = self.scheduler_config.num_decode_steps
                 if k > 1:
                     packed, caches = runner._jit_decode(
@@ -267,9 +292,11 @@ class Worker:
                 jax.block_until_ready(packed)
             logger.info("Warm-up: compiled %d decode executables (bs=%d) "
                         "in %.1fs", n, b, _time.monotonic() - start)
+            return n
         except Exception as e:  # warm-up is best-effort
             logger.warning("Warm-up failed (%s); compiling lazily instead",
                            e)
+            return None
 
     # --- step ------------------------------------------------------------
 

@@ -56,6 +56,23 @@ def test_mixed_stop_and_plain_requests_fused(tiny_llama_dir,
     assert got == ref
 
 
+@pytest.mark.parametrize("num_steps", [32, 20])
+def test_chunked_fused_decode_matches_unfused(tiny_llama_dir,
+                                              example_prompts,
+                                              monkeypatch, num_steps):
+    """Fused decode with C=8 chunks (K=32 → 4 full chunks; K=20 → 2 full
+    + a 4-step tail chunk) must match single-step decode token-for-token
+    — covers the chunk-boundary pool-context advance, the per-chunk
+    page commit, and the non-divisible tail schedule."""
+    monkeypatch.setenv("INTELLILLM_DECODE_CHUNK", "8")
+    params = [SamplingParams(temperature=0.0, max_tokens=24,
+                             ignore_eos=True)
+              for _ in example_prompts]
+    ref = _run(tiny_llama_dir, example_prompts, params, 1)
+    got = _run(tiny_llama_dir, example_prompts, params, num_steps)
+    assert got == ref
+
+
 def test_penalties_e2e_change_output(tiny_opt_dir, example_prompts):
     """Greedy + strong repetition penalty must diverge from plain greedy
     (tiny-OPT repeats tokens) and produce no repeated immediate bigrams of

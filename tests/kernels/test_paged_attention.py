@@ -153,6 +153,48 @@ def test_paged_attention_v4_matches_reference(hq, hkv, w, use_alibi):
                                rtol=tol, atol=tol)
 
 @requires_tpu
+@pytest.mark.parametrize("hq,hkv", [(8, 8), (8, 2)])
+@pytest.mark.parametrize("use_alibi", [False, True])
+def test_paged_attention_v3_v4_cross_consistency(hq, hkv, use_alibi):
+    """v3 and v4 must agree with each other far more tightly than either
+    agrees with the f32 jnp oracle (both use the same online-softmax
+    accumulation order per page). The loose oracle tolerances above could
+    mask a kernel regression; this tight cross-check cannot."""
+    from intellillm_tpu.layers.alibi import get_alibi_slopes
+    from intellillm_tpu.ops.pallas.paged_attention import paged_attention
+    from intellillm_tpu.ops.pallas.paged_attention_v4 import (
+        paged_attention_v4)
+
+    rng = np.random.default_rng(11)
+    b, d, nb, bs, w = 4, 128, 64, 16, 8
+    k_cache, v_cache = make_cache(rng, nb, hkv, bs, d, np.float32)
+    q = jnp.asarray(rng.normal(size=(b, 1, hq, d)).astype(np.float32))
+    tables = jnp.asarray(
+        rng.permutation(nb)[:b * w].reshape(b, w).astype(np.int32))
+    ctx = jnp.asarray(np.asarray([1, 17, 63, 128], np.int32))
+    slopes = (jnp.asarray(get_alibi_slopes(hq), jnp.float32)
+              if use_alibi else None)
+    scale = d**-0.5
+
+    import os
+    env = dict(os.environ)
+    try:
+        os.environ["INTELLILLM_PAGED_V4"] = "0"
+        out3, lse3 = paged_attention(q, k_cache, v_cache, tables, ctx,
+                                     scale, alibi_slopes=slopes,
+                                     return_lse=True)
+    finally:
+        os.environ.clear()
+        os.environ.update(env)
+    out4, lse4 = paged_attention_v4(q, k_cache, v_cache, tables, ctx,
+                                    scale, slopes, return_lse=True)
+    np.testing.assert_allclose(np.asarray(out3), np.asarray(out4),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse3), np.asarray(lse4),
+                               rtol=1e-5, atol=1e-5)
+
+
+@requires_tpu
 def test_paged_attention_v4_bf16_cache_wide_table():
     """bf16 KV with a 32-wide block table (llama-7b decode shape at
     max_model_len=512): ppg hits its 16-page cap, giving the largest

@@ -303,6 +303,127 @@ def test_deepseek_moe_routing_no_renorm():
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
 
 
+# --- decilm: variable GQA == degrouped uniform-GQA llama -----------------
+
+
+@pytest.fixture(scope="module")
+def decilm_pair(tmp_path_factory):
+    """(llama_dir, decilm_dir): the llama twin stores layer-0 K/V already
+    degrouped (1 kv head replicated to 2), the DeciLM checkpoint stores
+    the grouped original + num_key_value_heads_per_layer=[1, 2].
+    Degrouping is exact, so greedy tokens must match."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    root = tmp_path_factory.mktemp("decilm-eq")
+    llama_dir = str(root / "llama")
+    _, vocab_size = _build_word_tokenizer(llama_dir)
+    torch.manual_seed(0)
+    config = LlamaConfig(
+        vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, pad_token_id=0, bos_token_id=1,
+        eos_token_id=1, tie_word_embeddings=False,
+        torch_dtype=torch.float32)
+    model = LlamaForCausalLM(config).eval()
+    head_size = 64 // 4
+    with torch.no_grad():
+        for t in ("k_proj", "v_proj"):
+            w = getattr(model.model.layers[0].self_attn, t).weight
+            grouped = w[:head_size].clone()               # 1 kv head
+            w.copy_(grouped.repeat(2, 1))                 # degrouped
+    model.save_pretrained(llama_dir, safe_serialization=True)
+
+    deci_dir = str(root / "decilm")
+    _build_word_tokenizer(deci_dir)
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    tensors = dict(sd)
+    for t in ("k_proj", "v_proj"):
+        key = f"model.layers.0.self_attn.{t}.weight"
+        tensors[key] = sd[key][:head_size]                # store grouped
+    _save_tensors(deci_dir, tensors)
+    _save_config(deci_dir, {
+        "model_type": "deci",
+        "architectures": ["DeciLMForCausalLM"],
+        "vocab_size": vocab_size, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads_per_layer": [1, 2],
+        "hidden_act": "silu", "max_position_embeddings": 128,
+        "rms_norm_eps": 1e-6, "pad_token_id": 0, "bos_token_id": 1,
+        "eos_token_id": 1, "tie_word_embeddings": False,
+    })
+    return llama_dir, deci_dir
+
+
+def test_decilm_variable_gqa_matches_degrouped_llama(decilm_pair,
+                                                     example_prompts,
+                                                     hf_runner):
+    llama_dir, deci_dir = decilm_pair
+    hf = hf_runner(llama_dir)
+    golden = hf.generate_greedy(example_prompts, MAX_TOKENS)
+    ours = _engine_greedy(deci_dir, example_prompts)
+    for h, o in zip(golden, ours):
+        assert list(h[:len(o)]) == list(o[:len(h)]) or h == o, \
+            f"hf={h} ours={o}"
+
+
+# --- internlm: llama + attention biases ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def internlm_pair(tmp_path_factory):
+    """(llama_dir, internlm_dir) with identical math: HF llama with
+    attention_bias=True vs the same tensors under model_type=internlm
+    with bias=true."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    root = tmp_path_factory.mktemp("internlm-eq")
+    llama_dir = str(root / "llama")
+    _, vocab_size = _build_word_tokenizer(llama_dir)
+    torch.manual_seed(0)
+    config = LlamaConfig(
+        vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=128, pad_token_id=0, bos_token_id=1,
+        eos_token_id=1, tie_word_embeddings=False, attention_bias=True,
+        torch_dtype=torch.float32)
+    model = LlamaForCausalLM(config).eval()
+    with torch.no_grad():
+        # save_pretrained zero-initializes fresh biases; randomize so the
+        # equivalence actually exercises them.
+        for layer in model.model.layers:
+            for t in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                getattr(layer.self_attn, t).bias.normal_(std=0.1)
+    model.save_pretrained(llama_dir, safe_serialization=True)
+
+    il_dir = str(root / "internlm")
+    _build_word_tokenizer(il_dir)
+    _save_tensors(il_dir,
+                  {k: v.numpy() for k, v in model.state_dict().items()})
+    _save_config(il_dir, {
+        "model_type": "internlm",
+        "architectures": ["InternLMForCausalLM"],
+        "vocab_size": vocab_size, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "bias": True, "hidden_act": "silu",
+        "max_position_embeddings": 128, "rms_norm_eps": 1e-6,
+        "pad_token_id": 0, "bos_token_id": 1, "eos_token_id": 1,
+        "tie_word_embeddings": False,
+    })
+    return llama_dir, il_dir
+
+
+def test_internlm_bias_matches_llama_twin(internlm_pair, example_prompts,
+                                          hf_runner):
+    llama_dir, il_dir = internlm_pair
+    hf = hf_runner(llama_dir)
+    golden = hf.generate_greedy(example_prompts, MAX_TOKENS)
+    ours = _engine_greedy(il_dir, example_prompts)
+    for h, o in zip(golden, ours):
+        assert list(h[:len(o)]) == list(o[:len(h)]) or h == o, \
+            f"hf={h} ours={o}"
+
+
 # --- config shims --------------------------------------------------------
 
 
@@ -313,6 +434,9 @@ def test_deepseek_moe_routing_no_renorm():
     ("deepseek", {"hidden_size": 64}),
     ("aquila", {"hidden_size": 64}),
     ("Yi", {"hidden_size": 64}),
+    ("deci", {"hidden_size": 64,
+              "num_key_value_heads_per_layer": [1, 2]}),
+    ("internlm", {"hidden_size": 64, "bias": True}),
 ])
 def test_config_shim_parses_without_remote_code(tmp_path, model_type,
                                                 extra):

@@ -1,0 +1,63 @@
+"""Warm-up precompile smoke test.
+
+`Worker.warm_up_model` (the CUDA-graph-capture analogue, reference
+`model_runner.py:629-698`) normally runs only on TPU; here the backend
+gate is bypassed so the exact warm-up call sequence — including the
+fetch_indices (logits_processors) pytree variant and the fused-K program
+— executes on CPU. Regressions in the warm-up argument plumbing
+otherwise only surface as a swallowed best-effort warning on real
+hardware.
+"""
+import jax
+import pytest
+
+from intellillm_tpu.config import (CacheConfig, ModelConfig, ParallelConfig,
+                                   SchedulerConfig)
+from intellillm_tpu.worker.worker import Worker
+
+
+def _make_worker(num_decode_steps):
+    from transformers import LlamaConfig
+
+    hf = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, max_position_embeddings=128,
+                     tie_word_embeddings=False)
+    model_config = ModelConfig.from_hf_config(hf, dtype="float32",
+                                              max_model_len=128,
+                                              load_format="dummy")
+    cache_config = CacheConfig(block_size=16,
+                               num_device_blocks_override=64,
+                               swap_space_gib=0.01)
+    cache_config.num_device_blocks = 64
+    cache_config.num_cpu_blocks = 4
+    scheduler_config = SchedulerConfig(max_num_batched_tokens=2048,
+                                       max_num_seqs=8, max_model_len=128,
+                                       max_paddings=512,
+                                       num_decode_steps=num_decode_steps)
+    worker = Worker(model_config, ParallelConfig(), scheduler_config,
+                    cache_config)
+    worker.init_model()
+    worker.load_model()
+    worker.init_cache_engine(cache_config)
+    return worker
+
+
+@pytest.mark.parametrize("num_decode_steps", [1, 4])
+def test_warm_up_compiles_all_variants(monkeypatch, num_decode_steps):
+    worker = _make_worker(num_decode_steps)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    n = worker.warm_up_model()
+    # None means the best-effort except path fired — in this controlled
+    # environment that's a broken call sequence, not a hardware limit.
+    assert n is not None, "warm-up fell back to lazy compilation"
+    # Per warmed width bucket: single-step + (fused if K>1); plus one
+    # fetch_indices variant on the first width.
+    n_widths = len(worker.model_runner.block_width_buckets[:2])
+    per_width = 2 if num_decode_steps > 1 else 1
+    assert n == n_widths * per_width + 1
+
+
+def test_warm_up_skipped_on_cpu():
+    worker = _make_worker(1)
+    assert worker.warm_up_model() is None
