@@ -117,8 +117,12 @@ class LLMEngine:
         cc = self.cache_config
         if cc.num_device_blocks_override is not None:
             num_device = cc.num_device_blocks_override
-            num_cpu = max(
-                int(cc.swap_space_bytes // self._cache_block_bytes()), 1)
+            # The host swap pool is plain numpy: size it by logical bytes
+            # (get_cache_block_size reports lane-padded DEVICE bytes).
+            from intellillm_tpu.worker.cache_engine import CacheEngine
+            logical = CacheEngine.get_logical_cache_block_size(
+                cc.block_size, cc.cache_dtype, self.model_config)
+            num_cpu = max(int(cc.swap_space_bytes // logical), 1)
         else:
             num_device, num_cpu = self.worker.profile_num_available_blocks(
                 block_size=cc.block_size,
@@ -143,12 +147,6 @@ class LLMEngine:
                     num_device, num_cpu)
         self.worker.init_cache_engine(cc)
         self.worker.warm_up_model()
-
-    def _cache_block_bytes(self) -> int:
-        from intellillm_tpu.worker.cache_engine import CacheEngine
-        return CacheEngine.get_cache_block_size(
-            self.cache_config.block_size, self.cache_config.cache_dtype,
-            self.model_config, self.parallel_config)
 
     @classmethod
     def from_engine_args(cls, engine_args: EngineArgs,

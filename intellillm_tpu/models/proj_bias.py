@@ -25,6 +25,13 @@ class ProjBiasMixin(LlamaForCausalLM):
     # Subclasses override: projections that carry a checkpoint bias.
     bias_targets = ("q", "k", "v")
 
+    def _biases_expected(self) -> bool:
+        """Whether the checkpoint MUST contain bias tensors. Qwen2-family
+        checkpoints always ship QKV biases; InternLM overrides via
+        `config.bias`. Guards against a silent all-zeros fallback when a
+        checkpoint's tensor names don't match the expected layout."""
+        return getattr(self.config, "bias", True)
+
     def _proj(self, h, lp, lora, target):
         out = super()._proj(h, lp, lora, target)
         bias = lp.get(f"{target}_bias")
@@ -71,6 +78,13 @@ class ProjBiasMixin(LlamaForCausalLM):
         self._raw_biases = {}
         params = super().load_weights(model_name_or_path, load_format,
                                       revision)
+        if self._biases_expected() and not self._raw_biases:
+            raise ValueError(
+                f"{type(self).__name__}: checkpoint {model_name_or_path!r} "
+                "contains no 'model.layers.*.self_attn.*_proj.bias' "
+                "tensors but this architecture requires attention biases "
+                "— refusing to silently zero-fill them (nonstandard "
+                "tensor naming?)")
         for layer in params["layers"]:
             self._zero_biases(layer, as_jax=False)
         for name, arr in self._raw_biases.items():

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -36,6 +38,7 @@ def _grouped_query_reshape(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
     return q.reshape(*lead, num_kv_heads, group, d)
 
 
+@functools.partial(jax.jit, static_argnames=("scale", "sliding_window"))
 def prefill_attention_reference(
     q: jnp.ndarray,            # [B, L, Hq, D]
     k: jnp.ndarray,            # [B, L, Hkv, D]
@@ -82,6 +85,7 @@ def prefill_attention_reference(
     return out.reshape(b, l, hq, d).astype(q.dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("scale", "sliding_window"))
 def context_attention_reference(
     q: jnp.ndarray,             # [B, L, Hq, D] — the new (suffix) tokens
     k_new: jnp.ndarray,         # [B, L, Hkv, D]
@@ -168,6 +172,7 @@ def context_attention_reference(
     return out.reshape(b, l, hq, d).astype(q.dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("scale", "return_lse"))
 def decode_attention_reference(
     q: jnp.ndarray,             # [B, 1, Hq, D]
     k_cache: jnp.ndarray,       # [num_blocks, Hkv, block_size, D]

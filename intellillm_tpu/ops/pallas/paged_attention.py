@@ -294,17 +294,18 @@ def paged_attention(
     (935.8 vs 810.6 tok/s/chip, llama2-7b int8/fp8-KV bs=32).
     INTELLILLM_PAGED_V4=0 falls back to the v3 kernel below."""
     import os
+
+    from intellillm_tpu.utils import parse_env_flag
     raw = os.environ.get("INTELLILLM_PAGED_V4")
-    val = raw.strip().lower() if raw is not None else ""
+    flag = parse_env_flag(raw)
     # Empty/whitespace counts as unset (default: v4). Unrecognized values
     # warn rather than silently selecting a kernel.
-    if val and val not in ("0", "false", "off", "no", "1", "true", "on",
-                           "yes"):
+    if flag is None and raw is not None and raw.strip():
         import warnings
         warnings.warn(
             f"INTELLILLM_PAGED_V4={raw!r} not recognized; defaulting to v4"
             " (use 0/false/off/no to select v3)")
-    if val not in ("0", "false", "off", "no"):
+    if flag is not False:
         from intellillm_tpu.ops.pallas.paged_attention_v4 import (
             paged_attention_v4)
         return paged_attention_v4(q, k_cache, v_cache, block_tables,

@@ -146,3 +146,57 @@ def apply_platform_override() -> None:
     if plat:
         import jax
         jax.config.update("jax_platforms", plat)
+
+
+def parse_env_flag(raw):
+    """Uniform boolean env parsing shared by the INTELLILLM_* knobs:
+    returns True/False for recognized spellings, None for unset/empty or
+    unrecognized values (callers decide the default and whether to warn).
+    """
+    if raw is None:
+        return None
+    val = raw.strip().lower()
+    if not val:
+        return None
+    if val in ("0", "false", "off", "no"):
+        return False
+    if val in ("1", "true", "on", "yes"):
+        return True
+    return None
+
+
+def enable_persistent_compilation_cache() -> None:
+    """Point JAX's persistent compilation cache at a local directory so
+    engine restarts skip recompiling the decode/prefill executables
+    (the chunked fused-decode program takes minutes of XLA time at 7B;
+    CUDA-graph capture in the reference pays an analogous cost every
+    boot with no cache at all). Opt-out: INTELLILLM_COMPILE_CACHE=0;
+    override dir: INTELLILLM_COMPILE_CACHE=/path."""
+    raw = os.environ.get("INTELLILLM_COMPILE_CACHE", "").strip()
+    flag = parse_env_flag(raw)
+    default_path = os.path.expanduser("~/.cache/intellillm_tpu/xla")
+    if flag is False:
+        return
+    if flag is None and raw:
+        # Not a recognized boolean: a directory override — but only if it
+        # actually looks like a path ("yes"/"2"/"enable" are mistakes,
+        # not cache directories).
+        if os.sep in raw or raw.startswith((".", "~")):
+            path = os.path.expanduser(raw)
+        else:
+            import warnings
+            warnings.warn(
+                f"INTELLILLM_COMPILE_CACHE={raw!r} is neither a boolean "
+                "(0/1/true/false/on/off/yes/no) nor a path; using the "
+                f"default cache dir {default_path}")
+            path = default_path
+    else:
+        path = default_path
+    import jax
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception as e:  # cache is best-effort
+        import warnings
+        warnings.warn(f"persistent compilation cache unavailable: {e}")

@@ -131,10 +131,12 @@ class CacheEngine:
 
         TPU HBM arrays are tiled: the pool layout [NB, H, BS, D] pads the
         minor dim to the 128-lane width. For D=128 models physical ==
-        logical (measured via XLA memory_analysis on v5e for
-        fp8/bf16/f32), but small-head models (gpt2 D=64, tiny test
-        models D=16) physically occupy up to 8x their logical bytes —
-        sizing the pool by logical bytes made the memory profile
+        logical — measured via XLA memory_analysis on v5e across
+        fp8/int8/bf16/f32 AND block sizes 4/8/16/32 (no sublane padding:
+        when the minor dim is exactly one lane tile, XLA merges the major
+        dims, so BS needs no rounding). Small-head models (gpt2 D=64,
+        tiny test models D=16) physically occupy up to 8x their logical
+        bytes — sizing the pool by logical bytes made the memory profile
         allocate past HBM and OOM at engine init.
         """
         head_size = model_config.get_head_size()

@@ -60,6 +60,8 @@ class Worker:
     # --- init ------------------------------------------------------------
 
     def init_model(self) -> None:
+        from intellillm_tpu.utils import enable_persistent_compilation_cache
+        enable_persistent_compilation_cache()
         self.mesh = build_mesh(self.parallel_config)
         logger.info("Initialized mesh: %s (backend=%s)", self.mesh,
                     jax.default_backend())

@@ -309,8 +309,10 @@ def test_deepseek_moe_routing_no_renorm():
 @pytest.fixture(scope="module")
 def decilm_pair(tmp_path_factory):
     """(llama_dir, decilm_dir): the llama twin stores layer-0 K/V already
-    degrouped (1 kv head replicated to 2), the DeciLM checkpoint stores
-    the grouped original + num_key_value_heads_per_layer=[1, 2].
+    degrouped (2 kv heads expanded to 4 — interleaved h0,h0,h1,h1, which
+    is the only ordering consistent with grouped-query head mapping; a
+    tile ordering would break this equivalence), the DeciLM checkpoint
+    stores the grouped original + num_key_value_heads_per_layer=[2, 4].
     Degrouping is exact, so greedy tokens must match."""
     from transformers import LlamaConfig, LlamaForCausalLM
 
@@ -320,7 +322,7 @@ def decilm_pair(tmp_path_factory):
     torch.manual_seed(0)
     config = LlamaConfig(
         vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
-        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
         max_position_embeddings=128, pad_token_id=0, bos_token_id=1,
         eos_token_id=1, tie_word_embeddings=False,
         torch_dtype=torch.float32)
@@ -329,8 +331,11 @@ def decilm_pair(tmp_path_factory):
     with torch.no_grad():
         for t in ("k_proj", "v_proj"):
             w = getattr(model.model.layers[0].self_attn, t).weight
-            grouped = w[:head_size].clone()               # 1 kv head
-            w.copy_(grouped.repeat(2, 1))                 # degrouped
+            grouped = w[:2 * head_size].clone()           # 2 kv heads
+            degrouped = torch.repeat_interleave(
+                grouped.reshape(2, head_size, -1), 2,
+                dim=0).reshape(4 * head_size, -1)         # h0,h0,h1,h1
+            w.copy_(degrouped)
     model.save_pretrained(llama_dir, safe_serialization=True)
 
     deci_dir = str(root / "decilm")
@@ -339,7 +344,8 @@ def decilm_pair(tmp_path_factory):
     tensors = dict(sd)
     for t in ("k_proj", "v_proj"):
         key = f"model.layers.0.self_attn.{t}.weight"
-        tensors[key] = sd[key][:head_size]                # store grouped
+        tensors[key] = sd[key].reshape(
+            2, 2, head_size, -1)[:, 0].reshape(2 * head_size, -1)
     _save_tensors(deci_dir, tensors)
     _save_config(deci_dir, {
         "model_type": "deci",
@@ -347,7 +353,7 @@ def decilm_pair(tmp_path_factory):
         "vocab_size": vocab_size, "hidden_size": 64,
         "intermediate_size": 128, "num_hidden_layers": 2,
         "num_attention_heads": 4,
-        "num_key_value_heads_per_layer": [1, 2],
+        "num_key_value_heads_per_layer": [2, 4],
         "hidden_act": "silu", "max_position_embeddings": 128,
         "rms_norm_eps": 1e-6, "pad_token_id": 0, "bos_token_id": 1,
         "eos_token_id": 1, "tie_word_embeddings": False,
