@@ -15,7 +15,8 @@ Env knobs: INTELLILLM_BENCH_SIZE=7b|1b|tiny (default 7b),
            INTELLILLM_BENCH_K (fused decode steps, default 128),
            INTELLILLM_BENCH_KV (cache dtype, default fp8_e5m2 for 7b),
            INTELLILLM_BENCH_QUANT (default int8 for 7b),
-           INTELLILLM_BENCH_BLOCKS (KV pool size override).
+           INTELLILLM_BENCH_BLOCKS (KV pool size override, in blocks),
+           INTELLILLM_BENCH_BLOCK_SIZE (tokens per KV block, default 16).
 """
 from __future__ import annotations
 
@@ -53,7 +54,10 @@ def build_engine(size: str, max_num_seqs: int, max_model_len: int,
     model_config = ModelConfig.from_hf_config(
         hf_config, dtype="bfloat16", max_model_len=max_model_len,
         load_format="dummy", quantization=quantization)
-    cache_config = CacheConfig(block_size=16,
+    cache_config = CacheConfig(block_size=int(
+                                   os.environ.get(
+                                       "INTELLILLM_BENCH_BLOCK_SIZE",
+                                       "16")),
                                num_device_blocks_override=num_blocks,
                                swap_space_gib=0.05,
                                cache_dtype=cache_dtype)

@@ -39,13 +39,20 @@ def main():
     ap.add_argument("--k", type=int, default=16)
     ap.add_argument("--trace", default=None,
                     help="dir for jax.profiler trace of one fused step")
+    ap.add_argument("--kv", default="auto",
+                    help="cache dtype (e.g. fp8_e5m2; default bf16)")
+    ap.add_argument("--blocks", type=int, default=None,
+                    help="KV pool size in blocks (default: size-class)")
     args = ap.parse_args()
 
     import bench
+    default_blocks = {"7b": 512, "1b": 2048, "tiny": 4096}[args.size]
     engine = bench.build_engine(args.size, args.bs, 512,
-                                {"7b": 512, "1b": 2048, "tiny": 4096}[args.size],
+                                args.blocks if args.blocks is not None
+                                else default_blocks,
                                 quantization="int8" if args.size == "7b"
-                                else None)
+                                else None,
+                                cache_dtype=args.kv)
     runner = engine.worker.model_runner
     caches = engine.worker.cache_engine.device_cache
     model_config = engine.model_config

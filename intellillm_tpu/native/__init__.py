@@ -37,7 +37,8 @@ def _load() -> Optional[ctypes.CDLL]:
     if _tried:
         return _lib
     _tried = True
-    if os.environ.get("INTELLILLM_DISABLE_NATIVE") == "1":
+    from intellillm_tpu.utils import parse_env_flag
+    if parse_env_flag(os.environ.get("INTELLILLM_DISABLE_NATIVE")):
         return None
     try:
         if (not os.path.exists(_LIB)

@@ -23,7 +23,8 @@ def set_use_pallas(force: Optional[bool]) -> None:
 def use_pallas() -> bool:
     if _FORCE is not None:
         return _FORCE
-    env = os.environ.get("INTELLILLM_USE_PALLAS")
-    if env is not None:
-        return env not in ("0", "false", "False")
+    from intellillm_tpu.utils import parse_env_flag
+    flag = parse_env_flag(os.environ.get("INTELLILLM_USE_PALLAS"))
+    if flag is not None:
+        return flag
     return jax.default_backend() == "tpu"

@@ -221,11 +221,14 @@ def _paged_attention_call_v4(q_grouped, slopes, k_cache, v_cache, block_tables,
     # Head-block size: each page DMA moves [HP, BS, D] — bigger HP means
     # fewer, larger DMAs and fewer grid steps (the KV walk is DMA-issue-
     # bound, not bandwidth-bound). Measured on v5e, llama-7b end-to-end:
-    # hp cap 8 -> 1487, 16 -> 1603, 32 -> 1551 tok/s/chip (32 pays a
-    # quadratically growing junk-column score dot). 16 is the default;
+    # bf16 KV: hp cap 8 -> 1487, 16 -> 1603, 32 -> 1551 tok/s/chip (32
+    # pays a quadratically growing junk-column score dot); fp8 KV:
+    # 16 -> 1811, 32 -> 1836 (half-size pages tip the balance toward
+    # fewer, larger DMAs). Default 16, 32 for 1-byte caches;
     # INTELLILLM_PAGED_HP overrides for experiments.
-    hp = _largest_divisor(hkv,
-                          int(os.environ.get("INTELLILLM_PAGED_HP", "16")))
+    default_hp = 32 if k_cache.dtype.itemsize == 1 else 16
+    hp = _largest_divisor(
+        hkv, int(os.environ.get("INTELLILLM_PAGED_HP", default_hp)))
 
     # <8 sublanes in the q block: hint a f32 <1x128> layout (a bf16 <8x128>
     # memref would be mis-tiled for tiny G).
