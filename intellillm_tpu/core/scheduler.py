@@ -288,7 +288,7 @@ class Scheduler:
         while self.running:
             seq_group = self.running.popleft()
             while not self.block_manager.can_append_slots(
-                    seq_group, num_steps):
+                    seq_group, self._clamped_steps(seq_group, num_steps)):
                 if self.running:
                     victim = self.running.pop()  # lowest priority
                     self._preempt(victim, blocks_to_swap_out)
@@ -311,7 +311,9 @@ class Scheduler:
             lora_deferred_swap: List[SequenceGroup] = []
             while self.swapped:
                 seq_group = self.swapped[0]
-                if not self.block_manager.can_swap_in(seq_group, num_steps):
+                if not self.block_manager.can_swap_in(
+                        seq_group, self._clamped_steps(seq_group,
+                                                       num_steps)):
                     break
                 lora_id = seq_group.lora_int_id
                 if self._lora_cap_exceeded(curr_loras, lora_id):
@@ -383,14 +385,34 @@ class Scheduler:
         for seq in seq_group.get_seqs(status=SequenceStatus.WAITING):
             seq.status = SequenceStatus.RUNNING
 
+    def _clamped_steps(self, seq_group: SequenceGroup,
+                       num_steps: int) -> int:
+        """K-slot lookahead clamped at max_model_len, conservatively over
+        the group's running/swapped seqs (shortest seq needs the most).
+        Admission checks (can_append_slots / can_swap_in) must use the
+        SAME clamp as the actual reservation, or a near-cap sequence gets
+        preempted for blocks it would never allocate — with a tight pool
+        that preempt/re-prefill cycle never terminates."""
+        mml = self.scheduler_config.max_model_len
+        lens = [seq.get_len() for seq in seq_group.get_seqs()]
+        min_len = min(lens) if lens else mml
+        return max(1, min(num_steps, mml - min_len + 1))
+
     def _append_slots(
         self,
         seq_group: SequenceGroup,
         num_steps: int,
         blocks_to_copy: Dict[int, List[int]],
     ) -> None:
+        mml = self.scheduler_config.max_model_len
         for seq in seq_group.get_seqs(status=SequenceStatus.RUNNING):
-            for src, dst in self.block_manager.append_slots(seq, num_steps):
+            # Clamp the K-slot lookahead at max_model_len: decode positions
+            # past it are never written (the device drops overshoot), and
+            # reserving blocks beyond ceil(max_model_len/block_size) would
+            # overflow the block-table width buckets for prompts near the
+            # cap (len + K - 1 > max_model_len).
+            eff = max(1, min(num_steps, mml - seq.get_len() + 1))
+            for src, dst in self.block_manager.append_slots(seq, eff):
                 blocks_to_copy.setdefault(src, []).append(dst)
 
     def _preempt(

@@ -24,7 +24,14 @@ def use_pallas() -> bool:
     if _FORCE is not None:
         return _FORCE
     from intellillm_tpu.utils import parse_env_flag
-    flag = parse_env_flag(os.environ.get("INTELLILLM_USE_PALLAS"))
+    raw = os.environ.get("INTELLILLM_USE_PALLAS")
+    flag = parse_env_flag(raw)
     if flag is not None:
         return flag
+    if raw is not None and raw.strip():
+        import warnings
+        warnings.warn(
+            f"INTELLILLM_USE_PALLAS={raw!r} not recognized "
+            "(use 0/1/true/false/on/off/yes/no); deferring to the "
+            "backend default")
     return jax.default_backend() == "tpu"
