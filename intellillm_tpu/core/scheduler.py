@@ -394,7 +394,7 @@ class Scheduler:
         preempted for blocks it would never allocate — with a tight pool
         that preempt/re-prefill cycle never terminates."""
         mml = self.scheduler_config.max_model_len
-        lens = [seq.get_len() for seq in seq_group.get_seqs()]
+        lens = [seq.get_len() for seq in seq_group.get_unfinished_seqs()]
         min_len = min(lens) if lens else mml
         return max(1, min(num_steps, mml - min_len + 1))
 

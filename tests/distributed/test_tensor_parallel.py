@@ -64,6 +64,26 @@ def test_dp2_tp4_greedy_matches_single_device(tiny_llama_dir,
 
 
 @requires_8_devices
+def test_tp_chunked_fused_decode_matches_single_device(
+        tiny_llama_dir, example_prompts, single_device_reference,
+        monkeypatch):
+    """Chunked fused decode (K=32 as four C=8 chunk scans + page commits)
+    over a TP=2 mesh must reproduce the single-device tokens — covers
+    the per-chunk pool commit and pool-context advance under GSPMD
+    sharding of the KV pool."""
+    monkeypatch.setenv("INTELLILLM_DECODE_CHUNK", "8")
+    llm = LLM(model=tiny_llama_dir, dtype="float32",
+              tensor_parallel_size=2, num_device_blocks_override=128,
+              max_model_len=128, max_num_seqs=8, max_paddings=512,
+              swap_space=0.01, num_decode_steps=32)
+    params = SamplingParams(temperature=0.0, max_tokens=MAX_TOKENS)
+    outputs = llm.generate(example_prompts, params)
+    got = [o.outputs[0].token_ids for o in outputs]
+    for i, (r, g) in enumerate(zip(single_device_reference, got)):
+        assert r == g, f"prompt {i} tp=2 chunked: ref={r} got={g}"
+
+
+@requires_8_devices
 def test_tp_greedy_matches_hf(tiny_llama_dir, example_prompts, hf_runner):
     """TP=2 run matches HF transformers greedy decode token-for-token."""
     hf = hf_runner(tiny_llama_dir)
