@@ -1,0 +1,71 @@
+"""Prefix caching end-to-end.
+
+Reference pattern: `tests/prefix_caching/test_prefix_caching.py:1-41` —
+generating with `prefix_pos` (shared cached prompt prefix) must produce
+the exact same outputs as generating without it. Exercises the full
+chain: `prefix.py` pool → scheduler/block-manager prefix block sharing →
+model-runner prefix-prefill (context attention over cached prefix ++
+new tokens) → computed-flag flip after the first run.
+"""
+import pytest
+
+from intellillm_tpu import LLM, SamplingParams
+
+PREFIX = ("you are a helpful assistant and the user would like to know "
+          "about the city of paris in france where the")
+QUERIES = [
+    "capital is big",
+    "river runs fast and the water is blue",
+    "people make red wine",
+]
+MAX_TOKENS = 12
+
+
+@pytest.fixture(scope="module")
+def prefix_llm(tiny_llama_dir):
+    return LLM(model=tiny_llama_dir, dtype="float32",
+               num_device_blocks_override=192, max_model_len=128,
+               max_num_seqs=8, max_paddings=512, swap_space=0.01,
+               num_decode_steps=8)
+
+
+def test_prefix_pos_matches_plain_generation(prefix_llm):
+    prompts = [PREFIX + " " + q for q in QUERIES]
+    params = SamplingParams(temperature=0.0, max_tokens=MAX_TOKENS)
+
+    plain = prefix_llm.generate(prompts, params)
+    plain_tokens = [o.outputs[0].token_ids for o in plain]
+
+    # Token-align the prefix split the way the reference test does: use
+    # the tokenized prefix length as prefix_pos for every prompt.
+    tok = prefix_llm.llm_engine.tokenizer.encode(PREFIX)
+    prefix_pos = len(tok)
+
+    # First pass computes the prefix KV; a second pass must HIT the
+    # computed prefix. Both must equal the plain run exactly.
+    for _ in range(2):
+        cached = prefix_llm.generate(prompts, params,
+                                     prefix_pos=prefix_pos)
+        cached_tokens = [o.outputs[0].token_ids for o in cached]
+        assert cached_tokens == plain_tokens
+
+    # The pool actually cached and marked the prefix computed.
+    pool = prefix_llm.llm_engine.scheduler.prefix_pool
+    assert len(pool.prefixes) >= 1
+    assert any(p.computed for p in pool.prefixes.values())
+
+
+def test_prefix_pos_mixed_batch(prefix_llm):
+    """Prefix-bearing and plain requests in ONE batch must both match
+    their individually generated outputs."""
+    params = SamplingParams(temperature=0.0, max_tokens=MAX_TOKENS)
+    prompts = [PREFIX + " " + QUERIES[0], "the cat runs fast and the dog"]
+
+    solo = [prefix_llm.generate([p], params)[0].outputs[0].token_ids
+            for p in prompts]
+
+    tok = prefix_llm.llm_engine.tokenizer.encode(PREFIX)
+    mixed = prefix_llm.generate(prompts, params,
+                                prefix_pos=[len(tok), None])
+    mixed_tokens = [o.outputs[0].token_ids for o in mixed]
+    assert mixed_tokens == solo
