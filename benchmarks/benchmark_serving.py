@@ -10,17 +10,20 @@ percentiles, request/token throughput). Start the server first, e.g.:
 
     python -m intellillm_tpu.entrypoints.api_server --model ... &
     python benchmarks/benchmark_serving.py --backend generate ...
+
+(or use `benchmarks/serve_bench.py`, which boots the server and sweeps
+request rates in one command).
 """
 from __future__ import annotations
 
 import argparse
 import asyncio
-import json
 import os
 import random
 import sys
 import time
-from typing import List, Optional, Tuple
+from dataclasses import dataclass
+from typing import List, Optional
 
 import aiohttp
 import numpy as np
@@ -28,11 +31,17 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from benchmarks.common import percentiles, sample_requests  # noqa: E402
 
-# (prompt, prompt_len, output_len) → (e2e_latency, ttft, n_chunks)
-REQUEST_LATENCIES: List[Tuple[int, int, float, float, int]] = []
+
+@dataclass
+class RequestResult:
+    prompt_len: int
+    output_len: int
+    latency: float   # e2e seconds
+    ttft: float      # time to first streamed chunk, seconds
+    n_chunks: int    # streamed SSE chunks received
 
 
-async def get_request(requests, request_rate: float):
+async def _paced_requests(requests, request_rate: float):
     for req in requests:
         yield req
         if request_rate == float("inf"):
@@ -42,27 +51,18 @@ async def get_request(requests, request_rate: float):
 
 async def send_request(session: aiohttp.ClientSession, backend: str,
                        api_url: str, model: str, prompt: str,
-                       prompt_len: int, output_len: int,
-                       best_of: int) -> None:
+                       prompt_len: int, output_len: int, best_of: int,
+                       results: List[RequestResult]) -> None:
+    payload = {
+        "prompt": prompt,
+        "max_tokens": output_len,
+        "temperature": 0.0 if best_of > 1 else 1.0,
+        "best_of": best_of,
+        "ignore_eos": True,
+        "stream": True,
+    }
     if backend == "openai":
-        payload = {
-            "model": model,
-            "prompt": prompt,
-            "max_tokens": output_len,
-            "temperature": 0.0 if best_of > 1 else 1.0,
-            "best_of": best_of,
-            "ignore_eos": True,
-            "stream": True,
-        }
-    else:  # simple /generate server
-        payload = {
-            "prompt": prompt,
-            "max_tokens": output_len,
-            "temperature": 0.0 if best_of > 1 else 1.0,
-            "best_of": best_of,
-            "ignore_eos": True,
-            "stream": True,
-        }
+        payload["model"] = model
     start = time.perf_counter()
     ttft = None
     n_chunks = 0
@@ -75,27 +75,70 @@ async def send_request(session: aiohttp.ClientSession, backend: str,
                 ttft = time.perf_counter() - start
             n_chunks += 1
     latency = time.perf_counter() - start
-    REQUEST_LATENCIES.append((prompt_len, output_len, latency, ttft or
-                              latency, n_chunks))
+    results.append(RequestResult(prompt_len, output_len, latency,
+                                 ttft if ttft is not None else latency,
+                                 n_chunks))
 
 
-async def benchmark(args, requests) -> float:
-    api_url = (f"http://{args.host}:{args.port}/v1/completions"
-               if args.backend == "openai" else
-               f"http://{args.host}:{args.port}/generate")
+async def run_benchmark(backend: str, api_url: str, model: str, requests,
+                        request_rate: float, best_of: int = 1):
+    """Drive one pass over `requests`; returns (elapsed_s, results)."""
+    results: List[RequestResult] = []
     conn = aiohttp.TCPConnector(limit=0)
     timeout = aiohttp.ClientTimeout(total=6 * 3600)
     start = time.perf_counter()
     async with aiohttp.ClientSession(connector=conn,
                                      timeout=timeout) as session:
         tasks = []
-        async for prompt, prompt_len, output_len in get_request(
-                requests, args.request_rate):
+        async for prompt, prompt_len, output_len in _paced_requests(
+                requests, request_rate):
             tasks.append(asyncio.create_task(
-                send_request(session, args.backend, api_url, args.model,
-                             prompt, prompt_len, output_len, args.best_of)))
+                send_request(session, backend, api_url, model, prompt,
+                             prompt_len, output_len, best_of, results)))
         await asyncio.gather(*tasks)
-    return time.perf_counter() - start
+    return time.perf_counter() - start, results
+
+
+def compute_metrics(results: List[RequestResult], elapsed: float) -> dict:
+    lat = [r.latency for r in results]
+    ttft = [r.ttft for r in results]
+    # True inter-token pace: decode time spread over the tokens streamed
+    # after the first chunk. With fused multi-step decode tokens arrive in
+    # chunks of up to K, so this is the *average* pace a client observes,
+    # not a per-chunk gap.
+    tpot = [(r.latency - r.ttft) / max(r.output_len - 1, 1)
+            for r in results]
+    total_output = sum(r.output_len for r in results)
+    return {
+        "completed": len(results),
+        "elapsed_s": round(elapsed, 2),
+        "request_throughput_rps": round(len(results) / elapsed, 3),
+        "output_tok_s": round(total_output / elapsed, 1),
+        "latency_mean_s": round(float(np.mean(lat)), 3) if lat else None,
+        "latency_percentiles_s": {k: round(v, 3)
+                                  for k, v in percentiles(lat).items()},
+        "ttft_mean_ms": round(float(np.mean(ttft)) * 1e3, 1) if ttft
+        else None,
+        "ttft_percentiles_ms": {k: round(v * 1e3, 1)
+                                for k, v in percentiles(ttft).items()},
+        "tpot_mean_ms": round(float(np.mean(tpot)) * 1e3, 2) if tpot
+        else None,
+        "tpot_percentiles_ms": {k: round(v * 1e3, 2)
+                                for k, v in percentiles(tpot).items()},
+    }
+
+
+def build_requests(args, tokenizer):
+    raw = sample_requests(args.dataset, args.num_prompts, tokenizer,
+                          args.input_len, args.output_len, len(tokenizer),
+                          args.seed)
+    requests = []
+    for prompt_ids, output_len in raw:
+        prompt = tokenizer.decode(prompt_ids, skip_special_tokens=True)
+        # Re-encode: decode() can merge/split around special tokens, and
+        # the server budgets by *its* token count.
+        requests.append((prompt, len(tokenizer.encode(prompt)), output_len))
+    return requests
 
 
 def main(args):
@@ -104,38 +147,35 @@ def main(args):
 
     from transformers import AutoTokenizer
     tokenizer = AutoTokenizer.from_pretrained(args.tokenizer or args.model)
+    requests = build_requests(args, tokenizer)
 
-    raw = sample_requests(args.dataset, args.num_prompts, tokenizer,
-                          args.input_len, args.output_len, len(tokenizer),
-                          args.seed)
-    requests = []
-    for prompt_ids, output_len in raw:
-        prompt = tokenizer.decode(prompt_ids, skip_special_tokens=True)
-        requests.append((prompt, len(prompt_ids), output_len))
+    api_url = (f"http://{args.host}:{args.port}/v1/completions"
+               if args.backend == "openai" else
+               f"http://{args.host}:{args.port}/generate")
+    elapsed, results = asyncio.run(run_benchmark(
+        args.backend, api_url, args.model, requests, args.request_rate,
+        args.best_of))
+    m = compute_metrics(results, elapsed)
 
-    elapsed = asyncio.run(benchmark(args, requests))
-
-    total_output = sum(o for _, _, o in requests)
-    lat = [r[2] for r in REQUEST_LATENCIES]
-    ttft = [r[3] for r in REQUEST_LATENCIES]
-    per_tok = [r[2] / max(r[1], 1) for r in REQUEST_LATENCIES]
-
-    print(f"Completed {len(REQUEST_LATENCIES)}/{len(requests)} requests "
-          f"in {elapsed:.2f} s")
-    print(f"Request throughput: {len(REQUEST_LATENCIES) / elapsed:.2f} "
+    print(f"Completed {m['completed']}/{len(requests)} requests "
+          f"in {m['elapsed_s']:.2f} s")
+    print(f"Request throughput: {m['request_throughput_rps']:.2f} "
           "requests/s")
-    print(f"Output token throughput: {total_output / elapsed:.1f} tok/s")
-    print(f"Mean latency: {np.mean(lat):.3f} s  "
-          + "  ".join(f"{k}={v:.3f}s"
-                      for k, v in percentiles(lat).items()))
-    print(f"Mean TTFT: {np.mean(ttft) * 1e3:.1f} ms  "
-          + "  ".join(f"{k}={v * 1e3:.1f}ms"
-                      for k, v in percentiles(ttft).items()))
-    print(f"Mean per-output-token latency: "
-          f"{np.mean(per_tok) * 1e3:.1f} ms/tok")
+    print(f"Output token throughput: {m['output_tok_s']:.1f} tok/s")
+    if m["completed"]:
+        print(f"Mean latency: {m['latency_mean_s']:.3f} s  "
+              + "  ".join(f"{k}={v:.3f}s"
+                          for k, v in m["latency_percentiles_s"].items()))
+        print(f"Mean TTFT: {m['ttft_mean_ms']:.1f} ms  "
+              + "  ".join(f"{k}={v:.1f}ms"
+                          for k, v in m["ttft_percentiles_ms"].items()))
+        print(f"Mean TPOT: {m['tpot_mean_ms']:.2f} ms/tok  "
+              + "  ".join(f"{k}={v:.2f}ms"
+                          for k, v in m["tpot_percentiles_ms"].items()))
+    return m
 
 
-if __name__ == "__main__":
+def make_arg_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         description="Benchmark online serving throughput/latency.")
     parser.add_argument("--backend", type=str, default="openai",
@@ -155,4 +195,8 @@ if __name__ == "__main__":
                         help="requests/s Poisson rate; inf = send all at "
                         "once")
     parser.add_argument("--seed", type=int, default=0)
-    main(parser.parse_args())
+    return parser
+
+
+if __name__ == "__main__":
+    main(make_arg_parser().parse_args())

@@ -90,6 +90,35 @@ def build_llm(args):
     return llm
 
 
+def save_dummy_checkpoint(model_spec: str, out_dir: str,
+                          tokenizer_vocab: Optional[int] = None) -> str:
+    """Materialize a `dummy:SIZE` spec as an on-disk checkpoint dir the
+    servers can boot with `--load-format dummy`: the Llama config.json
+    plus a self-contained word-level tokenizer (no hub access; decode →
+    encode roundtrips exactly, so client- and server-side token counts
+    agree in `benchmark_serving.py`)."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from transformers import PreTrainedTokenizerFast
+
+    cfg = dummy_hf_config(model_spec)
+    cfg.save_pretrained(out_dir)
+    # Cover the full model vocab so detokenizing dummy-weight samples
+    # (uniform over vocab_size ids) never hits an out-of-range token.
+    if tokenizer_vocab is None:
+        tokenizer_vocab = cfg.vocab_size
+    vocab = {"<pad>": 0, "</s>": 1, "<unk>": 2}
+    for i in range(tokenizer_vocab - len(vocab)):
+        vocab[f"w{i:05d}"] = len(vocab)
+    tok = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    PreTrainedTokenizerFast(
+        tokenizer_object=tok, pad_token="<pad>", eos_token="</s>",
+        unk_token="<unk>").save_pretrained(out_dir)
+    return out_dir
+
+
 def sample_requests(
     dataset_path: Optional[str],
     num_prompts: int,

@@ -1,8 +1,11 @@
-"""Logical and physical KV-cache block handles.
+"""Physical KV-cache block handles.
 
-Role parity: reference `vllm/block.py` (LogicalTokenBlock :9,
-PhysicalTokenBlock :43). Physical blocks index into the preallocated HBM
-pool arrays owned by the CacheEngine; the host-side bookkeeping here is
+Role parity: reference `vllm/block.py` (PhysicalTokenBlock :43; the
+reference's LogicalTokenBlock :9 has no equivalent here — a sequence's
+logical block count is derived arithmetically from its token count in
+`sequence.Sequence.num_logical_blocks`, so no per-block host objects are
+materialized). Physical blocks index into the preallocated HBM pool
+arrays owned by the CacheEngine; the host-side bookkeeping here is
 device-agnostic.
 """
 from __future__ import annotations
@@ -10,41 +13,6 @@ from __future__ import annotations
 from typing import List
 
 from intellillm_tpu.utils import Device
-
-_BLANK_TOKEN_ID = -1
-
-
-class LogicalTokenBlock:
-    """A block-sized span of a sequence's token ids (host bookkeeping)."""
-
-    __slots__ = ("block_number", "block_size", "token_ids", "num_tokens")
-
-    def __init__(self, block_number: int, block_size: int) -> None:
-        self.block_number = block_number
-        self.block_size = block_size
-        self.token_ids: List[int] = [_BLANK_TOKEN_ID] * block_size
-        self.num_tokens = 0
-
-    def is_empty(self) -> bool:
-        return self.num_tokens == 0
-
-    def get_num_empty_slots(self) -> int:
-        return self.block_size - self.num_tokens
-
-    def is_full(self) -> bool:
-        return self.num_tokens == self.block_size
-
-    def append_tokens(self, token_ids: List[int]) -> None:
-        assert len(token_ids) <= self.get_num_empty_slots()
-        self.token_ids[self.num_tokens:self.num_tokens + len(token_ids)] = token_ids
-        self.num_tokens += len(token_ids)
-
-    def get_token_ids(self) -> List[int]:
-        return self.token_ids[:self.num_tokens]
-
-    def get_last_token_id(self) -> int:
-        assert self.num_tokens > 0
-        return self.token_ids[self.num_tokens - 1]
 
 
 class PhysicalTokenBlock:

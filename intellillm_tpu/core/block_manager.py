@@ -91,7 +91,7 @@ class BlockSpaceManager:
     def can_allocate(self, seq_group: SequenceGroup) -> AllocStatus:
         # All WAITING seqs in a group share the prompt, hence one table.
         seq = seq_group.get_seqs(status=SequenceStatus.WAITING)[0]
-        num_required = len(seq.logical_token_blocks)
+        num_required = seq.num_logical_blocks()
 
         if seq_group.prefix is not None and seq_group.prefix.allocated:
             num_required -= seq_group.prefix.get_num_blocks()
@@ -108,7 +108,7 @@ class BlockSpaceManager:
 
     def allocate(self, seq_group: SequenceGroup) -> None:
         seq = seq_group.get_seqs(status=SequenceStatus.WAITING)[0]
-        num_prompt_blocks = len(seq.logical_token_blocks)
+        num_prompt_blocks = seq.num_logical_blocks()
 
         block_table: BlockTable = []
         prefix_block_table: BlockTable = []

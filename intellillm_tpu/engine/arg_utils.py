@@ -40,6 +40,7 @@ class EngineArgs:
     num_decode_steps: int = 8
     # Model
     dtype: str = "auto"
+    load_format: str = "auto"
     revision: Optional[str] = None
     quantization: Optional[str] = None
     enforce_eager: bool = False
@@ -93,6 +94,10 @@ class EngineArgs:
                             help="decode iterations fused per device call")
         parser.add_argument("--dtype", type=str, default="auto",
                             choices=["auto", "bfloat16", "float32", "float16"])
+        parser.add_argument("--load-format", type=str, default="auto",
+                            choices=["auto", "safetensors", "pt", "dummy"],
+                            help="dummy = random weights (bench/profiling "
+                            "without a checkpoint)")
         parser.add_argument("--revision", type=str, default=None)
         parser.add_argument("--quantization", "-q", type=str, default=None)
         parser.add_argument("--enforce-eager", action="store_true")
@@ -117,6 +122,7 @@ class EngineArgs:
             tokenizer_mode=self.tokenizer_mode,
             trust_remote_code=self.trust_remote_code,
             dtype=self.dtype,
+            load_format=self.load_format,
             seed=self.seed,
             revision=self.revision,
             max_model_len=self.max_model_len,

@@ -130,7 +130,7 @@ class SamplingTensors:
             output_tokens = np.full((padded_n, lo), vocab_size, np.int32)
             for i, (prompt_ids, output_ids) in enumerate(row_token_ids):
                 prompt_tokens[i, :len(prompt_ids)] = prompt_ids
-                if output_ids:
+                if len(output_ids):
                     output_tokens[i, :len(output_ids)] = output_ids
 
         logprob_k = LOGPROB_K_BUCKETS[-1]

@@ -616,7 +616,7 @@ class ModelRunner:
             data = meta.seq_data[seq_id]
             row_params.append(meta.sampling_params)
             row_seeds.append(self._row_seed(seq_id, data.get_output_len()))
-            row_tokens.append((data.prompt_token_ids, data.output_token_ids))
+            row_tokens.append(data.token_views())
 
         st = SamplingTensors.build(row_params, row_seeds, row_tokens,
                                    self.vocab_size, padded_n)

@@ -30,3 +30,26 @@ def test_benchmark_throughput_smoke():
               "--no-tqdm"])
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "Throughput:" in r.stdout
+
+
+def test_benchmark_serving_smoke():
+    """serve_bench boots the OpenAI server (subprocess, dummy tiny model)
+    and drives benchmark_serving's Poisson load generator through real
+    HTTP — the whole north-star measurement path, minus the chip."""
+    import json
+    r = _run(["benchmarks/serve_bench.py", "--size", "tiny",
+              "--num-prompts", "4", "--rates", "inf", "--input-len", "8",
+              "--output-len", "8", "--max-model-len", "64",
+              "--max-num-seqs", "4", "--num-decode-steps", "4",
+              "--num-device-blocks", "64", "--port", "8733",
+              "--init-timeout", "240"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    summary = None
+    for line in r.stdout.splitlines():
+        if line.startswith('{"serve_bench_summary"'):
+            summary = json.loads(line)["serve_bench_summary"]
+    assert summary is not None, r.stdout[-2000:]
+    (m,) = summary["results"]
+    assert m["completed"] == 4
+    assert m["output_tok_s"] > 0
+    assert m["ttft_percentiles_ms"]["p50"] > 0
