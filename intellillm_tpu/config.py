@@ -84,8 +84,9 @@ class ModelConfig:
 
     _SUPPORTED_QUANT = ("awq", "gptq", "squeezellm", "int8")
     # Methods with a working TPU checkpoint loader (weight_utils.load_linear):
-    # AWQ converts losslessly to the device int4 representation; GPTQ and
-    # SqueezeLLM dequantize-on-load to per-channel int8.
+    # AWQ and GPTQ convert losslessly to the device int4 representation
+    # (GPTQ act-order via an input-row permutation); SqueezeLLM's
+    # non-uniform LUT dequantizes-on-load to per-channel int8 (logged).
     _LOADABLE_QUANT = ("int8", "awq", "gptq", "squeezellm")
 
     def _verify_quantization(self) -> None:

@@ -12,12 +12,14 @@ awq.py:12 / GPTQ gptq.py / SqueezeLLM squeezellm.py + CUDA kernels under
   checkpoints store, so their tensors convert losslessly (no re-rounding)
   at load; dequant happens inside the matmul's operand fusion.
 
-Checkpoint converters (`awq_unpack` / `gptq_unpack` /
+Checkpoint converters (`awq_unpack` / `gptq_to_int4` /
 `squeezellm_dequantize`) replace the reference's CUDA dequant kernels
 (`csrc/quantization/awq/gemm_kernels.cu`, `gptq/q_gemm.cu`,
-`squeezellm/quant_cuda_kernel.cu`): AWQ loads to int4 exactly; GPTQ
-dequantizes then requantizes to int8 (uniform handling of act-order
-g_idx); SqueezeLLM's non-uniform LUT dequantizes to int8.
+`squeezellm/quant_cuda_kernel.cu`): AWQ and GPTQ load to int4 exactly
+(GPTQ act-order becomes an input-row permutation — the exllama
+`gptq_shuffle` role — never a re-rounding); SqueezeLLM's non-uniform LUT
+cannot map onto an affine int4 grid, so it dequantizes and requantizes to
+int8 (documented, logged loudly at load).
 """
 from __future__ import annotations
 
@@ -113,6 +115,22 @@ def qmatmul(x: jnp.ndarray, w: Union[jnp.ndarray, QuantizedWeight]
     if not is_quantized(w):
         return x @ w
     if "q4" in w:
+        from intellillm_tpu.ops.dispatch import use_pallas
+        from intellillm_tpu.ops.pallas import quant_matmul as _qmm
+        rows = int(np.prod(x.shape[:-1]))
+        if use_pallas() and _qmm.supports(w) and rows >= 32:
+            # Pallas kernel: packed bytes stream HBM→VMEM, dequant feeds
+            # the MXU in-tile. It also reserves ZERO temp HBM, where the
+            # XLA path's buffer plan reserves ~6x the packed bytes
+            # (measured 541 MB for 4096x11008). Below ~32 rows XLA's own
+            # operand fusion is dequant-bound-free and faster (29us vs
+            # 132us at b=8 on v5e), so small decode batches stay on it.
+            return _qmm.quant_matmul_int4(x, w)
+        if "perm" in w:
+            # Act-order (GPTQ g_idx): weight rows were pre-sorted by group
+            # at load; mirror the same reorder on the activation's
+            # contraction dim.
+            x = jnp.take(x, w["perm"], axis=-1)
         return x @ _dequant_int4(w, x.dtype)
     out = jax.lax.dot_general(
         x, w["q"],
@@ -162,6 +180,43 @@ def awq_to_int4(qweight, qzeros, scales) -> QuantizedWeight:
     """Lossless AWQ → device int4 (same affine scheme)."""
     q, z, s = awq_unpack(qweight, qzeros, scales)
     return pack_int4(q, z, s)
+
+
+def gptq_to_int4(qweight: np.ndarray, qzeros: np.ndarray,
+                 scales: np.ndarray,
+                 g_idx: np.ndarray = None) -> Union[QuantizedWeight, None]:
+    """Lossless GPTQ → device int4: GPTQ stores the same group-wise 4-bit
+    affine scheme as AWQ, only packed differently, so no value is ever
+    re-rounded. Act-order checkpoints (non-trivial `g_idx`) get their
+    input rows stably sorted by group so each group is contiguous, plus a
+    "perm" entry that `qmatmul` applies to the activation — the role of
+    the reference's exllama shuffle (`gptq.py:208-209`,
+    `csrc/quantization/gptq/q_gemm.cu`) without changing any weight
+    value. Returns None when the group structure is irregular (e.g. a
+    group with more/fewer rows than group_size); the caller then falls
+    back to int8 requantization.
+    """
+    q = _unpack_int32_nibbles_rows(qweight)              # [in, out]
+    in_ = q.shape[0]
+    z = (_unpack_int32_nibbles(qzeros) + 1).astype(np.float32)  # [g, out]
+    s = np.asarray(scales, np.float32)                   # [g, out]
+    g = s.shape[0]
+    if g == 0 or in_ % g != 0 or in_ % 2 != 0:
+        return None
+    group = in_ // g
+    perm = None
+    if g_idx is not None and len(g_idx):
+        g_idx = np.asarray(g_idx, np.int64)
+        if not np.array_equal(g_idx, np.arange(in_) // group):
+            counts = np.bincount(g_idx, minlength=g)
+            if counts.shape[0] != g or not np.all(counts == group):
+                return None
+            perm = np.argsort(g_idx, kind="stable").astype(np.int32)
+            q = q[perm]
+    w = pack_int4(q, z, s)
+    if perm is not None:
+        w["perm"] = perm
+    return w
 
 
 def gptq_dequantize(qweight: np.ndarray, qzeros: np.ndarray,

@@ -110,6 +110,7 @@ def load_linear(raw, prefix: str, dtype: str, quantization=None,
     """
     from intellillm_tpu.layers.quantization import (awq_to_int4,
                                                     gptq_dequantize,
+                                                    gptq_to_int4,
                                                     quantize_int4,
                                                     quantize_int8,
                                                     squeezellm_dequantize)
@@ -146,14 +147,37 @@ def load_linear(raw, prefix: str, dtype: str, quantization=None,
                            raw[prefix + ".qzeros"],
                            raw[prefix + ".scales"])
     if quantization == "gptq":
+        if fp_ok:
+            w = gptq_dequantize(raw[prefix + ".qweight"],
+                                raw[prefix + ".qzeros"],
+                                raw[prefix + ".scales"],
+                                raw.get(prefix + ".g_idx"))
+            return cast_array(w, dtype)
+        qw = gptq_to_int4(raw[prefix + ".qweight"],
+                          raw[prefix + ".qzeros"],
+                          raw[prefix + ".scales"],
+                          raw.get(prefix + ".g_idx"))
+        if qw is not None:
+            return qw
+        logger.warning(
+            "GPTQ tensor %s has an irregular group layout; falling back "
+            "to int8 requantization (lossy vs the checkpoint).", prefix)
         w = gptq_dequantize(raw[prefix + ".qweight"],
                             raw[prefix + ".qzeros"],
                             raw[prefix + ".scales"],
                             raw.get(prefix + ".g_idx"))
-        return cast_array(w, dtype) if fp_ok else quantize_int8(w)
+        return quantize_int8(w)
     if quantization == "squeezellm":
         w = squeezellm_dequantize(raw[prefix + ".qweight"],
                                   raw[prefix + ".lookup_table"])
+        if not fp_ok:
+            # The non-uniform per-channel codebook has no lossless affine
+            # int4 mapping — say so every time rather than silently
+            # changing numerics for migrating checkpoints.
+            logger.warning(
+                "SqueezeLLM tensor %s: non-uniform LUT requantized to "
+                "per-channel int8 (approximate; reference executes the "
+                "LUT exactly via squeezellm_gemm).", prefix)
         return cast_array(w, dtype) if fp_ok else quantize_int8(w)
     raise ValueError(
         f"{prefix!r} is stored quantized but quantization={quantization!r}")

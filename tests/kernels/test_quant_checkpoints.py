@@ -108,6 +108,45 @@ def test_gptq_act_order():
     np.testing.assert_allclose(w, ref, rtol=1e-6)
 
 
+@pytest.mark.parametrize("scramble", [False, True])
+def test_gptq_to_int4_lossless(scramble):
+    """gptq_to_int4 + qmatmul must reproduce the exact dequant math,
+    including act-order checkpoints (activation permutation)."""
+    from intellillm_tpu.layers.quantization import gptq_to_int4
+
+    rng = np.random.default_rng(6)
+    in_, out, group = 32, 16, 8
+    q, z, s = _rand_gptq(rng, in_, out, group)
+    g_idx = np.arange(in_) // group
+    if scramble:
+        g_idx = g_idx[rng.permutation(in_)]
+    qweight = gptq_pack_rows(q)
+    qzeros = gptq_pack_cols((z - 1).astype(np.uint8))
+    packed = gptq_to_int4(qweight, qzeros, s, g_idx)
+    assert packed is not None
+    assert ("perm" in packed) == scramble
+    packed = {k: jnp.asarray(v) for k, v in packed.items()}
+    wf = (q.astype(np.float32) - z[g_idx]) * s[g_idx]     # exact dequant
+    x = rng.standard_normal((3, in_)).astype(np.float32)
+    ref = x @ wf
+    got = np.asarray(qmatmul(jnp.asarray(x), packed))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gptq_to_int4_irregular_groups_rejected():
+    """Unbalanced g_idx (a group with the wrong row count) must return
+    None so the loader falls back to int8 requantization."""
+    from intellillm_tpu.layers.quantization import gptq_to_int4
+
+    rng = np.random.default_rng(7)
+    in_, out, group = 32, 16, 8
+    q, z, s = _rand_gptq(rng, in_, out, group)
+    g_idx = np.zeros(in_, np.int32)        # everything in group 0
+    assert gptq_to_int4(gptq_pack_rows(q),
+                        gptq_pack_cols((z - 1).astype(np.uint8)),
+                        s, g_idx) is None
+
+
 def test_squeezellm_dequantize():
     rng = np.random.default_rng(3)
     in_, out = 16, 8
@@ -255,15 +294,13 @@ def test_awq_checkpoint_matches_dequant_twin(tiny_llama_dir, tmp_path,
         assert g[0] == o[0]
 
 
-def test_gptq_checkpoint_matches_int8_twin(tiny_llama_dir, tmp_path,
-                                           example_prompts):
-    """GPTQ loads → dequant → int8; twin = fp dequant checkpoint served
-    with quantization='int8' (identical device representation)."""
+def _gptqify_checkpoint(base_dir, tmp_path, group=16, act_order=False):
+    """Convert a tiny fp llama checkpoint into (gptq_dir, fp_twin_dir);
+    act_order scrambles each weight's g_idx (balanced groups)."""
     import safetensors.numpy
     from transformers import AutoModelForCausalLM, AutoTokenizer
 
-    group = 16
-    model = AutoModelForCausalLM.from_pretrained(tiny_llama_dir,
+    model = AutoModelForCausalLM.from_pretrained(base_dir,
                                                  torch_dtype=torch.float32)
     sd = {k: v.numpy() for k, v in model.state_dict().items()}
     targets = [k for k in sd
@@ -275,21 +312,30 @@ def test_gptq_checkpoint_matches_int8_twin(tiny_llama_dir, tmp_path,
         w = sd[name].T.astype(np.float32)
         in_, out = w.shape
         g = in_ // group
-        wg = w.reshape(g, group, out)
+        g_idx = (np.arange(in_) // group).astype(np.int32)
+        if act_order:
+            # A row-permuted (still balanced) group assignment: what a
+            # desc_act checkpoint looks like after GPTQ reorders columns
+            # by activation magnitude.
+            g_idx = g_idx[rng.permutation(in_)]
+        wg = np.stack([w[g_idx == j] for j in range(g)])   # [g, group, out]
         wmin, wmax = wg.min(1), wg.max(1)
         s = np.maximum((wmax - wmin) / 15.0, 1e-8).astype(np.float32)
         z = np.round(-wmin / s).clip(1, 15).astype(np.uint8)  # z-1 >= 0
-        q = np.clip(np.round(wg / s[:, None] + z[:, None]), 0,
-                    15).astype(np.uint8).reshape(in_, out)
-        deq = ((q.astype(np.float32).reshape(g, group, out) -
-                z[:, None]) * s[:, None]).reshape(in_, out)
+        q = np.zeros((in_, out), np.uint8)
+        deq = np.zeros((in_, out), np.float32)
+        for j in range(g):
+            rows = np.flatnonzero(g_idx == j)
+            qj = np.clip(np.round(w[rows] / s[j] + z[j]), 0,
+                         15).astype(np.uint8)
+            q[rows] = qj
+            deq[rows] = (qj.astype(np.float32) - z[j]) * s[j]
         prefix = name[:-len(".weight")]
         tensors[prefix + ".qweight"] = gptq_pack_rows(q)
         tensors[prefix + ".qzeros"] = gptq_pack_cols(
             (z.astype(np.int32) - 1).astype(np.uint8))
         tensors[prefix + ".scales"] = s
-        tensors[prefix + ".g_idx"] = (np.arange(in_) // group).astype(
-            np.int32)
+        tensors[prefix + ".g_idx"] = g_idx
         twin_sd[name] = deq.T.astype(np.float32)
 
     gptq_dir = str(tmp_path / "gptq")
@@ -297,20 +343,71 @@ def test_gptq_checkpoint_matches_int8_twin(tiny_llama_dir, tmp_path,
     safetensors.numpy.save_file(
         {k: np.ascontiguousarray(v) for k, v in tensors.items()},
         os.path.join(gptq_dir, "model.safetensors"))
-    with open(os.path.join(tiny_llama_dir, "config.json")) as f:
+    with open(os.path.join(base_dir, "config.json")) as f:
         cfg = json.load(f)
     cfg["quantization_config"] = {"quant_method": "gptq", "bits": 4,
-                                  "group_size": group}
+                                  "group_size": group,
+                                  "desc_act": act_order}
     with open(os.path.join(gptq_dir, "config.json"), "w") as f:
         json.dump(cfg, f)
-    AutoTokenizer.from_pretrained(tiny_llama_dir).save_pretrained(gptq_dir)
+    AutoTokenizer.from_pretrained(base_dir).save_pretrained(gptq_dir)
 
     twin_dir = str(tmp_path / "twin")
     model.load_state_dict({k: torch.from_numpy(np.ascontiguousarray(v))
                            for k, v in twin_sd.items()})
     model.save_pretrained(twin_dir, safe_serialization=True)
-    AutoTokenizer.from_pretrained(tiny_llama_dir).save_pretrained(twin_dir)
+    AutoTokenizer.from_pretrained(base_dir).save_pretrained(twin_dir)
+    return gptq_dir, twin_dir
 
-    golden = _greedy(twin_dir, example_prompts, quantization="int8")
+
+def _assert_int4_tree_matches_fp(params_q, params_fp):
+    """Every int4 leaf must dequantize BIT-EXACTLY to the fp twin's
+    value (undoing the act-order row sort where present)."""
+    from intellillm_tpu.layers.quantization import _dequant_int4
+
+    def compare(a, t):
+        if isinstance(a, dict) and "q4" in a:
+            deq = np.asarray(_dequant_int4(
+                {k: jnp.asarray(v) for k, v in a.items()
+                 if k != "perm"}, jnp.float32))
+            if "perm" in a:
+                inv = np.empty_like(np.asarray(a["perm"]))
+                inv[np.asarray(a["perm"])] = np.arange(len(inv))
+                deq = deq[inv]
+            np.testing.assert_array_equal(deq, np.asarray(t))
+        elif isinstance(a, dict):
+            for k in a:
+                compare(a[k], t[k])
+        elif isinstance(a, list):
+            for x, y in zip(a, t):
+                compare(x, y)
+        elif a is not None:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(t))
+
+    compare(params_q, params_fp)
+
+
+@pytest.mark.parametrize("act_order", [False, True])
+def test_gptq_checkpoint_lossless(tiny_llama_dir, tmp_path,
+                                  example_prompts, act_order):
+    """GPTQ now loads LOSSLESSLY to the int4 device format (reference
+    executes GPTQ exactly via gptq.py:114-212 + q_gemm.cu; here the same
+    4-bit affine values reach the device unchanged, act-order handled by
+    an input permutation). Weights must dequantize bit-exactly to the fp
+    twin and first greedy tokens must agree (full-sequence equality is
+    not asserted for the same fp32-accumulation-order reason as AWQ)."""
+    from intellillm_tpu.config import ModelConfig
+    from intellillm_tpu.models.model_loader import get_model
+
+    gptq_dir, twin_dir = _gptqify_checkpoint(tiny_llama_dir, tmp_path,
+                                             act_order=act_order)
+    mc = ModelConfig(model=gptq_dir, dtype="float32")
+    assert mc.quantization == "gptq"
+    _, params_q = get_model(mc)
+    _, params_fp = get_model(ModelConfig(model=twin_dir, dtype="float32"))
+    _assert_int4_tree_matches_fp(params_q, params_fp)
+
+    golden = _greedy(twin_dir, example_prompts)
     ours = _greedy(gptq_dir, example_prompts)
-    assert ours == golden
+    for gold, o in zip(golden, ours):
+        assert gold[0] == o[0]
