@@ -56,7 +56,9 @@ async def send_request(session: aiohttp.ClientSession, backend: str,
     payload = {
         "prompt": prompt,
         "max_tokens": output_len,
-        "temperature": 0.0 if best_of > 1 else 1.0,
+        # best_of > 1 requires sampling (greedy rejects best_of > 1);
+        # single-candidate runs measure the deterministic greedy path.
+        "temperature": 1.0 if best_of > 1 else 0.0,
         "best_of": best_of,
         "ignore_eos": True,
         "stream": True,

@@ -81,7 +81,10 @@ def main(args) -> dict:
     from transformers import AutoTokenizer
 
     model_dir = args.model_dir or tempfile.mkdtemp(prefix="serve-bench-")
-    save_dummy_checkpoint(f"dummy:{args.size}", model_dir)
+    if not os.path.exists(os.path.join(model_dir, "config.json")):
+        # Only materialize the dummy checkpoint into an EMPTY dir — never
+        # clobber an existing checkpoint passed via --model-dir.
+        save_dummy_checkpoint(f"dummy:{args.size}", model_dir)
     tokenizer = AutoTokenizer.from_pretrained(model_dir)
 
     proc = launch_server(model_dir, args)

@@ -33,3 +33,65 @@ def lora_delta(
     out = jnp.einsum("blr,bro->blo", h, b_sel,
                      preferred_element_type=jnp.float32)
     return out.astype(x.dtype)
+
+
+def lora_embed(
+    input_ids: jnp.ndarray,   # [B, L] int32, ids may reach vocab+extra
+    base_embed: jnp.ndarray,  # [>=vocab, E] base table (may be TP-padded)
+    vocab_size: int,
+    vocab_state: dict,        # manager vocab_stacks (embed_a/b, extra_embed)
+    row_slots: jnp.ndarray,   # [B]
+) -> jnp.ndarray:
+    """Embedding with adapter vocab support (reference
+    `vllm/lora/layers.py:147` VocabParallelEmbeddingWithLoRA): ids beyond
+    the base vocab read the adapter's extra-token rows, and the
+    PEFT-Embedding LoRA delta B·A[id] adds on top for all ids."""
+    h = base_embed[jnp.minimum(input_ids, base_embed.shape[0] - 1)]
+    is_extra = input_ids >= vocab_size
+    ex = vocab_state["extra_embed"][row_slots]          # [B, X, E]
+    idx = jnp.clip(input_ids - vocab_size, 0, ex.shape[1] - 1)
+    h_ex = jnp.take_along_axis(ex, idx[..., None], axis=1)
+    h = jnp.where(is_extra[..., None], h_ex, h)
+    # Per-token A row (embedding semantics) x per-row B.
+    a_rows = vocab_state["embed_a"][
+        row_slots[:, None], jnp.minimum(input_ids,
+                                        vocab_state["embed_a"].shape[1] - 1)]
+    delta = jnp.einsum("blr,bre->ble", a_rows,
+                       vocab_state["embed_b"][row_slots],
+                       preferred_element_type=jnp.float32)
+    return h + delta.astype(h.dtype)
+
+
+def lora_logits(
+    hidden: jnp.ndarray,      # [B, ..., E]
+    base_logits: jnp.ndarray,  # [B, ..., >=vocab] (may be TP-padded)
+    vocab_size: int,
+    vocab_state: dict,        # head_a/b, extra_head, extra_counts
+    row_slots: jnp.ndarray,   # [B]
+) -> jnp.ndarray:
+    """Logits with adapter vocab support (reference
+    `vllm/lora/layers.py:783` SamplerWithLoRA): base-vocab delta via the
+    lm_head A/B pair plus extra-token columns from the adapter's output
+    embeddings. Returns EXACTLY vocab+extra columns — padding columns are
+    dropped and invalid extra slots are -inf, so no downstream mask is
+    needed."""
+    ha = vocab_state["head_a"][row_slots]               # [B, E, R]
+    hb = vocab_state["head_b"][row_slots]               # [B, R, V]
+    t = jnp.einsum("b...e,ber->b...r", hidden, ha,
+                   preferred_element_type=jnp.float32).astype(hidden.dtype)
+    delta = jnp.einsum("b...r,brv->b...v", t, hb,
+                       preferred_element_type=jnp.float32)
+    base = (base_logits[..., :vocab_size]
+            + delta.astype(base_logits.dtype))
+
+    xh = vocab_state["extra_head"][row_slots]           # [B, E, X]
+    ex = jnp.einsum("b...e,bex->b...x", hidden, xh,
+                    preferred_element_type=jnp.float32
+                    ).astype(base_logits.dtype)
+    # Mask extra slots the row's adapter doesn't define (including all of
+    # them for slot-0 / no-adapter rows).
+    counts = vocab_state["extra_counts"][row_slots]     # [B]
+    pos = jnp.arange(ex.shape[-1])
+    counts_b = counts.reshape((-1, ) + (1, ) * (ex.ndim - 1))
+    ex = jnp.where(pos >= counts_b, -1e30, ex)
+    return jnp.concatenate([base, ex], axis=-1)

@@ -32,19 +32,45 @@ _PEFT_TARGET_MAP = {
     "up_proj": "up",
     "down_proj": "down",
 }
-_UNSUPPORTED_TARGETS = ("embed_tokens", "lm_head")
+# Vocab-level targets (reference `vllm/lora/layers.py:147`
+# VocabParallelEmbeddingWithLoRA / `:783` SamplerWithLoRA) are handled
+# outside the per-layer map: embed_tokens / lm_head adapters plus the
+# optional `new_embeddings.safetensors` extra-token rows.
+_VOCAB_TARGETS = ("embed_tokens", "lm_head")
 
 
 class LoRAModel:
     """One loaded adapter, host-side: per-layer, per-target (A, B) pairs.
 
     A is [dim_in, r]; B is [r, dim_out] pre-scaled by lora_alpha/r.
+    Vocab-level pieces (all optional):
+    - embed_ab: (A [vocab_a, r] row-indexed by token id, B [r, hidden])
+    - head_ab: (A [hidden, r], B [r, vocab_b]) — logit delta over the
+      base vocabulary
+    - extra_embed / extra_head: [n_extra, hidden] full rows for tokens the
+      adapter ADDS beyond the base vocab (ids vocab..vocab+n_extra).
     """
 
     def __init__(self, rank: int,
-                 layers: List[Dict[str, Tuple[np.ndarray, np.ndarray]]]):
+                 layers: List[Dict[str, Tuple[np.ndarray, np.ndarray]]],
+                 embed_ab: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+                 head_ab: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+                 extra_embed: Optional[np.ndarray] = None,
+                 extra_head: Optional[np.ndarray] = None):
         self.rank = rank
         self.layers = layers
+        self.embed_ab = embed_ab
+        self.head_ab = head_ab
+        self.extra_embed = extra_embed
+        self.extra_head = extra_head
+
+    @property
+    def extra_vocab_size(self) -> int:
+        if self.extra_embed is not None:
+            return self.extra_embed.shape[0]
+        if self.extra_head is not None:
+            return self.extra_head.shape[0]
+        return 0
 
     @property
     def targets(self) -> List[str]:
@@ -91,13 +117,21 @@ class LoRAModel:
             {} for _ in range(num_layers)
         ]
         pending: Dict[Tuple[int, str], Dict[str, np.ndarray]] = {}
+        vocab_pending: Dict[str, Dict[str, np.ndarray]] = {}
         for name, arr in tensors.items():
-            for bad in _UNSUPPORTED_TARGETS:
-                if f".{bad}." in name:
-                    raise ValueError(
-                        f"Adapter at {path} targets '{bad}'; embedding/"
-                        "lm_head LoRA is not supported")
             if ".layers." not in name:
+                # Vocab-level targets (embed_tokens / lm_head).
+                hit = next((t for t in _VOCAB_TARGETS if t in name), None)
+                if hit is None:
+                    continue
+                if "lora_embedding_A" in name or ".lora_A." in name:
+                    ab = "a"
+                elif "lora_embedding_B" in name or ".lora_B." in name:
+                    ab = "b"
+                else:
+                    continue
+                vocab_pending.setdefault(hit, {})[ab] = np.asarray(
+                    arr, np.float32)
                 continue
             li = int(name.split(".layers.")[1].split(".")[0])
             target = None
@@ -120,7 +154,46 @@ class LoRAModel:
             a = ab["a"].T
             b = ab["b"].T * scaling
             layers[li][target] = (a, b)
-        return cls(rank, layers)
+
+        embed_ab = head_ab = None
+        if "embed_tokens" in vocab_pending:
+            ab = vocab_pending["embed_tokens"]
+            if "a" not in ab or "b" not in ab:
+                raise ValueError("embed_tokens adapter missing "
+                                 "lora_embedding_A or lora_embedding_B")
+            # PEFT Embedding: A [r, vocab] (column per id), B [hidden, r].
+            embed_ab = (ab["a"].T, ab["b"].T * scaling)
+        if "lm_head" in vocab_pending:
+            ab = vocab_pending["lm_head"]
+            if "a" not in ab or "b" not in ab:
+                raise ValueError("lm_head adapter missing lora_A or lora_B")
+            # PEFT Linear: A [r, hidden], B [vocab, r].
+            head_ab = (ab["a"].T, ab["b"].T * scaling)
+
+        # Extra-token rows (reference new_embeddings.safetensors beside the
+        # adapter: full input/output embedding rows for added tokens).
+        extra_embed = extra_head = None
+        for fname in ("new_embeddings.safetensors", "new_embeddings.bin"):
+            fpath = os.path.join(path, fname)
+            if not os.path.exists(fpath):
+                continue
+            if fname.endswith(".safetensors"):
+                import safetensors.numpy
+                extra = dict(safetensors.numpy.load_file(fpath))
+            else:
+                import torch
+                extra = {k: v.float().numpy()
+                         for k, v in torch.load(fpath, map_location="cpu",
+                                                weights_only=True).items()}
+            if "input_embeddings" in extra:
+                extra_embed = np.asarray(extra["input_embeddings"],
+                                         np.float32)
+            if "output_embeddings" in extra:
+                extra_head = np.asarray(extra["output_embeddings"],
+                                        np.float32)
+            break
+        return cls(rank, layers, embed_ab=embed_ab, head_ab=head_ab,
+                   extra_embed=extra_embed, extra_head=extra_head)
 
 
 class LoRAModelManager:
@@ -136,6 +209,9 @@ class LoRAModelManager:
         max_lora_rank: int,
         dtype,
         mesh=None,
+        vocab_size: int = 0,
+        hidden_size: int = 0,
+        extra_vocab_size: int = 0,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -147,6 +223,9 @@ class LoRAModelManager:
         self.dtype = jnp.dtype(dtype)
         self.num_slots = max_loras + 1   # slot 0 = no-adapter zeros
         self.mesh = mesh
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.extra_vocab_size = extra_vocab_size
 
         def alloc(shape, spec):
             arr = jnp.zeros(shape, self.dtype)
@@ -167,6 +246,23 @@ class LoRAModelManager:
                 (num_layers, self.num_slots, din, self.max_rank), a_spec)
             self.b_stacks[t] = alloc(
                 (num_layers, self.num_slots, self.max_rank, dout), b_spec)
+
+        # Vocab-level stacks (reference lora/layers.py:147,783): adapter
+        # deltas on embed_tokens / lm_head plus full rows for extra tokens
+        # (ids vocab..vocab+extra). Small (a few MB), replicated.
+        self.vocab_stacks = None
+        if vocab_size and hidden_size and extra_vocab_size:
+            s, r, e, x = self.num_slots, self.max_rank, hidden_size, \
+                extra_vocab_size
+            self.vocab_stacks = {
+                "embed_a": alloc((s, vocab_size + x, r), (None,) * 3),
+                "embed_b": alloc((s, r, e), (None,) * 3),
+                "extra_embed": alloc((s, x, e), (None,) * 3),
+                "head_a": alloc((s, e, r), (None,) * 3),
+                "head_b": alloc((s, r, vocab_size), (None,) * 3),
+                "extra_head": alloc((s, e, x), (None,) * 3),
+                "extra_counts": jnp.zeros(s, jnp.int32),
+            }
 
         self._slot_by_id: Dict[int, int] = {}
         self._free_slots = list(range(1, self.num_slots))
@@ -199,6 +295,17 @@ class LoRAModelManager:
                     f"Adapter targets module '{t}' which this model does "
                     f"not expose for LoRA (supported: "
                     f"{sorted(self.target_dims)})")
+        needs_vocab = (lora.embed_ab is not None or lora.head_ab is not None
+                       or lora.extra_vocab_size)
+        if needs_vocab and self.vocab_stacks is None:
+            raise ValueError(
+                "Adapter targets embed_tokens/lm_head or adds vocabulary "
+                "but the model/config exposes no extra-vocab support "
+                "(lora_extra_vocab_size=0 or model lacks vocab dims)")
+        if lora.extra_vocab_size > self.extra_vocab_size:
+            raise ValueError(
+                f"Adapter adds {lora.extra_vocab_size} tokens > "
+                f"lora_extra_vocab_size {self.extra_vocab_size}")
         if self._free_slots:
             slot = self._free_slots.pop(0)
         else:
@@ -229,9 +336,44 @@ class LoRAModelManager:
             self.b_stacks[t] = self.b_stacks[t].at[:, slot].set(
                 b_host.astype(self.dtype))
 
+        if self.vocab_stacks is not None:
+            self._write_vocab_slot(slot, lora)
+
         self._slot_by_id[lora_id] = slot
         self._touch(lora_id)
         return slot
+
+    def _write_vocab_slot(self, slot: int, lora: LoRAModel) -> None:
+        vs, r = self.vocab_stacks, self.max_rank
+        v, e, x = self.vocab_size, self.hidden_size, self.extra_vocab_size
+
+        ea = np.zeros((v + x, r), np.float32)
+        eb = np.zeros((r, e), np.float32)
+        if lora.embed_ab is not None:
+            a, b = lora.embed_ab              # [vocab_a, r'], [r', e]
+            ea[:a.shape[0], :a.shape[1]] = a[:v + x]
+            eb[:b.shape[0], :] = b
+        ha = np.zeros((e, r), np.float32)
+        hb = np.zeros((r, v), np.float32)
+        if lora.head_ab is not None:
+            a, b = lora.head_ab               # [e, r'], [r', vocab_b]
+            ha[:, :a.shape[1]] = a
+            hb[:b.shape[0], :] = b[:, :v]
+        xe = np.zeros((x, e), np.float32)
+        xh = np.zeros((e, x), np.float32)
+        n = lora.extra_vocab_size
+        if lora.extra_embed is not None:
+            xe[:n] = lora.extra_embed
+        if lora.extra_head is not None:
+            xh[:, :n] = lora.extra_head.T
+        d = self.dtype
+        vs["embed_a"] = vs["embed_a"].at[slot].set(ea.astype(d))
+        vs["embed_b"] = vs["embed_b"].at[slot].set(eb.astype(d))
+        vs["extra_embed"] = vs["extra_embed"].at[slot].set(xe.astype(d))
+        vs["head_a"] = vs["head_a"].at[slot].set(ha.astype(d))
+        vs["head_b"] = vs["head_b"].at[slot].set(hb.astype(d))
+        vs["extra_head"] = vs["extra_head"].at[slot].set(xh.astype(d))
+        vs["extra_counts"] = vs["extra_counts"].at[slot].set(n)
 
     def deactivate(self, lora_id: int) -> None:
         slot = self._slot_by_id.pop(lora_id, None)
@@ -253,8 +395,11 @@ class LoRAModelManager:
         """The `lora` pytree passed into the jitted step: per-layer slices
         are taken inside the traced function."""
         import jax.numpy as jnp
-        return {
+        state = {
             "row_slots": jnp.asarray(row_slots, jnp.int32),
             "a": self.a_stacks,
             "b": self.b_stacks,
         }
+        if self.vocab_stacks is not None:
+            state["vocab"] = dict(self.vocab_stacks)
+        return state

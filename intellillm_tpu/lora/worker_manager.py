@@ -42,6 +42,9 @@ class WorkerLoRAManager:
             max_lora_rank=lora_config.max_lora_rank,
             dtype=lora_config.lora_dtype,
             mesh=mesh,
+            vocab_size=getattr(model.config, "vocab_size", 0),
+            hidden_size=getattr(model, "hidden_size", 0),
+            extra_vocab_size=lora_config.lora_extra_vocab_size,
         )
 
     def _get_lora(self, req: LoRARequest) -> LoRAModel:
@@ -88,9 +91,17 @@ class WorkerLoRAManager:
         if cfg.get("alpha_pattern"):
             raise ValueError(
                 "PEFT alpha_pattern (per-module alpha) is not supported")
-        from intellillm_tpu.lora.models import _PEFT_TARGET_MAP
+        from intellillm_tpu.lora.models import (_PEFT_TARGET_MAP,
+                                                _VOCAB_TARGETS)
         supported = set(self.device_manager.target_dims)
+        vocab_ok = self.device_manager.vocab_stacks is not None
         for mod in cfg.get("target_modules") or []:
+            if mod in _VOCAB_TARGETS:
+                if not vocab_ok:
+                    raise ValueError(
+                        f"Adapter targets {mod!r} but extra-vocab LoRA is "
+                        "disabled (lora_extra_vocab_size=0)")
+                continue
             key = _PEFT_TARGET_MAP.get(mod)
             if key is None or key not in supported:
                 raise ValueError(

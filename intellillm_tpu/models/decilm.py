@@ -39,6 +39,12 @@ class DeciLMForCausalLM(LlamaForCausalLM):
         if self._kv_heads_per_layer is None:
             return
         target = self.num_kv_heads
+        stray_biases = [n for n in raw if "self_attn" in n
+                        and n.endswith("_proj.bias")]
+        assert not stray_biases, (
+            "DeciLM degrouping only rewrites k/v weights; this checkpoint "
+            f"also ships attention biases ({stray_biases[:3]}...) that "
+            "would be silently dropped — unsupported.")
         for name in list(raw):
             if not (name.endswith("k_proj.weight")
                     or name.endswith("v_proj.weight")):

@@ -89,7 +89,13 @@ class LlamaForCausalLM:
         attn_metadata: AttentionMetadata,
         lora=None,
     ) -> Tuple[jnp.ndarray, List[KVCache]]:
-        h = params["embed_tokens"][input_ids]
+        if lora is not None and "vocab" in lora:
+            from intellillm_tpu.lora.layers import lora_embed
+            h = lora_embed(input_ids, params["embed_tokens"],
+                           self.config.vocab_size, lora["vocab"],
+                           lora["row_slots"])
+        else:
+            h = params["embed_tokens"][input_ids]
         residual = None
         new_caches: List[KVCache] = []
         for i in range(self.num_layers):
@@ -158,11 +164,19 @@ class LlamaForCausalLM:
         h = self._proj(self.act(gate) * up, lp, lora, "down")
         return h, residual, kv_cache
 
-    def compute_logits(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
+    def compute_logits(self, params: Params, hidden: jnp.ndarray,
+                       lora=None) -> jnp.ndarray:
         lm_head = params.get("lm_head")
         if lm_head is None:
-            return hidden @ params["embed_tokens"].T
-        return qmatmul(hidden, lm_head)
+            logits = hidden @ params["embed_tokens"].T
+        else:
+            logits = qmatmul(hidden, lm_head)
+        if lora is not None and "vocab" in lora:
+            from intellillm_tpu.lora.layers import lora_logits
+            # Returns exactly vocab+extra columns, invalid extras -inf.
+            logits = lora_logits(hidden, logits, self.config.vocab_size,
+                                 lora["vocab"], lora["row_slots"])
+        return logits
 
     # --- sharding --------------------------------------------------------
 

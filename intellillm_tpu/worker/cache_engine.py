@@ -146,7 +146,16 @@ class CacheEngine:
             cache_dtype = model_config.dtype
         itemsize = jnp.dtype(STR_DTYPE_TO_JNP[cache_dtype]).itemsize
         lanes = -(-head_size // 128) * 128             # minor: pad to 128
-        return 2 * num_layers * num_kv_heads * block_size * lanes * itemsize
+        eff_block_size = block_size
+        if lanes > 128:
+            # Two+ lane tiles in the minor dim: XLA cannot merge the major
+            # dims, so the sublane dim (BS) pads to the dtype tile —
+            # account for it or the pool sizing under-estimates HBM and
+            # OOMs at init (e.g. head_size 256 with block_size 8).
+            sublane = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+            eff_block_size = -(-block_size // sublane) * sublane
+        return (2 * num_layers * num_kv_heads * eff_block_size * lanes *
+                itemsize)
 
     @staticmethod
     def get_logical_cache_block_size(

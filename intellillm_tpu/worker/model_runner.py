@@ -175,17 +175,24 @@ class ModelRunner:
     def _compute_logits_and_sample(self, params, hidden_rows, temperatures,
                                    top_ks, top_ps, min_ps, seeds, pres_pen,
                                    freq_pen, rep_pen, prompt_tokens,
-                                   output_tokens, *, num_samples, logprob_k,
-                                   do_topk, do_topp, do_minp, do_penalties,
-                                   fetch_indices=None):
+                                   output_tokens, lora=None, *, num_samples,
+                                   logprob_k, do_topk, do_topp, do_minp,
+                                   do_penalties, fetch_indices=None):
         """fetch_indices: optional [M] row indices whose RAW (pre-penalty)
         logits are additionally returned for the host logits_processors
         escape path (reference sampler.py `_apply_logits_processors` runs
         arbitrary Python callables on the driver; here such rows are
         re-sampled on host — see execute_model)."""
-        logits = self.model.compute_logits(params, hidden_rows)
+        lora_vocab = lora is not None and "vocab" in lora
+        if lora_vocab:
+            # Extra-vocab LoRA: the model returns EXACTLY vocab+extra
+            # columns with invalid extras already -inf (lora/layers.py
+            # lora_logits) — no padding mask needed.
+            logits = self.model.compute_logits(params, hidden_rows, lora)
+        else:
+            logits = self.model.compute_logits(params, hidden_rows)
         logits = logits.astype(jnp.float32)
-        if logits.shape[-1] > self.vocab_size:
+        if not lora_vocab and logits.shape[-1] > self.vocab_size:
             # TP vocab padding (parallel/mesh.py): the padded columns hold
             # zeros from the padded weights — mask them so they can never
             # win greedy argmax or receive sampling mass.
@@ -205,7 +212,8 @@ class ModelRunner:
                      do_topk=do_topk, do_topp=do_topp, do_minp=do_minp)
         return out + (fetched, )
 
-    def _prompt_logprobs(self, params, hidden, token_ids, *, k: int):
+    def _prompt_logprobs(self, params, hidden, token_ids, lora=None, *,
+                         k: int):
         """Per-position prompt logprobs (reference sampler.py prompt-
         logprob path): position t's logits predict token t+1. Logits are
         computed in 128-position chunks via scan so [B, C, V] — not
@@ -218,12 +226,20 @@ class ModelRunner:
         nc = pad_l // chunk
         h = h.reshape(b, nc, chunk, e).swapaxes(0, 1)        # [nc, B, C, E]
         tg = targets.reshape(b, nc, chunk).swapaxes(0, 1)    # [nc, B, C]
+        lora_vocab = lora is not None and "vocab" in lora
 
         def body(carry, inp):
             h_c, t_c = inp
-            logits = self.model.compute_logits(params, h_c)
+            if lora_vocab:
+                # Extra-vocab LoRA: adapter head delta + extra-token
+                # columns, exact vocab+extra width (invalid extras -inf)
+                # — keeps prompt logprobs consistent with the sampler and
+                # makes adapter-added prompt ids index real columns.
+                logits = self.model.compute_logits(params, h_c, lora)
+            else:
+                logits = self.model.compute_logits(params, h_c)
             logits = logits.astype(jnp.float32)
-            if logits.shape[-1] > self.vocab_size:
+            if not lora_vocab and logits.shape[-1] > self.vocab_size:
                 # TP vocab padding: exclude padded columns (same mask as
                 # the sampling path) so log_softmax normalizes over the
                 # real vocab and top_k can't emit out-of-vocab ids.
@@ -260,7 +276,7 @@ class ModelRunner:
         sel = hidden[jnp.arange(b), logits_indices]          # [B, E]
         sampled, lp, tk_ids, tk_lp, fetched = self._compute_logits_and_sample(
             params, sel, temperatures, top_ks, top_ps, min_ps, seeds,
-            pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens,
+            pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens, lora,
             num_samples=num_samples, logprob_k=logprob_k, do_topk=do_topk,
             do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties,
             fetch_indices=fetch_indices)
@@ -268,7 +284,7 @@ class ModelRunner:
         extras = ()
         if prompt_logprob_k:
             extras += (self._prompt_logprobs(params, hidden, token_ids,
-                                             k=prompt_logprob_k), )
+                                             lora, k=prompt_logprob_k), )
         if fetched is not None:
             extras += (fetched, )
         return (packed, ) + extras + (new_caches, )
@@ -343,7 +359,7 @@ class ModelRunner:
                  tk_lp, _) = self._compute_logits_and_sample(
                     params, hidden[:, 0], temperatures, top_ks, top_ps,
                     min_ps, seeds_k, pres_pen, freq_pen, rep_pen,
-                    prompt_tokens, output_tokens, num_samples=1,
+                    prompt_tokens, output_tokens, lora, num_samples=1,
                     logprob_k=logprob_k, do_topk=do_topk, do_topp=do_topp,
                     do_minp=do_minp, do_penalties=do_penalties)
                 next_tokens = sampled[:, 0]
@@ -435,7 +451,7 @@ class ModelRunner:
         sampled, lp, tk_ids, tk_lp, fetched = self._compute_logits_and_sample(
             params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
             seeds, pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens,
-            num_samples=1, logprob_k=logprob_k, do_topk=do_topk,
+            lora, num_samples=1, logprob_k=logprob_k, do_topk=do_topk,
             do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties,
             fetch_indices=fetch_indices)
         packed = self._pack(sampled, lp, tk_ids[:, None, :],
@@ -618,15 +634,22 @@ class ModelRunner:
             row_seeds.append(self._row_seed(seq_id, data.get_output_len()))
             row_tokens.append(data.token_views())
 
-        st = SamplingTensors.build(row_params, row_seeds, row_tokens,
-                                   self.vocab_size, padded_n)
-
         lora_state = None
         if self.lora_manager is not None:
             row_loras = [meta_by_req[req_id].lora_request
                          for req_id, _ in rows]
             lora_state = self.lora_manager.set_active_loras(
                 row_loras, padded_n)
+
+        # With extra-vocab LoRA the logits widen to vocab+extra; the
+        # sampling tensors must use that width for the top_k "disabled"
+        # value and the penalty pad sentinel (the sentinel value scatters
+        # into column `vocab` otherwise — a REAL extra-token column).
+        eff_vocab = self.vocab_size
+        if lora_state is not None and "vocab" in lora_state:
+            eff_vocab += lora_state["vocab"]["extra_embed"].shape[1]
+        st = SamplingTensors.build(row_params, row_seeds, row_tokens,
+                                   eff_vocab, padded_n)
 
         num_samples = 1
         if is_prompt:

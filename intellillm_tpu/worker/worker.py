@@ -276,8 +276,18 @@ class Worker:
                     # first processor-bearing request doesn't trigger a
                     # full XLA compile mid-serving.
                     m = pad_to_bucket(1, runner.batch_buckets)
-                    # args ends at output_tokens; fill lora=None, then
-                    # fetch_indices.
+                    # The serving path (execute_model) binds every arg
+                    # POSITIONALLY, and jax.jit keys its dispatch cache on
+                    # the call structure — a keyword-bound warm-up would
+                    # compile an executable serving never reuses. Guard
+                    # against parameter-order drift (ADVICE r3) with an
+                    # explicit signature check instead.
+                    import inspect
+                    names = list(inspect.signature(
+                        runner._decode_fn_single).parameters)
+                    idx = names.index("output_tokens")
+                    assert names[idx + 1:idx + 3] == \
+                        ["lora", "fetch_indices"], names
                     fargs = args + (None, place(np.zeros(m, np.int32)))
                     packed, _fetched, caches = runner._jit_decode_single(
                         self.params, self.cache_engine.device_cache, *fargs,

@@ -1,0 +1,194 @@
+"""Extra-vocabulary LoRA tests (embed_tokens / lm_head adapters).
+
+Reference roles: `vllm/lora/layers.py:147` VocabParallelEmbeddingWithLoRA,
+`:783` SamplerWithLoRA, `vllm/config.py:453-465` lora_extra_vocab_size,
+and the new_embeddings.safetensors convention. Golden strategy: an engine
+serving the adapter must emit the same greedy tokens as a plain engine
+serving a checkpoint with the adapter merged AND the vocabulary resized
+(extra rows appended to embed_tokens/lm_head).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from intellillm_tpu.lora.request import LoRARequest
+from intellillm_tpu.sampling_params import SamplingParams
+
+_E = 64          # tiny-llama hidden size (tests/conftest.py)
+_LAYERS = 2
+_RANK = 8
+_ALPHA = 8.0
+_EXTRA = 4
+
+
+def _base_vocab(base_dir) -> int:
+    with open(os.path.join(base_dir, "config.json")) as f:
+        return json.load(f)["vocab_size"]
+
+
+def _make_vocab_adapter(base_dir, out_dir, seed=0):
+    """PEFT adapter with q/v projections + embed_tokens + lm_head targets,
+    new_embeddings rows, and a vocabulary-extended tokenizer."""
+    import safetensors.numpy
+    from transformers import AutoTokenizer
+
+    v = _base_vocab(base_dir)
+    rng = np.random.RandomState(seed)
+    t = {}
+    for li in range(_LAYERS):
+        for name, dout in (("q_proj", _E), ("v_proj", 32)):
+            base = f"base_model.model.model.layers.{li}.self_attn.{name}"
+            t[f"{base}.lora_A.weight"] = rng.randn(
+                _RANK, _E).astype(np.float32) * 0.1
+            t[f"{base}.lora_B.weight"] = rng.randn(
+                dout, _RANK).astype(np.float32) * 0.1
+    # PEFT Embedding layout: A [r, vocab], B [hidden, r].
+    t["base_model.model.model.embed_tokens.lora_embedding_A"] = \
+        rng.randn(_RANK, v).astype(np.float32) * 0.1
+    t["base_model.model.model.embed_tokens.lora_embedding_B"] = \
+        rng.randn(_E, _RANK).astype(np.float32) * 0.1
+    # PEFT Linear layout: A [r, hidden], B [vocab, r].
+    t["base_model.lm_head.lora_A.weight"] = rng.randn(
+        _RANK, _E).astype(np.float32) * 0.1
+    t["base_model.lm_head.lora_B.weight"] = rng.randn(
+        v, _RANK).astype(np.float32) * 0.1
+
+    os.makedirs(out_dir, exist_ok=True)
+    safetensors.numpy.save_file(
+        t, os.path.join(out_dir, "adapter_model.safetensors"))
+    # Extra-token rows. One output row is boosted so greedy generation
+    # actually emits an extra-vocab id (proving the extra-logit path).
+    inp = rng.randn(_EXTRA, _E).astype(np.float32) * 0.1
+    outp = rng.randn(_EXTRA, _E).astype(np.float32) * 0.1
+    outp[1] *= 40.0
+    safetensors.numpy.save_file(
+        {"input_embeddings": inp, "output_embeddings": outp},
+        os.path.join(out_dir, "new_embeddings.safetensors"))
+    with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
+        json.dump({"r": _RANK, "lora_alpha": _ALPHA,
+                   "target_modules": ["q_proj", "v_proj", "embed_tokens",
+                                      "lm_head"]}, f)
+    tok = AutoTokenizer.from_pretrained(base_dir)
+    tok.add_tokens([f"<extra{i}>" for i in range(_EXTRA)])
+    tok.save_pretrained(out_dir)
+    return out_dir
+
+
+def _make_vocab_merged(base_dir, adapter_dir, out_dir):
+    """Golden twin: vocab resized to v+extra, adapter merged into the
+    base weights, extra rows written verbatim."""
+    import safetensors.numpy
+    import torch
+    from transformers import AutoModelForCausalLM, AutoTokenizer
+
+    v = _base_vocab(base_dir)
+    model = AutoModelForCausalLM.from_pretrained(base_dir,
+                                                 torch_dtype=torch.float32)
+    t = safetensors.numpy.load_file(
+        os.path.join(adapter_dir, "adapter_model.safetensors"))
+    extra = safetensors.numpy.load_file(
+        os.path.join(adapter_dir, "new_embeddings.safetensors"))
+    scaling = _ALPHA / _RANK
+
+    model.resize_token_embeddings(v + _EXTRA)
+    sd = model.state_dict()
+    for name, arr in t.items():
+        if ".lora_A." not in name or "lm_head" in name:
+            continue
+        b_arr = t[name.replace(".lora_A.", ".lora_B.")]
+        target = name.replace("base_model.model.", "").replace(
+            ".lora_A.weight", ".weight")
+        sd[target] += torch.from_numpy(
+            (scaling * (b_arr @ arr)).astype(np.float32))
+    ea = t["base_model.model.model.embed_tokens.lora_embedding_A"]
+    eb = t["base_model.model.model.embed_tokens.lora_embedding_B"]
+    sd["model.embed_tokens.weight"][:v] += torch.from_numpy(
+        (scaling * (eb @ ea)).T.astype(np.float32))
+    sd["model.embed_tokens.weight"][v:] = torch.from_numpy(
+        extra["input_embeddings"])
+    ha = t["base_model.lm_head.lora_A.weight"]
+    hb = t["base_model.lm_head.lora_B.weight"]
+    sd["lm_head.weight"][:v] += torch.from_numpy(
+        (scaling * (hb @ ha)).astype(np.float32))
+    sd["lm_head.weight"][v:] = torch.from_numpy(
+        extra["output_embeddings"])
+    model.load_state_dict(sd)
+    model.save_pretrained(out_dir, safe_serialization=True)
+    tok = AutoTokenizer.from_pretrained(base_dir)
+    tok.add_tokens([f"<extra{i}>" for i in range(_EXTRA)])
+    tok.save_pretrained(out_dir)
+    return out_dir
+
+
+@pytest.fixture(scope="module")
+def vocab_setup(tiny_llama_dir, tmp_path_factory):
+    root = tmp_path_factory.mktemp("lora-vocab")
+    ad = _make_vocab_adapter(tiny_llama_dir, str(root / "ad"))
+    merged = _make_vocab_merged(tiny_llama_dir, ad, str(root / "merged"))
+    return dict(base=tiny_llama_dir, ad=ad, merged=merged)
+
+
+def _greedy(model_dir, prompts, lora_request=None, **kw):
+    from intellillm_tpu.entrypoints.llm import LLM
+    llm = LLM(model=model_dir, max_model_len=64,
+              num_device_blocks_override=64, **kw)
+    outs = llm.generate(prompts, SamplingParams(temperature=0.0,
+                                                max_tokens=8),
+                        lora_request=lora_request)
+    return [(o.outputs[0].token_ids, o.outputs[0].text) for o in outs]
+
+
+def test_extra_vocab_lora_matches_resized_merged_twin(vocab_setup,
+                                                      example_prompts):
+    """Adapter-extended vocabulary end to end: prompts containing added
+    tokens, embed/lm_head deltas, and extra-token logits must all match
+    the merged+resized golden twin under greedy."""
+    prompts = [p + " <extra0> <extra2>" for p in example_prompts[:3]]
+    golden = _greedy(vocab_setup["merged"], prompts)
+    ours = _greedy(vocab_setup["base"], prompts,
+                   lora_request=LoRARequest("ad", 1, vocab_setup["ad"]),
+                   enable_lora=True, max_loras=2, max_lora_rank=_RANK,
+                   lora_extra_vocab_size=_EXTRA)
+    v = _base_vocab(vocab_setup["base"])
+    emitted = [tid for ids, _ in ours for tid in ids]
+    assert any(tid >= v for tid in emitted), (
+        "boosted extra token never sampled — extra-logit path untested")
+    for (g_ids, g_text), (o_ids, o_text) in zip(golden, ours):
+        assert o_ids == g_ids
+        assert o_text == g_text
+
+
+def test_extra_vocab_rows_isolated_per_adapter(vocab_setup,
+                                               example_prompts):
+    """A no-adapter request in the same batch must NEVER sample an
+    extra-vocab id (its extra logits are masked to -inf), even while a
+    sibling row's adapter boosts one."""
+    from intellillm_tpu.entrypoints.llm import LLM
+
+    llm = LLM(model=vocab_setup["base"], max_model_len=64,
+              num_device_blocks_override=64, enable_lora=True, max_loras=2,
+              max_lora_rank=_RANK, lora_extra_vocab_size=_EXTRA)
+    params = SamplingParams(temperature=0.0, max_tokens=8)
+    engine = llm.llm_engine
+    engine.add_request("0", example_prompts[0], params,
+                       lora_request=LoRARequest("ad", 1, vocab_setup["ad"]))
+    engine.add_request("1", example_prompts[0], params)
+    outs = {o.request_id: o for o in llm._run_engine(use_tqdm=False)}
+    v = _base_vocab(vocab_setup["base"])
+    assert all(t < v for t in outs["1"].outputs[0].token_ids)
+    assert any(t >= v for t in outs["0"].outputs[0].token_ids)
+
+
+def test_vocab_adapter_rejected_when_extra_vocab_disabled(vocab_setup,
+                                                          example_prompts):
+    from intellillm_tpu.entrypoints.llm import LLM
+
+    llm = LLM(model=vocab_setup["base"], max_model_len=64,
+              num_device_blocks_override=64, enable_lora=True,
+              max_lora_rank=_RANK, lora_extra_vocab_size=0)
+    with pytest.raises(ValueError, match="extra-vocab"):
+        llm.llm_engine.add_request(
+            "0", example_prompts[0], SamplingParams(max_tokens=4),
+            lora_request=LoRARequest("ad", 1, vocab_setup["ad"]))
