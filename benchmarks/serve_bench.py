@@ -102,20 +102,33 @@ def main(args) -> dict:
         wait_healthy(proc, base, args.init_timeout, args.server_log)
 
         requests = build_requests(args, tokenizer)
-        # Warm-up: touch the *whole* prefill bucket ladder before
-        # measuring. Trickled arrivals hit small batch buckets (1, 2, 4,
-        # ...) that an all-at-once burst never exercises — each is a
+        # Warm-up: touch the *whole* (batch-bucket x block-width-bucket)
+        # ladder before measuring. Trickled arrivals hit small batch
+        # buckets (1, 2, 4, ...) that an all-at-once burst never
+        # exercises, and mid-load concurrency (e.g. a steady 8 req/s
+        # holding ~64 running) pairs those buckets with WIDER block
+        # tables than short warm contexts produce — each combo is a
         # separate XLA executable, and a first-compile mid-measurement
-        # shows up as a multi-second TTFT outlier. With the persistent
-        # compile cache this pass is fast on every boot after the first.
+        # stalls serving for tens of seconds (measured: one cold
+        # (bs=64, width=32) decode compile collapsed a rate-8 run to
+        # 188 tok/s). Warm outputs run past the first width-bucket
+        # boundary (16 blocks) to cover both widths; the persistent
+        # compile cache makes later boots fast.
+        warm_out = max(args.output_len,
+                       16 * args.block_size + 48 - args.input_len)
+        # Never exceed the context limit (the server would reject the
+        # request and abort the whole warm-up).
+        warm_out = max(1, min(warm_out,
+                              args.max_model_len - args.input_len - 1))
+        warm = [(p, pl, warm_out) for p, pl, _ in requests]
         n_warm = 1
-        while n_warm <= min(args.max_num_seqs, len(requests)):
+        while n_warm <= min(args.max_num_seqs, len(warm)):
             asyncio.run(run_benchmark("openai", api_url, model_name,
-                                      requests[:n_warm], float("inf")))
+                                      warm[:n_warm], float("inf")))
             n_warm *= 2
         asyncio.run(run_benchmark(
             "openai", api_url, model_name,
-            requests[:max(4, min(args.max_num_seqs, len(requests)))],
+            warm[:max(4, min(args.max_num_seqs, len(warm)))],
             float("inf")))
 
         for rate_s in args.rates.split(","):

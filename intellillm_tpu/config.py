@@ -242,6 +242,7 @@ class ParallelConfig:
         pipeline_parallel_size: int = 1,
         max_parallel_loading_workers: Optional[int] = None,
         disable_custom_collectives: bool = False,
+        sp_prefill_threshold: Optional[int] = None,
     ) -> None:
         self.tensor_parallel_size = tensor_parallel_size
         self.data_parallel_size = data_parallel_size
@@ -250,6 +251,19 @@ class ParallelConfig:
         # XLA owns ICI collectives; kept for CLI parity with the reference's
         # --disable-custom-all-reduce (subsumed by jax.lax.psum).
         self.disable_custom_collectives = disable_custom_collectives
+        # Sequence-parallel prefill (exceeds reference, SURVEY §2.6 "SP
+        # absent"): a single prompt of >= this many tokens runs its prefill
+        # with the sequence dim sharded over the mesh "data" axis via ring
+        # attention (ops/ring_attention.py). None disables. Requires
+        # data_parallel_size > 1.
+        self.sp_prefill_threshold = sp_prefill_threshold
+        if sp_prefill_threshold is not None and data_parallel_size <= 1:
+            logger.warning(
+                "sp_prefill_threshold=%d has no effect with "
+                "data_parallel_size=1: sequence-parallel prefill shards "
+                "the sequence over the mesh 'data' axis; long prompts "
+                "will keep the single-chip flash path.",
+                sp_prefill_threshold)
         self.world_size = (tensor_parallel_size * data_parallel_size *
                            pipeline_parallel_size)
         self._verify_args()

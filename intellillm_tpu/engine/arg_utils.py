@@ -26,6 +26,7 @@ class EngineArgs:
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     pipeline_parallel_size: int = 1
+    sp_prefill_threshold: Optional[int] = None
     # KV cache
     block_size: int = 16
     hbm_utilization: float = 0.90
@@ -74,6 +75,10 @@ class EngineArgs:
                             default=1)
         parser.add_argument("--pipeline-parallel-size", "-pp", type=int,
                             default=1)
+        parser.add_argument("--sp-prefill-threshold", type=int, default=None,
+                            help="prompts >= this many tokens prefill with "
+                            "the sequence dim sharded over the mesh 'data' "
+                            "axis (ring attention); None disables")
         parser.add_argument("--block-size", type=int, default=16,
                             choices=[8, 16, 32, 64, 128])
         parser.add_argument("--hbm-utilization", "--gpu-memory-utilization",
@@ -141,6 +146,7 @@ class EngineArgs:
             tensor_parallel_size=self.tensor_parallel_size,
             data_parallel_size=self.data_parallel_size,
             pipeline_parallel_size=self.pipeline_parallel_size,
+            sp_prefill_threshold=self.sp_prefill_threshold,
         )
         scheduler_config = SchedulerConfig(
             max_num_batched_tokens=self.max_num_batched_tokens,

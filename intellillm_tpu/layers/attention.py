@@ -57,6 +57,12 @@ class AttentionMetadata:
     # is the current substep (traced scalar).
     staged: bool = struct.field(pytree_node=False, default=False)
     stage_index: Optional[jnp.ndarray] = None
+    # Sequence-parallel prefill: (mesh, axis_name) — static; when set, the
+    # prompt attention runs as ring attention with the sequence dim
+    # sharded over that mesh axis (ops/ring_attention.py). The runner only
+    # sets this for single-prompt, no-prefix, no-ALiBi, no-sliding-window
+    # prefills past the configured length threshold.
+    sp: Optional[tuple] = struct.field(pytree_node=False, default=None)
 
 
 class PagedAttention:
@@ -109,6 +115,16 @@ class PagedAttention:
                     attn_metadata.block_tables, attn_metadata.prefix_lens,
                     new_lens, self.scale, self.alibi_slopes,
                     self.sliding_window)
+            elif attn_metadata.sp is not None:
+                # Ring attention over the mesh seq axis: K/V shards rotate
+                # via ppermute, each device accumulates its query shard
+                # with an online softmax — exact causal attention with
+                # O(L/N) peak activation memory per chip.
+                from intellillm_tpu.ops.ring_attention import ring_attention
+                mesh, axis = attn_metadata.sp
+                out = ring_attention(query, key, value, mesh, axis,
+                                     scale=self.scale, causal=True,
+                                     head_axis="model")
             else:
                 out = _prefill_dispatch(query, key, value,
                                         attn_metadata.context_lens,

@@ -112,12 +112,19 @@ def ring_attention(
     axis: str,
     scale: Optional[float] = None,
     causal: bool = True,
+    head_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Exact (ring) attention with the sequence dim sharded over `axis`.
 
     GQA: K/V keep their (smaller) head count end to end — queries are
     grouped [.., Hkv, G, D] and the grouped einsum attends each query
     group against its kv head, so the rotating shards stay O(Hkv).
+
+    `head_axis`: additionally shard the KV-head dim over a second mesh
+    axis (tensor parallelism). Heads are embarrassingly parallel in
+    attention, so the per-shard body is unchanged — without this, a
+    dp x tp mesh would all-gather the head-sharded q/k/v at the shard_map
+    boundary and every tp device would redo ALL heads' attention.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -126,8 +133,11 @@ def ring_attention(
     g = hq // hkv
     q_grouped = q.reshape(b, l, hkv, g, d)
 
-    qspec = P(None, axis, None, None, None)
-    kvspec = P(None, axis, None, None)
+    h_ax = (head_axis if head_axis is not None
+            and mesh.shape.get(head_axis, 1) > 1
+            and hkv % mesh.shape[head_axis] == 0 else None)
+    qspec = P(None, axis, h_ax, None, None)
+    kvspec = P(None, axis, h_ax, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis,
                           scale=float(scale), causal=causal),

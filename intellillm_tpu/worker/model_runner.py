@@ -536,6 +536,19 @@ class ModelRunner:
             for i, table in enumerate(block_tables):
                 bt[i, :len(table)] = table
 
+        # Sequence-parallel prefill: one long prompt shards its sequence
+        # dim over the mesh "data" axis (ring attention) instead of
+        # running the whole context on one chip's flash kernel. ALiBi and
+        # sliding-window prompts keep the flash path (the ring kernel has
+        # no bias/window support), as do prefix-cache hits.
+        sp = None
+        threshold = self.parallel_config.sp_prefill_threshold
+        if (threshold is not None and len(rows) == 1 and not use_prefix
+                and self._dp > 1 and max_new >= threshold
+                and self.sliding_window is None and not self._uses_alibi
+                and l % self._dp == 0):
+            sp = (self.mesh, "data")
+
         place = self._place_batch_array
         attn_metadata = AttentionMetadata(
             is_prompt=True,
@@ -544,6 +557,7 @@ class ModelRunner:
             block_tables=place(bt) if bt is not None else None,
             prefix_lens=place(np_prefix_lens) if use_prefix else None,
             use_prefix=use_prefix,
+            sp=sp,
         )
         arrays = {"token_ids": token_ids, "positions": positions,
                   "logits_indices": logits_indices}
@@ -583,10 +597,12 @@ class ModelRunner:
 
     def _place_batch_array(self, arr):
         """Shard a [B, ...] host array over the mesh "data" axis (dp > 1),
-        else hand it to jit as-is."""
+        else hand it to jit as-is. Batches that don't divide the axis
+        (e.g. a single long prompt on a dp mesh) replicate — jit still
+        runs them, just without batch-sharded placement."""
         if arr is None:
             return None
-        if self._dp <= 1:
+        if self._dp <= 1 or arr.shape[0] % self._dp:
             return jnp.asarray(arr)
         from jax.sharding import NamedSharding, PartitionSpec as P
         spec = P(*(("data", ) + (None, ) * (arr.ndim - 1)))
