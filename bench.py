@@ -16,7 +16,9 @@ Env knobs: INTELLILLM_BENCH_SIZE=7b|1b|tiny (default 7b),
            INTELLILLM_BENCH_KV (cache dtype, default fp8_e5m2 for 7b),
            INTELLILLM_BENCH_QUANT (default int8 for 7b),
            INTELLILLM_BENCH_BLOCKS (KV pool size override, in blocks),
-           INTELLILLM_BENCH_BLOCK_SIZE (tokens per KV block, default 16).
+           INTELLILLM_BENCH_BLOCK_SIZE (tokens per KV block, default 16),
+           INTELLILLM_BENCH_MML (max_model_len, default 512 — raise for
+           long-context operating points, e.g. 2048 with IN=1024).
 """
 from __future__ import annotations
 
@@ -34,23 +36,35 @@ SIZES = {
     "7b": (4096, 11008, 32, 32, 32, 32000),
     "1b": (2048, 5632, 22, 32, 4, 32000),
     "tiny": (256, 512, 2, 8, 8, 1024),
+    # "moe": Mixtral-architecture (8 experts, top-2) scaled to one v5e
+    # chip: ~3.4B params -> 3.4 GiB int8 (the real 8x7B needs TP=8, which
+    # this environment's single chip cannot host).
+    "moe": (2048, 4096, 16, 32, 8, 32000),
 }
 
 
 def build_engine(size: str, max_num_seqs: int, max_model_len: int,
                  num_blocks: int, quantization=None, cache_dtype="auto"):
-    from transformers import LlamaConfig
+    from transformers import LlamaConfig, MixtralConfig
 
     from intellillm_tpu.config import (CacheConfig, ModelConfig,
                                        ParallelConfig, SchedulerConfig)
     from intellillm_tpu.engine.llm_engine import LLMEngine
 
     hidden, inter, layers, heads, kv_heads, vocab = SIZES[size]
-    hf_config = LlamaConfig(
-        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
-        num_hidden_layers=layers, num_attention_heads=heads,
-        num_key_value_heads=kv_heads, max_position_embeddings=4096,
-        tie_word_embeddings=False)
+    if size == "moe":
+        hf_config = MixtralConfig(
+            vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+            num_hidden_layers=layers, num_attention_heads=heads,
+            num_key_value_heads=kv_heads, max_position_embeddings=4096,
+            num_local_experts=8, num_experts_per_tok=2,
+            tie_word_embeddings=False)
+    else:
+        hf_config = LlamaConfig(
+            vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+            num_hidden_layers=layers, num_attention_heads=heads,
+            num_key_value_heads=kv_heads, max_position_embeddings=4096,
+            tie_word_embeddings=False)
     model_config = ModelConfig.from_hf_config(
         hf_config, dtype="bfloat16", max_model_len=max_model_len,
         load_format="dummy", quantization=quantization)
@@ -105,7 +119,7 @@ def main():
     # with int8 weight quantization (6.7 GiB), which also frees HBM for a
     # real KV pool / batch. One 7B KV block (16 tokens) is 8 MiB.
     quant = os.environ.get("INTELLILLM_BENCH_QUANT",
-                           "int8" if size == "7b" else "none")
+                           "int8" if size in ("7b", "moe") else "none")
     quant = None if quant in ("none", "") else quant
     # fp8 KV halves cache HBM vs bf16. With chunked fused decode
     # (INTELLILLM_DECODE_CHUNK=16 default) the staging buffers shrank
@@ -117,13 +131,13 @@ def main():
     # bs=96 only fits with the fp8 pool; bf16 KV keeps the bs=32/512-block
     # configuration (bs=64 there would thrash the pool with preemptions).
     bs_7b = 96 if kv_dtype.startswith("fp8") else 32
-    default_bs = {"7b": bs_7b, "1b": 32, "tiny": 64}[size]
+    default_bs = {"7b": bs_7b, "1b": 32, "tiny": 64, "moe": 64}[size]
     batch_size = int(os.environ.get("INTELLILLM_BENCH_BS", default_bs))
     input_len = int(os.environ.get("INTELLILLM_BENCH_IN", "128"))
     output_len = int(os.environ.get("INTELLILLM_BENCH_OUT", "128"))
-    max_model_len = 512
+    max_model_len = int(os.environ.get("INTELLILLM_BENCH_MML", "512"))
     num_blocks = {"7b": 1600 if kv_dtype.startswith("fp8") else 512,
-                  "1b": 2048, "tiny": 4096}[size]
+                  "1b": 2048, "tiny": 4096, "moe": 2048}[size]
     num_blocks = int(os.environ.get("INTELLILLM_BENCH_BLOCKS", num_blocks))
     vocab = SIZES[size][5]
 
@@ -141,11 +155,12 @@ def main():
     out_tokens, elapsed = run(engine, batch_size, input_len, output_len,
                               vocab)
     tok_s = out_tokens / elapsed
+    family = "mixtral" if size == "moe" else "llama2"
     print(json.dumps({
-        "metric": f"llama2-{size}-dummy offline output tok/s/chip "
+        "metric": f"{family}-{size}-dummy offline output tok/s/chip "
                   f"(bs={batch_size}, in={input_len}, out={output_len}, "
-                  f"greedy, {'int8-w' if quant else 'bf16'}, "
-                  f"kv={kv_dtype})",
+                  f"mml={max_model_len}, greedy, "
+                  f"{'int8-w' if quant else 'bf16'}, kv={kv_dtype})",
         "value": round(tok_s, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),

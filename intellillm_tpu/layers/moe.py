@@ -154,10 +154,16 @@ def moe_ffn(
 ) -> jnp.ndarray:
     t = x.shape[0]
     n = w1.shape[0]
-    # Dense computes n*t token-expert products; grouped computes t*top_k
-    # plus at most one padding block per expert. Require a 2x FLOP win to
-    # cover grouped's sort/scatter overhead before switching.
-    if n * t > 2 * (t * top_k + n * block):
+    # Dispatch tuned against fetch-synced v5e device timing (n=8,
+    # top_k=2, e=2048, inter=4096, block=512): below ~256 tokens both
+    # paths are weight-read-bound and tie within noise (the FLOP counts
+    # don't matter — every expert's weights stream from HBM either way);
+    # from t=256 up grouped wins outright (4.6 vs 16.0 ms at t=256,
+    # 6.6 vs 8.9 ms at t=2048, 8.1 vs 13.9 ms at t=4096). Switch once
+    # the routed tokens alone fill a grouped matmul block — the measured
+    # crossover — instead of the old 2x-FLOP-win rule whose ~2k-token
+    # crossover left prefill-sized batches on the slow dense path.
+    if t * top_k >= block:
         return moe_ffn_grouped(x, gate_w, w1, w2, w3, top_k, block=block,
                                renormalize=renormalize)
     return moe_ffn_dense(x, gate_w, w1, w2, w3, top_k,

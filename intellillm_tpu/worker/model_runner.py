@@ -545,9 +545,15 @@ class ModelRunner:
         threshold = self.parallel_config.sp_prefill_threshold
         if (threshold is not None and len(rows) == 1 and not use_prefix
                 and self._dp > 1 and max_new >= threshold
-                and self.sliding_window is None and not self._uses_alibi
-                and l % self._dp == 0):
-            sp = (self.mesh, "data")
+                and self.sliding_window is None and not self._uses_alibi):
+            if l % self._dp == 0:
+                sp = (self.mesh, "data")
+            else:
+                logger.warning(
+                    "SP prefill skipped for a %d-token prompt: padded "
+                    "length %d does not divide the data axis (%d); "
+                    "falling back to single-chip flash attention.",
+                    max_new, l, self._dp)
 
         place = self._place_batch_array
         attn_metadata = AttentionMetadata(

@@ -15,6 +15,7 @@ intentionally subsumed by `jax.lax.psum`.
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -228,6 +229,15 @@ class Worker:
         Populates the (persistent) XLA compilation cache so the first real
         decode hit doesn't pay compile latency mid-serving.
 
+        INTELLILLM_WARMUP_FULL=1 extends warm-up to EVERY batch bucket
+        AND every block-table width bucket (default: top batch bucket x
+        two narrowest widths): any (batch-bucket x width-bucket) decode
+        executable left cold compiles mid-serving on first touch, which
+        stalls the engine for tens of seconds (measured: a cold
+        (bs=64, width=32) compile collapsed a steady rate-8 serving run
+        to 188 tok/s). With the persistent compilation cache the full
+        sweep is only expensive on the first boot per configuration.
+
         Skipped under enforce_eager and on CPU (tests): jit still compiles
         lazily on first use, warm-up only front-loads the latency."""
         if self.model_config.enforce_eager or jax.default_backend() == "cpu":
@@ -237,73 +247,83 @@ class Worker:
             return
         import time as _time
 
-        from intellillm_tpu.utils import pad_to_bucket
+        from intellillm_tpu.utils import parse_env_flag, pad_to_bucket
 
         start = _time.monotonic()
-        b = pad_to_bucket(self.scheduler_config.max_num_seqs,
-                          runner.batch_buckets)
+        top = pad_to_bucket(self.scheduler_config.max_num_seqs,
+                            runner.batch_buckets)
+        full = parse_env_flag(
+            os.environ.get("INTELLILLM_WARMUP_FULL", "")) is True
+        batch_sizes = ([bb for bb in runner.batch_buckets if bb <= top]
+                       if full else [top])
         place = runner._place_batch_array
         # All-pad batch: context_lens == 0 rows map every KV slot to the
         # out-of-bounds sentinel, so executing the real jitted programs
         # leaves the (donated, reassigned) pool bit-identical while
         # populating jit's dispatch cache with the exact runtime
         # executables — shardings included.
-        zeros_i = place(np.zeros((b, 1), np.int32))
         flags = dict(logprob_k=8, do_topk=False, do_topp=False,
                      do_minp=False, do_penalties=False)
         n = 0
         try:
-            for w in runner.block_width_buckets[:2]:
-                args = (place(np.zeros((b, 1), np.int32)), zeros_i,
-                        place(np.zeros((b, w), np.int32)),
-                        place(np.zeros(b, np.int32)),
-                        place(np.zeros(b, np.float32)),
-                        place(np.full(b, -1, np.int32)),
-                        place(np.ones(b, np.float32)),
-                        place(np.zeros(b, np.float32)),
-                        place(np.zeros(b, np.uint32)),
-                        place(np.zeros(b, np.float32)),
-                        place(np.zeros(b, np.float32)),
-                        place(np.ones(b, np.float32)), None, None)
-                packed, caches = runner._jit_decode_single(
-                    self.params, self.cache_engine.device_cache, *args,
-                    **flags)
-                self.cache_engine.device_cache = caches
-                n += 1
-                if w == runner.block_width_buckets[0]:
-                    # Passing fetch_indices changes the jit arg pytree
-                    # (logits_processors escape path) — warm it too, so the
-                    # first processor-bearing request doesn't trigger a
-                    # full XLA compile mid-serving.
-                    m = pad_to_bucket(1, runner.batch_buckets)
-                    # The serving path (execute_model) binds every arg
-                    # POSITIONALLY, and jax.jit keys its dispatch cache on
-                    # the call structure — a keyword-bound warm-up would
-                    # compile an executable serving never reuses. Guard
-                    # against parameter-order drift (ADVICE r3) with an
-                    # explicit signature check instead.
-                    import inspect
-                    names = list(inspect.signature(
-                        runner._decode_fn_single).parameters)
-                    idx = names.index("output_tokens")
-                    assert names[idx + 1:idx + 3] == \
-                        ["lora", "fetch_indices"], names
-                    fargs = args + (None, place(np.zeros(m, np.int32)))
-                    packed, _fetched, caches = runner._jit_decode_single(
-                        self.params, self.cache_engine.device_cache, *fargs,
+            # The serving path (execute_model) binds every arg
+            # POSITIONALLY, and jax.jit keys its dispatch cache on the
+            # call structure — a keyword-bound warm-up would compile
+            # executables serving never reuses. Guard against
+            # parameter-order drift (ADVICE r3) with a signature check;
+            # inside the try so drift degrades to lazy compilation (the
+            # documented best-effort contract), not a boot failure.
+            import inspect
+            names = list(inspect.signature(
+                runner._decode_fn_single).parameters)
+            idx = names.index("output_tokens")
+            assert names[idx + 1:idx + 3] == \
+                ["lora", "fetch_indices"], names
+            widths = (runner.block_width_buckets if full
+                      else runner.block_width_buckets[:2])
+            for b in batch_sizes:
+                zeros_i = place(np.zeros((b, 1), np.int32))
+                for w in widths:
+                    args = (place(np.zeros((b, 1), np.int32)), zeros_i,
+                            place(np.zeros((b, w), np.int32)),
+                            place(np.zeros(b, np.int32)),
+                            place(np.zeros(b, np.float32)),
+                            place(np.full(b, -1, np.int32)),
+                            place(np.ones(b, np.float32)),
+                            place(np.zeros(b, np.float32)),
+                            place(np.zeros(b, np.uint32)),
+                            place(np.zeros(b, np.float32)),
+                            place(np.zeros(b, np.float32)),
+                            place(np.ones(b, np.float32)), None, None)
+                    packed, caches = runner._jit_decode_single(
+                        self.params, self.cache_engine.device_cache, *args,
                         **flags)
                     self.cache_engine.device_cache = caches
                     n += 1
-                k = self.scheduler_config.num_decode_steps
-                if k > 1:
-                    packed, caches = runner._jit_decode(
-                        self.params, self.cache_engine.device_cache, *args,
-                        num_steps=k, **flags)
-                    self.cache_engine.device_cache = caches
-                    n += 1
-                jax.block_until_ready(packed)
-            logger.info("Warm-up: compiled %d decode executables (bs=%d) "
-                        "in %.1fs", n, b, _time.monotonic() - start)
+                    if b == top and w == runner.block_width_buckets[0]:
+                        # Passing fetch_indices changes the jit arg pytree
+                        # (logits_processors escape path) — warm it too, so
+                        # the first processor-bearing request doesn't
+                        # trigger a full XLA compile mid-serving.
+                        m = pad_to_bucket(1, runner.batch_buckets)
+                        fargs = args + (None, place(np.zeros(m, np.int32)))
+                        packed, _fetched, caches = runner._jit_decode_single(
+                            self.params, self.cache_engine.device_cache,
+                            *fargs, **flags)
+                        self.cache_engine.device_cache = caches
+                        n += 1
+                    k = self.scheduler_config.num_decode_steps
+                    if k > 1:
+                        packed, caches = runner._jit_decode(
+                            self.params, self.cache_engine.device_cache,
+                            *args, num_steps=k, **flags)
+                        self.cache_engine.device_cache = caches
+                        n += 1
+                    jax.block_until_ready(packed)
+            logger.info("Warm-up: compiled %d decode executables "
+                        "(bs=%s) in %.1fs", n,
+                        "/".join(str(x) for x in batch_sizes),
+                        _time.monotonic() - start)
             return n
         except Exception as e:  # warm-up is best-effort
             logger.warning("Warm-up failed (%s); compiling lazily instead",

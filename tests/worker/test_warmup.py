@@ -61,3 +61,16 @@ def test_warm_up_compiles_all_variants(monkeypatch, num_decode_steps):
 def test_warm_up_skipped_on_cpu():
     worker = _make_worker(1)
     assert worker.warm_up_model() is None
+
+
+def test_warm_up_full_covers_every_batch_bucket(monkeypatch):
+    """INTELLILLM_WARMUP_FULL=1 sweeps every batch bucket so no
+    (bs, width) decode executable is left to compile mid-serving."""
+    worker = _make_worker(num_decode_steps=4)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("INTELLILLM_WARMUP_FULL", "1")
+    n = worker.warm_up_model()
+    assert n is not None
+    buckets = worker.model_runner.batch_buckets  # 1,2,4,8 for max_seqs=8
+    n_widths = len(worker.model_runner.block_width_buckets[:2])
+    assert n == len(buckets) * n_widths * 2 + 1
