@@ -20,14 +20,21 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _sync(out):
+    """Force completion with a real device→host fetch: over the axon
+    tunnel `block_until_ready` returns before execution finishes, which
+    silently turns timings into enqueue-rate measurements."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jnp.ravel(leaf)[0])
+
+
 def timeit(fn, *args, n=10, warmup=2, **kw):
     for _ in range(warmup):
-        out = fn(*args, **kw)
-        jax.block_until_ready(out)
+        _sync(fn(*args, **kw))
     t0 = time.perf_counter()
     for _ in range(n):
         out = fn(*args, **kw)
-        jax.block_until_ready(out)
+    _sync(out)
     return (time.perf_counter() - t0) / n
 
 

@@ -154,16 +154,18 @@ def moe_ffn(
 ) -> jnp.ndarray:
     t = x.shape[0]
     n = w1.shape[0]
-    # Dispatch tuned against fetch-synced v5e device timing (n=8,
-    # top_k=2, e=2048, inter=4096, block=512): below ~256 tokens both
-    # paths are weight-read-bound and tie within noise (the FLOP counts
-    # don't matter — every expert's weights stream from HBM either way);
-    # from t=256 up grouped wins outright (4.6 vs 16.0 ms at t=256,
-    # 6.6 vs 8.9 ms at t=2048, 8.1 vs 13.9 ms at t=4096). Switch once
-    # the routed tokens alone fill a grouped matmul block — the measured
-    # crossover — instead of the old 2x-FLOP-win rule whose ~2k-token
-    # crossover left prefill-sized batches on the slow dense path.
-    if t * top_k >= block:
+    # Dense runs n*t token-expert rows; grouped runs the routed rows
+    # plus up to one padding block per expert: t*top_k + n*block worst
+    # case. Switch at row parity. Fetch-synced v5e device timing (n=8,
+    # top_k=2, e=2048, inter=4096, block=512) showed grouped at or ahead
+    # of dense from a few hundred tokens (4.6 vs 16.0 ms at t=256,
+    # 6.6 vs 8.9 ms at t=2048, 8.1 vs 13.9 ms at t=4096) and tied within
+    # noise below, so the old 2x-FLOP-win margin (crossover ~2k tokens)
+    # left prefill-sized batches on the slow dense path. The n*block
+    # padding term must stay in the inequality: many-expert models
+    # (DeepSeek n=64) pay n padding blocks on the grouped path, which
+    # dominates at small t.
+    if t * top_k + n * block <= n * t:
         return moe_ffn_grouped(x, gate_w, w1, w2, w3, top_k, block=block,
                                renormalize=renormalize)
     return moe_ffn_dense(x, gate_w, w1, w2, w3, top_k,

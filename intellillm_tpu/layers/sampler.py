@@ -31,7 +31,10 @@ _SAMPLING_EPS = 1e-5
 
 # Top-K panel buckets: K is padded to one of these so jit compiles a small
 # set of shapes (analogue of CUDA-graph size bucketing, but for sampling).
-LOGPROB_K_BUCKETS = (8, 16, 32, 64, 128)
+# Width buckets for the top-k logprob panel returned with every sample.
+# Bucket 1 matters: greedy serving with no logprobs request pays a
+# lax.top_k over [N, vocab] EVERY fused substep otherwise.
+LOGPROB_K_BUCKETS = (1, 8, 16, 32, 64, 128)
 # Penalty token-history length buckets (coarse: each distinct (Lp, Lo)
 # pair compiles a separate model executable).
 _PENALTY_LEN_BUCKETS = (128, 512, 2048, 8192, 32768)
@@ -61,6 +64,9 @@ class SamplingTensors:
     do_topk: bool
     do_topp: bool
     do_minp: bool
+    # False when every live row is greedy/beam (temperature < eps): the
+    # device sampler then skips Gumbel-noise generation over [N, vocab].
+    do_random: bool
     logprob_k: int                  # panel width (bucketed)
 
     @classmethod
@@ -75,7 +81,10 @@ class SamplingTensors:
         """row_token_ids: per row (prompt_token_ids, output_token_ids); only
         consulted when penalties are active."""
         n = len(row_params)
-        temps = np.ones(padded_n, np.float32)
+        # Padding rows are temperature-0 (greedy): their outputs are
+        # discarded, and keeping them greedy lets an all-greedy batch
+        # take the no-Gumbel fast path.
+        temps = np.zeros(padded_n, np.float32)
         top_ps = np.ones(padded_n, np.float32)
         top_ks = np.full(padded_n, vocab_size, np.int32)
         min_ps = np.zeros(padded_n, np.float32)
@@ -85,6 +94,7 @@ class SamplingTensors:
         seeds = np.zeros(padded_n, np.uint32)
 
         do_penalties = do_topk = do_topp = do_minp = False
+        do_random = False
         max_logprobs = 1
         for i, sp in enumerate(row_params):
             temps[i] = sp.temperature
@@ -105,6 +115,8 @@ class SamplingTensors:
                 do_topp = True
             if sp.min_p > _SAMPLING_EPS:
                 do_minp = True
+            if sp.temperature >= _SAMPLING_EPS:
+                do_random = True
             if sp.logprobs is not None:
                 max_logprobs = max(max_logprobs, sp.logprobs)
             if sp.use_beam_search:
@@ -141,7 +153,7 @@ class SamplingTensors:
 
         return cls(temps, top_ps, top_ks, min_ps, pres, freq, rep, seeds,
                    prompt_tokens, output_tokens, do_penalties, do_topk,
-                   do_topp, do_minp, logprob_k)
+                   do_topp, do_minp, do_random, logprob_k)
 
 
 def penalty_tensors_from_tokens(
@@ -311,6 +323,7 @@ def sample(
     do_topk: bool = False,
     do_topp: bool = False,
     do_minp: bool = False,
+    do_random: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Sample `num_samples` tokens per row (S>1 only for best_of>1 prompt
     rows; each sample uses an independent fold of the row seed).
@@ -321,11 +334,26 @@ def sample(
     logprob extraction precedes top-k/p masking, sampler.py:426).
     """
     logits = logits.astype(jnp.float32)
-    # Raw log-softmax panel for the API/beam search.
+    # Raw log-softmax panel for the API/beam search. K=1 collapses the
+    # top_k to the argmax row (the panel nobody asked for is free).
     raw_logprobs = jax.nn.log_softmax(logits, axis=-1)
-    topk_logprobs, topk_ids = jax.lax.top_k(raw_logprobs, logprob_k)
-
     greedy_ids = jnp.argmax(logits, axis=-1)
+    if logprob_k == 1:
+        topk_ids = greedy_ids[:, None]
+        topk_logprobs = jnp.take_along_axis(raw_logprobs, topk_ids, axis=-1)
+    else:
+        topk_logprobs, topk_ids = jax.lax.top_k(raw_logprobs, logprob_k)
+
+    if not do_random:
+        # Every live row is greedy (temperature < eps): skip the Gumbel
+        # noise over [N, S, V] entirely — at serving batch sizes that
+        # PRNG + argmax is real per-substep time.
+        assert num_samples == 1, "best_of>1 requires sampling rows"
+        sampled = greedy_ids[:, None].astype(jnp.int32)
+        sampled_logprobs = jnp.take_along_axis(raw_logprobs, sampled,
+                                               axis=-1)
+        return (sampled, sampled_logprobs, topk_ids.astype(jnp.int32),
+                topk_logprobs)
 
     # Random path: temperature-scale then filter then Gumbel-argmax.
     is_greedy = temperatures < _SAMPLING_EPS

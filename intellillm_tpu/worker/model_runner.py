@@ -113,19 +113,20 @@ class ModelRunner:
         self._jit_prefill = jax.jit(
             self._prefill_fn,
             static_argnames=("num_samples", "logprob_k", "do_topk", "do_topp",
-                             "do_minp", "do_penalties", "prompt_logprob_k"),
+                             "do_minp", "do_penalties", "do_random",
+                             "prompt_logprob_k"),
             donate_argnames=("kv_caches", ),
         )
         self._jit_decode = jax.jit(
             self._decode_fn,
             static_argnames=("num_steps", "logprob_k", "do_topk", "do_topp",
-                             "do_minp", "do_penalties"),
+                             "do_minp", "do_penalties", "do_random"),
             donate_argnames=("kv_caches", ),
         )
         self._jit_decode_single = jax.jit(
             self._decode_fn_single,
             static_argnames=("logprob_k", "do_topk", "do_topp", "do_minp",
-                             "do_penalties"),
+                             "do_penalties", "do_random"),
             donate_argnames=("kv_caches", ),
         )
 
@@ -177,7 +178,8 @@ class ModelRunner:
                                    freq_pen, rep_pen, prompt_tokens,
                                    output_tokens, lora=None, *, num_samples,
                                    logprob_k, do_topk, do_topp, do_minp,
-                                   do_penalties, fetch_indices=None):
+                                   do_penalties, do_random=True,
+                                   fetch_indices=None):
         """fetch_indices: optional [M] row indices whose RAW (pre-penalty)
         logits are additionally returned for the host logits_processors
         escape path (reference sampler.py `_apply_logits_processors` runs
@@ -209,7 +211,8 @@ class ModelRunner:
                                      pres_pen, freq_pen, rep_pen)
         out = sample(logits, temperatures, top_ks, top_ps, min_ps, seeds,
                      logprob_k=logprob_k, num_samples=num_samples,
-                     do_topk=do_topk, do_topp=do_topp, do_minp=do_minp)
+                     do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
+                     do_random=do_random)
         return out + (fetched, )
 
     def _prompt_logprobs(self, params, hidden, token_ids, lora=None, *,
@@ -269,7 +272,7 @@ class ModelRunner:
                     prompt_tokens, output_tokens, lora=None,
                     fetch_indices=None, *, num_samples,
                     logprob_k, do_topk, do_topp, do_minp, do_penalties,
-                    prompt_logprob_k=0):
+                    do_random=True, prompt_logprob_k=0):
         hidden, new_caches = self._call_model(params, token_ids, positions,
                                               kv_caches, attn_metadata, lora)
         b = token_ids.shape[0]
@@ -279,7 +282,7 @@ class ModelRunner:
             pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens, lora,
             num_samples=num_samples, logprob_k=logprob_k, do_topk=do_topk,
             do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties,
-            fetch_indices=fetch_indices)
+            do_random=do_random, fetch_indices=fetch_indices)
         packed = self._pack(sampled, lp, tk_ids[:, None, :], tk_lp[:, None, :])
         extras = ()
         if prompt_logprob_k:
@@ -293,7 +296,8 @@ class ModelRunner:
                    block_tables, context_lens, temperatures, top_ks, top_ps,
                    min_ps, seeds, pres_pen, freq_pen, rep_pen, prompt_tokens,
                    output_tokens, lora=None, *, num_steps, logprob_k,
-                   do_topk, do_topp, do_minp, do_penalties):
+                   do_topk, do_topp, do_minp, do_penalties,
+                   do_random=True):
         """K fused decode iterations (staged, chunked).
 
         The paged pool stays loop-invariant (read-only) through each scan —
@@ -361,7 +365,8 @@ class ModelRunner:
                     min_ps, seeds_k, pres_pen, freq_pen, rep_pen,
                     prompt_tokens, output_tokens, lora, num_samples=1,
                     logprob_k=logprob_k, do_topk=do_topk, do_topp=do_topp,
-                    do_minp=do_minp, do_penalties=do_penalties)
+                    do_minp=do_minp, do_penalties=do_penalties,
+                    do_random=do_random)
                 next_tokens = sampled[:, 0]
                 return ((next_tokens, stages),
                         (next_tokens, lp[:, 0], tk_ids, tk_lp))
@@ -421,7 +426,7 @@ class ModelRunner:
                           prompt_tokens, output_tokens, lora=None,
                           fetch_indices=None, *,
                           logprob_k, do_topk, do_topp, do_minp,
-                          do_penalties):
+                          do_penalties, do_random=True):
         """Unstaged single-step decode: writes KV to the pool before
         attention. Required for sliding-window models (exact window
         semantics need the ring layout) and used whenever K == 1."""
@@ -453,7 +458,7 @@ class ModelRunner:
             seeds, pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens,
             lora, num_samples=1, logprob_k=logprob_k, do_topk=do_topk,
             do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties,
-            fetch_indices=fetch_indices)
+            do_random=do_random, fetch_indices=fetch_indices)
         packed = self._pack(sampled, lp, tk_ids[:, None, :],
                             tk_lp[:, None, :])
         if fetched is not None:
@@ -696,7 +701,7 @@ class ModelRunner:
         common = dict(
             logprob_k=st.logprob_k,
             do_topk=st.do_topk, do_topp=st.do_topp, do_minp=st.do_minp,
-            do_penalties=st.do_penalties,
+            do_penalties=st.do_penalties, do_random=st.do_random,
         )
         sampling_args = (
             place(st.temperatures), place(st.top_ks), place(st.top_ps),

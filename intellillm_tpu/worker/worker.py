@@ -262,8 +262,17 @@ class Worker:
         # leaves the (donated, reassigned) pool bit-identical while
         # populating jit's dispatch cache with the exact runtime
         # executables — shardings included.
-        flags = dict(logprob_k=8, do_topk=False, do_topp=False,
-                     do_minp=False, do_penalties=False)
+        # Warm BOTH steady-state sampler variants (logprob_k bucket 1,
+        # no penalties/filters): greedy (do_random=False, the Gumbel-free
+        # fast path) AND plain sampled traffic (do_random=True) — each is
+        # a separate executable, and whichever is left cold compiles
+        # mid-serving on the first matching request.
+        flag_variants = [
+            dict(logprob_k=1, do_topk=False, do_topp=False,
+                 do_minp=False, do_penalties=False, do_random=False),
+            dict(logprob_k=1, do_topk=False, do_topp=False,
+                 do_minp=False, do_penalties=False, do_random=True),
+        ]
         n = 0
         try:
             # The serving path (execute_model) binds every arg
@@ -295,31 +304,37 @@ class Worker:
                             place(np.zeros(b, np.float32)),
                             place(np.zeros(b, np.float32)),
                             place(np.ones(b, np.float32)), None, None)
-                    packed, caches = runner._jit_decode_single(
-                        self.params, self.cache_engine.device_cache, *args,
-                        **flags)
-                    self.cache_engine.device_cache = caches
-                    n += 1
-                    if b == top and w == runner.block_width_buckets[0]:
-                        # Passing fetch_indices changes the jit arg pytree
-                        # (logits_processors escape path) — warm it too, so
-                        # the first processor-bearing request doesn't
-                        # trigger a full XLA compile mid-serving.
-                        m = pad_to_bucket(1, runner.batch_buckets)
-                        fargs = args + (None, place(np.zeros(m, np.int32)))
-                        packed, _fetched, caches = runner._jit_decode_single(
+                    for flags in flag_variants:
+                        packed, caches = runner._jit_decode_single(
                             self.params, self.cache_engine.device_cache,
-                            *fargs, **flags)
+                            *args, **flags)
                         self.cache_engine.device_cache = caches
                         n += 1
-                    k = self.scheduler_config.num_decode_steps
-                    if k > 1:
-                        packed, caches = runner._jit_decode(
-                            self.params, self.cache_engine.device_cache,
-                            *args, num_steps=k, **flags)
-                        self.cache_engine.device_cache = caches
-                        n += 1
-                    jax.block_until_ready(packed)
+                        if (not flags["do_random"] and b == top
+                                and w == runner.block_width_buckets[0]):
+                            # Passing fetch_indices changes the jit arg
+                            # pytree (logits_processors escape path) —
+                            # warm it too, so the first processor-bearing
+                            # request doesn't trigger a full XLA compile
+                            # mid-serving.
+                            m = pad_to_bucket(1, runner.batch_buckets)
+                            fargs = args + (None,
+                                            place(np.zeros(m, np.int32)))
+                            packed, _fetched, caches = \
+                                runner._jit_decode_single(
+                                    self.params,
+                                    self.cache_engine.device_cache,
+                                    *fargs, **flags)
+                            self.cache_engine.device_cache = caches
+                            n += 1
+                        k = self.scheduler_config.num_decode_steps
+                        if k > 1:
+                            packed, caches = runner._jit_decode(
+                                self.params, self.cache_engine.device_cache,
+                                *args, num_steps=k, **flags)
+                            self.cache_engine.device_cache = caches
+                            n += 1
+                        jax.block_until_ready(packed)
             logger.info("Warm-up: compiled %d decode executables "
                         "(bs=%s) in %.1fs", n,
                         "/".join(str(x) for x in batch_sizes),

@@ -16,15 +16,16 @@ from intellillm_tpu.config import (CacheConfig, ModelConfig, ParallelConfig,
 from intellillm_tpu.worker.worker import Worker
 
 
-def _make_worker(num_decode_steps):
+def _make_worker(num_decode_steps, max_model_len=128):
     from transformers import LlamaConfig
 
     hf = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
                      num_hidden_layers=2, num_attention_heads=4,
-                     num_key_value_heads=2, max_position_embeddings=128,
+                     num_key_value_heads=2,
+                     max_position_embeddings=max_model_len,
                      tie_word_embeddings=False)
     model_config = ModelConfig.from_hf_config(hf, dtype="float32",
-                                              max_model_len=128,
+                                              max_model_len=max_model_len,
                                               load_format="dummy")
     cache_config = CacheConfig(block_size=16,
                                num_device_blocks_override=64,
@@ -32,7 +33,8 @@ def _make_worker(num_decode_steps):
     cache_config.num_device_blocks = 64
     cache_config.num_cpu_blocks = 4
     scheduler_config = SchedulerConfig(max_num_batched_tokens=2048,
-                                       max_num_seqs=8, max_model_len=128,
+                                       max_num_seqs=8,
+                                       max_model_len=max_model_len,
                                        max_paddings=512,
                                        num_decode_steps=num_decode_steps)
     worker = Worker(model_config, ParallelConfig(), scheduler_config,
@@ -51,11 +53,12 @@ def test_warm_up_compiles_all_variants(monkeypatch, num_decode_steps):
     # None means the best-effort except path fired — in this controlled
     # environment that's a broken call sequence, not a hardware limit.
     assert n is not None, "warm-up fell back to lazy compilation"
-    # Per warmed width bucket: single-step + (fused if K>1); plus one
-    # fetch_indices variant on the first width.
+    # Per warmed (width, sampler-variant): single-step + (fused if K>1);
+    # two sampler variants (greedy fast path + sampled); plus one
+    # fetch_indices variant on the first width (greedy only).
     n_widths = len(worker.model_runner.block_width_buckets[:2])
-    per_width = 2 if num_decode_steps > 1 else 1
-    assert n == n_widths * per_width + 1
+    per_combo = 2 if num_decode_steps > 1 else 1
+    assert n == n_widths * 2 * per_combo + 1
 
 
 def test_warm_up_skipped_on_cpu():
@@ -64,13 +67,17 @@ def test_warm_up_skipped_on_cpu():
 
 
 def test_warm_up_full_covers_every_batch_bucket(monkeypatch):
-    """INTELLILLM_WARMUP_FULL=1 sweeps every batch bucket so no
-    (bs, width) decode executable is left to compile mid-serving."""
-    worker = _make_worker(num_decode_steps=4)
+    """INTELLILLM_WARMUP_FULL=1 sweeps every batch bucket AND every
+    width bucket so no (bs, width) decode executable is left to compile
+    mid-serving."""
+    worker = _make_worker(num_decode_steps=4, max_model_len=1024)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     monkeypatch.setenv("INTELLILLM_WARMUP_FULL", "1")
     n = worker.warm_up_model()
     assert n is not None
     buckets = worker.model_runner.batch_buckets  # 1,2,4,8 for max_seqs=8
-    n_widths = len(worker.model_runner.block_width_buckets[:2])
-    assert n == len(buckets) * n_widths * 2 + 1
+    # Full mode must cover ALL width buckets (>2 of them at mml=1024:
+    # 16/32/64), two sampler variants, single+fused per combo.
+    n_widths = len(worker.model_runner.block_width_buckets)
+    assert n_widths > 2
+    assert n == len(buckets) * n_widths * 2 * 2 + 1
