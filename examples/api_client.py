@@ -1,0 +1,49 @@
+"""Client for the simple /generate server.
+
+Role parity: reference `examples/api_client.py`. Start the server first:
+
+    python -m intellillm_tpu.entrypoints.api_server --model <model> &
+    python examples/api_client.py --prompt "hello my name is" --stream
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+import requests
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="localhost")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--prompt", default="hello my name is")
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--n", type=int, default=1)
+    ap.add_argument("--stream", action="store_true")
+    args = ap.parse_args()
+
+    url = f"http://{args.host}:{args.port}/generate"
+    payload = {
+        "prompt": args.prompt,
+        "n": args.n,
+        "temperature": args.temperature,
+        "max_tokens": args.max_tokens,
+        "stream": args.stream,
+    }
+    resp = requests.post(url, json=payload, stream=args.stream)
+    resp.raise_for_status()
+    if args.stream:
+        for chunk in resp.iter_lines(decode_unicode=True):
+            if not chunk:
+                continue
+            data = json.loads(chunk)
+            print(data["text"][0], flush=True)
+    else:
+        for i, text in enumerate(resp.json()["text"]):
+            print(f"[{i}] {text}")
+
+
+if __name__ == "__main__":
+    main()

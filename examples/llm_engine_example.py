@@ -1,0 +1,48 @@
+"""Drive LLMEngine directly (add_request / step loop).
+
+Role parity: reference `examples/llm_engine_example.py` — the low-level
+engine API under the `LLM` convenience wrapper, useful when you want
+custom admission timing or per-step visibility.
+
+    python examples/llm_engine_example.py --model /tmp/tiny-opt \
+        --max-model-len 128 --num-device-blocks-override 128
+"""
+from __future__ import annotations
+
+import argparse
+
+from intellillm_tpu.engine.arg_utils import EngineArgs
+from intellillm_tpu.engine.llm_engine import LLMEngine
+from intellillm_tpu.sampling_params import SamplingParams
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser = EngineArgs.add_cli_args(parser)
+    args = parser.parse_args()
+    engine = LLMEngine.from_engine_args(EngineArgs.from_cli_args(args))
+
+    test_prompts = [
+        ("the capital of france is",
+         SamplingParams(temperature=0.0, max_tokens=24)),
+        ("hello my name is",
+         SamplingParams(temperature=0.8, top_k=40, max_tokens=24)),
+        ("the president of the united states is",
+         SamplingParams(n=2, best_of=4, temperature=0.9, max_tokens=24)),
+    ]
+
+    request_id = 0
+    while test_prompts or engine.has_unfinished_requests():
+        if test_prompts:
+            prompt, params = test_prompts.pop(0)
+            engine.add_request(str(request_id), prompt, params)
+            request_id += 1
+        for out in engine.step():
+            if out.finished:
+                for c in out.outputs:
+                    print(f"[req {out.request_id}] {out.prompt!r} -> "
+                          f"{c.text!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Shared-prefix caching demo.
+
+Role parity: reference `examples/offline_inference_with_prefix.py` — a
+batch of prompts sharing a long instruction prefix computes the prefix
+KV once (`prefix_pos`) and reuses it for every later request.
+
+    python examples/offline_inference_with_prefix.py --model /tmp/tiny-opt
+"""
+from __future__ import annotations
+
+import argparse
+
+from intellillm_tpu import LLM, SamplingParams
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--max-model-len", type=int, default=None)
+    ap.add_argument("--num-device-blocks-override", type=int, default=None)
+    args = ap.parse_args()
+
+    prefix = ("you are a model that continues text and the text that "
+              "comes after this line is what you continue ")
+    prompts = [
+        "hello my name is",
+        "the president of the united states is",
+        "the capital of france is",
+    ]
+
+    llm = LLM(model=args.model, max_model_len=args.max_model_len,
+              num_device_blocks_override=args.num_device_blocks_override)
+    params = SamplingParams(temperature=0.0, max_tokens=16)
+
+    generating = [prefix + p for p in prompts]
+    # Tokenize the prefix once to find the shared boundary (prefix_pos
+    # must fall on a token boundary common to all prompts).
+    prefix_len = len(llm.get_tokenizer().encode(prefix.strip()))
+
+    # First request computes and caches the prefix KV...
+    first = llm.generate(generating[:1], params, prefix_pos=prefix_len)
+    # ...later requests reuse the cached prefix blocks.
+    rest = llm.generate(generating[1:], params, prefix_pos=prefix_len)
+
+    for out in first + rest:
+        print(f"{out.prompt[len(prefix):]!r} -> {out.outputs[0].text!r}")
+
+
+if __name__ == "__main__":
+    main()

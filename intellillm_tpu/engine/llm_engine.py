@@ -242,6 +242,16 @@ class LLMEngine:
                     "logits_processors must be callables taking "
                     "(output_token_ids, logits_row numpy array) and "
                     "returning a logits row.")
+        if sp.logits_processors and sp.temperature >= 1e-5:
+            # Known divergence for reference migrators (PARITY.md §2.2):
+            # processor-bearing rows sample on the HOST from a numpy
+            # Gumbel stream, so at temperature>0 the tokens differ from
+            # the same request without processors (greedy is identical).
+            logger.warning(
+                "Request attaches logits_processors with temperature>0: "
+                "sampling uses the host RNG stream for this request, so "
+                "tokens will differ from an identical processor-free "
+                "request (greedy output is unaffected).")
         from intellillm_tpu.layers.sampler import LOGPROB_K_BUCKETS
         if (sp.prompt_logprobs is not None
                 and sp.prompt_logprobs > LOGPROB_K_BUCKETS[-1]):
