@@ -14,16 +14,20 @@ gather reference elsewhere.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import struct
 
+from intellillm_tpu.logger import init_logger
 from intellillm_tpu.ops.attention import (context_attention_reference,
                                           decode_attention_reference,
                                           prefill_attention_reference)
 from intellillm_tpu.ops.kv_cache import reshape_and_cache
+
+logger = init_logger(__name__)
 
 _SUPPORTED_HEAD_SIZES = (64, 80, 96, 112, 128, 256)
 
@@ -116,15 +120,32 @@ class PagedAttention:
                     new_lens, self.scale, self.alibi_slopes,
                     self.sliding_window)
             elif attn_metadata.sp is not None:
-                # Ring attention over the mesh seq axis: K/V shards rotate
-                # via ppermute, each device accumulates its query shard
-                # with an online softmax — exact causal attention with
-                # O(L/N) peak activation memory per chip.
-                from intellillm_tpu.ops.ring_attention import ring_attention
+                # Sequence-parallel prefill over the mesh seq axis.
+                # Default: ring attention (ppermute K/V rotation, online
+                # softmax, O(L/N) peak activations — scales to any
+                # length). INTELLILLM_SP_MODE=ulysses switches to the
+                # all-to-all layout (2 a2a hops + one dense attention per
+                # head shard — fewer collectives while the full-sequence
+                # KV still fits a chip and kv heads divide the axis).
                 mesh, axis = attn_metadata.sp
-                out = ring_attention(query, key, value, mesh, axis,
-                                     scale=self.scale, causal=True,
-                                     head_axis="model")
+                mode = os.environ.get("INTELLILLM_SP_MODE", "ring").lower()
+                hkv = key.shape[2]
+                if mode == "ulysses" and hkv % mesh.shape[axis] == 0:
+                    from intellillm_tpu.ops.ulysses_attention import (
+                        ulysses_attention)
+                    out = ulysses_attention(query, key, value, mesh, axis,
+                                            scale=self.scale, causal=True)
+                else:
+                    if mode == "ulysses":
+                        logger.warning(
+                            "INTELLILLM_SP_MODE=ulysses needs kv heads "
+                            "(%d) divisible by the seq axis (%d); using "
+                            "ring attention.", hkv, mesh.shape[axis])
+                    from intellillm_tpu.ops.ring_attention import (
+                        ring_attention)
+                    out = ring_attention(query, key, value, mesh, axis,
+                                         scale=self.scale, causal=True,
+                                         head_axis="model")
             else:
                 out = _prefill_dispatch(query, key, value,
                                         attn_metadata.context_lens,

@@ -79,3 +79,36 @@ def test_sp_threshold_not_triggered_for_short_prompts(tiny_llama_dir):
     finally:
         ring_mod.ring_attention = orig
     assert calls["n"] == 0
+
+@requires_8_devices
+def test_sp_prefill_ulysses_mode_matches_single_device(tiny_llama_dir,
+                                                       monkeypatch):
+    """INTELLILLM_SP_MODE=ulysses routes the SP prefill through the
+    all-to-all path; tokens must still match the single-device run.
+    (tiny-llama has 2 kv heads — use dp=2 so heads divide the axis.)"""
+    monkeypatch.setenv("INTELLILLM_SP_MODE", "ulysses")
+    long_prompt = " ".join(["the cat runs fast and the dog is slow"] * 12)
+    params = SamplingParams(temperature=0.0, max_tokens=12)
+
+    ref = [o.outputs[0].token_ids
+           for o in _llm(tiny_llama_dir).generate([long_prompt], params)]
+
+    import intellillm_tpu.ops.ulysses_attention as ul_mod
+    calls = {"n": 0}
+    orig = ul_mod.ulysses_attention
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    ul_mod.ulysses_attention = counting
+    try:
+        llm = _llm(tiny_llama_dir, data_parallel_size=2,
+                   sp_prefill_threshold=48, max_paddings=40)
+        got = [o.outputs[0].token_ids
+               for o in llm.generate([long_prompt], params)]
+    finally:
+        ul_mod.ulysses_attention = orig
+
+    assert calls["n"] > 0, "ulysses path never engaged"
+    assert got == ref
