@@ -24,12 +24,100 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
 
 BASELINE_TOK_S_PER_CHIP = 250.0
+
+# Filled in as the bench progresses so the failure/watchdog paths can
+# report how far we got (warmup throughput, phase reached, retries).
+_PROGRESS = {"phase": "start", "probe": [], "warmup_tok_s": None}
+
+
+def _fail_record(reason: str, exit_code: int | None = None):
+    """Print the structured failure record (one JSON line, driver-parseable).
+
+    Role model: reference `.buildkite/run-benchmarks.sh` — CI that always
+    produces an annotation, even on failure. Round 4 lost its headline to a
+    single un-retried `jax.devices()` UNAVAILABLE; this record plus the
+    probe retries below make that unlosable.
+    """
+    rec = {
+        "metric": "error",
+        "value": _PROGRESS.get("warmup_tok_s") or 0,
+        "unit": "tok/s/chip (warmup partial)" if _PROGRESS.get(
+            "warmup_tok_s") else reason[:200],
+        "vs_baseline": round((_PROGRESS.get("warmup_tok_s") or 0)
+                             / BASELINE_TOK_S_PER_CHIP, 3),
+        "error": reason[:500],
+        "phase": _PROGRESS["phase"],
+        "probe_attempts": _PROGRESS["probe"],
+    }
+    print(json.dumps(rec), flush=True)
+    if exit_code is not None:
+        # os._exit: the watchdog fires when the process is wedged inside a
+        # non-interruptible runtime call; sys.exit would never unwind.
+        os._exit(exit_code)
+
+
+def probe_backend(attempts: int = 3, backoff_s: float = 60.0,
+                  probe_timeout_s: float = 300.0) -> bool:
+    """Probe the TPU backend in a SUBPROCESS with retry + backoff.
+
+    A wedged axon tunnel makes `jax.devices()` hang indefinitely with no
+    way to interrupt it in-process, and a failed in-process init is cached
+    by jax — so the probe runs out-of-process (also respecting the
+    one-TPU-process-at-a-time constraint: the probe fully exits before the
+    main process initializes the backend).
+    """
+    attempts = int(os.environ.get("INTELLILLM_BENCH_PROBE_ATTEMPTS",
+                                  attempts))
+    backoff_s = float(os.environ.get("INTELLILLM_BENCH_PROBE_BACKOFF",
+                                     backoff_s))
+    probe_timeout_s = float(os.environ.get(
+        "INTELLILLM_BENCH_PROBE_TIMEOUT", probe_timeout_s))
+    for i in range(attempts):
+        t0 = time.time()
+        rec = {"attempt": i + 1, "ok": False, "elapsed_s": 0.0, "err": ""}
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); print(d[0].platform)"],
+                capture_output=True, text=True, timeout=probe_timeout_s)
+            rec["ok"] = r.returncode == 0
+            if not rec["ok"]:
+                tail = (r.stderr.strip().splitlines() or ["unknown"])[-1]
+                rec["err"] = tail[:300]
+            else:
+                rec["platform"] = r.stdout.strip()
+        except subprocess.TimeoutExpired:
+            rec["err"] = f"probe hung > {probe_timeout_s:.0f}s (killed)"
+        except Exception as e:  # noqa: BLE001 - record and retry
+            rec["err"] = repr(e)[:300]
+        rec["elapsed_s"] = round(time.time() - t0, 1)
+        _PROGRESS["probe"].append(rec)
+        print(f"[bench] backend probe {rec}", file=sys.stderr, flush=True)
+        if rec["ok"]:
+            return True
+        if i < attempts - 1:
+            time.sleep(backoff_s)
+    return False
+
+
+def _start_watchdog(limit_s: float):
+    """Emit the failure record and hard-exit if the bench wedges mid-run."""
+    def _fire():
+        _fail_record(f"watchdog: bench exceeded {limit_s:.0f}s "
+                     f"(wedged in phase '{_PROGRESS['phase']}')",
+                     exit_code=3)
+    t = threading.Timer(limit_s, _fire)
+    t.daemon = True
+    t.start()
+    return t
 
 SIZES = {
     # (hidden, inter, layers, heads, kv_heads, vocab)
@@ -141,19 +229,66 @@ def main():
     num_blocks = int(os.environ.get("INTELLILLM_BENCH_BLOCKS", num_blocks))
     vocab = SIZES[size][5]
 
+    _start_watchdog(float(os.environ.get("INTELLILLM_BENCH_WATCHDOG_S",
+                                         "2700")))
+
+    _PROGRESS["phase"] = "probe"
+    if not probe_backend():
+        _fail_record("TPU backend unavailable after all probe retries")
+        sys.exit(1)
+
+    _PROGRESS["phase"] = "build_engine"
     try:
         engine = build_engine(size, batch_size, max_model_len, num_blocks,
                               quantization=quant, cache_dtype=kv_dtype)
     except Exception as e:
-        print(json.dumps({"metric": "error", "value": 0, "unit": str(e),
-                          "vs_baseline": 0.0}))
-        raise
+        # Only a backend-availability error is worth a 60s-sleep retry
+        # (the probe succeeded moments ago, so it would be a transient
+        # tunnel blip); config/OOM errors are deterministic — fail fast.
+        msg = str(e)
+        transient = ("UNAVAILABLE" in msg or "backend" in msg.lower()
+                     or "DEADLINE" in msg)
+        if not transient:
+            _fail_record(f"build_engine failed (non-transient): {e!r}")
+            raise
+        print(f"[bench] build_engine failed ({e!r}); retrying in 60s",
+              file=sys.stderr, flush=True)
+        time.sleep(60)
+        try:
+            import jax.extend.backend
+            jax.extend.backend.clear_backends()
+        except Exception as ce:
+            # Without the cache clear, jax re-raises the cached init
+            # failure and the retry below is useless — say so.
+            print(f"[bench] clear_backends unavailable ({ce!r}); retry "
+                  f"may hit jax's cached init failure", file=sys.stderr,
+                  flush=True)
+        try:
+            engine = build_engine(size, batch_size, max_model_len,
+                                  num_blocks, quantization=quant,
+                                  cache_dtype=kv_dtype)
+        except Exception as e2:
+            _fail_record(f"build_engine failed twice: {e2!r}")
+            raise
 
     # Warmup: compile prefill+decode buckets on a short run.
-    run(engine, batch_size, input_len, 4, vocab)
+    _PROGRESS["phase"] = "warmup"
+    try:
+        w_tokens, w_elapsed = run(engine, batch_size, input_len, 4, vocab)
+    except Exception as e:
+        _fail_record(f"warmup run failed: {e!r}")
+        raise
+    if w_elapsed > 0:
+        _PROGRESS["warmup_tok_s"] = round(w_tokens / w_elapsed, 2)
 
-    out_tokens, elapsed = run(engine, batch_size, input_len, output_len,
-                              vocab)
+    _PROGRESS["phase"] = "measure"
+    try:
+        out_tokens, elapsed = run(engine, batch_size, input_len,
+                                  output_len, vocab)
+    except Exception as e:
+        _fail_record(f"measured run failed after warmup: {e!r}")
+        raise
+    _PROGRESS["phase"] = "done"
     tok_s = out_tokens / elapsed
     family = "mixtral" if size == "moe" else "llama2"
     print(json.dumps({
