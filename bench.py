@@ -192,9 +192,11 @@ def run(engine, batch_size: int, input_len: int, output_len: int,
             prompt_token_ids=rng.integers(0, vocab, input_len).tolist(),
         )
     out_tokens = 0
+    pipelined = engine.pipeline_enabled
     start = time.perf_counter()
-    while engine.has_unfinished_requests():
-        for ro in engine.step():
+    while engine.has_unfinished_requests() or engine.has_inflight():
+        ros = engine.step_pipelined() if pipelined else engine.step()
+        for ro in ros:
             if ro.finished:
                 out_tokens += sum(len(c.token_ids) for c in ro.outputs)
     elapsed = time.perf_counter() - start

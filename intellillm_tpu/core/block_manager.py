@@ -191,6 +191,42 @@ class BlockSpaceManager:
                 block_table.append(self.device_allocator.allocate())
         return cows
 
+    def can_grow_all(self, targets: List[Tuple[int, int]]) -> bool:
+        """Whether `grow_to` would succeed for EVERY (seq_id, target_len)
+        pair without dipping below the watermark — the shortfalls sum, so
+        a per-row check would over-admit. Used by the pipelined decode
+        continuation, whose host sequence lengths lag the device by the
+        in-flight fused steps — targets are explicit token counts, not
+        `seq.get_len()`."""
+        total_short = 0
+        for seq_id, target_len in targets:
+            block_table = self.block_tables.get(seq_id)
+            if block_table is None:
+                return False
+            needed = (target_len + self.block_size - 1) // self.block_size
+            if self.block_sliding_window is not None:
+                needed = min(needed, self.block_sliding_window)
+            total_short += max(0, needed - len(block_table))
+        return total_short <= (self.device_allocator.get_num_free_blocks()
+                               - self.watermark_blocks)
+
+    def grow_to(self, seq_id: int, target_len: int) -> List[int]:
+        """Extend a sequence's block table to cover `target_len` tokens and
+        return the block-number table. No copy-on-write handling: the
+        continuation path only runs for sequences whose trailing block is
+        private (the first post-prefill decode step, which goes through
+        `append_slots`, resolves any fork sharing)."""
+        block_table = self.block_tables[seq_id]
+        needed = (target_len + self.block_size - 1) // self.block_size
+        while len(block_table) < needed:
+            if (self.block_sliding_window
+                    and len(block_table) >= self.block_sliding_window):
+                block_table.append(
+                    block_table[len(block_table) % self.block_sliding_window])
+            else:
+                block_table.append(self.device_allocator.allocate())
+        return [b.block_number for b in block_table]
+
     def fork(self, parent_seq: Sequence, child_seq: Sequence) -> None:
         src_block_table = self.block_tables[parent_seq.seq_id]
         self.block_tables[child_seq.seq_id] = src_block_table.copy()

@@ -97,6 +97,14 @@ class Scheduler:
         self.running: Deque[SequenceGroup] = deque()
         self.swapped: Deque[SequenceGroup] = deque()
 
+        # Pipelined-decode free guard: while a dispatched-but-unfetched
+        # device step still references a sequence's KV pages, freeing
+        # them would let a chained prefill reuse pages the in-flight
+        # step's commit will scribble over. Guarded seqs' frees are
+        # deferred until the engine unguards them (see LLMEngine pipeline).
+        self._free_guard: Dict[int, int] = {}       # seq_id -> refcount
+        self._deferred_free: Dict[int, Sequence] = {}
+
     @property
     def lora_enabled(self) -> bool:
         return self.lora_config is not None
@@ -148,7 +156,7 @@ class Scheduler:
 
     # --- the scheduling pass --------------------------------------------
 
-    def _schedule(self) -> SchedulerOutputs:
+    def _schedule(self, prefill_only: bool = False) -> SchedulerOutputs:
         blocks_to_swap_in: Dict[int, int] = {}
         blocks_to_swap_out: Dict[int, int] = {}
         blocks_to_copy: Dict[int, List[int]] = {}
@@ -256,6 +264,17 @@ class Scheduler:
                     ignored_seq_groups=ignored_seq_groups,
                 )
 
+        if prefill_only:
+            # Pipelined admission: the caller only wants prompts it can
+            # chain behind in-flight decode steps. No decode side effects
+            # (no re-sort, no preemption, no swap planning) may run with
+            # device steps still unfetched.
+            return SchedulerOutputs(
+                scheduled_seq_groups=[], prompt_run=True,
+                num_batched_tokens=0, blocks_to_swap_in={},
+                blocks_to_swap_out={}, blocks_to_copy={},
+                ignored_seq_groups=[])
+
         # Decode step. Highest-priority groups keep their blocks; the
         # lowest-priority running groups get preempted when memory runs out.
         self.running = deque(self.policy.sort_by_priority(now, self.running))
@@ -347,8 +366,10 @@ class Scheduler:
             num_decode_steps=num_steps,
         )
 
-    def schedule(self) -> Tuple[List[SequenceGroupMetadata], SchedulerOutputs]:
-        scheduler_outputs = self._schedule()
+    def schedule(
+        self, prefill_only: bool = False,
+    ) -> Tuple[List[SequenceGroupMetadata], SchedulerOutputs]:
+        scheduler_outputs = self._schedule(prefill_only=prefill_only)
 
         seq_group_metadata_list: List[SequenceGroupMetadata] = []
         for seq_group in scheduler_outputs.scheduled_seq_groups:
@@ -373,10 +394,36 @@ class Scheduler:
         self.block_manager.fork(parent_seq, child_seq)
 
     def free_seq(self, seq: Sequence) -> None:
+        if self._free_guard.get(seq.seq_id, 0) > 0:
+            self._deferred_free[seq.seq_id] = seq
+            return
         self.block_manager.free(seq)
 
     def free_finished_seq_groups(self) -> None:
         self.running = deque(sg for sg in self.running if not sg.is_finished())
+
+    # --- pipelined-decode support ----------------------------------------
+
+    def guard_seqs(self, seq_ids: Iterable[int]) -> None:
+        for sid in seq_ids:
+            self._free_guard[sid] = self._free_guard.get(sid, 0) + 1
+
+    def unguard_seqs(self, seq_ids: Iterable[int]) -> None:
+        for sid in seq_ids:
+            n = self._free_guard.get(sid, 0) - 1
+            if n > 0:
+                self._free_guard[sid] = n
+                continue
+            self._free_guard.pop(sid, None)
+            seq = self._deferred_free.pop(sid, None)
+            if seq is not None:
+                self.block_manager.free(seq)
+
+    def can_continue_decode(self) -> bool:
+        """Whether the current decode batch may be extended in place (same
+        rows, host state lagging) without a fresh scheduling pass: nothing
+        waiting for admission, nothing swapped out awaiting swap-in."""
+        return not self.waiting and not self.swapped
 
     # --- internals -------------------------------------------------------
 
@@ -438,6 +485,13 @@ class Scheduler:
         seqs = seq_group.get_seqs(status=SequenceStatus.RUNNING)
         assert len(seqs) == 1
         for seq in seqs:
+            # Recompute re-prefills from scratch, so the pages must really
+            # free NOW — a deferred free would leave the re-prefill
+            # double-allocated. The engine only runs a full (preempting)
+            # scheduling pass with the pipeline drained, so no guard can
+            # be active here.
+            assert self._free_guard.get(seq.seq_id, 0) == 0, (
+                "preempt-by-recompute hit a pipeline-guarded sequence")
             seq.status = SequenceStatus.WAITING
             self.block_manager.free(seq)
         # Highest-priority among waiting: front of the queue.

@@ -221,15 +221,18 @@ class AsyncLLMEngine:
         if finished_requests:
             self.engine.abort_request(finished_requests)
 
-        # The device step blocks; run it off-loop.
+        # The device step blocks; run it off-loop. step_pipelined keeps
+        # the device busy across the fetch RTT (see llm_engine.py).
         loop = asyncio.get_event_loop()
-        request_outputs = await loop.run_in_executor(None, self.engine.step)
+        step_fn = (self.engine.step_pipelined
+                   if self.engine.pipeline_enabled else self.engine.step)
+        request_outputs = await loop.run_in_executor(None, step_fn)
 
         for request_output in request_outputs:
             self._request_tracker.process_request_output(
                 request_output, verbose=self.log_requests)
 
-        return len(request_outputs) > 0
+        return len(request_outputs) > 0 or self.engine.has_inflight()
 
     async def run_engine_loop(self) -> None:
         has_requests_in_progress = False

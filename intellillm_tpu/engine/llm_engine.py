@@ -13,6 +13,7 @@ block counts → allocate pool → warm up).
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Iterable, List, Optional, Tuple, Union
 
 from intellillm_tpu.config import (CacheConfig, LoRAConfig, ModelConfig,
@@ -100,6 +101,18 @@ class LLMEngine:
         self.stat_logger = StatLogger(
             local_interval=_LOG_STATS_INTERVAL,
             labels=dict(model_name=model_config.model)) if log_stats else None
+
+        # Pipelined stepping (step_pipelined): keep up to `depth` device
+        # steps dispatched-but-unfetched so the device→host fetch (one
+        # network RTT in tunneled setups) and host post-processing overlap
+        # with device compute. INTELLILLM_PIPELINE=0 disables.
+        import os as _os
+        from intellillm_tpu.utils import pipeline_enabled_env
+        self.pipeline_enabled = pipeline_enabled_env()
+        self._pipeline_depth = max(
+            1, int(_os.environ.get("INTELLILLM_PIPELINE_DEPTH", "2")))
+        self._inflight: deque = deque()
+        self._pending_outputs: List[RequestOutput] = []
 
     # --- init ------------------------------------------------------------
 
@@ -310,6 +323,9 @@ class LLMEngine:
     # --- the hot loop -----------------------------------------------------
 
     def step(self) -> List[RequestOutput]:
+        assert not self._inflight, (
+            "serial step() called with pipelined steps in flight; use "
+            "step_pipelined() or drain_pipeline() first")
         seq_group_metadata_list, scheduler_outputs = self.scheduler.schedule()
 
         if not scheduler_outputs.is_empty():
@@ -324,6 +340,156 @@ class LLMEngine:
             outputs = []
 
         return self._process_model_outputs(outputs, scheduler_outputs)
+
+    # --- pipelined stepping ----------------------------------------------
+    #
+    # step() is strictly serial: schedule → dispatch → fetch → process. On
+    # a TPU behind a network tunnel the fetch alone costs ~1 RTT, and host
+    # post-processing (detokenize, stop checks, streaming) serializes with
+    # device compute — the chip idles roughly half of every step.
+    # step_pipelined() keeps up to `depth` device steps dispatched but
+    # unfetched:
+    #   - decode→decode: a continuation program slices its input tokens
+    #     from the previous step's ON-DEVICE packed output, so the host
+    #     never needs step N's results to dispatch step N+1 (the host's
+    #     view of sequence state intentionally trails the device);
+    #   - prompt admission chains on the in-flight cache futures (XLA
+    #     executes enqueued programs in order), so a new request never
+    #     waits for the pipeline to drain before its prefill starts;
+    #   - anything that needs a coherent host view (swap, preemption,
+    #     beam, penalties, K=1 batches) drains the pipeline first.
+    # KV pages referenced by in-flight steps are free-guarded in the
+    # scheduler: a sequence finishing host-side mid-pipeline stays a
+    # "zombie" row (its outputs are overshoot, discarded) and its pages
+    # are released only once the last referencing step is fetched.
+
+    def has_inflight(self) -> bool:
+        return bool(self._inflight)
+
+    def step_pipelined(self) -> List[RequestOutput]:
+        """Pipelined equivalent of step(): dispatches as much device work
+        as the pipeline depth allows, then fetches + processes the oldest
+        in-flight step. Returns [] only when fully idle."""
+        while len(self._inflight) < self._pipeline_depth:
+            if not self._pipeline_dispatch_one():
+                break
+        if not self._inflight:
+            pending, self._pending_outputs = self._pending_outputs, []
+            return pending
+        return self._finalize_one()
+
+    def drain_pipeline(self) -> List[RequestOutput]:
+        outs: List[RequestOutput] = []
+        while self._inflight:
+            outs.extend(self._finalize_one())
+        return outs
+
+    def _pipeline_dispatch_one(self) -> bool:
+        sched = self.scheduler
+        # New prompts admit immediately, chained behind in-flight steps.
+        if sched.waiting and not sched.swapped:
+            metas, so = sched.schedule(prefill_only=True)
+            if so.ignored_seq_groups and not metas:
+                # Rejected without device work (over-long prompts):
+                # surface their outputs with the next batch returned.
+                self._pending_outputs.extend(
+                    self._process_model_outputs([], so))
+                return True
+            if metas:
+                self._dispatch(metas, so)
+                return True
+            if self._inflight:
+                return False  # memory-blocked: drain, then full schedule
+        elif (self._inflight and self._inflight[-1].cont_state is not None
+                and sched.running and sched.can_continue_decode()):
+            if self._dispatch_cont():
+                return True
+            return False  # out of blocks for in-place growth: drain
+        if self._inflight:
+            return False
+        # Pipeline empty: full scheduling pass (may swap/preempt).
+        metas, so = sched.schedule()
+        if so.is_empty() and not metas:
+            if so.ignored_seq_groups:
+                self._pending_outputs.extend(
+                    self._process_model_outputs([], so))
+                return True
+            return False
+        if not metas:
+            # Swap-only plan (preemption emptied the running set): run
+            # the block ops eagerly — there is no device step to track.
+            self.worker.execute_model([], so.blocks_to_swap_in,
+                                      so.blocks_to_swap_out,
+                                      so.blocks_to_copy,
+                                      so.num_decode_steps)
+            self._pending_outputs.extend(
+                self._process_model_outputs([], so))
+            return True
+        self._dispatch(metas, so)
+        return True
+
+    def _dispatch(self, metas, scheduler_outputs) -> None:
+        step = self.worker.execute_model(
+            metas,
+            scheduler_outputs.blocks_to_swap_in,
+            scheduler_outputs.blocks_to_swap_out,
+            scheduler_outputs.blocks_to_copy,
+            scheduler_outputs.num_decode_steps,
+            defer_fetch=True,
+        )
+        seq_ids = [sid for m in metas for sid in m.seq_data]
+        self.scheduler.guard_seqs(seq_ids)
+        if step.cont_state is not None:
+            step.cont_state.groups = scheduler_outputs.scheduled_seq_groups
+        step._pipeline_seq_ids = seq_ids
+        step._pipeline_sched = scheduler_outputs
+        self._inflight.append(step)
+
+    def _dispatch_cont(self) -> bool:
+        prev = self._inflight[-1]
+        cont = prev.cont_state
+        k = cont.num_steps
+        lag = cont.steps_dispatched
+        mml = self.model_config.max_model_len
+        bm = self.scheduler.block_manager
+        targets = [(sid, min(int(cont.ctx0[i]) + lag + k - 1, mml))
+                   for i, (_, sid) in enumerate(cont.rows)]
+        if not bm.can_grow_all(targets):
+            return False
+        tables = [bm.grow_to(sid, target) for sid, target in targets]
+        step = self.worker.execute_decode_cont(cont, lag, tables,
+                                               prev.packed, prev.t1)
+        cont.steps_dispatched += k
+        seq_ids = [sid for _, sid in cont.rows]
+        self.scheduler.guard_seqs(seq_ids)
+        step._pipeline_seq_ids = seq_ids
+        step._pipeline_sched = SchedulerOutputs(
+            scheduled_seq_groups=cont.groups, prompt_run=False,
+            num_batched_tokens=len(cont.rows), blocks_to_swap_in={},
+            blocks_to_swap_out={}, blocks_to_copy={},
+            ignored_seq_groups=[], num_decode_steps=k)
+        self._inflight.append(step)
+        return True
+
+    def _finalize_one(self) -> List[RequestOutput]:
+        step = self._inflight.popleft()
+        # Groups that finished at an EARLIER finalize still appear in this
+        # step's (pre-dispatched) group snapshot; their rows are overshoot
+        # zombies — don't re-emit their finished outputs.
+        already_done = {
+            g.request_id
+            for g in step._pipeline_sched.scheduled_seq_groups
+            if g.is_finished()}
+        outputs = step.finalize()
+        request_outputs = self._process_model_outputs(outputs,
+                                                      step._pipeline_sched)
+        self.scheduler.unguard_seqs(step._pipeline_seq_ids)
+        request_outputs = [ro for ro in request_outputs
+                           if ro.request_id not in already_done]
+        if self._pending_outputs:
+            pending, self._pending_outputs = self._pending_outputs, []
+            return pending + request_outputs
+        return request_outputs
 
     def _process_model_outputs(
         self,

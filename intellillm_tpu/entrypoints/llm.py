@@ -124,8 +124,11 @@ class LLM:
             except ImportError:
                 pass
         outputs: List[RequestOutput] = []
-        while self.llm_engine.has_unfinished_requests():
-            step_outputs = self.llm_engine.step()
+        pipelined = self.llm_engine.pipeline_enabled
+        while (self.llm_engine.has_unfinished_requests()
+               or self.llm_engine.has_inflight()):
+            step_outputs = (self.llm_engine.step_pipelined() if pipelined
+                            else self.llm_engine.step())
             for output in step_outputs:
                 if output.finished:
                     outputs.append(output)

@@ -165,6 +165,15 @@ def parse_env_flag(raw):
     return None
 
 
+def pipeline_enabled_env() -> bool:
+    """Single source of truth for the INTELLILLM_PIPELINE flag (default
+    on) — the engine's stepping mode and the worker's continuation-program
+    warm-up must agree, or the first pipelined step pays a mid-serving
+    XLA compile."""
+    flag = parse_env_flag(os.environ.get("INTELLILLM_PIPELINE"))
+    return True if flag is None else flag
+
+
 def enable_persistent_compilation_cache() -> None:
     """Point JAX's persistent compilation cache at a local directory so
     engine restarts skip recompiling the decode/prefill executables

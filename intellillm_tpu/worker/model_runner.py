@@ -59,6 +59,73 @@ _SAMPLE_BUCKETS = (1, 2, 4, 8, 16)
 _SEED_STRIDE = np.uint32(0x9E3779B9)  # per-substep seed fold
 
 
+class DecodeContState:
+    """Row snapshot of a fused decode batch, enabling in-place continuation
+    steps whose input tokens come from the PREVIOUS step's on-device
+    output (pipelined decode: dispatch step N+1 before fetching step N,
+    hiding the device→host fetch latency behind device compute).
+
+    Host sequence state lags the device by the un-fetched steps, so the
+    snapshot carries everything a continuation needs numerically:
+    context lengths / output lengths at the fresh dispatch, row order,
+    params. Rows whose sequence finishes host-side mid-pipeline stay in
+    the batch as zombies (their outputs are overshoot, discarded by the
+    engine; their KV pages are free-guarded by the scheduler)."""
+
+    def __init__(self, metas, rows, ctx0, out_lens0, row_params, row_loras,
+                 num_steps):
+        self.metas = metas              # original metadata list (reused)
+        self.rows = rows                # [(request_id, seq_id)] row order
+        self.ctx0 = ctx0                # np [B] padded ctx at fresh prep
+        self.out_lens0 = out_lens0      # per live row output len at prep
+        self.row_params = row_params
+        self.row_loras = row_loras
+        self.num_steps = num_steps      # K of the fused program
+        self.groups = None              # engine fills: scheduled groups
+        self.steps_dispatched = num_steps  # device steps since fresh prep
+
+
+class InflightStep:
+    """A dispatched-but-unfetched device step. `finalize()` performs the
+    single packed device→host fetch and builds the per-substep sampler
+    outputs — identical post-processing to the eager path, just split so
+    the engine can overlap it with the next dispatched step."""
+
+    def __init__(self, runner, packed, metas, rows, t1, t2, logprob_k,
+                 is_prompt, num_steps, proc=None, plp=None):
+        self.runner = runner
+        self.packed = packed            # device array (also the cont input)
+        self.metas = metas
+        self.rows = rows
+        self.t1 = t1
+        self.t2 = t2
+        self.logprob_k = logprob_k
+        self.is_prompt = is_prompt
+        self.num_steps = num_steps
+        self.proc = proc                # (proc_rows, fetched_dev, params, tokens, seeds)
+        self.plp = plp                  # (plp_device_array, plp_k, row_params)
+        self.cont_state: Optional[DecodeContState] = None
+
+    def finalize(self) -> List[SamplerOutput]:
+        r = self.runner
+        if self.plp is not None:
+            plp_dev, plp_k, plp_params = self.plp
+            r._attach_prompt_logprobs(np.asarray(plp_dev), plp_k,
+                                      self.metas, self.rows, plp_params)
+        packed = np.array(self.packed) if self.proc else np.asarray(
+            self.packed)
+        sampled, sampled_lp, topk_ids, topk_lp = r._unpack(
+            packed, self.t1, self.t2, self.logprob_k)
+        if self.proc:
+            proc_rows, fetched, row_params, row_tokens, row_seeds = self.proc
+            r._resample_processor_rows(
+                proc_rows, np.asarray(fetched), row_params, row_tokens,
+                row_seeds, sampled, sampled_lp, topk_ids, topk_lp, self.t1)
+        return r._process_sampling(self.metas, self.rows, sampled,
+                                   sampled_lp, topk_ids, topk_lp,
+                                   self.is_prompt, self.num_steps)
+
+
 class ModelRunner:
 
     def __init__(
@@ -127,6 +194,16 @@ class ModelRunner:
             self._decode_fn_single,
             static_argnames=("logprob_k", "do_topk", "do_topp", "do_minp",
                              "do_penalties", "do_random"),
+            donate_argnames=("kv_caches", ),
+        )
+        # Pipelined continuation: same fused program, but the input tokens
+        # are sliced on device from the PREVIOUS step's packed output —
+        # prev_packed is NOT donated (the host still fetches it later).
+        self._jit_decode_cont = jax.jit(
+            self._decode_cont_fn,
+            static_argnames=("prev_t1", "num_steps", "logprob_k", "do_topk",
+                             "do_topp", "do_minp", "do_penalties",
+                             "do_random"),
             donate_argnames=("kv_caches", ),
         )
 
@@ -291,6 +368,25 @@ class ModelRunner:
         if fetched is not None:
             extras += (fetched, )
         return (packed, ) + extras + (new_caches, )
+
+    def _decode_cont_fn(self, params, kv_caches, prev_packed, positions,
+                        block_tables, context_lens, temperatures, top_ks,
+                        top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
+                        prompt_tokens, output_tokens, lora=None, *,
+                        prev_t1, num_steps, logprob_k, do_topk, do_topp,
+                        do_minp, do_penalties, do_random=True):
+        """Continuation of a fused decode: input tokens = the last substep's
+        samples from the previous step's packed output (column prev_t1-1 of
+        the _pack layout), so the host never needs the previous step's
+        results to keep the device busy."""
+        token_ids = prev_packed[:, prev_t1 - 1:prev_t1]
+        return self._decode_fn(
+            params, kv_caches, token_ids, positions, block_tables,
+            context_lens, temperatures, top_ks, top_ps, min_ps, seeds,
+            pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens,
+            lora, num_steps=num_steps, logprob_k=logprob_k,
+            do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
+            do_penalties=do_penalties, do_random=do_random)
 
     def _decode_fn(self, params, kv_caches, token_ids, positions,
                    block_tables, context_lens, temperatures, top_ks, top_ps,
@@ -620,6 +716,37 @@ class ModelRunner:
         return jax.device_put(jnp.asarray(arr),
                               NamedSharding(self.mesh, spec))
 
+    def _activate_lora(self, row_loras, padded_n: int):
+        """Returns (lora_state, effective vocab width). Extra-vocab LoRA
+        widens the logits to vocab+extra; every sampling-tensor build must
+        use that width for the top_k "disabled" value and the penalty pad
+        sentinel (the sentinel would otherwise scatter into a REAL
+        extra-token column)."""
+        lora_state = None
+        if self.lora_manager is not None and row_loras is not None:
+            lora_state = self.lora_manager.set_active_loras(row_loras,
+                                                            padded_n)
+        eff_vocab = self.vocab_size
+        if lora_state is not None and "vocab" in lora_state:
+            eff_vocab += lora_state["vocab"]["extra_embed"].shape[1]
+        return lora_state, eff_vocab
+
+    def _sampling_args_device(self, st: SamplingTensors, padded_n: int):
+        """The positional device-arg tuple every step program takes after
+        context_lens — order must match _decode_fn/_prefill_fn."""
+        place = self._place_batch_array
+        zeros = np.zeros(padded_n, np.float32)
+        return (
+            place(st.temperatures), place(st.top_ks), place(st.top_ps),
+            place(st.min_ps), place(st.seeds),
+            place(st.presence_penalties if st.do_penalties else zeros),
+            place(st.frequency_penalties if st.do_penalties else zeros),
+            place(st.repetition_penalties if st.do_penalties
+                  else np.ones(padded_n, np.float32)),
+            place(st.prompt_tokens) if st.do_penalties else None,
+            place(st.output_tokens) if st.do_penalties else None,
+        )
+
     def _row_seed(self, seq_id: int, step: int) -> int:
         # Deterministic per (engine seed, sequence, step).
         h = (self.engine_seed * 0x9E3779B1 + seq_id * 0x85EBCA77 +
@@ -633,8 +760,12 @@ class ModelRunner:
         seq_group_metadata_list: List[SequenceGroupMetadata],
         kv_caches,
         num_decode_steps: int = 1,
-    ) -> Tuple[List[SamplerOutput], Any]:
-        """Returns (outputs_per_substep, new_kv_caches)."""
+        defer_fetch: bool = False,
+    ) -> Tuple[Any, Any]:
+        """Returns (outputs_per_substep, new_kv_caches) — or, with
+        `defer_fetch`, (InflightStep, new_kv_caches): the device step is
+        dispatched but its results not fetched, so the caller can overlap
+        the fetch with further dispatched work (pipelined decode)."""
         if not seq_group_metadata_list:
             return [], kv_caches
 
@@ -653,28 +784,21 @@ class ModelRunner:
         row_params: List[SamplingParams] = []
         row_seeds: List[int] = []
         row_tokens: List[Tuple[List[int], List[int]]] = []
+        row_out_lens: List[int] = []
         meta_by_req = {m.request_id: m for m in seq_group_metadata_list}
         for req_id, seq_id in rows:
             meta = meta_by_req[req_id]
             data = meta.seq_data[seq_id]
             row_params.append(meta.sampling_params)
+            row_out_lens.append(data.get_output_len())
             row_seeds.append(self._row_seed(seq_id, data.get_output_len()))
             row_tokens.append(data.token_views())
 
-        lora_state = None
+        row_loras = None
         if self.lora_manager is not None:
             row_loras = [meta_by_req[req_id].lora_request
                          for req_id, _ in rows]
-            lora_state = self.lora_manager.set_active_loras(
-                row_loras, padded_n)
-
-        # With extra-vocab LoRA the logits widen to vocab+extra; the
-        # sampling tensors must use that width for the top_k "disabled"
-        # value and the penalty pad sentinel (the sentinel value scatters
-        # into column `vocab` otherwise — a REAL extra-token column).
-        eff_vocab = self.vocab_size
-        if lora_state is not None and "vocab" in lora_state:
-            eff_vocab += lora_state["vocab"]["extra_embed"].shape[1]
+        lora_state, eff_vocab = self._activate_lora(row_loras, padded_n)
         st = SamplingTensors.build(row_params, row_seeds, row_tokens,
                                    eff_vocab, padded_n)
 
@@ -697,22 +821,12 @@ class ModelRunner:
             fetch_indices = np.zeros(m, np.int32)
             fetch_indices[:len(proc_rows)] = proc_rows
 
-        zeros = np.zeros(padded_n, np.float32)
         common = dict(
             logprob_k=st.logprob_k,
             do_topk=st.do_topk, do_topp=st.do_topp, do_minp=st.do_minp,
             do_penalties=st.do_penalties, do_random=st.do_random,
         )
-        sampling_args = (
-            place(st.temperatures), place(st.top_ks), place(st.top_ps),
-            place(st.min_ps), place(st.seeds),
-            place(st.presence_penalties if st.do_penalties else zeros),
-            place(st.frequency_penalties if st.do_penalties else zeros),
-            place(st.repetition_penalties if st.do_penalties
-                  else np.ones(padded_n, np.float32)),
-            place(st.prompt_tokens) if st.do_penalties else None,
-            place(st.output_tokens) if st.do_penalties else None,
-        )
+        sampling_args = self._sampling_args_device(st, padded_n)
 
         if is_prompt:
             # prompt_logprobs: bucketed panel width, 0 = not requested.
@@ -732,11 +846,8 @@ class ModelRunner:
                 prompt_logprob_k=plp_k, **common)
             result = list(result)
             packed = result.pop(0)
-            if plp_k:
-                self._attach_prompt_logprobs(
-                    np.asarray(result.pop(0)), plp_k,
-                    seq_group_metadata_list, rows, row_params)
-            fetched = np.asarray(result.pop(0)) if proc_rows else None
+            plp = (result.pop(0), plp_k, row_params) if plp_k else None
+            fetched = result.pop(0) if proc_rows else None
             new_caches = result.pop(0)
             t1, t2 = num_samples, 1
             num_steps = 1
@@ -756,6 +867,7 @@ class ModelRunner:
                 place(arrays["block_tables"]), place(arrays["context_lens"]),
                 *sampling_args, lora_state)
             fetched = None
+            plp = None
             if num_steps == 1:
                 result = self._jit_decode_single(
                     *decode_args,
@@ -763,7 +875,6 @@ class ModelRunner:
                     else None, **common)
                 if proc_rows:
                     packed, fetched, new_caches = result
-                    fetched = np.asarray(fetched)
                 else:
                     packed, new_caches = result
             else:
@@ -775,22 +886,83 @@ class ModelRunner:
                                                       **common)
             t1 = t2 = num_steps
 
-        # ONE device→host transfer for everything. (np.array: the host
-        # resample below writes into the unpacked views, and jax device
-        # arrays convert to read-only numpy.)
-        packed = np.array(packed) if proc_rows else np.asarray(packed)
-        sampled, sampled_lp, topk_ids, topk_lp = self._unpack(
-            packed, t1, t2, st.logprob_k)
+        # ONE device→host transfer for everything, performed by
+        # InflightStep.finalize() — immediately on the eager path, or
+        # overlapped with later dispatches on the pipelined path.
+        step = InflightStep(
+            self, packed, seq_group_metadata_list, rows, t1, t2,
+            st.logprob_k, is_prompt, num_steps,
+            proc=((proc_rows, fetched, row_params, row_tokens, row_seeds)
+                  if proc_rows else None),
+            plp=plp if is_prompt else None)
+        if not is_prompt and num_steps > 1:
+            step.cont_state = DecodeContState(
+                seq_group_metadata_list, rows,
+                arrays["context_lens"].copy(), row_out_lens, row_params,
+                row_loras, num_steps)
+        if defer_fetch:
+            return step, new_caches
+        return step.finalize(), new_caches
 
-        if proc_rows:
-            self._resample_processor_rows(
-                proc_rows, fetched, row_params, row_tokens, row_seeds,
-                sampled, sampled_lp, topk_ids, topk_lp, t1)
+    def execute_decode_cont(
+        self,
+        cont: DecodeContState,
+        lag: int,
+        tables: List[List[int]],
+        prev_packed,
+        prev_t1: int,
+        kv_caches,
+        defer_fetch: bool = True,
+    ) -> Tuple[Any, Any]:
+        """Dispatch a continuation step of a fused decode batch: same rows,
+        input tokens sliced on device from `prev_packed`, context lengths
+        advanced numerically by `lag` (the device steps since the fresh
+        prep — the host sequence state is allowed to trail). `tables` are
+        the per-row block tables already grown by the scheduler to cover
+        this step's writes."""
+        num_steps = cont.num_steps
+        b = cont.ctx0.shape[0]
+        mml = self.max_model_len
+        ctx = np.where(cont.ctx0 > 0,
+                       np.minimum(cont.ctx0 + lag, mml), 0).astype(np.int32)
+        positions = np.maximum(ctx - 1, 0).astype(np.int32)[:, None]
+        w = pad_to_bucket(max(max((len(t) for t in tables), default=1),
+                              _MIN_BLOCK_TABLE_WIDTH),
+                          self.block_width_buckets)
+        block_tables = np.zeros((b, w), np.int32)
+        for i, t in enumerate(tables):
+            block_tables[i, :len(t)] = t
 
-        outputs = self._process_sampling(seq_group_metadata_list, rows,
-                                         sampled, sampled_lp, topk_ids,
-                                         topk_lp, is_prompt, num_steps)
-        return outputs, new_caches
+        # Seeds advance exactly as a fresh (caught-up) dispatch would
+        # compute them, so pipelined sampling streams match unpipelined.
+        row_seeds = [self._row_seed(sid, cont.out_lens0[i] + lag)
+                     for i, (_, sid) in enumerate(cont.rows)]
+
+        lora_state, eff_vocab = self._activate_lora(cont.row_loras, b)
+        st = SamplingTensors.build(cont.row_params, row_seeds, None,
+                                   eff_vocab, b)
+        # The scheduler only emits K>1 fused batches for penalty-free,
+        # processor-free, non-beam rows — which is also what makes the
+        # continuation legal in the first place.
+        assert not st.do_penalties, (
+            "decode continuation dispatched for a penalty-bearing batch")
+
+        place = self._place_batch_array
+        sampling_args = self._sampling_args_device(st, b)
+        packed, new_caches = self._jit_decode_cont(
+            self.params, kv_caches, prev_packed, place(positions),
+            place(block_tables), place(ctx), *sampling_args, lora_state,
+            prev_t1=prev_t1, num_steps=num_steps,
+            logprob_k=st.logprob_k, do_topk=st.do_topk, do_topp=st.do_topp,
+            do_minp=st.do_minp, do_penalties=False,
+            do_random=st.do_random)
+
+        step = InflightStep(self, packed, cont.metas, cont.rows, num_steps,
+                            num_steps, st.logprob_k, False, num_steps)
+        step.cont_state = cont
+        if defer_fetch:
+            return step, new_caches
+        return step.finalize(), new_caches
 
     def _attach_prompt_logprobs(self, plp_packed, k, metas, rows,
                                 row_params):

@@ -334,6 +334,21 @@ class Worker:
                                 *args, num_steps=k, **flags)
                             self.cache_engine.device_cache = caches
                             n += 1
+                            from intellillm_tpu.utils import (
+                                pipeline_enabled_env)
+                            if pipeline_enabled_env():
+                                # Pipelined continuation program: same arg
+                                # shapes, tokens sliced from the previous
+                                # step's packed output (which the fused
+                                # warm-up call above just produced with
+                                # exactly the runtime shape/dtype).
+                                packed, caches = runner._jit_decode_cont(
+                                    self.params,
+                                    self.cache_engine.device_cache,
+                                    packed, *args[1:], prev_t1=k,
+                                    num_steps=k, **flags)
+                                self.cache_engine.device_cache = caches
+                                n += 1
                         jax.block_until_ready(packed)
             logger.info("Warm-up: compiled %d decode executables "
                         "(bs=%s) in %.1fs", n,
@@ -354,9 +369,11 @@ class Worker:
         blocks_to_swap_out: Dict[int, int],
         blocks_to_copy: Dict[int, List[int]],
         num_decode_steps: int = 1,
+        defer_fetch: bool = False,
     ) -> List[SamplerOutput]:
         """Returns one SamplerOutput per fused decode substep (length 1 for
-        prompt runs and unfused decodes)."""
+        prompt runs and unfused decodes). With `defer_fetch`, returns the
+        dispatched-but-unfetched InflightStep instead (pipelined path)."""
         if blocks_to_swap_out:
             self.cache_engine.swap_out(blocks_to_swap_out)
         if blocks_to_swap_in:
@@ -369,6 +386,16 @@ class Worker:
 
         outputs, new_caches = self.model_runner.execute_model(
             seq_group_metadata_list, self.cache_engine.device_cache,
-            num_decode_steps)
+            num_decode_steps, defer_fetch=defer_fetch)
         self.cache_engine.device_cache = new_caches
         return outputs
+
+    def execute_decode_cont(self, cont, lag: int, tables, prev_packed,
+                            prev_t1: int):
+        """Dispatch a pipelined decode continuation (no swaps/copies — the
+        engine only continues batches with no pending block ops)."""
+        step, new_caches = self.model_runner.execute_decode_cont(
+            cont, lag, tables, prev_packed, prev_t1,
+            self.cache_engine.device_cache, defer_fetch=True)
+        self.cache_engine.device_cache = new_caches
+        return step
