@@ -54,7 +54,8 @@ def quantize_int8_jax(w: jnp.ndarray) -> QuantizedWeight:
 
 
 def is_quantized(w: Any) -> bool:
-    return isinstance(w, dict) and (("q" in w and "s" in w) or "q4" in w)
+    return isinstance(w, dict) and (("q" in w and "s" in w) or "q4" in w
+                                    or "q4lut" in w)
 
 
 # --- int4 (group-wise asymmetric, AWQ/GPTQ-compatible) -------------------
@@ -103,17 +104,37 @@ def _dequant_int4(w: QuantizedWeight, dtype) -> jnp.ndarray:
     return wf.reshape(2 * in2, out).astype(dtype)
 
 
+def _dequant_int4lut(w: QuantizedWeight, dtype) -> jnp.ndarray:
+    """{"q4lut","lut"} → dense [in, out]: per-channel 16-entry codebook
+    gather (exact SqueezeLLM semantics)."""
+    q4 = w["q4lut"]
+    in2, out = q4.shape
+    lo = (q4 & 0xF)
+    hi = (q4 >> 4)
+    q = jnp.stack([lo, hi], axis=1).reshape(2 * in2, out).astype(jnp.int32)
+    return jnp.take_along_axis(
+        w["lut"], q, axis=0).astype(dtype)               # lut [16, out]
+
+
 def qmatmul(x: jnp.ndarray, w: Union[jnp.ndarray, QuantizedWeight]
             ) -> jnp.ndarray:
-    """x @ w for plain, int8-quantized, or int4-quantized weights.
+    """x @ w for plain, int8-quantized, int4-quantized, or LUT-quantized
+    (SqueezeLLM) weights.
 
     int8: mixed-dtype dot_general keeps the weight un-dequantized in HBM;
     the per-channel scale applies to the f32 accumulator. int4: nibble
     unpack + affine dequant fuse into the dot's operand producer, so HBM
-    stores only the packed bytes + group scales/zeros.
+    stores only the packed bytes + group scales/zeros. q4lut: same packed
+    nibbles, dequantized through the exact per-channel codebook.
     """
     if not is_quantized(w):
         return x @ w
+    if "q4lut" in w:
+        from intellillm_tpu.ops.dispatch import use_pallas
+        from intellillm_tpu.ops.pallas import quant_matmul as _qmm
+        if use_pallas() and _qmm.supports_lut(w):
+            return _qmm.quant_matmul_int4_lut(x, w)
+        return x @ _dequant_int4lut(w, x.dtype)
     if "q4" in w:
         from intellillm_tpu.ops.dispatch import use_pallas
         from intellillm_tpu.ops.pallas import quant_matmul as _qmm
@@ -247,3 +268,23 @@ def squeezellm_dequantize(qweight: np.ndarray,
     out = q.shape[1]
     lut = np.asarray(lookup_table, np.float32)           # [out, 16]
     return lut[np.arange(out)[None, :], q]               # [in, out]
+
+
+def squeezellm_to_q4lut(qweight: np.ndarray,
+                        lookup_table: np.ndarray):
+    """SqueezeLLM checkpoint tensors → LOSSLESS device format
+    {"q4lut": uint8 [in/2, out], "lut": f32 [16, out]}: the packed
+    nibbles are the codebook indices verbatim (repacked 8-per-int32 →
+    2-per-byte, same even/odd split as pack_int4) and the non-uniform
+    per-channel table executes exactly at matmul time — matching the
+    reference's in-kernel LUT dequant
+    (csrc/quantization/squeezellm/quant_cuda_kernel.cu:1-225) instead of
+    an int8 re-rounding. Returns None for layouts the packer can't
+    express (odd input dim)."""
+    q = _unpack_int32_nibbles_rows(qweight)              # [in, out]
+    if q.shape[0] % 2:
+        return None
+    q4 = (q[0::2] | (q[1::2] << 4)).astype(np.uint8)     # [in/2, out]
+    lut = np.ascontiguousarray(
+        np.asarray(lookup_table, np.float32).T)          # [16, out]
+    return {"q4lut": q4, "lut": lut}

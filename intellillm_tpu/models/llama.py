@@ -28,9 +28,12 @@ from intellillm_tpu.models.weight_utils import (cast_array,
 
 Params = Dict[str, Any]
 
-# Methods that use the int8 {"q","s"} device representation (GPTQ and
-# SqueezeLLM dequantize-on-load into it); AWQ uses int4 {"q4","s4","z4"}.
-_INT8_REPR_METHODS = ("int8", "gptq", "squeezellm")
+# Quantization methods whose DUMMY weights use the int8 {"q","s"} device
+# representation. Real checkpoints may resolve differently per tensor
+# (AWQ/GPTQ → int4 {"q4","s4","z4"}, SqueezeLLM → exact-LUT
+# {"q4lut","lut"}, irregular layouts → int8) — partition_specs therefore
+# emits a union spec covering every representation.
+_INT8_REPR_METHODS = ("int8", "gptq")
 
 
 def _slice_lora(lora, layer_idx: int):
@@ -188,17 +191,22 @@ class LlamaForCausalLM:
         from jax.sharding import PartitionSpec as P
 
         def w(spec):
-            """Quantized weights shard q on the same dims; int8 scales
-            follow the output dim; int4 group scales/zeros are [g, out]
-            and shard like the weight."""
-            if self.quantization in _INT8_REPR_METHODS:
-                return {"q": spec, "s": P(spec[1])}
-            if self.quantization == "awq":     # device int4
-                # s4/z4 are [groups, out]: shard only the out dim — group
-                # counts rarely divide the mesh (in/128 on row-parallel).
-                return {"q4": spec, "s4": P(None, spec[1]),
-                        "z4": P(None, spec[1])}
-            return spec
+            """Quantized weights shard q on the same dims; per-out-channel
+            tensors (int8 scale, int4 group scales/zeros, the SqueezeLLM
+            codebook) shard only the out dim — group/codebook counts
+            rarely divide the mesh. The spec is a UNION over every device
+            representation the loader can produce (int8 {"q","s"}, int4
+            {"q4","s4","z4","perm"}, LUT {"q4lut","lut"}): spec lookup is
+            by tree path, so keys absent from the actual param dict are
+            simply never consulted, while a per-quantization guess would
+            silently replicate a mismatched repr (GPTQ loads int4 OR falls
+            back to int8 depending on the checkpoint's group layout)."""
+            if self.quantization is None:
+                return spec
+            return {"q": spec, "s": P(spec[1]),
+                    "q4": spec, "s4": P(None, spec[1]),
+                    "z4": P(None, spec[1]), "perm": P(),
+                    "q4lut": spec, "lut": P(None, spec[1])}
 
         layer = {
             "input_norm": P(),
@@ -246,6 +254,18 @@ class LlamaForCausalLM:
                  scale).astype(dtype)
             if len(shape) != 2 or not quantize:
                 return w
+            if self.quantization == "squeezellm":
+                # Dummy q4lut: random codebook indices + a uniform
+                # per-channel table spanning the weight scale (real
+                # checkpoints carry k-means centroids; dummy load only
+                # needs the right shapes/dtypes for perf work).
+                in_, out = shape
+                kq, _ = jax.random.split(key)
+                q4 = jax.random.randint(kq, (in_ // 2, out), 0, 256,
+                                        jnp.int32).astype(jnp.uint8)
+                lut = (jnp.arange(16, dtype=jnp.float32)[:, None] - 7.5
+                       ) * (scale / 4) * jnp.ones((1, out), jnp.float32)
+                return {"q4lut": q4, "lut": lut}
             if self.quantization in _INT8_REPR_METHODS:
                 return quantize_int8_jax(w)
             if self.quantization == "awq":

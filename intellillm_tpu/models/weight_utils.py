@@ -168,16 +168,25 @@ def load_linear(raw, prefix: str, dtype: str, quantization=None,
                             raw.get(prefix + ".g_idx"))
         return quantize_int8(w)
     if quantization == "squeezellm":
+        if fp_ok:
+            w = squeezellm_dequantize(raw[prefix + ".qweight"],
+                                      raw[prefix + ".lookup_table"])
+            return cast_array(w, dtype)
+        # Lossless device format: packed codebook indices + the exact
+        # per-channel [16, out] table, executed by the LUT dequant-matmul
+        # (ops/pallas/quant_matmul.quant_matmul_int4_lut) — parity with
+        # the reference's in-kernel LUT
+        # (csrc/quantization/squeezellm/quant_cuda_kernel.cu).
+        from intellillm_tpu.layers.quantization import squeezellm_to_q4lut
+        qw = squeezellm_to_q4lut(raw[prefix + ".qweight"],
+                                 raw[prefix + ".lookup_table"])
+        if qw is not None:
+            return qw
+        logger.warning(
+            "SqueezeLLM tensor %s has an odd input dim; falling back to "
+            "int8 requantization (lossy vs the checkpoint).", prefix)
         w = squeezellm_dequantize(raw[prefix + ".qweight"],
                                   raw[prefix + ".lookup_table"])
-        if not fp_ok:
-            # The non-uniform per-channel codebook has no lossless affine
-            # int4 mapping — say so every time rather than silently
-            # changing numerics for migrating checkpoints.
-            logger.warning(
-                "SqueezeLLM tensor %s: non-uniform LUT requantized to "
-                "per-channel int8 (approximate; reference executes the "
-                "LUT exactly via squeezellm_gemm).", prefix)
-        return cast_array(w, dtype) if fp_ok else quantize_int8(w)
+        return quantize_int8(w)
     raise ValueError(
         f"{prefix!r} is stored quantized but quantization={quantization!r}")

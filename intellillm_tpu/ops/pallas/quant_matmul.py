@@ -101,6 +101,61 @@ def _quant_matmul_2d(xe, xo, q4, s4, z4, block_b: int, block_out: int,
     )(xe, xo, q4, s4, z4)
 
 
+def _kernel_lut(xe_ref, xo_ref, q4_ref, lut_ref, o_ref, acc_ref):
+    """SqueezeLLM variant: dequant via the exact per-channel 16-entry
+    codebook (reference csrc/quantization/squeezellm/quant_cuda_kernel.cu
+    dequantizes through __ldg(lookup_table) in-kernel; here the [16, bo]
+    LUT tile sits in VMEM and a 16-way select chain realizes the gather —
+    Mosaic has no per-lane dynamic gather, and 16 vectorized selects are
+    cheap next to the MXU dot)."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q4_ref[:].astype(jnp.int32)                  # [bk, bo]
+
+    def deq(nibble):                                 # [bk, bo] i32 -> bf16
+        val = jnp.zeros(nibble.shape, jnp.float32)
+        for v in range(16):
+            val = jnp.where(nibble == v, lut_ref[v, :][None, :], val)
+        return val.astype(jnp.bfloat16)
+
+    acc = jax.lax.dot_general(
+        xe_ref[:], deq(q & 0xF),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    acc += jax.lax.dot_general(
+        xo_ref[:], deq(q >> 4),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    acc_ref[:] += acc
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _flush():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_b", "block_out", "block_k"))
+def _quant_matmul_2d_lut(xe, xo, q4, lut, block_b: int, block_out: int,
+                         block_k: int):
+    b = xe.shape[0]
+    in2, out = q4.shape
+    grid = (b // block_b, out // block_out, in2 // block_k)
+    return pl.pallas_call(
+        _kernel_lut,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_b, block_k), lambda i, j, k: (i, k)),
+            pl.BlockSpec((block_b, block_k), lambda i, j, k: (i, k)),
+            pl.BlockSpec((block_k, block_out), lambda i, j, k: (k, j)),
+            pl.BlockSpec((16, block_out), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_b, block_out),
+                               lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((b, out), xe.dtype),
+        scratch_shapes=[pltpu.VMEM((block_b, block_out), jnp.float32)],
+    )(xe, xo, q4, lut)
+
+
 def _pad_dim(a, dim: int, to: int):
     short = -a.shape[dim] % to
     if short == 0:
@@ -119,6 +174,47 @@ def supports(w: dict) -> bool:
         return False
     gs2 = in2 // g
     return gs2 > 0 and (128 % gs2 == 0 or gs2 % 128 == 0)
+
+
+def supports_lut(w: dict) -> bool:
+    return "q4lut" in w and w["lut"].shape[0] == 16
+
+
+def quant_matmul_int4_lut(x: jnp.ndarray, w: dict) -> jnp.ndarray:
+    """x @ lut_dequant(w) for a squeezellm_to_q4lut weight
+    ({"q4lut": uint8 [in/2, out], "lut": f32 [16, out]}). Any leading
+    batch dims. Zero-padded K rows contribute nothing because the
+    activation halves are zero there (the LUT value of index 0 is
+    multiplied by 0)."""
+    q4, lut = w["q4lut"], w["lut"]
+    lead = x.shape[:-1]
+    in_ = x.shape[-1]
+    in2, out = q4.shape
+
+    x2 = x.reshape(-1, in_)
+    b = x2.shape[0]
+    xs = x2.reshape(b, in2, 2)
+    xe, xo = xs[:, :, 0], xs[:, :, 1]
+
+    block_k = min(_BLOCK_K_TARGET, -(-in2 // 128) * 128)
+    if in2 % block_k:
+        xe = _pad_dim(xe, 1, block_k)
+        xo = _pad_dim(xo, 1, block_k)
+        q4 = _pad_dim(q4, 0, block_k)
+
+    block_b = min(_BLOCK_B, -(-b // 16) * 16)
+    if b % block_b:
+        xe = _pad_dim(xe, 0, block_b)
+        xo = _pad_dim(xo, 0, block_b)
+
+    block_out = _BLOCK_OUT if out % _BLOCK_OUT == 0 else 128
+    if out % block_out:
+        q4 = _pad_dim(q4, 1, block_out)
+        lut = _pad_dim(lut, 1, block_out)
+
+    y = _quant_matmul_2d_lut(xe, xo, q4, lut, block_b=block_b,
+                             block_out=block_out, block_k=block_k)
+    return y[:b, :out].reshape(*lead, out)
 
 
 def quant_matmul_int4(x: jnp.ndarray, w: dict) -> jnp.ndarray:

@@ -411,3 +411,113 @@ def test_gptq_checkpoint_lossless(tiny_llama_dir, tmp_path,
     ours = _greedy(gptq_dir, example_prompts)
     for gold, o in zip(golden, ours):
         assert gold[0] == o[0]
+
+
+def _squeezellmify_checkpoint(base_dir, tmp_path):
+    """Convert a tiny fp llama checkpoint into (sqllm_dir, fp_twin_dir):
+    per-channel 16-entry codebooks (channel quantiles — real checkpoints
+    use k-means centroids; the format is identical) + nearest-index
+    qweights, twin = exact LUT dequant."""
+    import safetensors.numpy
+    from transformers import AutoModelForCausalLM, AutoTokenizer
+
+    model = AutoModelForCausalLM.from_pretrained(base_dir,
+                                                 torch_dtype=torch.float32)
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    targets = [k for k in sd
+               if k.endswith("_proj.weight") and "layers" in k]
+    tensors = {k: v for k, v in sd.items() if k not in targets}
+    twin_sd = dict(sd)
+    for name in targets:
+        w = sd[name].T.astype(np.float32)          # [in, out]
+        in_, out = w.shape
+        # Per-channel codebook: 16 quantiles of that channel's values.
+        lut = np.quantile(w, np.linspace(0, 1, 16), axis=0).T  # [out, 16]
+        lut = np.ascontiguousarray(lut.astype(np.float32))
+        q = np.abs(w[:, :, None] - lut[None]).argmin(-1).astype(np.uint8)
+        deq = np.take_along_axis(lut, q.transpose(1, 0), axis=1
+                                 ).transpose(1, 0)  # lut[o, q[i,o]]
+        prefix = name[:-len(".weight")]
+        tensors[prefix + ".qweight"] = gptq_pack_rows(q)
+        tensors[prefix + ".lookup_table"] = lut
+        twin_sd[name] = np.ascontiguousarray(deq.T.astype(np.float32))
+
+    sq_dir = str(tmp_path / "sqllm")
+    os.makedirs(sq_dir, exist_ok=True)
+    safetensors.numpy.save_file(
+        {k: np.ascontiguousarray(v) for k, v in tensors.items()},
+        os.path.join(sq_dir, "model.safetensors"))
+    with open(os.path.join(base_dir, "config.json")) as f:
+        cfg = json.load(f)
+    cfg["quantization_config"] = {"quant_method": "squeezellm",
+                                  "bits": 4}
+    with open(os.path.join(sq_dir, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    AutoTokenizer.from_pretrained(base_dir).save_pretrained(sq_dir)
+
+    twin_dir = str(tmp_path / "sqllm-twin")
+    model.load_state_dict({k: torch.from_numpy(np.ascontiguousarray(v))
+                           for k, v in twin_sd.items()})
+    model.save_pretrained(twin_dir, safe_serialization=True)
+    AutoTokenizer.from_pretrained(base_dir).save_pretrained(twin_dir)
+    return sq_dir, twin_dir
+
+
+def test_squeezellm_checkpoint_lossless(tiny_llama_dir, tmp_path,
+                                        example_prompts, caplog):
+    """SqueezeLLM loads LOSSLESSLY to the {"q4lut","lut"} device format —
+    the exact per-channel codebook executes at matmul time (reference
+    squeezellm.py:122-127 + quant_cuda_kernel.cu), with NO int8
+    requantization anywhere: every quantized leaf must dequantize
+    bit-exactly to the fp twin and first greedy tokens must agree."""
+    from intellillm_tpu.config import ModelConfig
+    from intellillm_tpu.layers.quantization import _dequant_int4lut
+    from intellillm_tpu.models.model_loader import get_model
+
+    sq_dir, twin_dir = _squeezellmify_checkpoint(tiny_llama_dir, tmp_path)
+    mc = ModelConfig(model=sq_dir, dtype="float32")
+    assert mc.quantization == "squeezellm"
+    import logging
+    with caplog.at_level(logging.WARNING):
+        _, params_q = get_model(mc)
+    assert not [r for r in caplog.records
+                if "requantiz" in r.getMessage()], (
+        "squeezellm load emitted a requantization warning — the lossless "
+        "path did not engage")
+    _, params_fp = get_model(ModelConfig(model=twin_dir, dtype="float32"))
+
+    def compare(a, t):
+        if isinstance(a, dict) and "q4lut" in a:
+            deq = np.asarray(_dequant_int4lut(
+                {k: jnp.asarray(v) for k, v in a.items()}, jnp.float32))
+            np.testing.assert_array_equal(deq, np.asarray(t))
+        elif isinstance(a, dict):
+            for k in a:
+                compare(a[k], t[k])
+        elif isinstance(a, list):
+            for x, y in zip(a, t):
+                compare(x, y)
+        elif a is not None:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(t))
+
+    compare(params_q, params_fp)
+    # Every projection really is LUT-format (nothing fell back to int8).
+    n_lut = []
+
+    def count(a):
+        if isinstance(a, dict) and "q4lut" in a:
+            n_lut.append(1)
+        elif isinstance(a, dict):
+            for v in a.values():
+                count(v)
+        elif isinstance(a, list):
+            for v in a:
+                count(v)
+
+    count(params_q)
+    assert len(n_lut) > 0
+
+    golden = _greedy(twin_dir, example_prompts)
+    ours = _greedy(sq_dir, example_prompts)
+    for gold, o in zip(golden, ours):
+        assert gold[0] == o[0]

@@ -57,6 +57,63 @@ def test_quant_matmul_3d_and_perm():
     np.testing.assert_allclose(got, ref, atol=0.15, rtol=0.02)
 
 
+def _pack_rows_int32(m: np.ndarray) -> np.ndarray:
+    """8 sequential nibbles per int32 along the input dim (the GPTQ /
+    SqueezeLLM checkpoint qweight layout)."""
+    in_, out = m.shape
+    packed = np.zeros((in_ // 8, out), np.int32)
+    for j in range(8):
+        packed |= m[j::8].astype(np.int32) << (4 * j)
+    return packed
+
+
+def _pack_lut(rng, in_, out):
+    """Random SqueezeLLM-style weight: per-channel sorted 16-entry
+    codebook + random indices."""
+    q = rng.integers(0, 16, size=(in_, out)).astype(np.uint8)
+    lut = np.sort(rng.standard_normal((16, out)).astype(np.float32),
+                  axis=0)
+    q4 = (q[0::2] | (q[1::2] << 4)).astype(np.uint8)
+    return {"q4lut": jnp.asarray(q4), "lut": jnp.asarray(lut)}
+
+
+@pytest.mark.parametrize("in_,out,b", [
+    (256, 384, 3),
+    (64, 128, 40),
+    (300, 136, 5),          # non-128-divisible out, odd K padding
+])
+def test_quant_matmul_lut_matches_jnp_path(in_, out, b):
+    """SqueezeLLM LUT kernel vs the exact jnp codebook-gather dequant
+    (reference csrc/quantization/squeezellm/quant_cuda_kernel.cu role)."""
+    from intellillm_tpu.layers.quantization import _dequant_int4lut
+    from intellillm_tpu.ops.pallas.quant_matmul import (
+        quant_matmul_int4_lut, supports_lut)
+    rng = np.random.default_rng(6)
+    w = _pack_lut(rng, in_, out)
+    assert supports_lut(w)
+    x = jnp.asarray(rng.standard_normal((b, in_)).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    ref = np.asarray(x @ _dequant_int4lut(w, x.dtype), np.float32)
+    got = np.asarray(quant_matmul_int4_lut(x, w), np.float32)
+    np.testing.assert_allclose(got, ref, atol=0.15, rtol=0.02)
+
+
+def test_lut_dequant_is_exact():
+    """The jnp LUT dequant reproduces the codebook values bit-exactly
+    (no affine approximation anywhere in the path)."""
+    from intellillm_tpu.layers.quantization import (_dequant_int4lut,
+                                                    squeezellm_to_q4lut)
+    rng = np.random.default_rng(7)
+    in_, out = 32, 24
+    q = rng.integers(0, 16, size=(in_, out)).astype(np.uint8)
+    lut_ck = rng.standard_normal((out, 16)).astype(np.float32)  # [out,16]
+    w = squeezellm_to_q4lut(_pack_rows_int32(q), lut_ck)
+    deq = np.asarray(_dequant_int4lut(
+        {k: jnp.asarray(v) for k, v in w.items()}, jnp.float32))
+    ref = np.stack([lut_ck[o, q[:, o]] for o in range(out)], axis=1)
+    np.testing.assert_array_equal(deq, ref)
+
+
 @pytest.mark.skipif(jax.default_backend() != "tpu",
                     reason="memory_analysis buffer plan is TPU-specific")
 def test_int4_stays_packed_in_hbm():
