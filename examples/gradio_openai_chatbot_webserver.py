@@ -1,0 +1,79 @@
+"""Gradio chat UI against the OpenAI-compatible server.
+
+Role parity: reference `examples/gradio_openai_chatbot_webserver.py` —
+a ChatInterface that streams chat completions. This version speaks the
+SSE wire format directly with `requests` (the `openai` client package is
+not required). Start the server, then the demo:
+
+    python -m intellillm_tpu.entrypoints.openai.api_server \
+        --model <model> --chat-template examples/template_chatml.jinja &
+    python examples/gradio_openai_chatbot_webserver.py \
+        --model <served-model-name>
+
+Requires `gradio` (not bundled); exits with an install hint when missing.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+import requests
+
+try:
+    import gradio as gr
+except ImportError as e:  # pragma: no cover - environment-dependent
+    raise SystemExit(
+        "This demo needs gradio: pip install gradio") from e
+
+
+def stream_chat(messages, args):
+    """Yield accumulated assistant text from the SSE chat stream."""
+    body = {
+        "model": args.model,
+        "messages": messages,
+        "temperature": args.temp,
+        "stream": True,
+    }
+    if args.stop_token_ids:
+        body["stop_token_ids"] = [
+            int(t) for t in args.stop_token_ids.split(",") if t.strip()]
+    headers = {"Authorization": f"Bearer {args.api_key}"}
+    resp = requests.post(f"{args.model_url}/chat/completions",
+                         json=body, headers=headers, stream=True)
+    resp.raise_for_status()
+    partial = ""
+    for line in resp.iter_lines(decode_unicode=True):
+        if not line or not line.startswith("data:"):
+            continue
+        payload = line[len("data:"):].strip()
+        if payload == "[DONE]":
+            break
+        delta = json.loads(payload)["choices"][0].get("delta", {})
+        partial += delta.get("content") or ""
+        yield partial
+
+
+def predict(message, history, args):
+    messages = [{"role": "system", "content": args.system_prompt}]
+    for human, assistant in history:
+        messages.append({"role": "user", "content": human})
+        messages.append({"role": "assistant", "content": assistant})
+    messages.append({"role": "user", "content": message})
+    yield from stream_chat(messages, args)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="localhost")
+    ap.add_argument("--port", type=int, default=8002)
+    ap.add_argument("--model-url", default="http://localhost:8000/v1")
+    ap.add_argument("--model", default="dummy")
+    ap.add_argument("--api-key", default="EMPTY")
+    ap.add_argument("--temp", type=float, default=0.8)
+    ap.add_argument("--stop-token-ids", default="")
+    ap.add_argument("--system-prompt",
+                    default="You are a helpful assistant.")
+    args = ap.parse_args()
+    gr.ChatInterface(
+        lambda message, history: predict(message, history, args)
+    ).queue().launch(server_name=args.host, server_port=args.port)

@@ -1,0 +1,71 @@
+"""Gradio demo for the simple /generate server.
+
+Role parity: reference `examples/gradio_webserver.py` — a one-box text
+completion UI streaming from the plain API server. Start the server,
+then this demo:
+
+    python -m intellillm_tpu.entrypoints.api_server --model <model> &
+    python examples/gradio_webserver.py --model-url \
+        http://localhost:8000/generate
+
+Requires `gradio` (not bundled with intellillm-tpu); the demo exits with
+an install hint when it is missing.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+import requests
+
+try:
+    import gradio as gr
+except ImportError as e:  # pragma: no cover - environment-dependent
+    raise SystemExit(
+        "This demo needs gradio: pip install gradio") from e
+
+
+def stream_completion(prompt: str, model_url: str, max_tokens: int,
+                      temperature: float):
+    """Yield the growing completion text from the newline-delimited JSON
+    stream (entrypoints/api_server.py emits one {"text": [...]} line per
+    engine step)."""
+    resp = requests.post(
+        model_url,
+        json={"prompt": prompt, "stream": True,
+              "max_tokens": max_tokens, "temperature": temperature},
+        stream=True)
+    resp.raise_for_status()
+    for line in resp.iter_lines(decode_unicode=True):
+        if not line:
+            continue
+        yield json.loads(line)["text"][0]
+
+
+def build_demo(args):
+    with gr.Blocks() as demo:
+        gr.Markdown("# intellillm-tpu text completion demo\n")
+        inputbox = gr.Textbox(label="Input",
+                              placeholder="Enter text and press ENTER")
+        outputbox = gr.Textbox(
+            label="Output", placeholder="Generated result from the model")
+
+        def bot(prompt):
+            yield from stream_completion(prompt, args.model_url,
+                                         args.max_tokens, args.temperature)
+
+        inputbox.submit(bot, [inputbox], [outputbox])
+    return demo
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="localhost")
+    ap.add_argument("--port", type=int, default=8001)
+    ap.add_argument("--model-url",
+                    default="http://localhost:8000/generate")
+    ap.add_argument("--max-tokens", type=int, default=128)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    args = ap.parse_args()
+    build_demo(args).queue().launch(server_name=args.host,
+                                    server_port=args.port)

@@ -1,0 +1,68 @@
+"""The shipped chat-template files render the documented formats.
+
+Role parity: reference `examples/template_{alpaca,baichuan,chatml,
+inkbot}.jinja` — served via --chat-template; rendered here exactly the
+way transformers' apply_chat_template compiles them (jinja2 sandbox,
+trim_blocks/lstrip_blocks)."""
+import os
+
+import pytest
+
+jinja2 = pytest.importorskip("jinja2")
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "..", "examples")
+
+CONV = [
+    {"role": "system", "content": "Be terse."},
+    {"role": "user", "content": "hi there"},
+    {"role": "assistant", "content": "hello"},
+    {"role": "user", "content": "what's 2+2?"},
+]
+
+
+def _render(name, messages, add_generation_prompt=True):
+    with open(os.path.join(EXAMPLES, name)) as f:
+        src = f.read()
+    env = jinja2.Environment(trim_blocks=True, lstrip_blocks=True)
+    return env.from_string(src).render(
+        messages=messages, add_generation_prompt=add_generation_prompt)
+
+
+@pytest.mark.parametrize("name", [
+    "template_alpaca.jinja", "template_baichuan.jinja",
+    "template_chatml.jinja", "template_inkbot.jinja",
+])
+def test_templates_render_all_roles(name):
+    out = _render(name, CONV)
+    assert "hi there" in out
+    assert "hello" in out
+    assert "what's 2+2?" in out
+
+
+def test_baichuan_markers():
+    out = _render("template_baichuan.jinja", CONV)
+    assert out.count("<reserved_106>") == 2            # two user turns
+    # one assistant turn + the generation prompt
+    assert out.count("<reserved_107>") == 2
+    assert out.strip().startswith("Be terse.")
+    assert out.rstrip().endswith("<reserved_107>")
+
+
+def test_inkbot_markers():
+    meta = [{"role": "meta-current_date", "content": "2024-01-01"},
+            {"role": "meta-task_name", "content": "general"}] + CONV
+    out = _render("template_inkbot.jinja", meta)
+    for tag in ("<#meta#>", "<#system#>", "<#chat#>", "<#user#>",
+                "<#bot#>"):
+        assert tag in out
+    assert "- Date: 2024-01-01" in out
+    assert "- Task: general" in out
+    assert out.rstrip().endswith("<#bot#>")
+
+
+def test_no_generation_prompt_when_assistant_last():
+    msgs = CONV[:3]                                     # ends on assistant
+    out = _render("template_baichuan.jinja", msgs)
+    assert not out.rstrip().endswith("<reserved_107>")
+    out = _render("template_inkbot.jinja", msgs)
+    assert not out.rstrip().endswith("<#bot#>")
