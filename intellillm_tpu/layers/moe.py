@@ -152,6 +152,18 @@ def moe_ffn(
     block: int = 512,
     renormalize: bool = True,
 ) -> jnp.ndarray:
+    # QuantMixtral (reference mixtral_quant.py): expert stacks arrive as
+    # packed int4 — HBM holds only the packed bytes; the dense stack is a
+    # transient dequant feeding the expert einsums (XLA fuses the affine
+    # into the dot producers where profitable).
+    from intellillm_tpu.layers.quantization import (dequant_int4_stack,
+                                                    is_quantized)
+    if is_quantized(w1):
+        w1 = dequant_int4_stack(w1, x.dtype)
+    if is_quantized(w2):
+        w2 = dequant_int4_stack(w2, x.dtype)
+    if is_quantized(w3):
+        w3 = dequant_int4_stack(w3, x.dtype)
     t = x.shape[0]
     n = w1.shape[0]
     # Dense runs n*t token-expert rows; grouped runs the routed rows

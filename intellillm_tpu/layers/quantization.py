@@ -23,7 +23,7 @@ int8 (documented, logged loudly at load).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple, Union
+from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -114,6 +114,55 @@ def _dequant_int4lut(w: QuantizedWeight, dtype) -> jnp.ndarray:
     q = jnp.stack([lo, hi], axis=1).reshape(2 * in2, out).astype(jnp.int32)
     return jnp.take_along_axis(
         w["lut"], q, axis=0).astype(dtype)               # lut [16, out]
+
+
+def dequant_int4_stack(w: QuantizedWeight, dtype) -> jnp.ndarray:
+    """Expert-stacked int4 → dense [N, in, out] (QuantMixtral: reference
+    `mixtral_quant.py` runs per-expert quantized linears; here the packed
+    per-expert tensors persist in HBM and dequantize on the fly for the
+    grouped/dense MoE einsum). Optional "inv" [N, in] undoes per-expert
+    GPTQ act-order row sorting."""
+    q4 = w["q4"]                                     # [N, in/2, out]
+    n, in2, out = q4.shape
+    lo = (q4 & 0xF)
+    hi = (q4 >> 4)
+    q = jnp.stack([lo, hi], axis=2).reshape(n, 2 * in2, out)
+    g = w["s4"].shape[1]
+    qg = q.astype(jnp.float32).reshape(n, g, (2 * in2) // g, out)
+    wf = (qg - w["z4"][:, :, None]) * w["s4"][:, :, None]
+    wf = wf.reshape(n, 2 * in2, out)
+    if "inv" in w:
+        wf = jnp.take_along_axis(wf, w["inv"][:, :, None], axis=1)
+    return wf.astype(dtype)
+
+
+def stack_expert_int4(per_expert: list) -> Optional[QuantizedWeight]:
+    """Stack per-expert pack_int4 dicts into the 3D QuantMixtral device
+    format; returns None if any expert failed conversion or shapes
+    disagree. Act-order perms become a stacked inverse-gather index."""
+    if any(e is None for e in per_expert):
+        return None
+    shapes = {e["q4"].shape for e in per_expert}
+    if len(shapes) != 1:
+        return None
+    out: QuantizedWeight = {
+        "q4": np.stack([e["q4"] for e in per_expert]),
+        "s4": np.stack([e["s4"] for e in per_expert]),
+        "z4": np.stack([e["z4"] for e in per_expert]),
+    }
+    if any("perm" in e for e in per_expert):
+        in_ = out["q4"].shape[1] * 2
+        invs = []
+        for e in per_expert:
+            perm = e.get("perm")
+            if perm is None:
+                invs.append(np.arange(in_, dtype=np.int32))
+            else:
+                inv = np.empty(in_, np.int32)
+                inv[perm] = np.arange(in_, dtype=np.int32)
+                invs.append(inv)
+        out["inv"] = np.stack(invs)
+    return out
 
 
 def qmatmul(x: jnp.ndarray, w: Union[jnp.ndarray, QuantizedWeight]

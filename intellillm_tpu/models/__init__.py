@@ -39,11 +39,8 @@ _MODEL_REGISTRY: Dict[str, Type] = {
     "OPTForCausalLM": OPTForCausalLM,
     "GPT2LMHeadModel": GPT2LMHeadModel,
     "MixtralForCausalLM": MixtralForCausalLM,
-    # Reference mixtral_quant.py arch name. Same graph; NOTE the loader
-    # only wires int8 weight-only quantization for Mixtral
-    # (supported_quantization), so GPTQ/AWQ QuantMixtral checkpoints are
-    # rejected at load with a clear NotImplementedError rather than
-    # being unrecognized.
+    # Reference mixtral_quant.py arch name: GPTQ/AWQ checkpoints load as
+    # per-expert packed-int4 stacks (models/mixtral.py load_weights E()).
     "QuantMixtralForCausalLM": MixtralForCausalLM,
     "Qwen2ForCausalLM": Qwen2ForCausalLM,
     "BloomForCausalLM": BloomForCausalLM,

@@ -17,16 +17,21 @@ from intellillm_tpu.config import ModelConfig
 from intellillm_tpu.layers.attention import KVCache
 from intellillm_tpu.layers.moe import moe_ffn
 from intellillm_tpu.layers.normalization import fused_add_rms_norm, rms_norm
-from intellillm_tpu.layers.quantization import qmatmul, quantize_int8
+from intellillm_tpu.layers.quantization import qmatmul
+from intellillm_tpu.logger import init_logger
 from intellillm_tpu.models.llama import LlamaForCausalLM, Params
 from intellillm_tpu.models.weight_utils import (cast_array,
                                                 hf_model_weights_iterator)
 
+logger = init_logger(__name__)
+
 
 class MixtralForCausalLM(LlamaForCausalLM):
 
-    # Expert stacks load fp; only int8 attention quantization is wired.
-    supported_quantization = ("int8", )
+    # int8 quantize-on-load, plus GPTQ/AWQ QuantMixtral checkpoints
+    # (reference `mixtral_quant.py`): per-expert packed-int4 stacks
+    # dequantized through the exact codes inside the MoE layer.
+    supported_quantization = ("int8", "awq", "gptq")
 
     def __init__(self, model_config: ModelConfig) -> None:
         super().__init__(model_config)
@@ -73,15 +78,25 @@ class MixtralForCausalLM(LlamaForCausalLM):
     def partition_specs(self):
         from jax.sharding import PartitionSpec as P
         specs = super().partition_specs()
+
+        def ew(spec):
+            """Expert-stacked weights: dim 0 = expert axis (EP candidate);
+            quantized stacks shard q4 like the dense weight and the
+            per-group tensors on the out dim only (union over reprs, same
+            rationale as LlamaForCausalLM.partition_specs)."""
+            if self.quantization in ("awq", "gptq"):
+                return {"q4": spec, "s4": P(None, None, spec[2]),
+                        "z4": P(None, None, spec[2]), "inv": P()}
+            return spec
+
         for layer in specs["layers"]:
             for k in ("gate", "up", "down"):
                 layer.pop(k, None)
             layer["gate_router"] = P()
-            # Expert-stacked weights: dim 0 = expert axis (EP candidate),
-            # shard the wide inner dim over "model" for TP.
-            layer["w1"] = P(None, None, "model")
-            layer["w3"] = P(None, None, "model")
-            layer["w2"] = P(None, "model", None)
+            # Shard the wide inner dim over "model" for TP.
+            layer["w1"] = ew(P(None, None, "model"))
+            layer["w3"] = ew(P(None, None, "model"))
+            layer["w2"] = ew(P(None, "model", None))
         return specs
 
     def init_random_params(self, seed: int = 0) -> Params:
@@ -115,17 +130,52 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 continue
             raw[name] = arr
 
-        def E(key):
-            # Expert weights stay full precision (stacked 3D; int8 MoE
-            # expert quantization is a follow-up) — matches the fp
-            # partition specs set in partition_specs above.
-            return cast_array(raw[key].T, self.dtype)
+        from intellillm_tpu.layers.quantization import (awq_to_int4,
+                                                        gptq_to_int4,
+                                                        stack_expert_int4)
+        from intellillm_tpu.models.weight_utils import load_linear
 
-        def W(key):
-            w = cast_array(raw[key].T, self.dtype)
-            if self.quantization == "int8":
-                return quantize_int8(w)
-            return w
+        def _expert_int4(prefix):
+            """One expert linear → pack_int4 dict (or None: irregular)."""
+            if self.quantization == "awq":
+                return awq_to_int4(raw[prefix + ".qweight"],
+                                   raw[prefix + ".qzeros"],
+                                   raw[prefix + ".scales"])
+            return gptq_to_int4(raw[prefix + ".qweight"],
+                                raw[prefix + ".qzeros"],
+                                raw[prefix + ".scales"],
+                                raw.get(prefix + ".g_idx"))
+
+        def E(moe_prefix, wname):
+            """Stacked expert weights [N, in, out]. fp checkpoints stack
+            dense; GPTQ/AWQ QuantMixtral checkpoints (reference
+            `mixtral_quant.py` — per-expert quantized linears) stack the
+            packed int4 tensors, executed by the MoE layer's on-the-fly
+            dequant. Irregular layouts fall back to dense fp (lossless,
+            just bigger)."""
+            keys = [f"{moe_prefix}experts.{j}.{wname}" for j in
+                    range(self.num_experts)]
+            if (self.quantization in ("awq", "gptq")
+                    and keys[0] + ".qweight" in raw):
+                stacked = stack_expert_int4(
+                    [_expert_int4(k) for k in keys])
+                if stacked is not None:
+                    return stacked
+                logger.warning(
+                    "QuantMixtral expert stack %s* has an irregular "
+                    "layout; loading dequantized fp instead.", moe_prefix)
+                return np.stack([
+                    load_linear(raw, k, self.dtype, self.quantization,
+                                fp_ok=True)
+                    for k in keys])
+            return np.stack(
+                [cast_array(raw[k + ".weight"].T, self.dtype)
+                 for k in keys])
+
+        def W(prefix):
+            # Attention / head projections: same per-tensor resolution as
+            # the llama loader (fp, int8-on-load, or packed AWQ/GPTQ).
+            return load_linear(raw, prefix, self.dtype, self.quantization)
 
         def V(key):
             return cast_array(raw[key], self.dtype)
@@ -133,28 +183,29 @@ class MixtralForCausalLM(LlamaForCausalLM):
         params: Params = {
             "embed_tokens": V("model.embed_tokens.weight"),
             "norm": V("model.norm.weight"),
-            "lm_head": W("lm_head.weight") if "lm_head.weight" in raw else None,
+            # lm_head stays fp in AWQ/GPTQ checkpoints (reference
+            # mixtral_quant.py uses an unquantized ParallelLMHead).
+            "lm_head": (load_linear(raw, "lm_head", self.dtype,
+                                    self.quantization, fp_ok=True)
+                        if ("lm_head.weight" in raw
+                            or "lm_head.qweight" in raw) else None),
             "layers": [],
         }
-        n = self.num_experts
         for i in range(self.num_layers):
             lp = f"model.layers.{i}."
             moe = lp + "block_sparse_moe."
             layer = {
                 "input_norm": V(lp + "input_layernorm.weight"),
                 "post_attn_norm": V(lp + "post_attention_layernorm.weight"),
-                "q": W(lp + "self_attn.q_proj.weight"),
-                "k": W(lp + "self_attn.k_proj.weight"),
-                "v": W(lp + "self_attn.v_proj.weight"),
-                "o": W(lp + "self_attn.o_proj.weight"),
+                "q": W(lp + "self_attn.q_proj"),
+                "k": W(lp + "self_attn.k_proj"),
+                "v": W(lp + "self_attn.v_proj"),
+                "o": W(lp + "self_attn.o_proj"),
                 "gate_router": cast_array(raw[moe + "gate.weight"].T,
                                           "float32"),
-                "w1": np.stack([E(f"{moe}experts.{j}.w1.weight")
-                                for j in range(n)]),
-                "w2": np.stack([E(f"{moe}experts.{j}.w2.weight")
-                                for j in range(n)]),
-                "w3": np.stack([E(f"{moe}experts.{j}.w3.weight")
-                                for j in range(n)]),
+                "w1": E(moe, "w1"),
+                "w2": E(moe, "w2"),
+                "w3": E(moe, "w3"),
             }
             params["layers"].append(layer)
         return params
