@@ -136,7 +136,8 @@ def build_engine(size: str, max_num_seqs: int, max_model_len: int,
     from transformers import LlamaConfig, MixtralConfig
 
     from intellillm_tpu.config import (CacheConfig, ModelConfig,
-                                       ParallelConfig, SchedulerConfig)
+                                       ParallelConfig, SchedulerConfig,
+                                       SpeculativeConfig)
     from intellillm_tpu.engine.llm_engine import LLMEngine
 
     hidden, inter, layers, heads, kv_heads, vocab = SIZES[size]
@@ -172,9 +173,27 @@ def build_engine(size: str, max_num_seqs: int, max_model_len: int,
         # per 128 tokens/seq amortizes it (measured: K=32 -> 1042,
         # K=64 -> 1345, K=128 -> 1487 tok/s/chip at bs=64).
         num_decode_steps=int(os.environ.get("INTELLILLM_BENCH_K", "128")))
+    # Speculative mode (benchmarks/spec_bench.py): a dummy draft model of
+    # the named size proposes K tokens per round.
+    speculative_config = None
+    spec_size = os.environ.get("INTELLILLM_BENCH_SPEC", "").strip()
+    if spec_size:
+        dh, di, dl, dhe, dkv, dv = SIZES[spec_size]
+        assert dv == vocab, "draft vocab must match target"
+        draft_hf = LlamaConfig(
+            vocab_size=dv, hidden_size=dh, intermediate_size=di,
+            num_hidden_layers=dl, num_attention_heads=dhe,
+            num_key_value_heads=dkv, max_position_embeddings=4096,
+            tie_word_embeddings=False)
+        draft_mc = ModelConfig.from_hf_config(
+            draft_hf, dtype="bfloat16", max_model_len=max_model_len,
+            load_format="dummy")
+        speculative_config = SpeculativeConfig(
+            draft_mc, int(os.environ.get("INTELLILLM_BENCH_SPEC_K", "4")))
     return LLMEngine(model_config, cache_config, ParallelConfig(),
-                     scheduler_config, log_stats=False,
-                     skip_tokenizer_init=True)
+                     scheduler_config,
+                     speculative_config=speculative_config,
+                     log_stats=False, skip_tokenizer_init=True)
 
 
 def run(engine, batch_size: int, input_len: int, output_len: int,

@@ -1,0 +1,80 @@
+"""Speculative-decoding throughput bracketing on dummy weights.
+
+Real acceptance rates need real checkpoints (random draft/target weights
+never agree), so this harness brackets the machinery instead
+(reference role: `vllm/worker/spec_decode/` — which the reference never
+measured either, since it never wired the scaffold):
+
+  - floor  (a~0):  7B target + 1B draft, real acceptance — every round
+                   pays draft K+1 + verify K+1 and emits ~1 token
+  - ceiling (a=1): same pair with INTELLILLM_SPEC_FORCE_ACCEPT=1 —
+                   every round emits K+1 tokens
+  - baseline:      plain 7B fused decode at the same K
+
+Prints one JSON line per mode. Usage:
+    python benchmarks/spec_bench.py [--k 4] [--bs 32] [--out 64]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run_mode(mode: str, args) -> dict:
+    """Each mode runs in a subprocess: one TPU process at a time, and the
+    force-accept env must not leak between modes."""
+    env = dict(os.environ)
+    env["INTELLILLM_BENCH_SIZE"] = "7b"
+    env["INTELLILLM_BENCH_BS"] = str(args.bs)
+    env["INTELLILLM_BENCH_OUT"] = str(args.out)
+    env["INTELLILLM_BENCH_IN"] = str(args.input_len)
+    if mode == "baseline":
+        env["INTELLILLM_BENCH_K"] = str(args.k + 1)
+    else:
+        env["INTELLILLM_BENCH_SPEC"] = "1b"
+        env["INTELLILLM_BENCH_SPEC_K"] = str(args.k)
+        if mode == "ceiling":
+            env["INTELLILLM_SPEC_FORCE_ACCEPT"] = "1"
+    t0 = time.time()
+    r = subprocess.run([sys.executable,
+                        os.path.join(os.path.dirname(__file__), "..",
+                                     "bench.py")],
+                       capture_output=True, text=True, env=env,
+                       timeout=2400)
+    line = None
+    for ln in r.stdout.strip().splitlines():
+        try:
+            line = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+    return {"mode": mode, "rc": r.returncode,
+            "wall_s": round(time.time() - t0, 1), "result": line}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--bs", type=int, default=32)
+    ap.add_argument("--out", type=int, default=64)
+    ap.add_argument("--input-len", type=int, default=128)
+    ap.add_argument("--modes", default="baseline,floor,ceiling")
+    args = ap.parse_args()
+    results = []
+    for mode in args.modes.split(","):
+        rec = run_mode(mode.strip(), args)
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+    ok = [r for r in results if r["result"]]
+    print(json.dumps({"spec_bench_summary": {
+        r["mode"]: (r["result"] or {}).get("value") for r in results}}))
+    return 0 if len(ok) == len(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -358,6 +358,35 @@ class LoRAConfig:
                 "max_num_batched_tokens must be <= 65528 when LoRA is enabled.")
 
 
+class SpeculativeConfig:
+    """Draft-model speculative decoding.
+
+    Reference role: `vllm/worker/spec_decode/multi_step_worker.py:22`
+    (draft multi-step worker) + `vllm/layers/rejection_sampler.py:9` —
+    scaffolding the reference never wired into its engine. Here it is
+    engine-integrated for greedy batches: the draft model proposes
+    `num_speculative_tokens` tokens with one fused scan, the target
+    verifies all of them (plus a bonus token) in one teacher-forced fused
+    call, and greedy acceptance keeps the longest agreeing prefix — the
+    emitted stream is exactly the target model's greedy stream.
+    """
+
+    def __init__(self, draft_model_config: ModelConfig,
+                 num_speculative_tokens: int) -> None:
+        if num_speculative_tokens < 1:
+            raise ValueError("num_speculative_tokens must be >= 1")
+        self.draft_model_config = draft_model_config
+        self.num_speculative_tokens = num_speculative_tokens
+
+    def verify_with_model_config(self, model_config: ModelConfig) -> None:
+        dv = self.draft_model_config.get_vocab_size()
+        tv = model_config.get_vocab_size()
+        if dv != tv:
+            raise ValueError(
+                f"Draft model vocab ({dv}) must match the target's ({tv}) "
+                "— speculative tokens are compared by id.")
+
+
 def _get_and_verify_dtype(hf_config, dtype: Union[str, "object"]) -> str:
     """Resolve dtype string. TPU-first: 'auto' maps fp16 checkpoints to
     bfloat16 (fp16 has no TPU advantage and risks overflow); fp32 stays fp32

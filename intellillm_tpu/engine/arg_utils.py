@@ -45,6 +45,9 @@ class EngineArgs:
     revision: Optional[str] = None
     quantization: Optional[str] = None
     enforce_eager: bool = False
+    # Speculative decoding (draft model + greedy verify)
+    speculative_model: Optional[str] = None
+    num_speculative_tokens: int = 5
     # LoRA
     enable_lora: bool = False
     max_loras: int = 1
@@ -113,6 +116,9 @@ class EngineArgs:
         parser.add_argument("--lora-dtype", type=str, default="auto")
         parser.add_argument("--max-cpu-loras", type=int, default=None)
         parser.add_argument("--disable-log-stats", action="store_true")
+        parser.add_argument("--speculative-model", type=str, default=None)
+        parser.add_argument("--num-speculative-tokens", type=int,
+                            default=5)
         return parser
 
     @classmethod
@@ -167,8 +173,22 @@ class EngineArgs:
             )
             lora_config.verify_with_model_config(model_config)
             lora_config.verify_with_scheduler_config(scheduler_config)
+        speculative_config = None
+        if self.speculative_model is not None:
+            from intellillm_tpu.config import SpeculativeConfig
+            draft_mc = ModelConfig(
+                model=self.speculative_model,
+                tokenizer=self.speculative_model,
+                dtype=self.dtype,
+                load_format=self.load_format,
+                seed=self.seed,
+                max_model_len=model_config.max_model_len,
+            )
+            speculative_config = SpeculativeConfig(
+                draft_mc, self.num_speculative_tokens)
+            speculative_config.verify_with_model_config(model_config)
         return (model_config, cache_config, parallel_config, scheduler_config,
-                lora_config)
+                lora_config, speculative_config)
 
 
 @dataclass

@@ -46,6 +46,7 @@ class LLMEngine:
         parallel_config: ParallelConfig,
         scheduler_config: SchedulerConfig,
         lora_config: Optional[LoRAConfig] = None,
+        speculative_config=None,
         log_stats: bool = True,
         length_predictor=None,
         skip_tokenizer_init: bool = False,
@@ -73,8 +74,22 @@ class LLMEngine:
         else:
             self._init_tokenizer()
 
-        self.worker = Worker(model_config, parallel_config, scheduler_config,
-                             cache_config, lora_config)
+        self.speculative_config = speculative_config
+        if speculative_config is not None:
+            # One engine decode "step" = K draft proposals + the bonus
+            # token; the scheduler must reserve K+1 KV slots per pass.
+            scheduler_config.num_decode_steps = (
+                speculative_config.num_speculative_tokens + 1)
+            from intellillm_tpu.worker.spec_decode.spec_worker import (
+                SpecDecodeWorker)
+            self.worker = SpecDecodeWorker(
+                model_config, parallel_config, scheduler_config,
+                cache_config, lora_config,
+                speculative_config=speculative_config)
+        else:
+            self.worker = Worker(model_config, parallel_config,
+                                 scheduler_config, cache_config,
+                                 lora_config)
         self.worker.init_model()
         self.worker.load_model()
 
@@ -88,6 +103,11 @@ class LLMEngine:
         if scheduler_config.num_decode_steps > 1 and (
                 model_config.get_sliding_window() is not None
                 or model_uses_alibi(self.worker.model)):
+            if speculative_config is not None:
+                raise ValueError(
+                    "Speculative decoding needs the fused multi-step "
+                    "decode program, which sliding-window/ALiBi models "
+                    "cannot use.")
             logger.info(
                 "Clamping num_decode_steps %d -> 1 (model uses %s).",
                 scheduler_config.num_decode_steps,
@@ -108,7 +128,10 @@ class LLMEngine:
         # with device compute. INTELLILLM_PIPELINE=0 disables.
         import os as _os
         from intellillm_tpu.utils import pipeline_enabled_env
-        self.pipeline_enabled = pipeline_enabled_env()
+        # Speculative decoding owns its own dispatch pattern (draft +
+        # teacher-forced verify per step) — no pipelined continuations.
+        self.pipeline_enabled = (pipeline_enabled_env()
+                                 and speculative_config is None)
         self._pipeline_depth = max(
             1, int(_os.environ.get("INTELLILLM_PIPELINE_DEPTH", "2")))
         self._inflight: deque = deque()

@@ -196,6 +196,12 @@ class ModelRunner:
                              "do_penalties", "do_random"),
             donate_argnames=("kv_caches", ),
         )
+        self._jit_decode_teacher = jax.jit(
+            self._decode_teacher_fn,
+            static_argnames=("num_steps", "logprob_k", "do_topk", "do_topp",
+                             "do_minp", "do_penalties", "do_random"),
+            donate_argnames=("kv_caches", ),
+        )
         # Pipelined continuation: same fused program, but the input tokens
         # are sliced on device from the PREVIOUS step's packed output —
         # prev_packed is NOT donated (the host still fetches it later).
@@ -388,12 +394,36 @@ class ModelRunner:
             do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
             do_penalties=do_penalties, do_random=do_random)
 
+    def _decode_teacher_fn(self, params, kv_caches, teacher_tokens,
+                           positions, block_tables, context_lens,
+                           temperatures, top_ks, top_ps, min_ps, seeds,
+                           pres_pen, freq_pen, rep_pen, prompt_tokens,
+                           output_tokens, lora=None, *, num_steps,
+                           logprob_k, do_topk, do_topp, do_minp,
+                           do_penalties, do_random=True):
+        """Teacher-forced fused decode (speculative verification): substep
+        k's input is teacher_tokens[:, k] — the draft's proposal — not the
+        previous substep's sample, so one device call scores every draft
+        position with the TARGET model while committing their KV (rejected
+        positions are simply overwritten on the next step; context length
+        governs what attention ever reads). Outputs are the target's own
+        choices per position, which the host compares against the drafts
+        (reference rejection-sampler role for greedy acceptance)."""
+        return self._decode_fn(
+            params, kv_caches, teacher_tokens[:, :1], positions,
+            block_tables, context_lens, temperatures, top_ks, top_ps,
+            min_ps, seeds, pres_pen, freq_pen, rep_pen, prompt_tokens,
+            output_tokens, lora, num_steps=num_steps, logprob_k=logprob_k,
+            do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
+            do_penalties=do_penalties, do_random=do_random,
+            teacher_tokens=teacher_tokens)
+
     def _decode_fn(self, params, kv_caches, token_ids, positions,
                    block_tables, context_lens, temperatures, top_ks, top_ps,
                    min_ps, seeds, pres_pen, freq_pen, rep_pen, prompt_tokens,
                    output_tokens, lora=None, *, num_steps, logprob_k,
                    do_topk, do_topp, do_minp, do_penalties,
-                   do_random=True):
+                   do_random=True, teacher_tokens=None):
         """K fused decode iterations (staged, chunked).
 
         The paged pool stays loop-invariant (read-only) through each scan —
@@ -436,6 +466,11 @@ class ModelRunner:
         def make_substep(pool_ctx, cur_caches, chunk_base):
             def substep(carry, k):
                 cur_tokens, stages = carry
+                if teacher_tokens is not None:
+                    # Speculative verification: inputs come from the draft
+                    # proposal, not the previous substep's sample.
+                    cur_tokens = jnp.take(teacher_tokens,
+                                          chunk_base + k, axis=1)
                 pos_k = jnp.minimum(base_pos + chunk_base + k,
                                     self.max_model_len - 1)
                 meta = AttentionMetadata(
@@ -962,6 +997,52 @@ class ModelRunner:
         step.cont_state = cont
         if defer_fetch:
             return step, new_caches
+        return step.finalize(), new_caches
+
+    def execute_model_teacher(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        kv_caches,
+        teacher_rows: List[List[int]],
+        num_steps: int,
+    ) -> Tuple[List[SamplerOutput], Any]:
+        """Teacher-forced decode over `num_steps` positions per row
+        (speculative verification with the TARGET model): teacher_rows[i]
+        holds the `num_steps` input tokens for live row i
+        ([last_accepted, draft_1, ..]). Returns the target's per-position
+        choices in the usual per-substep SamplerOutput shape."""
+        arrays, rows = self._prepare_decode(seq_group_metadata_list)
+        padded_n = arrays["token_ids"].shape[0]
+        teacher = np.zeros((padded_n, num_steps), np.int32)
+        for i, toks in enumerate(teacher_rows):
+            teacher[i, :len(toks)] = toks
+
+        row_params: List[SamplingParams] = []
+        row_seeds: List[int] = []
+        meta_by_req = {m.request_id: m for m in seq_group_metadata_list}
+        for req_id, seq_id in rows:
+            meta = meta_by_req[req_id]
+            data = meta.seq_data[seq_id]
+            row_params.append(meta.sampling_params)
+            row_seeds.append(self._row_seed(seq_id, data.get_output_len()))
+
+        lora_state, eff_vocab = self._activate_lora(None, padded_n)
+        st = SamplingTensors.build(row_params, row_seeds, None, eff_vocab,
+                                   padded_n)
+        assert not st.do_penalties, (
+            "speculative verification dispatched for a penalty batch")
+        place = self._place_batch_array
+        sampling_args = self._sampling_args_device(st, padded_n)
+        packed, new_caches = self._jit_decode_teacher(
+            self.params, kv_caches, place(teacher),
+            place(arrays["positions"]), place(arrays["block_tables"]),
+            place(arrays["context_lens"]), *sampling_args, lora_state,
+            num_steps=num_steps, logprob_k=st.logprob_k,
+            do_topk=st.do_topk, do_topp=st.do_topp, do_minp=st.do_minp,
+            do_penalties=False, do_random=st.do_random)
+        step = InflightStep(self, packed, seq_group_metadata_list, rows,
+                            num_steps, num_steps, st.logprob_k, False,
+                            num_steps)
         return step.finalize(), new_caches
 
     def _attach_prompt_logprobs(self, plp_packed, k, metas, rows,

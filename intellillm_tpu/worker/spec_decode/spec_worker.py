@@ -1,0 +1,241 @@
+"""Engine-integrated speculative decoding worker.
+
+Role parity: reference `vllm/worker/spec_decode/multi_step_worker.py:22`
+(draft multi-step execution) + `vllm/model_executor/layers/
+rejection_sampler.py:9` (acceptance) — components the reference shipped
+but never wired into its engine; here they run end-to-end behind
+--speculative-model / --num-speculative-tokens.
+
+TPU design:
+- The draft model proposes K tokens in ONE fused-scan device call (the
+  scan feeds each sample into the next substep on device — the entire
+  reference MultiStepWorker host loop collapses into the existing
+  `_decode_fn`).
+- The target verifies all K proposals plus a bonus token in ONE
+  teacher-forced fused call (`_decode_teacher_fn`): substep k's input is
+  the draft's token, outputs are the target's own per-position choices.
+- Greedy acceptance keeps the longest agreeing prefix + the target's
+  token at the first disagreement, so the emitted stream is exactly the
+  target's greedy stream (the correctness test).
+- No KV rollback: rejected positions simply get overwritten by the next
+  step's writes, and the context length governs what attention reads —
+  both for the target pool and the draft pool (which shares the
+  scheduler's block tables but has its own arrays sized for the draft
+  architecture).
+- The draft is purely advisory: if its cache goes stale (a fallback
+  step ran without it), acceptance drops but outputs stay exact.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from intellillm_tpu.config import (CacheConfig, LoRAConfig, ModelConfig,
+                                   ParallelConfig, SchedulerConfig,
+                                   SpeculativeConfig)
+from intellillm_tpu.logger import init_logger
+from intellillm_tpu.sampling_params import SamplingType
+from intellillm_tpu.sequence import (SamplerOutput, SequenceGroupMetadata,
+                                     SequenceGroupOutput)
+from intellillm_tpu.worker.worker import Worker
+
+logger = init_logger(__name__)
+
+
+class SpecDecodeWorker(Worker):
+
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        parallel_config: ParallelConfig,
+        scheduler_config: SchedulerConfig,
+        cache_config: CacheConfig,
+        lora_config: Optional[LoRAConfig] = None,
+        speculative_config: Optional[SpeculativeConfig] = None,
+    ) -> None:
+        super().__init__(model_config, parallel_config, scheduler_config,
+                         cache_config, lora_config)
+        assert speculative_config is not None
+        self.spec_config = speculative_config
+        self.k_spec = speculative_config.num_speculative_tokens
+        # BENCHMARK-ONLY: accept every draft regardless of the target's
+        # choices. Dummy-weight perf runs have no meaningful acceptance
+        # rate (random draft/target never agree), so this measures the
+        # machinery's a=1.0 upper bound; outputs are NOT target-exact.
+        import os
+        from intellillm_tpu.utils import parse_env_flag
+        self.force_accept = parse_env_flag(
+            os.environ.get("INTELLILLM_SPEC_FORCE_ACCEPT")) is True
+        if self.force_accept:
+            logger.warning(
+                "INTELLILLM_SPEC_FORCE_ACCEPT=1: acceptance check "
+                "bypassed (benchmark mode) — outputs are not meaningful "
+                "text, only throughput is.")
+        self.draft_runner = None
+        self.draft_cache_engine = None
+        # Rolling acceptance stats (reference RejectionSampler counters).
+        self.num_draft_tokens = 0
+        self.num_accepted_tokens = 0
+
+    # --- init ------------------------------------------------------------
+
+    def load_model(self) -> None:
+        super().load_model()
+        from intellillm_tpu.models.model_loader import get_model
+        from intellillm_tpu.parallel.mesh import shard_params
+        from intellillm_tpu.worker.model_runner import ModelRunner
+
+        draft_mc = self.spec_config.draft_model_config
+        self.spec_config.verify_with_model_config(self.model_config)
+        draft_model, draft_host = get_model(draft_mc)
+        draft_params = shard_params(draft_host, self.mesh, draft_model)
+        self.draft_runner = ModelRunner(
+            draft_model, draft_params, draft_mc, self.scheduler_config,
+            self.cache_config, self.parallel_config, mesh=self.mesh,
+            lora_manager=None)
+        logger.info("Speculative decoding: draft=%s K=%d", draft_mc.model,
+                    self.k_spec)
+
+    def init_cache_engine(self, cache_config: CacheConfig) -> None:
+        super().init_cache_engine(cache_config)
+        from intellillm_tpu.parallel.mesh import shard_kv_cache
+        from intellillm_tpu.worker.cache_engine import CacheEngine
+
+        draft_mc = self.spec_config.draft_model_config
+        kv_sharding = shard_kv_cache(self.mesh,
+                                     draft_mc.get_total_num_kv_heads())
+        # Same block count/size as the target pool: the scheduler's block
+        # tables index BOTH pools.
+        self.draft_cache_engine = CacheEngine(cache_config, draft_mc,
+                                              self.parallel_config,
+                                              sharding=kv_sharding)
+
+    # --- step ------------------------------------------------------------
+
+    def execute_model(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        blocks_to_swap_in: Dict[int, int],
+        blocks_to_swap_out: Dict[int, int],
+        blocks_to_copy: Dict[int, List[int]],
+        num_decode_steps: int = 1,
+        defer_fetch: bool = False,
+    ) -> List[SamplerOutput]:
+        assert not defer_fetch, (
+            "speculative decoding does not support pipelined dispatch")
+        # Block ops mirror onto BOTH pools (shared block tables).
+        for ce in (self.cache_engine, self.draft_cache_engine):
+            if blocks_to_swap_out:
+                ce.swap_out(blocks_to_swap_out)
+            if blocks_to_swap_in:
+                ce.swap_in(blocks_to_swap_in)
+            if blocks_to_copy:
+                ce.copy(blocks_to_copy)
+
+        if not seq_group_metadata_list:
+            return []
+
+        if seq_group_metadata_list[0].is_prompt:
+            # Prefill both models; the draft's sampled token is discarded
+            # (its KV is what matters).
+            outputs, new_caches = self.model_runner.execute_model(
+                seq_group_metadata_list, self.cache_engine.device_cache, 1)
+            self.cache_engine.device_cache = new_caches
+            _, dnew = self.draft_runner.execute_model(
+                seq_group_metadata_list,
+                self.draft_cache_engine.device_cache, 1)
+            self.draft_cache_engine.device_cache = dnew
+            return outputs
+
+        if (num_decode_steps == self.k_spec + 1
+                and self._spec_eligible(seq_group_metadata_list)):
+            return self._spec_decode(seq_group_metadata_list,
+                                     num_decode_steps)
+
+        # Fallback: plain target decode. The draft pool misses these
+        # tokens, which can only lower future acceptance, never
+        # correctness (every emitted token is target-verified).
+        outputs, new_caches = self.model_runner.execute_model(
+            seq_group_metadata_list, self.cache_engine.device_cache,
+            num_decode_steps)
+        self.cache_engine.device_cache = new_caches
+        return outputs
+
+    @staticmethod
+    def _spec_eligible(metas: List[SequenceGroupMetadata]) -> bool:
+        """Greedy, single-sequence, adapter-free batches only: greedy
+        acceptance reproduces the target stream exactly; sampled
+        acceptance (rejection sampling against draft probs) and LoRA
+        drafts are not wired."""
+        for meta in metas:
+            sp = meta.sampling_params
+            if (sp.sampling_type != SamplingType.GREEDY
+                    or len(meta.seq_data) != 1
+                    or meta.lora_request is not None
+                    or sp.logits_processors):
+                return False
+        return True
+
+    def _spec_decode(
+        self,
+        metas: List[SequenceGroupMetadata],
+        num_steps: int,
+    ) -> List[SamplerOutput]:
+        k = num_steps - 1
+
+        # 1. Draft proposes K tokens — run K+1 substeps so the draft pool
+        # also gets the KV of the K-th proposal (inputs are
+        # [last, d_1..d_K]); the (K+1)-th proposal is discarded. Without
+        # this the draft pool keeps a one-position hole per round, which
+        # silently degrades acceptance even for a perfect draft.
+        d_out, dnew = self.draft_runner.execute_model(
+            metas, self.draft_cache_engine.device_cache, num_steps)
+        self.draft_cache_engine.device_cache = dnew
+
+        # 2. Teacher-forced target verification over K+1 positions:
+        # inputs [last_accepted, d_1 .. d_K] per row.
+        teacher_rows: List[List[int]] = []
+        for meta in metas:
+            (data, ) = meta.seq_data.values()
+            teacher_rows.append([data.get_last_token_id()])
+        for step_out in d_out[:k]:
+            for i, group_out in enumerate(step_out):
+                teacher_rows[i].append(group_out.samples[0].output_token)
+        t_out, tnew = self.model_runner.execute_model_teacher(
+            metas, self.cache_engine.device_cache, teacher_rows, num_steps)
+        self.cache_engine.device_cache = tnew
+
+        # 3. Greedy acceptance: longest prefix where the target agrees
+        # with the draft, plus the target's token at the first
+        # disagreement (the "bonus"). All emitted tokens are the
+        # TARGET's choices — t_out[s][i] — so the stream is exactly the
+        # target's greedy stream.
+        acc_len: List[int] = []
+        for i in range(len(metas)):
+            drafts = teacher_rows[i][1:]
+            a = 0
+            for j in range(k):
+                if (self.force_accept
+                        or t_out[j][i].samples[0].output_token
+                        == drafts[j]):
+                    a += 1
+                else:
+                    break
+            acc_len.append(a + 1)
+            self.num_draft_tokens += k
+            self.num_accepted_tokens += a
+
+        outputs: List[SamplerOutput] = []
+        for s in range(max(acc_len)):
+            step_list: SamplerOutput = []
+            for i in range(len(metas)):
+                if s < acc_len[i]:
+                    step_list.append(t_out[s][i])
+                else:
+                    step_list.append(SequenceGroupOutput([], None))
+            outputs.append(step_list)
+        return outputs
+
+    def acceptance_rate(self) -> float:
+        if self.num_draft_tokens == 0:
+            return 0.0
+        return self.num_accepted_tokens / self.num_draft_tokens
