@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 TPU measurement battery — run when the axon tunnel is healthy.
+# One TPU process at a time: every step below is sequential, and the
+# background availability prober must be paused first.
+#
+#   touch /tmp/tpu_probe_pause && bash benchmarks/round5_tpu_runbook.sh
+#
+# Results accumulate in benchmarks/round5_results/.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/round5_results
+mkdir -p "$OUT"
+
+log() { echo "== $(date +%H:%M:%S) $*" | tee -a "$OUT/runbook.log"; }
+
+run() { # name, env..., -- cmd...
+  local name=$1; shift
+  log "start $name"
+  "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
+  log "done $name rc=$? -> $(tail -c 300 "$OUT/$name.json" | tr '\n' ' ')"
+}
+
+# 1. Headline (hardened bench; also first pipelined offline number).
+run headline_pipelined python bench.py
+run headline_nopipeline env INTELLILLM_PIPELINE=0 python bench.py
+
+# 2. bs sweep incl. the BASELINE-named bs=256 config.
+for bs in 64 96 128 192 256; do
+  run "bs_sweep_$bs" env INTELLILLM_BENCH_BS=$bs python bench.py
+done
+
+# 3. Long context: retune pool at mml=2048 and add mml=4096.
+run longctx_2048 env INTELLILLM_BENCH_MML=2048 INTELLILLM_BENCH_IN=1024 \
+    INTELLILLM_BENCH_BS=16 python bench.py
+run longctx_2048_big_pool env INTELLILLM_BENCH_MML=2048 \
+    INTELLILLM_BENCH_IN=1024 INTELLILLM_BENCH_BS=24 \
+    INTELLILLM_BENCH_BLOCKS=1800 python bench.py
+run longctx_4096 env INTELLILLM_BENCH_MML=4096 INTELLILLM_BENCH_IN=3072 \
+    INTELLILLM_BENCH_BS=8 INTELLILLM_BENCH_BLOCKS=1800 python bench.py
+
+# 4. Serving sweep (north star): pipelined vs not.
+run serve_pipelined python benchmarks/serve_bench.py --size 7b \
+    --quantization int8 --kv-cache-dtype fp8_e5m2 \
+    --num-device-blocks 1600 --max-num-seqs 96 --rates 2,4,8,12,16,inf
+run serve_nopipeline env INTELLILLM_PIPELINE=0 \
+    python benchmarks/serve_bench.py --size 7b --quantization int8 \
+    --kv-cache-dtype fp8_e5m2 --num-device-blocks 1600 \
+    --max-num-seqs 96 --rates 8,16
+
+# 5. Real-checkpoint load validation (task 8).
+run real_checkpoint python benchmarks/real_checkpoint_tpu.py
+
+# 6. Speculative machinery bracketing.
+run spec_bracket python benchmarks/spec_bench.py --k 4 --bs 32 --out 64
+
+log "runbook complete"
