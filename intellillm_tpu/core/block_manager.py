@@ -7,6 +7,13 @@ planning, sliding-window block rings, allocation watermark. The physical
 block numbers index the HBM pool arrays held by the worker's CacheEngine;
 this module never touches device memory itself — it emits block-op plans
 (swap-in / swap-out / copy dicts) that the worker executes.
+
+Honesty note: the refcounted free-list / CoW / swap bookkeeping is a
+deliberate close port of the reference's host-side block manager (pure
+bookkeeping, SURVEY §7.4). Additions that have no reference analogue:
+multi-slot (K-step) reservation for fused decode, and target-length
+growth (`grow_to`) for pipelined continuations whose host lengths trail
+the device.
 """
 from __future__ import annotations
 

@@ -12,6 +12,14 @@ TPU-specific change: the padding budget is interpreted against the
 prefill-shape *buckets* the runner will pad to (XLA static shapes), not
 raw max-prompt-len padding; the policy is pluggable (FCFS / SJF — the
 IntelliLLM fork's research scheduler made first-class, SURVEY §2.10).
+
+Honesty note: the queue/admission control flow here is a deliberate
+close port of the reference's host-side scheduler (pure-Python logic
+with no hardware component — SURVEY §7.4 sanctions porting such layers
+nearly verbatim). What is NOT ported: the bucketed padding budget, the
+policy-driven admission order, the clamped K-slot lookahead for fused
+multi-step decode, prefill-only scheduling for pipelined admission, and
+the free-guard machinery for dispatched-but-unfetched device steps.
 """
 from __future__ import annotations
 
