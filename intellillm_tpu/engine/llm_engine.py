@@ -136,6 +136,11 @@ class LLMEngine:
             1, int(_os.environ.get("INTELLILLM_PIPELINE_DEPTH", "2")))
         self._inflight: deque = deque()
         self._pending_outputs: List[RequestOutput] = []
+        # Joiner tracking: prompts admitted mid-pipeline produce sequences
+        # that only join decode at the next fresh schedule; conts past
+        # them are capped (see _cont_budget_ok).
+        self._joiners_pending = False
+        self._conts_past_prompt = 0
 
     # --- init ------------------------------------------------------------
 
@@ -423,8 +428,9 @@ class LLMEngine:
                 return True
             if self._inflight:
                 return False  # memory-blocked: drain, then full schedule
-        elif (self._inflight and self._inflight[-1].cont_state is not None
-                and sched.running and sched.can_continue_decode()):
+        elif (self._inflight and sched.running
+                and sched.can_continue_decode()
+                and self._cont_budget_ok()):
             if self._dispatch_cont():
                 return True
             return False  # out of blocks for in-place growth: drain
@@ -466,10 +472,39 @@ class LLMEngine:
             step.cont_state.groups = scheduler_outputs.scheduled_seq_groups
         step._pipeline_seq_ids = seq_ids
         step._pipeline_sched = scheduler_outputs
+        if scheduler_outputs.prompt_run:
+            self._joiners_pending = True
+            self._conts_past_prompt = 0
+        else:
+            # A fresh decode schedule merged every running sequence.
+            self._joiners_pending = False
+            self._conts_past_prompt = 0
         self._inflight.append(step)
 
+    def _newest_decode_inflight(self):
+        """The newest in-flight entry that can seed a continuation — it
+        need not be the pipeline tail: prompt admissions interleave, and
+        a continuation chained PAST a prefill is legal (the prefill
+        touches disjoint pages, and the cont's row snapshot predates the
+        new sequences, which join at the next fresh schedule)."""
+        for step in reversed(self._inflight):
+            if step.cont_state is not None:
+                return step
+        return None
+
+    def _cont_budget_ok(self) -> bool:
+        """At most one continuation may be dispatched past un-merged
+        prompt admissions: freshly admitted sequences have their first
+        token (from prefill) but join decode only at the next fresh
+        schedule — unbounded conts would starve their TPOT."""
+        if self._newest_decode_inflight() is None:
+            return False
+        if not self._joiners_pending:
+            return True
+        return self._conts_past_prompt < 1
+
     def _dispatch_cont(self) -> bool:
-        prev = self._inflight[-1]
+        prev = self._newest_decode_inflight()
         cont = prev.cont_state
         k = cont.num_steps
         lag = cont.steps_dispatched
@@ -483,6 +518,8 @@ class LLMEngine:
         step = self.worker.execute_decode_cont(cont, lag, tables,
                                                prev.packed, prev.t1)
         cont.steps_dispatched += k
+        if self._joiners_pending:
+            self._conts_past_prompt += 1
         seq_ids = [sid for _, sid in cont.rows]
         self.scheduler.guard_seqs(seq_ids)
         step._pipeline_seq_ids = seq_ids

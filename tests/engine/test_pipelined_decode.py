@@ -152,6 +152,33 @@ def test_pipelined_tight_pool_drains(tiny_llama_dir):
     assert len(done["0"][0][0]) >= 16
 
 
+def test_pipelined_continuous_arrivals(tiny_llama_dir, example_prompts):
+    """High-rate pattern: a new request arrives on (almost) every call,
+    so prompt admissions interleave with decode continuations chained
+    PAST them (the _cont_budget_ok path). Outputs must still match the
+    serial loop exactly."""
+    prompts = (example_prompts * 3)[:10]
+    reqs = [(str(i), p, SamplingParams(temperature=0.0, max_tokens=16,
+                                       ignore_eos=True))
+            for i, p in enumerate(prompts)]
+    ref = _run_serial(_build(tiny_llama_dir, max_num_seqs=12), reqs)
+
+    llm = _build(tiny_llama_dir, max_num_seqs=12)
+    engine = llm.llm_engine
+    outs = []
+    pending = list(reqs)
+    calls = 0
+    engine.add_request(*pending.pop(0))
+    while (engine.has_unfinished_requests() or engine.has_inflight()
+           or pending):
+        if pending:
+            engine.add_request(*pending.pop(0))
+        outs.extend(engine.step_pipelined())
+        calls += 1
+        assert calls < 2000
+    assert _collect(outs) == ref
+
+
 def test_pipelined_k1_falls_back(tiny_opt_dir, example_prompts):
     """K=1 batches (no continuation program) still work through the
     pipelined driver — each step drains before the next fresh schedule."""
