@@ -109,6 +109,27 @@ class SpecDecodeWorker(Worker):
                                               self.parallel_config,
                                               sharding=kv_sharding)
 
+    # --- memory accounting ------------------------------------------------
+
+    def _extra_weights_bytes(self, shard_bytes) -> int:
+        import jax
+        if self.draft_runner is None:
+            return 0
+        return sum(shard_bytes(x)
+                   for x in jax.tree.leaves(self.draft_runner.params))
+
+    def _extra_block_bytes(self, block_size: int, cache_dtype: str) -> int:
+        """Every scheduler block also occupies a mirror block in the
+        draft pool (same indices, draft-architecture-sized arrays)."""
+        from intellillm_tpu.worker.cache_engine import CacheEngine
+        draft_mc = self.spec_config.draft_model_config
+        bb = CacheEngine.get_cache_block_size(block_size, cache_dtype,
+                                              draft_mc,
+                                              self.parallel_config)
+        tp = self.parallel_config.tensor_parallel_size
+        nkv = draft_mc.get_total_num_kv_heads()
+        return bb // tp if tp > 1 and nkv % tp == 0 else bb
+
     # --- step ------------------------------------------------------------
 
     def execute_model(

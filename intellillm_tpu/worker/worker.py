@@ -124,12 +124,15 @@ class Worker:
 
         weights_bytes = sum(
             shard_bytes(x) for x in jax.tree.leaves(self.params))
+        weights_bytes += self._extra_weights_bytes(shard_bytes)
 
         # KV pool shards by kv-head over the "model" axis when divisible.
         tp = self.parallel_config.tensor_parallel_size
         nkv = self.model_config.get_total_num_kv_heads()
         block_bytes_per_chip = (block_bytes // tp
                                 if tp > 1 and nkv % tp == 0 else block_bytes)
+        block_bytes_per_chip += self._extra_block_bytes(block_size,
+                                                        cache_dtype)
 
         temp_bytes = self._estimate_step_temp_bytes()
         # Fused-decode staging buffers (2 per layer, [B, C, Hkv, D]) and
@@ -159,6 +162,16 @@ class Worker:
             total / 2**30, weights_bytes / 2**30, temp_bytes / 2**30,
             block_bytes_per_chip / 2**10, num_device_blocks, num_cpu_blocks)
         return int(num_device_blocks), num_cpu_blocks
+
+    def _extra_weights_bytes(self, shard_bytes) -> int:
+        """Additional per-chip resident weight bytes a subclass holds
+        (e.g. a speculative draft model)."""
+        return 0
+
+    def _extra_block_bytes(self, block_size: int, cache_dtype: str) -> int:
+        """Additional per-block HBM a subclass consumes for every block
+        the scheduler allocates (e.g. the draft model's mirror pool)."""
+        return 0
 
     def _estimate_step_temp_bytes(self) -> int:
         """Compile the largest prefill shape against a tiny dummy cache and
