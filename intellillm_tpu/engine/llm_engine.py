@@ -716,9 +716,11 @@ class LLMEngine:
             return True
         if sampling_params.early_stopping == "never":
             if length_penalty > 0.0:
+                budget = (sampling_params.max_tokens
+                          if sampling_params.max_tokens is not None
+                          else self.scheduler_config.max_model_len)
                 max_possible_len = max(
-                    best_running_seq.get_prompt_len() +
-                    sampling_params.max_tokens,
+                    best_running_seq.get_prompt_len() + budget,
                     self.scheduler_config.max_model_len)
                 best_possible = best_running_seq.get_beam_search_score(
                     length_penalty, seq_len=max_possible_len,

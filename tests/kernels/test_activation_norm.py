@@ -1,0 +1,89 @@
+"""Activation and normalization layers vs torch reference formulas.
+
+Role parity: reference `tests/kernels/test_activation.py` (SiluAndMul,
+NewGELU, FastGELU vs torch) and `tests/kernels/test_layernorm.py`
+(RMSNorm with/without residual vs a float32 reference).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from intellillm_tpu.layers.activation import (gelu_fast, gelu_new,
+                                              get_act_fn, silu_and_mul)
+from intellillm_tpu.layers.normalization import (fused_add_rms_norm,
+                                                 layer_norm, rms_norm)
+
+
+@pytest.mark.parametrize("shape", [(7, 128), (2, 5, 64)])
+def test_silu_and_mul_matches_torch(shape):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(shape[:-1] + (2 * shape[-1], )
+                            ).astype(np.float32)
+    t = torch.from_numpy(x)
+    ref = (F.silu(t[..., :shape[-1]]) * t[..., shape[-1]:]).numpy()
+    got = np.asarray(silu_and_mul(jnp.asarray(x)))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gelu_new_matches_hf():
+    from transformers.activations import NewGELUActivation
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((11, 96)).astype(np.float32)
+    ref = NewGELUActivation()(torch.from_numpy(x)).numpy()
+    got = np.asarray(gelu_new(jnp.asarray(x)))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gelu_fast_matches_hf():
+    from transformers.activations import FastGELUActivation
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((11, 96)).astype(np.float32)
+    ref = FastGELUActivation()(torch.from_numpy(x)).numpy()
+    got = np.asarray(gelu_fast(jnp.asarray(x)))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_get_act_fn_known_and_unknown():
+    assert get_act_fn("gelu_new") is gelu_new
+    with pytest.raises((KeyError, ValueError)):
+        get_act_fn("definitely-not-an-activation")
+
+
+@pytest.mark.parametrize("eps", [1e-6, 1e-5])
+def test_rms_norm_matches_reference(eps):
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((5, 17, 64)).astype(np.float32)
+    w = rng.standard_normal(64).astype(np.float32)
+    var = (x.astype(np.float64) ** 2).mean(-1, keepdims=True)
+    ref = (x / np.sqrt(var + eps) * w).astype(np.float32)
+    got = np.asarray(rms_norm(jnp.asarray(x), jnp.asarray(w), eps))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_add_rms_norm_matches_unfused():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((3, 9, 64)).astype(np.float32)
+    res = rng.standard_normal((3, 9, 64)).astype(np.float32)
+    w = rng.standard_normal(64).astype(np.float32)
+    eps = 1e-6
+    summed = x + res
+    ref = np.asarray(rms_norm(jnp.asarray(summed), jnp.asarray(w), eps))
+    got, new_res = fused_add_rms_norm(jnp.asarray(x), jnp.asarray(res),
+                                      jnp.asarray(w), eps)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_res), summed,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_layer_norm_matches_torch():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((4, 8, 32)).astype(np.float32)
+    w = rng.standard_normal(32).astype(np.float32)
+    b = rng.standard_normal(32).astype(np.float32)
+    ref = F.layer_norm(torch.from_numpy(x), (32, ),
+                       torch.from_numpy(w), torch.from_numpy(b)).numpy()
+    got = np.asarray(layer_norm(jnp.asarray(x), jnp.asarray(w),
+                                jnp.asarray(b)))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)

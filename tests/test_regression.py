@@ -1,0 +1,43 @@
+"""Behavior regressions users reported against the reference engine —
+pinned here so this engine never reintroduces them.
+
+Role parity: reference `tests/test_regression.py`
+(test_duplicated_ignored_sequence_group — vllm issue #1655 — and
+test_max_tokens_none).
+"""
+from intellillm_tpu import LLM, SamplingParams
+
+
+def _llm(model_dir, **kw):
+    args = dict(dtype="float32", num_device_blocks_override=128,
+                max_model_len=128, max_num_seqs=8, max_paddings=512,
+                swap_space=0.01)
+    args.update(kw)
+    return LLM(model=model_dir, **args)
+
+
+def test_duplicated_ignored_sequence_group(tiny_opt_dir):
+    """An over-long prompt must be IGNORED (finish_reason length, no
+    crash) and still produce exactly one output per prompt — the
+    reference once emitted duplicated RequestOutputs for ignored groups
+    (vllm issue #1655)."""
+    llm = _llm(tiny_opt_dir)
+    prompts = ["hello my name is", "the cat runs fast " * 200]
+    outs = llm.generate(prompts, SamplingParams(temperature=0.01,
+                                                top_p=0.1,
+                                                max_tokens=64))
+    assert len(outs) == len(prompts)
+    ids = [o.request_id for o in outs]
+    assert len(ids) == len(set(ids))
+
+
+def test_max_tokens_none(tiny_opt_dir):
+    """max_tokens=None generates until EOS or the model-length cap."""
+    llm = _llm(tiny_opt_dir, max_model_len=64)
+    outs = llm.generate(["hello my name is"],
+                        SamplingParams(temperature=0.01, top_p=0.1,
+                                       max_tokens=None))
+    assert len(outs) == 1
+    out = outs[0].outputs[0]
+    assert len(out.token_ids) >= 1
+    assert out.finish_reason in ("stop", "length")

@@ -1,0 +1,66 @@
+"""SamplingParams validation surface.
+
+Role parity: reference `tests/test_sampling_params.py` (max_tokens=None)
+plus the validation behaviors the reference checks implicitly via
+`sampling_params.py:_verify_args`.
+"""
+import pytest
+
+from intellillm_tpu import SamplingParams
+
+
+def test_defaults():
+    sp = SamplingParams()
+    assert sp.n == 1 and sp.best_of == 1
+    assert sp.max_tokens == 16
+    assert sp.stop == [] and sp.stop_token_ids == []
+
+
+def test_max_tokens_none_allowed():
+    sp = SamplingParams(temperature=0.01, top_p=0.1, max_tokens=None)
+    assert sp.max_tokens is None
+
+
+@pytest.mark.parametrize("kwargs,match", [
+    (dict(n=0), "n must be"),
+    (dict(n=2, best_of=1), "best_of"),
+    (dict(temperature=-0.1), "temperature"),
+    (dict(top_p=0.0), "top_p"),
+    (dict(top_p=1.5), "top_p"),
+    (dict(top_k=0), "top_k"),
+    (dict(top_k=-2), "top_k"),
+    (dict(min_p=-0.5), "min_p"),
+    (dict(max_tokens=0), "max_tokens"),
+    (dict(logprobs=-1), "logprobs"),
+    (dict(prompt_logprobs=-1), "prompt_logprobs"),
+    (dict(presence_penalty=3.0), "presence_penalty"),
+    (dict(frequency_penalty=-3.0), "frequency_penalty"),
+    (dict(repetition_penalty=0.0), "repetition_penalty"),
+])
+def test_invalid_values_rejected(kwargs, match):
+    with pytest.raises(ValueError, match=match):
+        SamplingParams(**kwargs)
+
+
+def test_beam_search_constraints():
+    # Beam needs best_of > 1 and zero temperature knobs.
+    SamplingParams(use_beam_search=True, best_of=2, temperature=0.0)
+    with pytest.raises(ValueError):
+        SamplingParams(use_beam_search=True, best_of=1)
+    with pytest.raises(ValueError):
+        SamplingParams(use_beam_search=True, best_of=2, temperature=0.7)
+    # early_stopping only means something for beam search.
+    with pytest.raises(ValueError):
+        SamplingParams(early_stopping=True)
+
+
+def test_greedy_best_of_must_be_one():
+    with pytest.raises(ValueError, match="best_of"):
+        SamplingParams(temperature=0.0, best_of=3)
+
+
+def test_stop_normalization():
+    sp = SamplingParams(stop="the")
+    assert sp.stop == ["the"]
+    sp = SamplingParams(stop=["a", "b"], stop_token_ids=[5])
+    assert sp.stop == ["a", "b"] and sp.stop_token_ids == [5]
