@@ -35,8 +35,15 @@ run longctx_2048 env INTELLILLM_BENCH_MML=2048 INTELLILLM_BENCH_IN=1024 \
 run longctx_2048_big_pool env INTELLILLM_BENCH_MML=2048 \
     INTELLILLM_BENCH_IN=1024 INTELLILLM_BENCH_BS=24 \
     INTELLILLM_BENCH_BLOCKS=1800 python bench.py
+run longctx_2048_block32 env INTELLILLM_BENCH_MML=2048 \
+    INTELLILLM_BENCH_IN=1024 INTELLILLM_BENCH_BS=16 \
+    INTELLILLM_BENCH_BLOCK_SIZE=32 python bench.py
 run longctx_4096 env INTELLILLM_BENCH_MML=4096 INTELLILLM_BENCH_IN=3072 \
     INTELLILLM_BENCH_BS=8 INTELLILLM_BENCH_BLOCKS=1800 python bench.py
+
+# 3b. Prefill attention wall time vs length (flash, real chip).
+run sp_prefill python benchmarks/sp_prefill_bench.py --size 7b \
+    --lengths 2048,4096,8192 --modes flash
 
 # 4. Serving sweep (north star): pipelined vs not.
 run serve_pipelined python benchmarks/serve_bench.py --size 7b \
