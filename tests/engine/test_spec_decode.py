@@ -136,3 +136,16 @@ def test_spec_decode_vocab_mismatch_rejected(tiny_llama_dir,
     model.save_pretrained(d, safe_serialization=True)
     with pytest.raises(ValueError, match="vocab"):
         _run(tiny_llama_dir, [], speculative_model=d)
+
+
+def test_spec_decode_tp2(tiny_llama_dir, draft_llama_dir, example_prompts):
+    """Speculative decoding under TP=2 on the virtual mesh: both models
+    shard over the same mesh; outputs still match plain greedy."""
+    reqs = [(str(i), p, SamplingParams(temperature=0.0, max_tokens=12,
+                                       ignore_eos=True))
+            for i, p in enumerate(example_prompts[:2])]
+    ref, _ = _run(tiny_llama_dir, reqs)
+    got, _ = _run(tiny_llama_dir, reqs, tensor_parallel_size=2,
+                  speculative_model=draft_llama_dir,
+                  num_speculative_tokens=4)
+    assert got == ref
