@@ -18,6 +18,9 @@ def main():
     parser.add_argument("--dtype", type=str, default="auto")
     parser.add_argument("--max-model-len", type=int, default=None)
     parser.add_argument("--num-device-blocks-override", type=int, default=None)
+    parser.add_argument("--speculative-model", type=str, default=None,
+                        help="Draft model dir for speculative decoding")
+    parser.add_argument("--num-speculative-tokens", type=int, default=5)
     args = parser.parse_args()
 
     prompts = [
@@ -34,10 +37,14 @@ def main():
         max_tokens=args.max_tokens,
     )
 
+    spec = ({"speculative_model": args.speculative_model,
+             "num_speculative_tokens": args.num_speculative_tokens}
+            if args.speculative_model else {})
     llm = LLM(model=args.model,
               dtype=args.dtype,
               max_model_len=args.max_model_len,
-              num_device_blocks_override=args.num_device_blocks_override)
+              num_device_blocks_override=args.num_device_blocks_override,
+              **spec)
     outputs = llm.generate(prompts, sampling_params)
     for output in outputs:
         for comp in output.outputs:
