@@ -10,6 +10,8 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=benchmarks/round5_results
 mkdir -p "$OUT"
+touch /tmp/tpu_probe_pause                 # one TPU process at a time
+trap 'rm -f /tmp/tpu_probe_pause' EXIT
 
 log() { echo "== $(date +%H:%M:%S) $*" | tee -a "$OUT/runbook.log"; }
 
