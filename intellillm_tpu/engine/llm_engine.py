@@ -510,6 +510,25 @@ class LLMEngine:
         lag = cont.steps_dispatched
         mml = self.model_config.max_model_len
         bm = self.scheduler.block_manager
+        # A continuation is pure overshoot if every row's token budget is
+        # already covered by the dispatched-but-unfetched steps — the
+        # host KNOWS max_tokens and the model-length cap even though it
+        # hasn't seen the tokens yet (EOS/stops stay unpredictable; those
+        # rows still justify speculative continuation). The offline shape
+        # max_tokens == K would otherwise waste an entire fused call per
+        # batch.
+        any_needed = False
+        for i in range(len(cont.rows)):
+            ctx_i = int(cont.ctx0[i])
+            if ctx_i == 0:
+                continue
+            mt = cont.row_params[i].max_tokens
+            if ((mt is None or cont.out_lens0[i] + lag < mt)
+                    and ctx_i + lag < mml):
+                any_needed = True
+                break
+        if not any_needed:
+            return False
         targets = [(sid, min(int(cont.ctx0[i]) + lag + k - 1, mml))
                    for i, (_, sid) in enumerate(cont.rows)]
         if not bm.can_grow_all(targets):

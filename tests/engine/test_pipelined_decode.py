@@ -179,6 +179,38 @@ def test_pipelined_continuous_arrivals(tiny_llama_dir, example_prompts):
     assert _collect(outs) == ref
 
 
+def test_no_overshoot_cont_when_budgets_exhausted(tiny_llama_dir,
+                                                  example_prompts,
+                                                  monkeypatch):
+    """max_tokens == K (the offline-bench shape): after the one fused
+    decode call covers every row's budget, the pipeline must NOT dispatch
+    a continuation — it would be a 100% overshoot device call."""
+    llm = _build(tiny_llama_dir, num_decode_steps=8)
+    engine = llm.llm_engine
+    calls = {"cont": 0}
+    orig = engine.worker.execute_decode_cont
+
+    def counting(*a, **kw):
+        calls["cont"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(engine.worker, "execute_decode_cont", counting)
+    for i, p in enumerate(example_prompts):
+        engine.add_request(str(i), p,
+                           SamplingParams(temperature=0.0, max_tokens=8,
+                                          ignore_eos=True))
+    outs = []
+    n = 0
+    while engine.has_unfinished_requests() or engine.has_inflight():
+        outs.extend(engine.step_pipelined())
+        n += 1
+        assert n < 100
+    done = _collect(outs)
+    assert all(len(v[0][0]) == 8 for v in done.values())
+    assert calls["cont"] == 0, (
+        "pipeline dispatched a pure-overshoot continuation")
+
+
 def test_pipelined_k1_falls_back(tiny_opt_dir, example_prompts):
     """K=1 batches (no continuation program) still work through the
     pipelined driver — each step drains before the next fresh schedule."""
