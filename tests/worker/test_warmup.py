@@ -54,11 +54,13 @@ def test_warm_up_compiles_all_variants(monkeypatch, num_decode_steps):
     # environment that's a broken call sequence, not a hardware limit.
     assert n is not None, "warm-up fell back to lazy compilation"
     # Per warmed (width, sampler-variant): single-step + (fused +
-    # pipelined continuation if K>1); two sampler variants (greedy fast
-    # path + sampled); plus one fetch_indices variant on the first width
-    # (greedy only).
+    # pipelined continuation if K>1 and pipelining enabled); two sampler
+    # variants (greedy fast path + sampled); plus one fetch_indices
+    # variant on the first width (greedy only).
+    from intellillm_tpu.utils import pipeline_enabled_env
     n_widths = len(worker.model_runner.block_width_buckets[:2])
-    per_combo = 3 if num_decode_steps > 1 else 1
+    per_combo = ((3 if pipeline_enabled_env() else 2)
+                 if num_decode_steps > 1 else 1)
     assert n == n_widths * 2 * per_combo + 1
 
 
@@ -78,8 +80,10 @@ def test_warm_up_full_covers_every_batch_bucket(monkeypatch):
     assert n is not None
     buckets = worker.model_runner.batch_buckets  # 1,2,4,8 for max_seqs=8
     # Full mode must cover ALL width buckets (>2 of them at mml=1024:
-    # 16/32/64), two sampler variants, single+fused+continuation per
-    # combo.
+    # 16/32/64), two sampler variants, single+fused(+continuation when
+    # pipelining is enabled) per combo.
+    from intellillm_tpu.utils import pipeline_enabled_env
     n_widths = len(worker.model_runner.block_width_buckets)
     assert n_widths > 2
-    assert n == len(buckets) * n_widths * 2 * 3 + 1
+    per_combo = 3 if pipeline_enabled_env() else 2
+    assert n == len(buckets) * n_widths * 2 * per_combo + 1
