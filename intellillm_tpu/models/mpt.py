@@ -51,8 +51,11 @@ class MPTForCausalLM:
         get = (attn_cfg.get if isinstance(attn_cfg, dict)
                else lambda k, d=None: getattr(attn_cfg, k, d))
         self.clip_qkv = get("clip_qkv", None) if attn_cfg else None
-        if attn_cfg and get("qk_ln", False):
-            raise NotImplementedError("MPT qk_ln is not supported")
+        # llm-foundry qk_ln: full-width LayerNorm on q and k after the
+        # Wqkv split, before the head reshape (reference
+        # `vllm/model_executor/models/mpt.py` q_ln/k_ln; HF's MptModel
+        # cannot execute such checkpoints at all).
+        self.qk_ln = bool(attn_cfg and get("qk_ln", False))
         alibi_bias_max = (get("alibi_bias_max", 8) if attn_cfg else 8)
         softmax_scale = (get("softmax_scale", None) if attn_cfg else None)
         self.attn = PagedAttention(
@@ -85,6 +88,9 @@ class MPTForCausalLM:
         if self.clip_qkv is not None:
             qkv = jnp.clip(qkv, -self.clip_qkv, self.clip_qkv)
         q, k, v = jnp.split(qkv, 3, axis=-1)
+        if self.qk_ln:
+            q = layer_norm(q, lp["q_ln"]["w"], lp["q_ln"]["b"], 1e-5)
+            k = layer_norm(k, lp["k_ln"]["w"], lp["k_ln"]["b"], 1e-5)
         q = q.reshape(b, l, self.num_heads, self.head_size)
         k = k.reshape(b, l, self.num_heads, self.head_size)
         v = v.reshape(b, l, self.num_heads, self.head_size)
@@ -118,6 +124,9 @@ class MPTForCausalLM:
             "wqkv": dict(col), "out_proj": dict(row),
             "up": dict(col), "down": dict(row),
         }
+        if self.qk_ln:
+            layer["q_ln"] = dict(norm)
+            layer["k_ln"] = dict(norm)
         return {
             "wte": P("model", None),
             "norm_f": dict(norm),
@@ -149,13 +158,17 @@ class MPTForCausalLM:
         layers = []
         for i in range(self.num_layers):
             lk = jax.random.split(keys[i], 4)
-            layers.append({
+            layer = {
                 "norm_1": norm(), "norm_2": norm(),
                 "wqkv": lin(lk[0], e, 3 * e),
                 "out_proj": lin(lk[1], e, e),
                 "up": lin(lk[2], e, inner),
                 "down": lin(lk[3], inner, e),
-            })
+            }
+            if self.qk_ln:
+                layer["q_ln"] = norm()
+                layer["k_ln"] = norm()
+            layers.append(layer)
         return {
             "wte": rand(keys[-1], (cfg.vocab_size, e)),
             "norm_f": norm(),
@@ -194,14 +207,18 @@ class MPTForCausalLM:
         }
         for i in range(self.num_layers):
             p = f"blocks.{i}."
-            params["layers"].append({
+            layer = {
                 "norm_1": norm(p + "norm_1"),
                 "norm_2": norm(p + "norm_2"),
                 "wqkv": lin(p + "attn.Wqkv"),
                 "out_proj": lin(p + "attn.out_proj"),
                 "up": lin(p + "ffn.up_proj"),
                 "down": lin(p + "ffn.down_proj"),
-            })
+            }
+            if self.qk_ln:
+                layer["q_ln"] = norm(p + "attn.q_ln")
+                layer["k_ln"] = norm(p + "attn.k_ln")
+            params["layers"].append(layer)
         return params
 
 

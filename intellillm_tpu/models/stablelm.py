@@ -41,12 +41,13 @@ class StableLMForCausalLM:
         self.ln_eps = getattr(cfg, "layer_norm_eps", 1e-5)
         self.act = get_act_fn(getattr(cfg, "hidden_act", "silu"))
         self.use_qkv_bias = getattr(cfg, "use_qkv_bias", False)
-        if getattr(cfg, "qk_layernorm", False):
-            raise NotImplementedError(
-                "StableLM qk_layernorm is not supported yet")
-        if getattr(cfg, "use_parallel_residual", False):
-            raise NotImplementedError(
-                "StableLM use_parallel_residual is not supported yet")
+        # Per-head q/k LayerNorms (HF StableLmLayerNormPerHead: one
+        # bias-free LayerNorm per head, applied before rope) and the
+        # GPT-NeoX-style parallel residual
+        # (x + attn(ln1(x)) + mlp(ln1(x)), no post-attention norm).
+        self.qk_layernorm = getattr(cfg, "qk_layernorm", False)
+        self.parallel_residual = getattr(cfg, "use_parallel_residual",
+                                         False)
         rope_pct = (getattr(cfg, "partial_rotary_factor", None)
                     or getattr(cfg, "rope_pct", 0.25))
         rotary_dim = int(self.head_size * rope_pct)
@@ -76,6 +77,15 @@ class StableLMForCausalLM:
             out = out + p["b"]
         return out
 
+    def _per_head_ln(self, x, w):
+        """Bias-free LayerNorm over head_size with per-head weights
+        (HF StableLmLayerNormPerHead). x [B, L, H, D], w [H, D]."""
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+        out = (xf - mu) / jnp.sqrt(var + self.ln_eps) * w[None, None]
+        return out.astype(x.dtype)
+
     def _layer(self, lp, h, kv_cache, attn_metadata, positions):
         b, l, e = h.shape
         residual = h
@@ -87,10 +97,22 @@ class StableLMForCausalLM:
                                            self.head_size)
         v = self._proj(x, lp["v"]).reshape(b, l, self.num_kv_heads,
                                            self.head_size)
+        if self.qk_layernorm:
+            q = self._per_head_ln(q, lp["q_ln"])
+            k = self._per_head_ln(k, lp["k_ln"])
         q, k = self.rope(positions, q, k)
         attn_out, kv_cache = self.attn(q, k, v, kv_cache, attn_metadata)
-        h = residual + self._proj(attn_out.reshape(b, l, e), lp["o"])
+        attn_o = self._proj(attn_out.reshape(b, l, e), lp["o"])
 
+        if self.parallel_residual:
+            # x + attn(ln1(x)) + mlp(ln1(x)) — the MLP reads the SAME
+            # normed input; no post-attention layernorm exists.
+            gate = self._proj(x, lp["gate"])
+            up = self._proj(x, lp["up"])
+            mlp_o = self._proj(self.act(gate) * up, lp["down"])
+            return residual + attn_o + mlp_o, kv_cache
+
+        h = residual + attn_o
         residual = h
         x = layer_norm(h, lp["post_attn_ln"]["w"], lp["post_attn_ln"]["b"],
                        self.ln_eps)
@@ -111,6 +133,12 @@ class StableLMForCausalLM:
                  "q": dict(col), "k": dict(col), "v": dict(col),
                  "o": dict(row), "gate": dict(col), "up": dict(col),
                  "down": dict(row)}
+        if self.qk_layernorm:
+            # [H, D] per-head weights follow the head split of q/k cols.
+            layer["q_ln"] = P("model", None)
+            layer["k_ln"] = P("model", None)
+        if self.parallel_residual:
+            layer.pop("post_attn_ln")
         return {"embed_tokens": P("model", None), "norm": dict(norm),
                 "lm_head": P(None, "model"),
                 "layers": [dict(layer) for _ in range(self.num_layers)]}
@@ -140,12 +168,20 @@ class StableLMForCausalLM:
         qb = self.use_qkv_bias
         for i in range(self.num_layers):
             lk = jax.random.split(keys[i], 7)
-            layers.append({
+            layer = {
                 "input_ln": norm(), "post_attn_ln": norm(),
                 "q": lin(lk[0], e, e, qb), "k": lin(lk[1], e, hkv, qb),
                 "v": lin(lk[2], e, hkv, qb), "o": lin(lk[3], e, e),
                 "gate": lin(lk[4], e, inter), "up": lin(lk[5], e, inter),
-                "down": lin(lk[6], inter, e)})
+                "down": lin(lk[6], inter, e)}
+            if self.qk_layernorm:
+                layer["q_ln"] = jnp.ones((self.num_heads,
+                                          self.head_size), dtype)
+                layer["k_ln"] = jnp.ones((self.num_kv_heads,
+                                          self.head_size), dtype)
+            if self.parallel_residual:
+                layer.pop("post_attn_ln")
+            layers.append(layer)
         return {"embed_tokens": rand(keys[-2], (v, e)),
                 "norm": norm(),
                 "lm_head": rand(keys[-1], (e, v)),
@@ -187,9 +223,8 @@ class StableLMForCausalLM:
         }
         for i in range(self.num_layers):
             p = f"model.layers.{i}."
-            params["layers"].append({
+            layer = {
                 "input_ln": norm(p + "input_layernorm"),
-                "post_attn_ln": norm(p + "post_attention_layernorm"),
                 "q": lin(p + "self_attn.q_proj"),
                 "k": lin(p + "self_attn.k_proj"),
                 "v": lin(p + "self_attn.v_proj"),
@@ -197,5 +232,18 @@ class StableLMForCausalLM:
                 "gate": lin(p + "mlp.gate_proj"),
                 "up": lin(p + "mlp.up_proj"),
                 "down": lin(p + "mlp.down_proj"),
-            })
+            }
+            if not self.parallel_residual:
+                layer["post_attn_ln"] = norm(
+                    p + "post_attention_layernorm")
+            if self.qk_layernorm:
+                # HF StableLmLayerNormPerHead: one bias-free LayerNorm
+                # per head, stored as .norms.{h}.weight — stack to [H, D].
+                layer["q_ln"] = jnp.stack([
+                    V(f"{p}self_attn.q_layernorm.norms.{h}.weight")
+                    for h in range(self.num_heads)])
+                layer["k_ln"] = jnp.stack([
+                    V(f"{p}self_attn.k_layernorm.norms.{h}.weight")
+                    for h in range(self.num_kv_heads)])
+            params["layers"].append(layer)
         return params
