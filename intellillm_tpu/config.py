@@ -82,12 +82,11 @@ class ModelConfig:
                 f"Unknown tokenizer mode: {self.tokenizer_mode}; "
                 "must be 'auto' or 'slow'.")
 
+    # Every supported method has a lossless TPU checkpoint loader
+    # (weight_utils.load_linear): int8 quantize-on-load; AWQ/GPTQ →
+    # packed int4 (act-order via an input-row permutation); SqueezeLLM →
+    # exact per-channel LUT ({"q4lut","lut"}).
     _SUPPORTED_QUANT = ("awq", "gptq", "squeezellm", "int8")
-    # Methods with a working TPU checkpoint loader (weight_utils.load_linear):
-    # AWQ and GPTQ convert losslessly to the device int4 representation
-    # (GPTQ act-order via an input-row permutation); SqueezeLLM's
-    # non-uniform LUT dequantizes-on-load to per-channel int8 (logged).
-    _LOADABLE_QUANT = ("int8", "awq", "gptq", "squeezellm")
 
     def _verify_quantization(self) -> None:
         if self.quantization is None:
@@ -106,14 +105,6 @@ class ModelConfig:
             raise ValueError(
                 f"Unknown quantization method: {self.quantization}; "
                 f"supported: {self._SUPPORTED_QUANT}")
-        if (self.quantization is not None
-                and self.quantization not in self._LOADABLE_QUANT):
-            # Fail here with a clear message instead of an opaque KeyError
-            # at load_weights time.
-            raise NotImplementedError(
-                f"Quantization method '{self.quantization}' is not yet "
-                "supported on TPU (no checkpoint loader). Supported today: "
-                f"{self._LOADABLE_QUANT}.")
         # Bit-width check applies whether the method was auto-detected or
         # passed explicitly — only 4-bit AWQ/GPTQ/SqueezeLLM loads.
         if self.quantization in ("awq", "gptq", "squeezellm"):
