@@ -862,6 +862,11 @@ class LLMEngine:
             if sg.is_finished():
                 e2e.append(now - sg.arrival_time)
 
+        spec_rate = None
+        if (self.speculative_config is not None
+                and getattr(self.worker, "num_draft_tokens", 0) > 0):
+            spec_rate = self.worker.acceptance_rate()
+
         return Stats(
             now=now,
             num_running=len(self.scheduler.running),
@@ -874,4 +879,5 @@ class LLMEngine:
             time_to_first_tokens=time_to_first,
             time_per_output_tokens=time_per_output,
             time_e2e_requests=e2e,
+            spec_acceptance_rate=spec_rate,
         )

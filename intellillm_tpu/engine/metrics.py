@@ -35,6 +35,9 @@ class Stats:
     time_to_first_tokens: List[float] = field(default_factory=list)
     time_per_output_tokens: List[float] = field(default_factory=list)
     time_e2e_requests: List[float] = field(default_factory=list)
+    # Speculative decoding: rolling draft-token acceptance rate (None
+    # when spec decoding is off) — reference RejectionSampler counters.
+    spec_acceptance_rate: float = None
 
 
 class _Metrics:
@@ -84,6 +87,10 @@ class _Metrics:
             "intellillm_e2e_request_latency_seconds",
             "Histogram of end to end request latency in seconds.", labelnames,
             buckets=[1.0, 2.5, 5.0, 10.0, 15.0, 20.0, 30.0, 40.0, 50.0, 60.0])
+        self.gauge_spec_acceptance = Gauge(
+            "intellillm_spec_acceptance_rate",
+            "Speculative decoding draft-token acceptance rate (rolling).",
+            labelnames)
 
 
 class StatLogger:
@@ -121,6 +128,9 @@ class StatLogger:
                 m.histogram_time_per_output_token.labels(*lv).observe(t)
             for t in stats.time_e2e_requests:
                 m.histogram_e2e_request_latency.labels(*lv).observe(t)
+            if stats.spec_acceptance_rate is not None:
+                m.gauge_spec_acceptance.labels(*lv).set(
+                    stats.spec_acceptance_rate)
 
         self.num_prompt_tokens.append(stats.num_prompt_tokens)
         self.num_generation_tokens.append(stats.num_generation_tokens)
