@@ -847,8 +847,12 @@ class LLMEngine:
 
         prompt_tokens = (scheduler_outputs.num_batched_tokens
                          if scheduler_outputs.prompt_run else 0)
+        # A decode pass generates num_decode_steps tokens PER ROW
+        # (num_batched_tokens counts rows); without the multiplier the
+        # throughput log and Prometheus counter under-report by K.
         generation_tokens = (0 if scheduler_outputs.prompt_run else
-                             scheduler_outputs.num_batched_tokens)
+                             scheduler_outputs.num_batched_tokens *
+                             scheduler_outputs.num_decode_steps)
 
         time_to_first: List[float] = []
         time_per_output: List[float] = []
