@@ -857,11 +857,14 @@ class LLMEngine:
         time_to_first: List[float] = []
         time_per_output: List[float] = []
         e2e: List[float] = []
+        k = max(scheduler_outputs.num_decode_steps, 1)
         for sg in scheduler_outputs.scheduled_seq_groups:
             if scheduler_outputs.prompt_run and sg.first_scheduled_time:
                 time_to_first.append(now - sg.arrival_time)
             elif not scheduler_outputs.prompt_run and sg.last_token_time:
-                time_per_output.append(now - sg.last_token_time)
+                # One decode pass emits K tokens; the histogram records
+                # PER-TOKEN time.
+                time_per_output.append((now - sg.last_token_time) / k)
             sg.last_token_time = now
             if sg.is_finished():
                 e2e.append(now - sg.arrival_time)
