@@ -850,20 +850,30 @@ class LLMEngine:
         # A decode pass generates num_decode_steps tokens PER ROW
         # (num_batched_tokens counts rows); without the multiplier the
         # throughput log and Prometheus counter under-report by K.
-        generation_tokens = (0 if scheduler_outputs.prompt_run else
-                             scheduler_outputs.num_batched_tokens *
-                             scheduler_outputs.num_decode_steps)
+        # Speculative passes emit a VARIABLE count (accepted+1 per row) —
+        # use the worker's actual emission, not K+1.
+        k_eff = scheduler_outputs.num_decode_steps
+        if scheduler_outputs.prompt_run:
+            generation_tokens = 0
+        elif self.speculative_config is not None:
+            generation_tokens = getattr(self.worker, "last_pass_emitted",
+                                        scheduler_outputs.num_batched_tokens)
+            rows = max(scheduler_outputs.num_batched_tokens, 1)
+            k_eff = max(generation_tokens / rows, 1e-6)
+        else:
+            generation_tokens = (scheduler_outputs.num_batched_tokens *
+                                 scheduler_outputs.num_decode_steps)
 
         time_to_first: List[float] = []
         time_per_output: List[float] = []
         e2e: List[float] = []
-        k = max(scheduler_outputs.num_decode_steps, 1)
+        k = max(k_eff, 1e-6)
         for sg in scheduler_outputs.scheduled_seq_groups:
             if scheduler_outputs.prompt_run and sg.first_scheduled_time:
                 time_to_first.append(now - sg.arrival_time)
             elif not scheduler_outputs.prompt_run and sg.last_token_time:
-                # One decode pass emits K tokens; the histogram records
-                # PER-TOKEN time.
+                # One decode pass emits ~k tokens per row; the histogram
+                # records PER-TOKEN time.
                 time_per_output.append((now - sg.last_token_time) / k)
             sg.last_token_time = now
             if sg.is_finished():

@@ -90,7 +90,16 @@ def shard_params(host_params: Any, mesh: Mesh, model) -> Any:
 
     def place(path, leaf):
         key = jax.tree_util.keystr(path)
-        spec = spec_by_path.get(key) or P()
+        spec = spec_by_path.get(key)
+        if spec is None:
+            # Quantized-weight spec dicts ({"q4": spec, ...}) cover the
+            # packed representations, but the loader may legitimately
+            # fall back to a DENSE array at the parent path (irregular
+            # group layouts, dummy weights). The dense weight has the
+            # same dims as its packed "q4"/"q" form — inherit that spec
+            # instead of silently replicating a multi-GiB expert stack.
+            spec = (spec_by_path.get(key + "['q4']")
+                    or spec_by_path.get(key + "['q']") or P())
         fixed = []
         for dim, axis in enumerate(spec):
             if axis is None:

@@ -57,6 +57,9 @@ class SpecDecodeWorker(Worker):
         assert speculative_config is not None
         self.spec_config = speculative_config
         self.k_spec = speculative_config.num_speculative_tokens
+        # Spec mode never pipelines: skip the continuation-program
+        # compile; warm_up_model warms teacher/draft programs instead.
+        self.warm_cont_program = False
         # BENCHMARK-ONLY: accept every draft regardless of the target's
         # choices. Dummy-weight perf runs have no meaningful acceptance
         # rate (random draft/target never agree), so this measures the
@@ -75,6 +78,10 @@ class SpecDecodeWorker(Worker):
         # Rolling acceptance stats (reference RejectionSampler counters).
         self.num_draft_tokens = 0
         self.num_accepted_tokens = 0
+        # Tokens actually emitted by the most recent decode pass (spec
+        # passes emit a VARIABLE count: accepted+1 per row; throughput
+        # stats must not assume K+1).
+        self.last_pass_emitted = 0
 
     # --- init ------------------------------------------------------------
 
@@ -108,6 +115,64 @@ class SpecDecodeWorker(Worker):
         self.draft_cache_engine = CacheEngine(cache_config, draft_mc,
                                               self.parallel_config,
                                               sharding=kv_sharding)
+
+    def warm_up_model(self):
+        """Warm-up for spec serving: the target's standard decode
+        programs (fallback path, K = k_spec+1), the DRAFT model's decode
+        programs (by re-running the generic warm-up against the draft
+        runner/cache), and the teacher-forced verification program —
+        otherwise each compiles lazily as a multi-second stall on the
+        first real request."""
+        n = super().warm_up_model()
+        if n is None:
+            return None
+        saved = (self.model_runner, self.cache_engine, self.params)
+        self.model_runner = self.draft_runner
+        self.cache_engine = self.draft_cache_engine
+        self.params = self.draft_runner.params
+        try:
+            n_draft = super().warm_up_model()
+        finally:
+            self.model_runner, self.cache_engine, self.params = saved
+        n_teacher = self._warm_teacher()
+        return n + (n_draft or 0) + n_teacher
+
+    def _warm_teacher(self) -> int:
+        """Compile the teacher-forced program at the top batch bucket /
+        narrowest width for the greedy sampler variant (spec eligibility
+        is greedy-only)."""
+        import numpy as np
+
+        runner = self.model_runner
+        k1 = self.k_spec + 1
+        try:
+            b = runner.batch_buckets[-1]
+            w = runner.block_width_buckets[0]
+            place = runner._place_batch_array
+            args = (place(np.zeros((b, k1), np.int32)),      # teacher
+                    place(np.zeros((b, 1), np.int32)),       # positions
+                    place(np.zeros((b, w), np.int32)),
+                    place(np.zeros(b, np.int32)),
+                    place(np.zeros(b, np.float32)),
+                    place(np.full(b, -1, np.int32)),
+                    place(np.ones(b, np.float32)),
+                    place(np.zeros(b, np.float32)),
+                    place(np.zeros(b, np.uint32)),
+                    place(np.zeros(b, np.float32)),
+                    place(np.zeros(b, np.float32)),
+                    place(np.ones(b, np.float32)), None, None)
+            packed, caches = runner._jit_decode_teacher(
+                self.params, self.cache_engine.device_cache, *args,
+                num_steps=k1, logprob_k=1, do_topk=False, do_topp=False,
+                do_minp=False, do_penalties=False, do_random=False)
+            self.cache_engine.device_cache = caches
+            import jax
+            jax.block_until_ready(packed)
+            return 1
+        except Exception as e:  # best-effort, same contract as warm-up
+            logger.warning("Teacher warm-up failed (%s); compiling "
+                           "lazily instead", e)
+            return 0
 
     # --- memory accounting ------------------------------------------------
 
@@ -179,6 +244,9 @@ class SpecDecodeWorker(Worker):
             seq_group_metadata_list, self.cache_engine.device_cache,
             num_decode_steps)
         self.cache_engine.device_cache = new_caches
+        self.last_pass_emitted = (num_decode_steps *
+                                  sum(len(m.seq_data)
+                                      for m in seq_group_metadata_list))
         return outputs
 
     @staticmethod
@@ -244,6 +312,7 @@ class SpecDecodeWorker(Worker):
             acc_len.append(a + 1)
             self.num_draft_tokens += k
             self.num_accepted_tokens += a
+        self.last_pass_emitted = sum(acc_len)
 
         outputs: List[SamplerOutput] = []
         for s in range(max(acc_len)):

@@ -57,6 +57,11 @@ class Worker:
         self.params = None
         self.model_runner: Optional[ModelRunner] = None
         self.cache_engine: Optional[CacheEngine] = None
+        # Whether warm-up should compile the pipelined-continuation
+        # program (SpecDecodeWorker disables it: spec mode never
+        # pipelines, and warms its own teacher/draft programs instead).
+        from intellillm_tpu.utils import pipeline_enabled_env
+        self.warm_cont_program = pipeline_enabled_env()
 
     # --- init ------------------------------------------------------------
 
@@ -347,9 +352,7 @@ class Worker:
                                 *args, num_steps=k, **flags)
                             self.cache_engine.device_cache = caches
                             n += 1
-                            from intellillm_tpu.utils import (
-                                pipeline_enabled_env)
-                            if pipeline_enabled_env():
+                            if self.warm_cont_program:
                                 # Pipelined continuation program: same arg
                                 # shapes, tokens sliced from the previous
                                 # step's packed output (which the fused

@@ -166,3 +166,29 @@ def test_quant_mixtral_tp2(quant_mixtral_dirs, example_prompts):
     single = _greedy(gq_dir, example_prompts)
     tp2 = _greedy(gq_dir, example_prompts, tp=2)
     assert tp2 == single
+
+
+def test_dense_fallback_inherits_quant_sharding(quant_mixtral_dirs):
+    """Dense-fallback leaves at quantized-spec paths (dummy weights,
+    irregular layouts) must inherit the packed form's sharding instead of
+    silently replicating multi-GiB expert stacks on TP meshes."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from intellillm_tpu.config import ModelConfig
+    from intellillm_tpu.models.model_loader import get_model
+    from intellillm_tpu.parallel.mesh import shard_params
+
+    gq_dir, _ = quant_mixtral_dirs
+    # Dummy load: quantization="gptq" but expert stacks come out DENSE.
+    mc = ModelConfig(model=gq_dir, dtype="float32", load_format="dummy")
+    model, params = get_model(mc, load_format="dummy")
+    devs = np.array(jax.devices()[:2]).reshape(1, 2)
+    mesh = Mesh(devs, ("data", "model"))
+    placed = shard_params(params, mesh, model)
+    w1 = placed["layers"][0]["w1"]
+    assert not isinstance(w1, dict)          # really the dense fallback
+    spec = w1.sharding.spec
+    assert "model" in tuple(spec), (
+        f"dense expert stack replicated instead of sharded: {spec}")

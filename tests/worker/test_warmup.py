@@ -87,3 +87,50 @@ def test_warm_up_full_covers_every_batch_bucket(monkeypatch):
     assert n_widths > 2
     per_combo = 3 if pipeline_enabled_env() else 2
     assert n == len(buckets) * n_widths * 2 * per_combo + 1
+
+
+def test_spec_worker_warmup_covers_teacher_and_draft(monkeypatch):
+    """Speculative serving warm-up must compile the draft model's decode
+    programs and the teacher-forced verification program (and must NOT
+    compile the pipelined-continuation program spec mode never uses)."""
+    from transformers import LlamaConfig
+
+    from intellillm_tpu.config import SpeculativeConfig
+    from intellillm_tpu.worker.spec_decode.spec_worker import (
+        SpecDecodeWorker)
+
+    def mc(hidden, inter, layers):
+        hf = LlamaConfig(vocab_size=128, hidden_size=hidden,
+                         intermediate_size=inter, num_hidden_layers=layers,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=128,
+                         tie_word_embeddings=False)
+        return ModelConfig.from_hf_config(hf, dtype="float32",
+                                          max_model_len=128,
+                                          load_format="dummy")
+
+    cache_config = CacheConfig(block_size=16,
+                               num_device_blocks_override=64,
+                               swap_space_gib=0.01)
+    cache_config.num_device_blocks = 64
+    cache_config.num_cpu_blocks = 4
+    k_spec = 3
+    scheduler_config = SchedulerConfig(max_num_batched_tokens=2048,
+                                       max_num_seqs=8, max_model_len=128,
+                                       max_paddings=512,
+                                       num_decode_steps=k_spec + 1)
+    spec = SpeculativeConfig(mc(32, 64, 1), k_spec)
+    worker = SpecDecodeWorker(mc(64, 128, 2), ParallelConfig(),
+                              scheduler_config, cache_config,
+                              speculative_config=spec)
+    worker.init_model()
+    worker.load_model()
+    worker.init_cache_engine(cache_config)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    n = worker.warm_up_model()
+    assert n is not None, "spec warm-up fell back to lazy compilation"
+    # target standard programs + the same set for the draft + 1 teacher;
+    # no continuation programs in either pass.
+    n_widths = len(worker.model_runner.block_width_buckets[:2])
+    per_model = n_widths * 2 * 2 + 1   # single+fused, 2 sampler variants
+    assert n == 2 * per_model + 1
