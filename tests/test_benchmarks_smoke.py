@@ -53,3 +53,42 @@ def test_benchmark_serving_smoke():
     assert m["completed"] == 4
     assert m["output_tok_s"] > 0
     assert m["ttft_percentiles_ms"]["p50"] > 0
+
+
+def test_sp_prefill_bench_smoke():
+    """sp_prefill_bench emits one JSON line per (mode, length) on the CPU
+    backend (flash under interpret mode, ring on the virtual mesh)."""
+    import json
+    env = dict(os.environ)
+    env["INTELLILLM_JAX_PLATFORM"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, "benchmarks/sp_prefill_bench.py", "--size",
+         "tiny", "--lengths", "256", "--modes", "flash,ring"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    lines = [json.loads(x) for x in r.stdout.splitlines()
+             if x.startswith("{")]
+    assert len(lines) == 2
+    assert all(x["value"] > 0 for x in lines)
+
+
+def test_spec_bench_modes_build():
+    """spec_bench's engine configuration (draft + force-accept env)
+    drives bench.py end to end on CPU."""
+    import json
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(INTELLILLM_BENCH_SIZE="tiny", INTELLILLM_BENCH_SPEC="tiny",
+               INTELLILLM_BENCH_SPEC_K="2", INTELLILLM_BENCH_BS="2",
+               INTELLILLM_BENCH_IN="8", INTELLILLM_BENCH_OUT="4",
+               INTELLILLM_SPEC_FORCE_ACCEPT="1")
+    r = subprocess.run([sys.executable, "bench.py"], env=env,
+                       capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["value"] > 0
