@@ -292,10 +292,17 @@ def main():
             _fail_record(f"build_engine failed twice: {e2!r}")
             raise
 
-    # Warmup: compile prefill+decode buckets on a short run.
+    # Warmup: compile prefill+decode buckets on a short run. When the
+    # measured run will chain pipelined continuations (out > K), the
+    # warmup must run K+2 tokens so the continuation executable compiles
+    # HERE, not inside the measurement.
     _PROGRESS["phase"] = "warmup"
+    k_steps = int(os.environ.get("INTELLILLM_BENCH_K", "128"))
+    warm_out = (k_steps + 2 if engine.pipeline_enabled
+                and output_len > k_steps else 4)
     try:
-        w_tokens, w_elapsed = run(engine, batch_size, input_len, 4, vocab)
+        w_tokens, w_elapsed = run(engine, batch_size, input_len, warm_out,
+                                  vocab)
     except Exception as e:
         _fail_record(f"warmup run failed: {e!r}")
         raise

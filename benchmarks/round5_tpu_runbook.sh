@@ -25,6 +25,11 @@ run() { # name, env..., -- cmd...
 # 1. Headline (hardened bench; also first pipelined offline number).
 run headline_pipelined python bench.py
 run headline_nopipeline env INTELLILLM_PIPELINE=0 python bench.py
+# With pipelining the fetch no longer needs K-huge amortization (out==K
+# means ONE fused call and no continuations at the default shape) —
+# smaller K with chained continuations may now win:
+run headline_k64 env INTELLILLM_BENCH_K=64 python bench.py
+run headline_k32 env INTELLILLM_BENCH_K=32 python bench.py
 
 # 2. bs sweep incl. the BASELINE-named bs=256 config.
 for bs in 64 96 128 192 256; do
