@@ -61,6 +61,58 @@ def launch_server(model_dir: str, args) -> subprocess.Popen:
                             stderr=subprocess.STDOUT)
 
 
+def snapshot_observability(base: str) -> dict:
+    """Scrape /metrics and distill the step-phase histograms and XLA
+    compile counters into a compact dict for the summary JSON, so BENCH
+    files carry latency attribution next to throughput."""
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            text = r.read().decode(errors="replace")
+    except Exception as e:
+        return {"error": f"metrics scrape failed: {e}"}
+
+    phase_sum: dict = {}
+    phase_count: dict = {}
+    out = {"step_phase_seconds": phase_sum, "step_phase_samples": phase_count,
+           "xla_compiles": {}, "xla_cache_hits": {},
+           "xla_compile_time_seconds": {}, "kernel_dispatch": {}}
+    simple = {"intellillm_xla_compiles_total": ("xla_compiles", "program"),
+              "intellillm_xla_cache_hits_total":
+                  ("xla_cache_hits", "program"),
+              "intellillm_kernel_dispatch_total":
+                  ("kernel_dispatch", "path")}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        try:
+            name_labels, value = line.rsplit(None, 1)
+            value = float(value)
+            name, _, labels = name_labels.partition("{")
+            labels = dict(
+                kv.split("=", 1) for kv in labels.rstrip("}").split(",")
+                if "=" in kv) if labels else {}
+            labels = {k: v.strip('"') for k, v in labels.items()}
+        except ValueError:
+            continue
+        if name == "intellillm_step_phase_seconds_sum":
+            phase_sum[labels.get("phase", "?")] = value
+        elif name == "intellillm_step_phase_seconds_count":
+            phase_count[labels.get("phase", "?")] = value
+        elif name == "intellillm_step_time_seconds_sum":
+            out["step_time_seconds_sum"] = value
+        elif name == "intellillm_step_time_seconds_count":
+            out["step_count"] = value
+        elif name == "intellillm_xla_compile_time_seconds_sum":
+            out["xla_compile_time_seconds"][
+                labels.get("program", "?")] = value
+        elif name == "intellillm_live_executables":
+            out["live_executables"] = value
+        elif name in simple:
+            key, label = simple[name]
+            out[key][labels.get(label, "?")] = value
+    return out
+
+
 def wait_healthy(proc: subprocess.Popen, base: str, timeout: float,
                  server_log: str) -> None:
     deadline = time.monotonic() + timeout
@@ -140,6 +192,7 @@ def main(args) -> dict:
             summary["results"].append(m)
             print(json.dumps({"serve_bench_rate": rate_s, **m}),
                   flush=True)
+        summary["observability"] = snapshot_observability(base)
     finally:
         proc.send_signal(signal.SIGKILL)
         proc.wait()

@@ -32,6 +32,7 @@ from intellillm_tpu.config import CacheConfig, LoRAConfig, SchedulerConfig
 from intellillm_tpu.core.block_manager import AllocStatus, BlockSpaceManager
 from intellillm_tpu.core.policy import Policy, PolicyFactory
 from intellillm_tpu.logger import init_logger
+from intellillm_tpu.obs import get_flight_recorder, get_step_tracer
 from intellillm_tpu.prefix import PrefixPool
 from intellillm_tpu.sequence import (Sequence, SequenceData, SequenceGroup,
                                      SequenceGroupMetadata, SequenceStatus)
@@ -113,6 +114,9 @@ class Scheduler:
         self._free_guard: Dict[int, int] = {}       # seq_id -> refcount
         self._deferred_free: Dict[int, Sequence] = {}
 
+        self._tracer = get_step_tracer()
+        self._flight = get_flight_recorder()
+
     @property
     def lora_enabled(self) -> bool:
         return self.lora_config is not None
@@ -150,6 +154,7 @@ class Scheduler:
                     request_ids.remove(seq_group.request_id)
             for seq_group in aborted:
                 state_queue.remove(seq_group)
+                self._flight.record(seq_group.request_id, "aborted")
                 for seq in seq_group.get_seqs():
                     if seq.is_finished():
                         continue
@@ -255,6 +260,9 @@ class Scheduler:
                 scheduled.append(seq_group)
                 if seq_group.first_scheduled_time is None:
                     seq_group.first_scheduled_time = now
+                    self._flight.record(seq_group.request_id, "scheduled")
+                self._flight.record(seq_group.request_id, "prefill_start",
+                                    detail=f"tokens={num_prompt_tokens}")
 
             # Deferred-for-LoRA groups go back to the front (in order).
             for sg in reversed(lora_deferred):
@@ -377,25 +385,27 @@ class Scheduler:
     def schedule(
         self, prefill_only: bool = False,
     ) -> Tuple[List[SequenceGroupMetadata], SchedulerOutputs]:
-        scheduler_outputs = self._schedule(prefill_only=prefill_only)
+        with self._tracer.span("schedule"):
+            scheduler_outputs = self._schedule(prefill_only=prefill_only)
 
-        seq_group_metadata_list: List[SequenceGroupMetadata] = []
-        for seq_group in scheduler_outputs.scheduled_seq_groups:
-            seq_data: Dict[int, SequenceData] = {}
-            block_tables: Dict[int, List[int]] = {}
-            for seq in seq_group.get_seqs(status=SequenceStatus.RUNNING):
-                seq_data[seq.seq_id] = seq.data
-                block_tables[seq.seq_id] = self.block_manager.get_block_table(seq)
-            seq_group_metadata_list.append(
-                SequenceGroupMetadata(
-                    request_id=seq_group.request_id,
-                    is_prompt=scheduler_outputs.prompt_run,
-                    seq_data=seq_data,
-                    sampling_params=seq_group.sampling_params,
-                    block_tables=block_tables,
-                    lora_request=seq_group.lora_request,
-                    prefix=seq_group.prefix,
-                ))
+            seq_group_metadata_list: List[SequenceGroupMetadata] = []
+            for seq_group in scheduler_outputs.scheduled_seq_groups:
+                seq_data: Dict[int, SequenceData] = {}
+                block_tables: Dict[int, List[int]] = {}
+                for seq in seq_group.get_seqs(status=SequenceStatus.RUNNING):
+                    seq_data[seq.seq_id] = seq.data
+                    block_tables[seq.seq_id] = (
+                        self.block_manager.get_block_table(seq))
+                seq_group_metadata_list.append(
+                    SequenceGroupMetadata(
+                        request_id=seq_group.request_id,
+                        is_prompt=scheduler_outputs.prompt_run,
+                        seq_data=seq_data,
+                        sampling_params=seq_group.sampling_params,
+                        block_tables=block_tables,
+                        lora_request=seq_group.lora_request,
+                        prefix=seq_group.prefix,
+                    ))
         return seq_group_metadata_list, scheduler_outputs
 
     def fork_seq(self, parent_seq: Sequence, child_seq: Sequence) -> None:
@@ -484,6 +494,8 @@ class Scheduler:
                 preemption_mode = PreemptionMode.RECOMPUTE
             else:
                 preemption_mode = PreemptionMode.SWAP
+        self._flight.record(seq_group.request_id, "preempted",
+                            detail=preemption_mode.name.lower())
         if preemption_mode == PreemptionMode.RECOMPUTE:
             self._preempt_by_recompute(seq_group)
         else:
@@ -520,6 +532,8 @@ class Scheduler:
     ) -> None:
         mapping = self.block_manager.swap_in(seq_group)
         blocks_to_swap_in.update(mapping)
+        self._flight.record(seq_group.request_id, "swapped_in",
+                            detail=f"blocks={len(mapping)}")
         for seq in seq_group.get_seqs(status=SequenceStatus.SWAPPED):
             seq.status = SequenceStatus.RUNNING
 
@@ -534,5 +548,7 @@ class Scheduler:
                 "the swap space to avoid this error.")
         mapping = self.block_manager.swap_out(seq_group)
         blocks_to_swap_out.update(mapping)
+        self._flight.record(seq_group.request_id, "swapped_out",
+                            detail=f"blocks={len(mapping)}")
         for seq in seq_group.get_seqs(status=SequenceStatus.RUNNING):
             seq.status = SequenceStatus.SWAPPED

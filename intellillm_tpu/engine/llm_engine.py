@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Iterable, List, Optional, Tuple, Union
+from typing import Dict, Iterable, List, Optional, Tuple, Union
 
 from intellillm_tpu.config import (CacheConfig, LoRAConfig, ModelConfig,
                                    ParallelConfig, SchedulerConfig)
@@ -22,6 +22,8 @@ from intellillm_tpu.core.scheduler import Scheduler, SchedulerOutputs
 from intellillm_tpu.engine.arg_utils import EngineArgs
 from intellillm_tpu.engine.metrics import StatLogger, Stats
 from intellillm_tpu.logger import init_logger
+from intellillm_tpu.obs import (get_flight_recorder, get_step_tracer,
+                                request_context)
 from intellillm_tpu.outputs import RequestOutput
 from intellillm_tpu.sampling_params import SamplingParams
 from intellillm_tpu.sequence import (SamplerOutput, Sequence, SequenceGroup,
@@ -116,6 +118,15 @@ class LLMEngine:
             scheduler_config.num_decode_steps = 1
 
         self._init_cache()
+
+        # Observability (docs/observability.md): step-phase tracer and the
+        # per-request flight recorder. The last drained step breakdown is
+        # kept on the engine so tests and benches can read it even with
+        # log_stats off.
+        self._tracer = get_step_tracer()
+        self._flight = get_flight_recorder()
+        self.last_step_phases: dict = {}
+        self.last_step_time: float = 0.0
 
         self.scheduler = Scheduler(scheduler_config, cache_config, lora_config)
         self.stat_logger = StatLogger(
@@ -221,8 +232,9 @@ class LLMEngine:
             self.worker.lora_manager.validate_request(lora_request)
         self._validate_sampling_params(sampling_params)
         if prompt_token_ids is None:
-            prompt_token_ids = self.tokenizer.encode(prompt, request_id,
-                                                     lora_request)
+            with request_context(request_id):
+                prompt_token_ids = self.tokenizer.encode(prompt, request_id,
+                                                         lora_request)
 
         block_size = self.cache_config.block_size
         seq_id = next(self.seq_counter)
@@ -259,6 +271,8 @@ class LLMEngine:
         seq_group = SequenceGroup(request_id, [seq], sampling_params,
                                   arrival_time, lora_request, prefix,
                                   predicted_len)
+        self._flight.record(request_id, "arrived",
+                            detail=f"prompt_tokens={len(prompt_token_ids)}")
         self.scheduler.add_seq_group(seq_group)
 
     # Sampler shape-bucket limits (see layers/sampler.py LOGPROB_K_BUCKETS
@@ -354,6 +368,7 @@ class LLMEngine:
         assert not self._inflight, (
             "serial step() called with pipelined steps in flight; use "
             "step_pipelined() or drain_pipeline() first")
+        self._tracer.begin_step()
         seq_group_metadata_list, scheduler_outputs = self.scheduler.schedule()
 
         if not scheduler_outputs.is_empty():
@@ -398,6 +413,7 @@ class LLMEngine:
         """Pipelined equivalent of step(): dispatches as much device work
         as the pipeline depth allows, then fetches + processes the oldest
         in-flight step. Returns [] only when fully idle."""
+        self._tracer.begin_step()
         while len(self._inflight) < self._pipeline_depth:
             if not self._pipeline_dispatch_one():
                 break
@@ -421,7 +437,8 @@ class LLMEngine:
                 # Rejected without device work (over-long prompts):
                 # surface their outputs with the next batch returned.
                 self._pending_outputs.extend(
-                    self._process_model_outputs([], so))
+                    self._process_model_outputs([], so,
+                                                is_step_boundary=False))
                 return True
             if metas:
                 self._dispatch(metas, so)
@@ -441,7 +458,8 @@ class LLMEngine:
         if so.is_empty() and not metas:
             if so.ignored_seq_groups:
                 self._pending_outputs.extend(
-                    self._process_model_outputs([], so))
+                    self._process_model_outputs([], so,
+                                                is_step_boundary=False))
                 return True
             return False
         if not metas:
@@ -452,7 +470,8 @@ class LLMEngine:
                                       so.blocks_to_copy,
                                       so.num_decode_steps)
             self._pending_outputs.extend(
-                self._process_model_outputs([], so))
+                self._process_model_outputs([], so,
+                                            is_step_boundary=False))
             return True
         self._dispatch(metas, so)
         return True
@@ -574,6 +593,7 @@ class LLMEngine:
         self,
         outputs_per_substep: List[SamplerOutput],
         scheduler_outputs: SchedulerOutputs,
+        is_step_boundary: bool = True,
     ) -> List[RequestOutput]:
         now = time.monotonic()
         scheduled_seq_groups = scheduler_outputs.scheduled_seq_groups
@@ -598,10 +618,13 @@ class LLMEngine:
                         continue
                     if seq_group.first_token_time is None:
                         seq_group.first_token_time = now
+                        self._flight.record(seq_group.request_id,
+                                            "first_token")
                     s = go.samples[0]
                     seq.append_token_id(s.output_token, s.logprobs)
                     if self.tokenizer is not None:
-                        self._decode_sequence(seq, sp)
+                        with self._tracer.span("detokenize"):
+                            self._decode_sequence(seq, sp)
                     self._check_stop(seq, sp)
                     if seq.is_finished():
                         self.scheduler.free_seq(seq)
@@ -613,6 +636,7 @@ class LLMEngine:
                 outputs = output[idx]
                 if seq_group.first_token_time is None and outputs.samples:
                     seq_group.first_token_time = now
+                    self._flight.record(seq_group.request_id, "first_token")
                 self._process_sequence_group_outputs(seq_group, outputs)
 
         self.scheduler.free_finished_seq_groups()
@@ -620,6 +644,13 @@ class LLMEngine:
         request_outputs: List[RequestOutput] = []
         for seq_group in (scheduled_seq_groups +
                           scheduler_outputs.ignored_seq_groups):
+            if seq_group.is_finished():
+                reasons = sorted({
+                    r for r in (SequenceStatus.get_finished_reason(s.status)
+                                for s in seq_group.get_seqs())
+                    if r is not None})
+                self._flight.record(seq_group.request_id, "finished",
+                                    detail=",".join(reasons) or None)
             request_outputs.append(RequestOutput.from_seq_group(seq_group))
 
         # Flip freshly computed prefixes (reference llm_engine.py:727-731).
@@ -628,8 +659,25 @@ class LLMEngine:
                 if seq_group.prefix is not None:
                     seq_group.prefix.computed = True
 
+        # Drain the step-phase tracer even with stats logging off, so the
+        # breakdown stays readable off the engine (tests, benches). Only
+        # the once-per-logical-step call sites drain (is_step_boundary);
+        # the pipelined dispatch path may process ignored/swap-only plans
+        # mid-step, and an early drain there would consume the step timer
+        # and split one step's breakdown across multiple StatLogger rows.
+        phases: Dict[str, float] = {}
+        step_time = 0.0
+        if is_step_boundary:
+            phases, step_time = self._tracer.end_step()
+            if phases or step_time:
+                self.last_step_phases = phases
+                self.last_step_time = step_time
+
         if self.stat_logger is not None:
-            self.stat_logger.log(self._get_stats(scheduler_outputs))
+            stats = self._get_stats(scheduler_outputs)
+            stats.step_phase_times = phases
+            stats.step_time = step_time
+            self.stat_logger.log(stats)
         return request_outputs
 
     # --- per-group output processing (incl. beam search) ------------------
@@ -675,7 +723,8 @@ class LLMEngine:
 
         for seq, _ in child_seqs:
             if self.tokenizer is not None:
-                self._decode_sequence(seq, sampling_params)
+                with self._tracer.span("detokenize"):
+                    self._decode_sequence(seq, sampling_params)
             self._check_stop(seq, sampling_params)
 
         if not sampling_params.use_beam_search:

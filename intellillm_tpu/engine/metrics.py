@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from intellillm_tpu.logger import init_logger
 
@@ -37,7 +37,12 @@ class Stats:
     time_e2e_requests: List[float] = field(default_factory=list)
     # Speculative decoding: rolling draft-token acceptance rate (None
     # when spec decoding is off) — reference RejectionSampler counters.
-    spec_acceptance_rate: float = None
+    spec_acceptance_rate: Optional[float] = None
+    # Step-phase breakdown from obs.tracing (exclusive seconds per phase
+    # for this iteration) and the iteration's wall time. Empty / 0.0 when
+    # tracing is disabled.
+    step_phase_times: Dict[str, float] = field(default_factory=dict)
+    step_time: float = 0.0
 
 
 class _Metrics:
@@ -91,6 +96,32 @@ class _Metrics:
             "intellillm_spec_acceptance_rate",
             "Speculative decoding draft-token acceptance rate (rolling).",
             labelnames)
+        self.histogram_step_phase = Histogram(
+            "intellillm_step_phase_seconds",
+            "Exclusive wall time per engine-step phase (obs.tracing).",
+            list(labelnames) + ["phase"],
+            buckets=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                     0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5])
+        self.histogram_step_time = Histogram(
+            "intellillm_step_time_seconds",
+            "Total wall time of one engine step.", labelnames,
+            buckets=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0])
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        """Drop the singleton and unregister its collectors so tests can
+        rebuild engines (with possibly different label sets) without
+        tripping prometheus duplicate-registration errors."""
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
 
 
 class StatLogger:
@@ -104,6 +135,10 @@ class StatLogger:
         self.last_local_log = time.monotonic()
         self.num_prompt_tokens: List[int] = []
         self.num_generation_tokens: List[int] = []
+        # Interval accumulators for the "step breakdown" log line.
+        self.phase_seconds: Dict[str, float] = {}
+        self.step_seconds: float = 0.0
+        self.num_steps: int = 0
         self.metrics = _Metrics(list(labels.keys())) if _PROMETHEUS else None
 
     def _throughput(self, tracked: List[int], now: float) -> float:
@@ -131,9 +166,19 @@ class StatLogger:
             if stats.spec_acceptance_rate is not None:
                 m.gauge_spec_acceptance.labels(*lv).set(
                     stats.spec_acceptance_rate)
+            for phase, secs in stats.step_phase_times.items():
+                m.histogram_step_phase.labels(*lv, phase).observe(secs)
+            if stats.step_time > 0.0:
+                m.histogram_step_time.labels(*lv).observe(stats.step_time)
 
         self.num_prompt_tokens.append(stats.num_prompt_tokens)
         self.num_generation_tokens.append(stats.num_generation_tokens)
+        for phase, secs in stats.step_phase_times.items():
+            self.phase_seconds[phase] = (
+                self.phase_seconds.get(phase, 0.0) + secs)
+        if stats.step_time > 0.0 or stats.step_phase_times:
+            self.step_seconds += stats.step_time
+            self.num_steps += 1
 
         if stats.now - self.last_local_log > self.local_interval:
             prompt_tps = self._throughput(self.num_prompt_tokens, stats.now)
@@ -145,6 +190,22 @@ class StatLogger:
                 "cache usage: %.1f%%", prompt_tps, gen_tps,
                 stats.num_running, stats.num_swapped, stats.num_waiting,
                 stats.device_cache_usage * 100, stats.cpu_cache_usage * 100)
+            if self.num_steps > 0 and self.phase_seconds:
+                from intellillm_tpu.obs.tracing import PHASES
+                ordered = [p for p in PHASES if p in self.phase_seconds]
+                ordered += [p for p in self.phase_seconds
+                            if p not in ordered]
+                covered = sum(self.phase_seconds.values())
+                other = max(self.step_seconds - covered, 0.0)
+                parts = ["%s %.1fms" % (
+                    p, self.phase_seconds[p] / self.num_steps * 1e3)
+                    for p in ordered]
+                parts.append("other %.1fms" % (other / self.num_steps * 1e3))
+                logger.info("Step breakdown over %d steps (avg/step): %s",
+                            self.num_steps, ", ".join(parts))
             self.num_prompt_tokens = []
             self.num_generation_tokens = []
+            self.phase_seconds = {}
+            self.step_seconds = 0.0
+            self.num_steps = 0
             self.last_local_log = stats.now

@@ -18,6 +18,8 @@ from aiohttp import web
 
 from intellillm_tpu.engine.arg_utils import AsyncEngineArgs
 from intellillm_tpu.engine.async_llm_engine import AsyncLLMEngine
+from intellillm_tpu.entrypoints.debug_routes import add_debug_routes
+from intellillm_tpu.obs import request_context
 from intellillm_tpu.sampling_params import SamplingParams
 from intellillm_tpu.utils import random_uuid
 
@@ -41,41 +43,54 @@ async def generate(request: web.Request) -> web.StreamResponse:
     sampling_params = SamplingParams(**request_dict)
     request_id = random_uuid()
 
-    results_generator = engine.generate(prompt, sampling_params, request_id,
-                                        prefix_pos=prefix_pos)
+    # Bind the request id to this handler's context for the whole
+    # response lifetime (not just generator creation) so log lines
+    # emitted from this handler while streaming carry %(request_id)s
+    # (logger.py).
+    with request_context(request_id):
+        results_generator = engine.generate(prompt, sampling_params,
+                                            request_id,
+                                            prefix_pos=prefix_pos)
 
-    if stream:
-        response = web.StreamResponse(
-            headers={"Content-Type": "application/x-ndjson"})
-        await response.prepare(request)
+        if stream:
+            response = web.StreamResponse(
+                headers={"Content-Type": "application/x-ndjson"})
+            await response.prepare(request)
+            async for request_output in results_generator:
+                text_outputs = [
+                    request_output.prompt + output.text
+                    for output in request_output.outputs
+                ]
+                await response.write(
+                    (json.dumps({"text": text_outputs}) + "\n").encode())
+            await response.write_eof()
+            return response
+
+        final_output = None
         async for request_output in results_generator:
-            text_outputs = [
-                request_output.prompt + output.text
-                for output in request_output.outputs
-            ]
-            await response.write(
-                (json.dumps({"text": text_outputs}) + "\n").encode())
-        await response.write_eof()
-        return response
+            if (request.transport is not None
+                    and request.transport.is_closing()):
+                await engine.abort(request_id)
+                return web.Response(status=499)
+            final_output = request_output
 
-    final_output = None
-    async for request_output in results_generator:
-        if request.transport is not None and request.transport.is_closing():
-            await engine.abort(request_id)
-            return web.Response(status=499)
-        final_output = request_output
-
-    assert final_output is not None
-    text_outputs = [
-        final_output.prompt + output.text for output in final_output.outputs
-    ]
-    return web.json_response({"text": text_outputs})
+        assert final_output is not None
+        text_outputs = [
+            final_output.prompt + output.text
+            for output in final_output.outputs
+        ]
+        return web.json_response({"text": text_outputs})
 
 
-def build_app() -> web.Application:
+def build_app(enable_profiling: bool = False) -> web.Application:
     app = web.Application()
     app.router.add_get("/health", health)
     app.router.add_post("/generate", generate)
+    # This server has no auth middleware, so the profiler admin routes
+    # (which degrade serving and write traces to a caller-chosen dir)
+    # stay off unless explicitly opted in.
+    add_debug_routes(app, lambda: engine.engine if engine else None,
+                     enable_profiling=enable_profiling)
     return app
 
 
@@ -86,13 +101,17 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", type=str, default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--enable-profiling", action="store_true",
+                        help="expose the jax.profiler admin endpoints "
+                        "(/debug/profiler/start|stop)")
     parser = AsyncEngineArgs.add_cli_args(parser)
     args = parser.parse_args()
 
     engine_args = AsyncEngineArgs.from_cli_args(args)
     engine = AsyncLLMEngine.from_engine_args(engine_args)
 
-    web.run_app(build_app(), host=args.host, port=args.port,
+    web.run_app(build_app(enable_profiling=args.enable_profiling),
+                host=args.host, port=args.port,
                 keepalive_timeout=TIMEOUT_KEEP_ALIVE)
 
 

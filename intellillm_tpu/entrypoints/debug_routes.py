@@ -1,0 +1,82 @@
+"""Debug/observability HTTP routes shared by both API servers.
+
+    GET  /debug/trace?request_id=<id>   flight-recorder events for one
+                                        request (404 if unknown/evicted)
+    GET  /debug/trace                   live request ids + recently
+                                        finished traces (?limit=N)
+    POST /debug/profiler/start?dir=...  begin a jax.profiler device trace
+    POST /debug/profiler/stop           end it (writes the trace to disk)
+
+See docs/observability.md. The profiler endpoints drive
+LLMEngine.start_profile/stop_profile and are admin-only: profiling
+degrades serving and writes trace files to a caller-chosen directory,
+so they are registered only with `enable_profiling=True` (the servers'
+--enable-profiling flag). The read-only /debug/trace route is always
+registered; on the OpenAI server every /debug route additionally sits
+behind the same --api-key auth as every non-health route.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+from aiohttp import web
+
+from intellillm_tpu.obs import get_flight_recorder
+
+
+def add_debug_routes(app: web.Application,
+                     get_engine: Callable[[], Optional[object]],
+                     enable_profiling: bool = False) -> None:
+    """`get_engine` returns the synchronous LLMEngine (or None before
+    startup) — resolved per request because both servers assign their
+    engine globals after module import."""
+
+    async def debug_trace(request: web.Request) -> web.Response:
+        recorder = get_flight_recorder()
+        request_id = request.query.get("request_id")
+        if request_id:
+            events = recorder.get_trace(request_id)
+            if events is None:
+                return web.json_response(
+                    {"error": f"no trace for request_id={request_id} "
+                     "(never seen, or evicted from the ring)"}, status=404)
+            return web.json_response({"request_id": request_id,
+                                      "events": events})
+        try:
+            limit = int(request.query.get("limit", "32"))
+        except ValueError:
+            return web.json_response({"error": "limit must be an integer"},
+                                     status=400)
+        return web.json_response({
+            "live_request_ids": recorder.live_request_ids(),
+            "recent_finished": recorder.recent_finished(limit),
+        })
+
+    async def profiler_start(request: web.Request) -> web.Response:
+        engine = get_engine()
+        if engine is None:
+            return web.json_response({"error": "engine not ready"},
+                                     status=503)
+        trace_dir = request.query.get("dir", "/tmp/intellillm-trace")
+        started = engine.start_profile(trace_dir)
+        if started is None:
+            return web.json_response(
+                {"error": "a trace is already running"}, status=409)
+        return web.json_response({"trace_dir": started})
+
+    async def profiler_stop(request: web.Request) -> web.Response:
+        engine = get_engine()
+        if engine is None:
+            return web.json_response({"error": "engine not ready"},
+                                     status=503)
+        # stop_trace serializes the whole trace to disk — keep it off the
+        # event loop so in-flight requests/streams don't stall.
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, engine.stop_profile)
+        return web.json_response({"ok": True})
+
+    app.router.add_get("/debug/trace", debug_trace)
+    if enable_profiling:
+        app.router.add_post("/debug/profiler/start", profiler_start)
+        app.router.add_post("/debug/profiler/stop", profiler_stop)

@@ -21,6 +21,7 @@ from intellillm_tpu.entrypoints.openai.protocol import (ChatCompletionRequest,
                                                         CompletionRequest,
                                                         ErrorResponse)
 from intellillm_tpu.entrypoints.openai.serving_chat import OpenAIServingChat
+from intellillm_tpu.entrypoints.debug_routes import add_debug_routes
 from intellillm_tpu.entrypoints.openai.serving_completion import (
     OpenAIServingCompletion)
 from intellillm_tpu.logger import init_logger
@@ -136,6 +137,10 @@ def build_app(api_key: Optional[str] = None,
         # writes trace files to a caller-chosen directory).
         app.router.add_post("/start_profile", start_profile)
         app.router.add_post("/stop_profile", stop_profile)
+    add_debug_routes(
+        app, lambda: (openai_serving_completion.engine.engine
+                      if openai_serving_completion is not None else None),
+        enable_profiling=enable_profiling)
     return app
 
 
@@ -149,8 +154,9 @@ def make_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--chat-template", type=str, default=None)
     parser.add_argument("--response-role", type=str, default="assistant")
     parser.add_argument("--enable-profiling", action="store_true",
-                        help="expose /start_profile and /stop_profile "
-                        "admin endpoints (jax.profiler traces)")
+                        help="expose the jax.profiler admin endpoints "
+                        "(/debug/profiler/start|stop and the legacy "
+                        "/start_profile, /stop_profile)")
     parser = AsyncEngineArgs.add_cli_args(parser)
     return parser
 

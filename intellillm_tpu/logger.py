@@ -1,19 +1,57 @@
 """Logging for intellillm-tpu.
 
 Role parity: reference `vllm/logger.py` (custom formatter + root handler).
+
+Environment knobs:
+  INTELLILLM_LOG_LEVEL       DEBUG/INFO/WARNING/ERROR (default INFO).
+  INTELLILLM_LOG_REQUEST_ID  when truthy, log lines carry the request id
+                             currently bound via obs.request_context, so
+                             engine logs correlate with flight-recorder
+                             events. Off by default (keeps the line short).
+
+Every record gets a `request_id` attribute either way (the filter runs
+unconditionally), so custom formats with %(request_id)s never KeyError.
 """
+import contextvars
 import logging
+import os
 import sys
 
+# Current request id for log correlation. Set by obs.tracing.request_context;
+# lives here (leaf module, no internal imports) to avoid import cycles.
+request_id_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "intellillm_request_id", default="-")
+
 _FORMAT = "%(levelname)s %(asctime)s [%(name)s:%(lineno)d] %(message)s"
+_FORMAT_RID = ("%(levelname)s %(asctime)s [%(name)s:%(lineno)d]"
+               " [req=%(request_id)s] %(message)s")
 _DATE_FORMAT = "%m-%d %H:%M:%S"
 
+
+class _RequestIdFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.request_id = request_id_ctx.get()
+        return True
+
+
+def _level_from_env() -> int:
+    name = os.environ.get("INTELLILLM_LOG_LEVEL", "INFO").strip().upper()
+    level = logging.getLevelName(name)
+    if not isinstance(level, int):
+        return logging.INFO
+    return level
+
+
 _root = logging.getLogger("intellillm_tpu")
-_root.setLevel(logging.INFO)
+_root.setLevel(_level_from_env())
 _root.propagate = False
 
+_with_rid = os.environ.get("INTELLILLM_LOG_REQUEST_ID", "").strip().lower() \
+    in ("1", "true", "yes", "on")
 _handler = logging.StreamHandler(sys.stdout)
-_handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+_handler.setFormatter(logging.Formatter(
+    _FORMAT_RID if _with_rid else _FORMAT, datefmt=_DATE_FORMAT))
+_handler.addFilter(_RequestIdFilter())
 _root.addHandler(_handler)
 
 

@@ -1,0 +1,22 @@
+"""Observability subsystem: step-phase tracing, XLA compile tracking,
+and the per-request flight recorder. See docs/observability.md."""
+from intellillm_tpu.obs.compile_tracker import (CompileTracker,
+                                                get_compile_tracker,
+                                                record_kernel_dispatch)
+from intellillm_tpu.obs.flight_recorder import (EVENTS, FlightRecorder,
+                                                get_flight_recorder)
+from intellillm_tpu.obs.tracing import (PHASES, StepTracer, get_step_tracer,
+                                        request_context)
+
+__all__ = [
+    "CompileTracker",
+    "EVENTS",
+    "FlightRecorder",
+    "PHASES",
+    "StepTracer",
+    "get_compile_tracker",
+    "get_flight_recorder",
+    "get_step_tracer",
+    "record_kernel_dispatch",
+    "request_context",
+]

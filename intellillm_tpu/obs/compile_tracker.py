@@ -1,0 +1,195 @@
+"""XLA compile tracking for the jit-executable cache.
+
+Every (batch-bucket x length/width-bucket x static-flag) combination the
+runner dispatches is a separate XLA executable (`worker/model_runner.py`
+shape bucketing). A cold bucket compiles mid-serving and stalls the
+engine for seconds-to-tens-of-seconds; this module makes that visible as
+metric deltas instead of mystery latency spikes:
+
+    intellillm_xla_compiles_total{program}      first call per bucket
+    intellillm_xla_cache_hits_total{program}    every re-dispatch
+    intellillm_xla_compile_time_seconds{program}  first-call wall time
+                                                  (trace + compile + dispatch)
+    intellillm_live_executables                 distinct buckets seen
+
+Tracking is host-side: the runner derives a bucket key from exactly the
+quantities jit keys its dispatch cache on (padded shapes + static args),
+so the compile counter increments once per new bucket and never on a
+cache hit — deterministically, independent of XLA's persistent on-disk
+cache (which can make a "compile" fast but not free).
+
+`ops/dispatch.py` also records its Pallas-vs-reference kernel choice here
+(intellillm_kernel_dispatch_total{path}); the choice is made at trace
+time, so the counts move together with compiles.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Hashable, Set
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter, Gauge, Histogram
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+_COMPILE_TIME_BUCKETS = [0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                         10.0, 30.0, 60.0, 120.0, 300.0]
+
+
+class _CompileMetrics:
+    """Prometheus collectors for compile tracking (process-global, built
+    once — same singleton pattern as engine/metrics._Metrics)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.counter_compiles = Counter(
+            "intellillm_xla_compiles_total",
+            "XLA executable compiles (first dispatch of a new jit bucket).",
+            ["program"])
+        self.counter_cache_hits = Counter(
+            "intellillm_xla_cache_hits_total",
+            "jit dispatches served by an already-compiled executable.",
+            ["program"])
+        self.histogram_compile_time = Histogram(
+            "intellillm_xla_compile_time_seconds",
+            "Wall time of the first dispatch of a new jit bucket "
+            "(trace + compile + dispatch).", ["program"],
+            buckets=_COMPILE_TIME_BUCKETS)
+        self.gauge_live_executables = Gauge(
+            "intellillm_live_executables",
+            "Distinct jit buckets (live XLA executables) seen so far.")
+        self.counter_kernel_dispatch = Counter(
+            "intellillm_kernel_dispatch_total",
+            "Kernel dispatch decisions at trace time (ops/dispatch.py).",
+            ["path"])
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+class CompileTracker:
+    """Host-side registry of jit buckets dispatched so far.
+
+    `call()` wraps a jit dispatch: a never-seen (program, key) counts as a
+    compile and its wall time feeds the compile-time histogram; a known
+    key counts as a cache hit. Thread-safe (the async engine dispatches
+    from an executor thread while tests may read snapshots)."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._keys: Dict[str, Set[Hashable]] = {}
+        self._compiles: Dict[str, int] = {}
+        self._hits: Dict[str, int] = {}
+        self._compile_time: Dict[str, float] = {}
+        self._kernel_dispatch: Dict[str, int] = {}
+        self._metrics = _CompileMetrics() if _PROMETHEUS else None
+
+    def call(self, program: str, key: Hashable,
+             fn: Callable[..., Any], /, *args, **kwargs) -> Any:
+        if not self.enabled:
+            return fn(*args, **kwargs)
+        with self._lock:
+            is_new = key not in self._keys.setdefault(program, set())
+            if is_new:
+                self._keys[program].add(key)
+        if not is_new:
+            self._record_hit(program)
+            return fn(*args, **kwargs)
+        t0 = time.monotonic()
+        try:
+            out = fn(*args, **kwargs)
+        except BaseException:
+            # Failed first dispatch (e.g. compile OOM): forget the key so
+            # a retry counts as a fresh compile, not a cache hit.
+            with self._lock:
+                self._keys.get(program, set()).discard(key)
+            raise
+        self._record_compile(program, time.monotonic() - t0, key)
+        return out
+
+    def _record_compile(self, program: str, elapsed: float,
+                        key: Hashable) -> None:
+        with self._lock:
+            self._compiles[program] = self._compiles.get(program, 0) + 1
+            self._compile_time[program] = (
+                self._compile_time.get(program, 0.0) + elapsed)
+            live = sum(len(k) for k in self._keys.values())
+        logger.debug("XLA compile: program=%s key=%s %.3fs (%d live "
+                     "executables)", program, key, elapsed, live)
+        if self._metrics is not None:
+            self._metrics.counter_compiles.labels(program).inc()
+            self._metrics.histogram_compile_time.labels(program).observe(
+                elapsed)
+            self._metrics.gauge_live_executables.set(live)
+
+    def _record_hit(self, program: str) -> None:
+        with self._lock:
+            self._hits[program] = self._hits.get(program, 0) + 1
+        if self._metrics is not None:
+            self._metrics.counter_cache_hits.labels(program).inc()
+
+    def record_kernel_dispatch(self, path: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._kernel_dispatch[path] = (
+                self._kernel_dispatch.get(path, 0) + 1)
+        if self._metrics is not None:
+            self._metrics.counter_kernel_dispatch.labels(path).inc()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time copy for tests / bench attribution dumps."""
+        with self._lock:
+            return {
+                "compiles": dict(self._compiles),
+                "cache_hits": dict(self._hits),
+                "compile_time_seconds": dict(self._compile_time),
+                "live_executables": sum(
+                    len(k) for k in self._keys.values()),
+                "kernel_dispatch": dict(self._kernel_dispatch),
+            }
+
+    def reset_for_testing(self) -> None:
+        with self._lock:
+            self._keys = {}
+            self._compiles = {}
+            self._hits = {}
+            self._compile_time = {}
+            self._kernel_dispatch = {}
+        if self._metrics is not None:
+            _CompileMetrics.reset_for_testing()
+            self._metrics = _CompileMetrics() if _PROMETHEUS else None
+
+
+_COMPILE_TRACKER = CompileTracker()
+
+
+def get_compile_tracker() -> CompileTracker:
+    return _COMPILE_TRACKER
+
+
+def record_kernel_dispatch(path: str) -> None:
+    _COMPILE_TRACKER.record_kernel_dispatch(path)

@@ -1,0 +1,122 @@
+"""Per-request flight recorder: bounded ring buffer of lifecycle events.
+
+Aggregate metrics say the fleet is slow; the flight recorder says what
+happened to *this* request: when it arrived, when the scheduler admitted
+it, whether it was preempted or swapped, when the first token landed and
+why it finished. Events are appended from the engine/scheduler hot path
+(a lock-guarded deque append — cheap enough to leave on in production)
+and read back via `GET /debug/trace?request_id=` on both API servers.
+
+Memory is bounded three ways: per-request event deques are capped
+(default 64 events — preemption loops can't grow one without bound),
+the live-request table is capped (default 2048; oldest evicted), and
+finished requests move to a separate finished ring (default 256) so
+"what just happened" stays queryable after the request is freed.
+
+Event names used by the engine/scheduler wiring:
+
+    arrived, scheduled, prefill_start, preempted, swapped_out,
+    swapped_in, first_token, finished, aborted
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+# Canonical event names (wiring sites pass these strings).
+EVENTS = ("arrived", "scheduled", "prefill_start", "preempted",
+          "swapped_out", "swapped_in", "first_token", "finished", "aborted")
+
+_TERMINAL = ("finished", "aborted")
+
+
+class FlightRecorder:
+    """Thread-safe bounded store of per-request lifecycle events."""
+
+    def __init__(self, enabled: bool = True, max_events_per_request: int = 64,
+                 max_live_requests: int = 2048,
+                 max_finished_requests: int = 256) -> None:
+        self.enabled = enabled
+        self.max_events_per_request = max_events_per_request
+        self.max_live_requests = max_live_requests
+        self.max_finished_requests = max_finished_requests
+        self._lock = threading.Lock()
+        # request_id -> deque of (wall_ts, event, detail)
+        self._live: "OrderedDict[str, deque]" = OrderedDict()
+        self._finished: "OrderedDict[str, deque]" = OrderedDict()
+
+    def record(self, request_id: str, event: str,
+               detail: Optional[str] = None) -> None:
+        if not self.enabled:
+            return
+        ts = time.time()
+        with self._lock:
+            if request_id in self._finished:
+                # Pipelined steps can re-report groups already finalized
+                # (zombie rows); their trace is sealed.
+                return
+            buf = self._live.get(request_id)
+            if buf is None:
+                buf = deque(maxlen=self.max_events_per_request)
+                self._live[request_id] = buf
+                while len(self._live) > self.max_live_requests:
+                    self._live.popitem(last=False)
+            buf.append((ts, event, detail))
+            if event in _TERMINAL:
+                self._live.pop(request_id, None)
+                self._finished[request_id] = buf
+                while len(self._finished) > self.max_finished_requests:
+                    self._finished.popitem(last=False)
+
+    def get_trace(self, request_id: str) -> Optional[List[Dict[str, Any]]]:
+        """Events for one request in arrival order, or None if unknown
+        (never recorded, or evicted from both rings)."""
+        with self._lock:
+            buf = self._live.get(request_id) or self._finished.get(request_id)
+            if buf is None:
+                return None
+            items = list(buf)
+        return [{"ts": ts, "event": ev,
+                 **({"detail": d} if d is not None else {})}
+                for ts, ev, d in items]
+
+    def recent_finished(self, limit: int = 32) -> List[Dict[str, Any]]:
+        """Most-recently finished requests (newest first), each with its
+        full event list — the /debug/trace dump when no id is given."""
+        with self._lock:
+            items = [(rid, list(buf))
+                     for rid, buf in reversed(self._finished.items())]
+        out = []
+        for rid, events in items[:limit]:
+            out.append({
+                "request_id": rid,
+                "events": [{"ts": ts, "event": ev,
+                            **({"detail": d} if d is not None else {})}
+                           for ts, ev, d in events],
+            })
+        return out
+
+    def live_request_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._live.keys())
+
+    def reset_for_testing(self) -> None:
+        with self._lock:
+            self._live = OrderedDict()
+            self._finished = OrderedDict()
+
+
+def _enabled_from_env() -> bool:
+    from intellillm_tpu.utils import parse_env_flag
+    flag = parse_env_flag(os.environ.get("INTELLILLM_FLIGHT_RECORDER"))
+    return True if flag is None else flag
+
+
+_FLIGHT_RECORDER = FlightRecorder(enabled=_enabled_from_env())
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return _FLIGHT_RECORDER

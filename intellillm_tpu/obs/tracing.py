@@ -1,0 +1,152 @@
+"""Step-phase tracing: low-overhead span timers for the engine hot loop.
+
+The reference stack (and our port of its `engine/metrics.py`) only counts
+tokens and queue depths; it cannot say WHERE an engine iteration spends
+its wall time. This module decomposes each step into named phases —
+
+    schedule        scheduler pass + metadata build (core/scheduler.py)
+    prepare_inputs  host batch prep + sampling tensors (model_runner)
+    execute         jit dispatch of the device step (model_runner)
+    sample          packed D2H fetch + sampler post-processing
+    swap_copy       KV block swap-in/out/copy ops (worker)
+    detokenize      incremental detokenization (llm_engine)
+
+— with monotonic clocks and a shared null context manager on the
+disabled path, so tracing costs two `time.monotonic()` calls per span
+when on and one attribute read when off (INTELLILLM_TRACING=0).
+
+Spans may nest: a child's time is subtracted from its enclosing span, so
+the per-phase times are *exclusive* and sum to covered wall time without
+double counting. The engine brackets each iteration with `begin_step()` /
+`end_step()`; `end_step()` drains the accumulated phase dict plus the
+step's wall time, which `StatLogger` exports as per-phase Prometheus
+histograms and folds into the periodic "step breakdown" log line.
+
+One process-global tracer (like the Prometheus registry): the scheduler,
+worker, and runner all record into the engine's current step without
+threading a handle through every call signature.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Tuple
+
+# Phases in display order (the breakdown log line follows it).
+PHASES = ("schedule", "prepare_inputs", "execute", "sample", "swap_copy",
+          "detokenize")
+
+
+class _NullSpan:
+    """Shared do-nothing context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_phase", "_t0", "_child")
+
+    def __init__(self, tracer: "StepTracer", phase: str) -> None:
+        self._tracer = tracer
+        self._phase = phase
+
+    def __enter__(self):
+        self._child = 0.0
+        self._tracer._stack.append(self)
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.monotonic() - self._t0
+        t = self._tracer
+        t._stack.pop()
+        # Exclusive time: subtract what nested spans already claimed.
+        t._acc[self._phase] = t._acc.get(self._phase, 0.0) + dur - self._child
+        if t._stack:
+            t._stack[-1]._child += dur
+        return False
+
+
+class StepTracer:
+    """Accumulates exclusive wall time per phase for the current engine
+    step. Single-writer by design (the engine's step loop); readers take
+    the drained snapshots, never the live dict."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._acc: Dict[str, float] = {}
+        self._stack: List[_Span] = []
+        self._step_start = None
+
+    def span(self, phase: str):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, phase)
+
+    def begin_step(self) -> None:
+        if self.enabled:
+            self._step_start = time.monotonic()
+
+    def end_step(self) -> Tuple[Dict[str, float], float]:
+        """Drain (phase_times, step_wall_time). Spans recorded outside a
+        begin/end bracket carry into the next drain; without a bracket the
+        wall time degrades to the phase sum."""
+        if not self.enabled:
+            return {}, 0.0
+        acc, self._acc = self._acc, {}
+        if self._step_start is None:
+            return acc, sum(acc.values())
+        total = time.monotonic() - self._step_start
+        self._step_start = None
+        # A drain mid-span (not expected on the engine paths) would leak
+        # the open span's time; the stack is empty at every call site.
+        return acc, total
+
+    def reset_for_testing(self) -> None:
+        self._acc = {}
+        self._stack = []
+        self._step_start = None
+
+
+def _enabled_from_env() -> bool:
+    from intellillm_tpu.utils import parse_env_flag
+    flag = parse_env_flag(os.environ.get("INTELLILLM_TRACING"))
+    return True if flag is None else flag
+
+
+_STEP_TRACER = StepTracer(enabled=_enabled_from_env())
+
+
+def get_step_tracer() -> StepTracer:
+    return _STEP_TRACER
+
+
+class request_context:
+    """Bind a request id to the logging layer for the duration of a
+    with-block: `%(request_id)s` in a log format (see logger.py,
+    INTELLILLM_LOG_REQUEST_ID=1) then correlates engine log lines with
+    the flight recorder's per-request events."""
+
+    __slots__ = ("_rid", "_token")
+
+    def __init__(self, request_id: str) -> None:
+        self._rid = request_id
+
+    def __enter__(self):
+        from intellillm_tpu.logger import request_id_ctx
+        self._token = request_id_ctx.set(self._rid)
+        return self
+
+    def __exit__(self, *exc):
+        from intellillm_tpu.logger import request_id_ctx
+        request_id_ctx.reset(self._token)
+        return False

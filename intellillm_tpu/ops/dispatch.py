@@ -21,6 +21,15 @@ def set_use_pallas(force: Optional[bool]) -> None:
 
 
 def use_pallas() -> bool:
+    result = _resolve_use_pallas()
+    # Dispatch decisions happen at trace time, so the counter moves in
+    # lockstep with XLA compiles (intellillm_kernel_dispatch_total).
+    from intellillm_tpu.obs import record_kernel_dispatch
+    record_kernel_dispatch("pallas" if result else "reference")
+    return result
+
+
+def _resolve_use_pallas() -> bool:
     if _FORCE is not None:
         return _FORCE
     from intellillm_tpu.utils import parse_env_flag

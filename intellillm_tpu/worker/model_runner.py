@@ -43,6 +43,7 @@ from intellillm_tpu.layers.sampler import (LOGPROB_K_BUCKETS,
                                            sample, sample_row_host)
 from intellillm_tpu.logger import init_logger
 from intellillm_tpu.native import build_decode_batch, build_prompt_slots
+from intellillm_tpu.obs import get_compile_tracker, get_step_tracer
 from intellillm_tpu.ops.kv_cache import PAD_SLOT_ID
 from intellillm_tpu.sampling_params import SamplingParams, SamplingType
 from intellillm_tpu.sequence import (SamplerOutput, SequenceGroupMetadata,
@@ -107,6 +108,10 @@ class InflightStep:
         self.cont_state: Optional[DecodeContState] = None
 
     def finalize(self) -> List[SamplerOutput]:
+        with self.runner._tracer.span("sample"):
+            return self._finalize()
+
+    def _finalize(self) -> List[SamplerOutput]:
         r = self.runner
         if self.plp is not None:
             plp_dev, plp_k, plp_params = self.plp
@@ -148,6 +153,8 @@ class ModelRunner:
         self.parallel_config = parallel_config
         self.mesh = mesh
         self._dp = (mesh.shape.get("data", 1) if mesh is not None else 1)
+        self._tracer = get_step_tracer()
+        self._compile_tracker = get_compile_tracker()
 
         self.block_size = cache_config.block_size
         self.sliding_window = model_config.get_sliding_window()
@@ -807,61 +814,64 @@ class ModelRunner:
         is_prompt = seq_group_metadata_list[0].is_prompt
         place = self._place_batch_array
 
-        if is_prompt:
-            arrays, attn_metadata, rows = self._prepare_prompt(
-                seq_group_metadata_list)
-        else:
-            arrays, rows = self._prepare_decode(seq_group_metadata_list)
+        with self._tracer.span("prepare_inputs"):
+            if is_prompt:
+                arrays, attn_metadata, rows = self._prepare_prompt(
+                    seq_group_metadata_list)
+            else:
+                arrays, rows = self._prepare_decode(seq_group_metadata_list)
 
-        padded_n = arrays["token_ids"].shape[0]
+            padded_n = arrays["token_ids"].shape[0]
 
-        # Per-row sampling params / seeds / token histories.
-        row_params: List[SamplingParams] = []
-        row_seeds: List[int] = []
-        row_tokens: List[Tuple[List[int], List[int]]] = []
-        row_out_lens: List[int] = []
-        meta_by_req = {m.request_id: m for m in seq_group_metadata_list}
-        for req_id, seq_id in rows:
-            meta = meta_by_req[req_id]
-            data = meta.seq_data[seq_id]
-            row_params.append(meta.sampling_params)
-            row_out_lens.append(data.get_output_len())
-            row_seeds.append(self._row_seed(seq_id, data.get_output_len()))
-            row_tokens.append(data.token_views())
+            # Per-row sampling params / seeds / token histories.
+            row_params: List[SamplingParams] = []
+            row_seeds: List[int] = []
+            row_tokens: List[Tuple[List[int], List[int]]] = []
+            row_out_lens: List[int] = []
+            meta_by_req = {m.request_id: m for m in seq_group_metadata_list}
+            for req_id, seq_id in rows:
+                meta = meta_by_req[req_id]
+                data = meta.seq_data[seq_id]
+                row_params.append(meta.sampling_params)
+                row_out_lens.append(data.get_output_len())
+                row_seeds.append(self._row_seed(seq_id,
+                                                data.get_output_len()))
+                row_tokens.append(data.token_views())
 
-        row_loras = None
-        if self.lora_manager is not None:
-            row_loras = [meta_by_req[req_id].lora_request
-                         for req_id, _ in rows]
-        lora_state, eff_vocab = self._activate_lora(row_loras, padded_n)
-        st = SamplingTensors.build(row_params, row_seeds, row_tokens,
-                                   eff_vocab, padded_n)
+            row_loras = None
+            if self.lora_manager is not None:
+                row_loras = [meta_by_req[req_id].lora_request
+                             for req_id, _ in rows]
+            lora_state, eff_vocab = self._activate_lora(row_loras, padded_n)
+            st = SamplingTensors.build(row_params, row_seeds, row_tokens,
+                                       eff_vocab, padded_n)
 
-        num_samples = 1
-        if is_prompt:
-            for sp in row_params:
-                if (sp.sampling_type == SamplingType.RANDOM
-                        and sp.best_of > 1):
-                    num_samples = max(num_samples, sp.best_of)
-            num_samples = pad_to_bucket(num_samples, _SAMPLE_BUCKETS)
+            num_samples = 1
+            if is_prompt:
+                for sp in row_params:
+                    if (sp.sampling_type == SamplingType.RANDOM
+                            and sp.best_of > 1):
+                        num_samples = max(num_samples, sp.best_of)
+                num_samples = pad_to_bucket(num_samples, _SAMPLE_BUCKETS)
 
-        # logits_processors escape path: rows carrying Python processors
-        # get their RAW logits fetched and are re-sampled on host (the
-        # scheduler forces K=1 for such batches; prefill is always 1 step).
-        proc_rows = [i for i, sp in enumerate(row_params)
-                     if sp.logits_processors]
-        fetch_indices = None
-        if proc_rows:
-            m = pad_to_bucket(len(proc_rows), self.batch_buckets)
-            fetch_indices = np.zeros(m, np.int32)
-            fetch_indices[:len(proc_rows)] = proc_rows
+            # logits_processors escape path: rows carrying Python
+            # processors get their RAW logits fetched and are re-sampled
+            # on host (the scheduler forces K=1 for such batches; prefill
+            # is always 1 step).
+            proc_rows = [i for i, sp in enumerate(row_params)
+                         if sp.logits_processors]
+            fetch_indices = None
+            if proc_rows:
+                m = pad_to_bucket(len(proc_rows), self.batch_buckets)
+                fetch_indices = np.zeros(m, np.int32)
+                fetch_indices[:len(proc_rows)] = proc_rows
 
-        common = dict(
-            logprob_k=st.logprob_k,
-            do_topk=st.do_topk, do_topp=st.do_topp, do_minp=st.do_minp,
-            do_penalties=st.do_penalties, do_random=st.do_random,
-        )
-        sampling_args = self._sampling_args_device(st, padded_n)
+            common = dict(
+                logprob_k=st.logprob_k,
+                do_topk=st.do_topk, do_topp=st.do_topp, do_minp=st.do_minp,
+                do_penalties=st.do_penalties, do_random=st.do_random,
+            )
+            sampling_args = self._sampling_args_device(st, padded_n)
 
         if is_prompt:
             # prompt_logprobs: bucketed panel width, 0 = not requested.
@@ -871,14 +881,26 @@ class ModelRunner:
                     plp_k = max(plp_k, sp.prompt_logprobs, 1)
             if plp_k:
                 plp_k = pad_to_bucket(plp_k, LOGPROB_K_BUCKETS)
-            result = self._jit_prefill(
-                self.params, kv_caches,
-                place(arrays["token_ids"]), place(arrays["positions"]),
-                attn_metadata, place(arrays["logits_indices"]),
-                *sampling_args, lora_state,
-                place(fetch_indices) if fetch_indices is not None else None,
-                num_samples=num_samples,
-                prompt_logprob_k=plp_k, **common)
+            # Mirror of jit's dispatch-cache key: padded shapes + static
+            # args + pytree-structure toggles (see obs/compile_tracker.py).
+            bucket = (padded_n, arrays["token_ids"].shape[1], num_samples,
+                      plp_k,
+                      fetch_indices.shape[0] if fetch_indices is not None
+                      else None,
+                      lora_state is not None, attn_metadata.use_prefix,
+                      attn_metadata.sp is not None,
+                      tuple(sorted(common.items())))
+            with self._tracer.span("execute"):
+                result = self._compile_tracker.call(
+                    "prefill", bucket, self._jit_prefill,
+                    self.params, kv_caches,
+                    place(arrays["token_ids"]), place(arrays["positions"]),
+                    attn_metadata, place(arrays["logits_indices"]),
+                    *sampling_args, lora_state,
+                    place(fetch_indices) if fetch_indices is not None
+                    else None,
+                    num_samples=num_samples,
+                    prompt_logprob_k=plp_k, **common)
             result = list(result)
             packed = result.pop(0)
             plp = (result.pop(0), plp_k, row_params) if plp_k else None
@@ -903,11 +925,19 @@ class ModelRunner:
                 *sampling_args, lora_state)
             fetched = None
             plp = None
+            bucket = (padded_n, arrays["block_tables"].shape[1],
+                      num_steps,
+                      fetch_indices.shape[0] if fetch_indices is not None
+                      else None,
+                      lora_state is not None,
+                      tuple(sorted(common.items())))
             if num_steps == 1:
-                result = self._jit_decode_single(
-                    *decode_args,
-                    place(fetch_indices) if fetch_indices is not None
-                    else None, **common)
+                with self._tracer.span("execute"):
+                    result = self._compile_tracker.call(
+                        "decode_single", bucket, self._jit_decode_single,
+                        *decode_args,
+                        place(fetch_indices) if fetch_indices is not None
+                        else None, **common)
                 if proc_rows:
                     packed, fetched, new_caches = result
                 else:
@@ -916,9 +946,10 @@ class ModelRunner:
                 assert not proc_rows, (
                     "logits_processors present in a fused K>1 decode batch; "
                     "the scheduler should have forced K=1")
-                packed, new_caches = self._jit_decode(*decode_args,
-                                                      num_steps=num_steps,
-                                                      **common)
+                with self._tracer.span("execute"):
+                    packed, new_caches = self._compile_tracker.call(
+                        "decode_fused", bucket, self._jit_decode,
+                        *decode_args, num_steps=num_steps, **common)
             t1 = t2 = num_steps
 
         # ONE device→host transfer for everything, performed by
@@ -956,41 +987,48 @@ class ModelRunner:
         the per-row block tables already grown by the scheduler to cover
         this step's writes."""
         num_steps = cont.num_steps
-        b = cont.ctx0.shape[0]
-        mml = self.max_model_len
-        ctx = np.where(cont.ctx0 > 0,
-                       np.minimum(cont.ctx0 + lag, mml), 0).astype(np.int32)
-        positions = np.maximum(ctx - 1, 0).astype(np.int32)[:, None]
-        w = pad_to_bucket(max(max((len(t) for t in tables), default=1),
-                              _MIN_BLOCK_TABLE_WIDTH),
-                          self.block_width_buckets)
-        block_tables = np.zeros((b, w), np.int32)
-        for i, t in enumerate(tables):
-            block_tables[i, :len(t)] = t
+        with self._tracer.span("prepare_inputs"):
+            b = cont.ctx0.shape[0]
+            mml = self.max_model_len
+            ctx = np.where(cont.ctx0 > 0,
+                           np.minimum(cont.ctx0 + lag, mml),
+                           0).astype(np.int32)
+            positions = np.maximum(ctx - 1, 0).astype(np.int32)[:, None]
+            w = pad_to_bucket(max(max((len(t) for t in tables), default=1),
+                                  _MIN_BLOCK_TABLE_WIDTH),
+                              self.block_width_buckets)
+            block_tables = np.zeros((b, w), np.int32)
+            for i, t in enumerate(tables):
+                block_tables[i, :len(t)] = t
 
-        # Seeds advance exactly as a fresh (caught-up) dispatch would
-        # compute them, so pipelined sampling streams match unpipelined.
-        row_seeds = [self._row_seed(sid, cont.out_lens0[i] + lag)
-                     for i, (_, sid) in enumerate(cont.rows)]
+            # Seeds advance exactly as a fresh (caught-up) dispatch would
+            # compute them, so pipelined sampling streams match
+            # unpipelined.
+            row_seeds = [self._row_seed(sid, cont.out_lens0[i] + lag)
+                         for i, (_, sid) in enumerate(cont.rows)]
 
-        lora_state, eff_vocab = self._activate_lora(cont.row_loras, b)
-        st = SamplingTensors.build(cont.row_params, row_seeds, None,
-                                   eff_vocab, b)
-        # The scheduler only emits K>1 fused batches for penalty-free,
-        # processor-free, non-beam rows — which is also what makes the
-        # continuation legal in the first place.
-        assert not st.do_penalties, (
-            "decode continuation dispatched for a penalty-bearing batch")
+            lora_state, eff_vocab = self._activate_lora(cont.row_loras, b)
+            st = SamplingTensors.build(cont.row_params, row_seeds, None,
+                                       eff_vocab, b)
+            # The scheduler only emits K>1 fused batches for penalty-free,
+            # processor-free, non-beam rows — which is also what makes the
+            # continuation legal in the first place.
+            assert not st.do_penalties, (
+                "decode continuation dispatched for a penalty-bearing batch")
 
-        place = self._place_batch_array
-        sampling_args = self._sampling_args_device(st, b)
-        packed, new_caches = self._jit_decode_cont(
-            self.params, kv_caches, prev_packed, place(positions),
-            place(block_tables), place(ctx), *sampling_args, lora_state,
-            prev_t1=prev_t1, num_steps=num_steps,
-            logprob_k=st.logprob_k, do_topk=st.do_topk, do_topp=st.do_topp,
-            do_minp=st.do_minp, do_penalties=False,
-            do_random=st.do_random)
+            place = self._place_batch_array
+            sampling_args = self._sampling_args_device(st, b)
+        flags = dict(logprob_k=st.logprob_k, do_topk=st.do_topk,
+                     do_topp=st.do_topp, do_minp=st.do_minp,
+                     do_penalties=False, do_random=st.do_random)
+        bucket = (b, w, prev_t1, num_steps, lora_state is not None,
+                  tuple(sorted(flags.items())))
+        with self._tracer.span("execute"):
+            packed, new_caches = self._compile_tracker.call(
+                "decode_cont", bucket, self._jit_decode_cont,
+                self.params, kv_caches, prev_packed, place(positions),
+                place(block_tables), place(ctx), *sampling_args, lora_state,
+                prev_t1=prev_t1, num_steps=num_steps, **flags)
 
         step = InflightStep(self, packed, cont.metas, cont.rows, num_steps,
                             num_steps, st.logprob_k, False, num_steps)
@@ -1011,7 +1049,8 @@ class ModelRunner:
         holds the `num_steps` input tokens for live row i
         ([last_accepted, draft_1, ..]). Returns the target's per-position
         choices in the usual per-substep SamplerOutput shape."""
-        arrays, rows = self._prepare_decode(seq_group_metadata_list)
+        with self._tracer.span("prepare_inputs"):
+            arrays, rows = self._prepare_decode(seq_group_metadata_list)
         padded_n = arrays["token_ids"].shape[0]
         teacher = np.zeros((padded_n, num_steps), np.int32)
         for i, toks in enumerate(teacher_rows):
@@ -1033,13 +1072,18 @@ class ModelRunner:
             "speculative verification dispatched for a penalty batch")
         place = self._place_batch_array
         sampling_args = self._sampling_args_device(st, padded_n)
-        packed, new_caches = self._jit_decode_teacher(
-            self.params, kv_caches, place(teacher),
-            place(arrays["positions"]), place(arrays["block_tables"]),
-            place(arrays["context_lens"]), *sampling_args, lora_state,
-            num_steps=num_steps, logprob_k=st.logprob_k,
-            do_topk=st.do_topk, do_topp=st.do_topp, do_minp=st.do_minp,
-            do_penalties=False, do_random=st.do_random)
+        flags = dict(logprob_k=st.logprob_k, do_topk=st.do_topk,
+                     do_topp=st.do_topp, do_minp=st.do_minp,
+                     do_penalties=False, do_random=st.do_random)
+        bucket = (padded_n, arrays["block_tables"].shape[1], num_steps,
+                  lora_state is not None, tuple(sorted(flags.items())))
+        with self._tracer.span("execute"):
+            packed, new_caches = self._compile_tracker.call(
+                "decode_teacher", bucket, self._jit_decode_teacher,
+                self.params, kv_caches, place(teacher),
+                place(arrays["positions"]), place(arrays["block_tables"]),
+                place(arrays["context_lens"]), *sampling_args, lora_state,
+                num_steps=num_steps, **flags)
         step = InflightStep(self, packed, seq_group_metadata_list, rows,
                             num_steps, num_steps, st.logprob_k, False,
                             num_steps)

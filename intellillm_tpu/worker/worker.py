@@ -390,12 +390,15 @@ class Worker:
         """Returns one SamplerOutput per fused decode substep (length 1 for
         prompt runs and unfused decodes). With `defer_fetch`, returns the
         dispatched-but-unfetched InflightStep instead (pipelined path)."""
-        if blocks_to_swap_out:
-            self.cache_engine.swap_out(blocks_to_swap_out)
-        if blocks_to_swap_in:
-            self.cache_engine.swap_in(blocks_to_swap_in)
-        if blocks_to_copy:
-            self.cache_engine.copy(blocks_to_copy)
+        if blocks_to_swap_out or blocks_to_swap_in or blocks_to_copy:
+            from intellillm_tpu.obs import get_step_tracer
+            with get_step_tracer().span("swap_copy"):
+                if blocks_to_swap_out:
+                    self.cache_engine.swap_out(blocks_to_swap_out)
+                if blocks_to_swap_in:
+                    self.cache_engine.swap_in(blocks_to_swap_in)
+                if blocks_to_copy:
+                    self.cache_engine.copy(blocks_to_copy)
 
         if not seq_group_metadata_list:
             return []

@@ -1,0 +1,132 @@
+"""End-to-end observability: a real CPU engine run must export per-phase
+timing that adds up, count XLA compiles exactly once per jit bucket, and
+leave an ordered flight-recorder trace per request.
+
+These are the PR's acceptance tests — they drive the full stack
+(LLM → LLMEngine → Scheduler → Worker → ModelRunner) rather than the
+obs primitives in isolation (tests/obs/ covers those).
+"""
+import pytest
+
+from intellillm_tpu import LLM, SamplingParams
+from intellillm_tpu.engine.metrics import _Metrics, _PROMETHEUS
+from intellillm_tpu.obs import (get_compile_tracker, get_flight_recorder,
+                                get_step_tracer)
+
+
+@pytest.fixture
+def fresh_obs():
+    """Reset the process-global observability state around the test so
+    earlier engine tests in the same process don't pollute counters."""
+    get_step_tracer().reset_for_testing()
+    get_compile_tracker().reset_for_testing()
+    get_flight_recorder().reset_for_testing()
+    _Metrics.reset_for_testing()
+    yield
+    _Metrics.reset_for_testing()
+
+
+def _registry_value(name: str, label_filter=None) -> float:
+    from prometheus_client import REGISTRY
+    total = 0.0
+    for metric in REGISTRY.collect():
+        for sample in metric.samples:
+            if sample.name == name and (
+                    label_filter is None or
+                    all(sample.labels.get(k) == v
+                        for k, v in label_filter.items())):
+                total += sample.value
+    return total
+
+
+@pytest.mark.skipif(not _PROMETHEUS, reason="needs prometheus_client")
+def test_engine_run_exports_phase_breakdown(tiny_opt_dir, fresh_obs):
+    llm = LLM(model=tiny_opt_dir, dtype="float32",
+              num_device_blocks_override=128, max_model_len=128,
+              max_num_seqs=8, max_paddings=512, swap_space=0.01,
+              disable_log_stats=False)
+    engine = llm.llm_engine
+    params = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+    for i, prompt in enumerate(["hello my name is",
+                                "the capital of france is"]):
+        engine.add_request(str(i), prompt, params)
+    outs = llm._run_engine(use_tqdm=False)
+    assert all(len(o.outputs[0].token_ids) == 24 for o in outs)
+
+    phase_sum = _registry_value("intellillm_step_phase_seconds_sum")
+    step_sum = _registry_value("intellillm_step_time_seconds_sum")
+    n_steps = _registry_value("intellillm_step_time_seconds_count")
+    assert n_steps > 0, "no step histogram samples exported"
+    assert phase_sum > 0.0
+    # Exclusive phase accounting: the sum must cover at least 80% of step
+    # wall time (acceptance criterion) and can never exceed it by more
+    # than drain jitter.
+    assert phase_sum >= 0.8 * step_sum, (
+        f"phases cover only {phase_sum / step_sum:.0%} of step time")
+    assert phase_sum <= step_sum * 1.05 + 0.005
+
+    # The hot phases must all have fired on a prefill+decode run.
+    for phase in ("schedule", "prepare_inputs", "execute", "sample",
+                  "detokenize"):
+        assert _registry_value("intellillm_step_phase_seconds_count",
+                               {"phase": phase}) > 0, f"{phase} missing"
+
+    # The engine also keeps the last drained breakdown in-process. (The
+    # last pipelined drain can be a tail finalize with no execute span,
+    # so only non-emptiness is guaranteed.)
+    assert engine.last_step_time > 0.0
+    assert engine.last_step_phases
+
+
+def test_compile_counters_once_per_bucket(tiny_opt_dir, fresh_obs):
+    llm = LLM(model=tiny_opt_dir, dtype="float32",
+              num_device_blocks_override=128, max_model_len=128,
+              max_num_seqs=8, max_paddings=512, swap_space=0.01)
+    engine = llm.llm_engine
+    params = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+
+    engine.add_request("11", "hello my name is", params)
+    llm._run_engine(use_tqdm=False)
+    snap1 = get_compile_tracker().snapshot()
+    assert snap1["compiles"].get("prefill") == 1, snap1
+    decode_compiles1 = sum(v for k, v in snap1["compiles"].items()
+                           if k.startswith("decode"))
+    assert decode_compiles1 >= 1, snap1
+    assert snap1["live_executables"] == sum(snap1["compiles"].values())
+
+    # Identical second request: every bucket is warm — zero new compiles,
+    # only cache hits.
+    engine.add_request("12", "hello my name is", params)
+    llm._run_engine(use_tqdm=False)
+    snap2 = get_compile_tracker().snapshot()
+    assert snap2["compiles"] == snap1["compiles"], (
+        f"cache hit recompiled: {snap1['compiles']} -> {snap2['compiles']}")
+    assert sum(snap2["cache_hits"].values()) > sum(
+        snap1["cache_hits"].values())
+
+
+def test_flight_recorder_traces_request_lifecycle(tiny_opt_dir, fresh_obs):
+    llm = LLM(model=tiny_opt_dir, dtype="float32",
+              num_device_blocks_override=128, max_model_len=128,
+              max_num_seqs=8, max_paddings=512, swap_space=0.01)
+    engine = llm.llm_engine
+    params = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    engine.add_request("21", "the cat runs fast and the dog", params)
+    llm._run_engine(use_tqdm=False)
+
+    trace = get_flight_recorder().get_trace("21")
+    assert trace is not None
+    events = [e["event"] for e in trace]
+    # Ordered lifecycle: arrival → admission → prefill → first token →
+    # finish, with monotonically nondecreasing timestamps.
+    for a, b in [("arrived", "scheduled"), ("scheduled", "prefill_start"),
+                 ("prefill_start", "first_token"),
+                 ("first_token", "finished")]:
+        assert events.index(a) < events.index(b), events
+    assert all(trace[i]["ts"] <= trace[i + 1]["ts"]
+               for i in range(len(trace) - 1))
+    assert trace[events.index("finished")].get("detail") == "length"
+    # Finished: moved off the live table into the finished ring.
+    assert "21" not in get_flight_recorder().live_request_ids()
+    assert any(x["request_id"] == "21"
+               for x in get_flight_recorder().recent_finished())

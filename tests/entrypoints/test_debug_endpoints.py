@@ -1,0 +1,146 @@
+"""In-process smoke test of the server observability surface: /health,
+/metrics (exports `intellillm_` series), and the /debug routes — via
+aiohttp's TestServer, no subprocess or real engine needed."""
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from intellillm_tpu.engine.metrics import _Metrics, _PROMETHEUS
+from intellillm_tpu.entrypoints import api_server as demo_server
+from intellillm_tpu.entrypoints.openai import api_server as openai_server
+from intellillm_tpu.obs import get_flight_recorder
+
+
+def _seed_recorder():
+    recorder = get_flight_recorder()
+    recorder.reset_for_testing()
+    recorder.record("smoke-1", "arrived", detail="prompt_tokens=4")
+    recorder.record("smoke-1", "scheduled")
+    recorder.record("smoke-1", "prefill_start", detail="tokens=4")
+    recorder.record("smoke-1", "first_token")
+    recorder.record("smoke-1", "finished", detail="stop")
+    recorder.record("smoke-live", "arrived")
+    return recorder
+
+
+def _run(app, scenario):
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await scenario(client)
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+@pytest.mark.skipif(not _PROMETHEUS, reason="needs prometheus_client")
+def test_openai_server_observability_surface():
+    _Metrics.reset_for_testing()
+    _Metrics(["model_name"])  # register the intellillm_ collectors
+    _seed_recorder()
+    try:
+        async def scenario(client):
+            resp = await client.get("/health")
+            assert resp.status == 200
+
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            body = await resp.text()
+            assert "intellillm_" in body
+            assert "intellillm_step_phase_seconds" in body
+            assert "intellillm_xla_compiles_total" in body
+
+            # Completed request: ordered lifecycle events.
+            resp = await client.get("/debug/trace",
+                                    params={"request_id": "smoke-1"})
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["request_id"] == "smoke-1"
+            assert [e["event"] for e in data["events"]] == [
+                "arrived", "scheduled", "prefill_start", "first_token",
+                "finished"]
+            ts = [e["ts"] for e in data["events"]]
+            assert ts == sorted(ts)
+
+            resp = await client.get("/debug/trace",
+                                    params={"request_id": "never-seen"})
+            assert resp.status == 404
+
+            resp = await client.get("/debug/trace")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["live_request_ids"] == ["smoke-live"]
+            assert [x["request_id"] for x in data["recent_finished"]] == [
+                "smoke-1"]
+
+            resp = await client.get("/debug/trace",
+                                    params={"limit": "bogus"})
+            assert resp.status == 400
+
+            # Profiler admin routes are opt-in (--enable-profiling):
+            # absent by default.
+            resp = await client.post("/debug/profiler/start")
+            assert resp.status == 404
+            resp = await client.post("/debug/profiler/stop")
+            assert resp.status == 404
+
+        _run(openai_server.build_app(), scenario)
+    finally:
+        get_flight_recorder().reset_for_testing()
+        _Metrics.reset_for_testing()
+
+
+def test_openai_server_debug_routes_require_api_key():
+    """--api-key must gate /debug like every non-health route."""
+    async def scenario(client):
+        resp = await client.get("/debug/trace")
+        assert resp.status == 401
+        resp = await client.get(
+            "/debug/trace", headers={"Authorization": "Bearer sekrit"})
+        assert resp.status == 200
+        resp = await client.get("/health")
+        assert resp.status == 200  # health stays open
+
+    _run(openai_server.build_app(api_key="sekrit"), scenario)
+
+
+def test_profiler_routes_registered_only_with_opt_in():
+    """--enable-profiling gates the profiler admin endpoints on both
+    servers (they degrade serving and write traces to a caller-chosen
+    dir; the demo server has no auth at all)."""
+    async def gated(client):
+        # Registered, but no engine behind this test app: refuses 503
+        # instead of tracing.
+        resp = await client.post("/debug/profiler/start")
+        assert resp.status == 503
+        resp = await client.post("/debug/profiler/stop")
+        assert resp.status == 503
+
+    async def absent(client):
+        resp = await client.post("/debug/profiler/start")
+        assert resp.status == 404
+        resp = await client.post("/debug/profiler/stop")
+        assert resp.status == 404
+
+    _run(openai_server.build_app(enable_profiling=True), gated)
+    _run(demo_server.build_app(enable_profiling=True), gated)
+    _run(demo_server.build_app(), absent)
+
+
+def test_demo_server_has_debug_routes():
+    _seed_recorder()
+    try:
+        async def scenario(client):
+            resp = await client.get("/health")
+            assert resp.status == 200
+            resp = await client.get("/debug/trace",
+                                    params={"request_id": "smoke-1"})
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["events"][-1]["event"] == "finished"
+
+        _run(demo_server.build_app(), scenario)
+    finally:
+        get_flight_recorder().reset_for_testing()

@@ -1,0 +1,99 @@
+"""CompileTracker unit tests: exactly one compile per new jit bucket,
+zero on cache hits, and snapshot contents."""
+from intellillm_tpu.obs.compile_tracker import (CompileTracker,
+                                                get_compile_tracker)
+
+
+def test_first_call_is_compile_repeat_is_hit():
+    t = CompileTracker(enabled=True)
+    calls = []
+
+    def fn(x, y=0):
+        calls.append((x, y))
+        return x + y
+
+    assert t.call("prefill", (8, 16), fn, 1, y=2) == 3
+    snap = t.snapshot()
+    assert snap["compiles"] == {"prefill": 1}
+    assert snap["cache_hits"] == {}
+    assert snap["compile_time_seconds"]["prefill"] >= 0.0
+    assert snap["live_executables"] == 1
+
+    # Same bucket again: a cache hit, never a second compile.
+    assert t.call("prefill", (8, 16), fn, 5, y=5) == 10
+    snap = t.snapshot()
+    assert snap["compiles"] == {"prefill": 1}
+    assert snap["cache_hits"] == {"prefill": 1}
+    assert calls == [(1, 2), (5, 5)]
+
+
+def test_new_bucket_compiles_again():
+    t = CompileTracker(enabled=True)
+    fn = lambda: None  # noqa: E731
+    t.call("decode_single", (8, 4), fn)
+    t.call("decode_single", (16, 4), fn)  # different batch bucket
+    t.call("decode_fused", (8, 4), fn)    # same key, different program
+    snap = t.snapshot()
+    assert snap["compiles"] == {"decode_single": 2, "decode_fused": 1}
+    assert snap["cache_hits"] == {}
+    assert snap["live_executables"] == 3
+
+
+def test_many_hits_single_compile():
+    t = CompileTracker(enabled=True)
+    for _ in range(10):
+        t.call("decode_cont", (4, 2, True), lambda: 1)
+    snap = t.snapshot()
+    assert snap["compiles"] == {"decode_cont": 1}
+    assert snap["cache_hits"] == {"decode_cont": 9}
+
+
+def test_kernel_dispatch_counts():
+    t = CompileTracker(enabled=True)
+    t.record_kernel_dispatch("pallas")
+    t.record_kernel_dispatch("reference")
+    t.record_kernel_dispatch("reference")
+    assert t.snapshot()["kernel_dispatch"] == {"pallas": 1, "reference": 2}
+
+
+def test_disabled_tracker_passes_through():
+    t = CompileTracker(enabled=False)
+    assert t.call("prefill", (1,), lambda v: v * 2, 21) == 42
+    t.record_kernel_dispatch("pallas")
+    snap = t.snapshot()
+    assert snap["compiles"] == {}
+    assert snap["kernel_dispatch"] == {}
+
+
+def test_failed_first_dispatch_is_not_a_cache_hit():
+    t = CompileTracker(enabled=True)
+
+    def boom():
+        raise RuntimeError("compile failed")
+
+    try:
+        t.call("prefill", (2,), boom)
+    except RuntimeError:
+        pass
+    # A failed first dispatch (e.g. compile OOM) never produced an
+    # executable: nothing is recorded and the retry counts as the
+    # bucket's (one) real compile, not a hit.
+    snap = t.snapshot()
+    assert snap["compiles"] == {}
+    assert snap["cache_hits"] == {}
+    assert snap["live_executables"] == 0
+    t.call("prefill", (2,), lambda: None)
+    snap = t.snapshot()
+    assert snap["compiles"] == {"prefill": 1}
+    assert snap["cache_hits"] == {}
+    # Only a successful dispatch claims the key: the next call is a hit.
+    t.call("prefill", (2,), lambda: None)
+    assert t.snapshot()["cache_hits"] == {"prefill": 1}
+
+
+def test_global_tracker_reset():
+    t = get_compile_tracker()
+    assert get_compile_tracker() is t
+    t.call("prefill", ("test-sentinel-key",), lambda: None)
+    t.reset_for_testing()
+    assert t.snapshot()["compiles"] == {}

@@ -1,0 +1,109 @@
+"""FlightRecorder unit tests: ordering, the three eviction bounds, and
+sealed-trace semantics."""
+from intellillm_tpu.obs.flight_recorder import (EVENTS, FlightRecorder,
+                                                get_flight_recorder)
+
+
+def _events(recorder, rid):
+    return [e["event"] for e in recorder.get_trace(rid)]
+
+
+def test_events_kept_in_order_with_details():
+    r = FlightRecorder(enabled=True)
+    r.record("r1", "arrived", detail="prompt_tokens=5")
+    r.record("r1", "scheduled")
+    r.record("r1", "prefill_start", detail="tokens=5")
+    r.record("r1", "first_token")
+    r.record("r1", "finished", detail="length")
+    trace = r.get_trace("r1")
+    assert [e["event"] for e in trace] == [
+        "arrived", "scheduled", "prefill_start", "first_token", "finished"]
+    assert trace[0]["detail"] == "prompt_tokens=5"
+    assert "detail" not in trace[1]
+    assert all(trace[i]["ts"] <= trace[i + 1]["ts"]
+               for i in range(len(trace) - 1))
+
+
+def test_unknown_request_returns_none():
+    r = FlightRecorder(enabled=True)
+    assert r.get_trace("nope") is None
+
+
+def test_per_request_event_cap():
+    r = FlightRecorder(enabled=True, max_events_per_request=4)
+    r.record("r1", "arrived")
+    for _ in range(10):
+        r.record("r1", "preempted")
+        r.record("r1", "scheduled")
+    events = _events(r, "r1")
+    assert len(events) == 4
+    # Oldest events (including "arrived") were evicted; newest kept.
+    assert events[-1] == "scheduled"
+    assert "arrived" not in events
+
+
+def test_live_request_cap_evicts_oldest():
+    r = FlightRecorder(enabled=True, max_live_requests=2)
+    r.record("old", "arrived")
+    r.record("mid", "arrived")
+    r.record("new", "arrived")
+    assert r.get_trace("old") is None
+    assert r.live_request_ids() == ["mid", "new"]
+
+
+def test_finished_ring_cap_and_order():
+    r = FlightRecorder(enabled=True, max_finished_requests=2)
+    for rid in ("a", "b", "c"):
+        r.record(rid, "arrived")
+        r.record(rid, "finished")
+    assert r.get_trace("a") is None  # evicted from the finished ring
+    recent = r.recent_finished()
+    assert [x["request_id"] for x in recent] == ["c", "b"]  # newest first
+    assert [e["event"] for e in recent[0]["events"]] == ["arrived",
+                                                         "finished"]
+
+
+def test_terminal_event_seals_trace():
+    """Pipelined steps can re-report a finished group (zombie rows);
+    records after finished/aborted must be dropped."""
+    r = FlightRecorder(enabled=True)
+    r.record("r1", "arrived")
+    r.record("r1", "finished")
+    r.record("r1", "scheduled")  # late zombie record
+    assert _events(r, "r1") == ["arrived", "finished"]
+    assert "r1" not in r.live_request_ids()
+
+
+def test_aborted_is_terminal():
+    r = FlightRecorder(enabled=True)
+    r.record("r1", "arrived")
+    r.record("r1", "aborted")
+    assert [x["request_id"] for x in r.recent_finished()] == ["r1"]
+
+
+def test_recent_finished_limit():
+    r = FlightRecorder(enabled=True)
+    for i in range(5):
+        r.record(str(i), "finished")
+    assert len(r.recent_finished(limit=3)) == 3
+
+
+def test_disabled_recorder_records_nothing():
+    r = FlightRecorder(enabled=False)
+    r.record("r1", "arrived")
+    assert r.get_trace("r1") is None
+    assert r.recent_finished() == []
+
+
+def test_event_names_are_canonical():
+    assert set(EVENTS) >= {"arrived", "scheduled", "prefill_start",
+                           "preempted", "swapped_out", "swapped_in",
+                           "first_token", "finished", "aborted"}
+
+
+def test_global_recorder_reset():
+    r = get_flight_recorder()
+    assert get_flight_recorder() is r
+    r.record("x", "arrived")
+    r.reset_for_testing()
+    assert r.get_trace("x") is None

@@ -1,0 +1,49 @@
+"""StatLogger must degrade gracefully when prometheus_client is absent
+(the engine never requires it — `serve` extra only)."""
+import importlib
+import sys
+
+import intellillm_tpu.engine.metrics as metrics_mod
+
+
+def test_statlogger_without_prometheus(monkeypatch):
+    # Unregister the real singleton's collectors BEFORE hiding the
+    # package (afterwards the module can't reach the registry), then
+    # make `import prometheus_client` raise ImportError and rebuild the
+    # module so its _PROMETHEUS flag flips off.
+    metrics_mod._Metrics.reset_for_testing()
+    monkeypatch.setitem(sys.modules, "prometheus_client", None)
+    try:
+        reloaded = importlib.reload(metrics_mod)
+        assert reloaded._PROMETHEUS is False
+
+        logger = reloaded.StatLogger(local_interval=0.0,
+                                     labels={"model_name": "m"})
+        assert logger.metrics is None
+        stats = reloaded.Stats(
+            now=1000.0, num_running=1, num_swapped=0, num_waiting=2,
+            device_cache_usage=0.5, cpu_cache_usage=0.0,
+            num_prompt_tokens=16, num_generation_tokens=4,
+            time_to_first_tokens=[0.01],
+            time_per_output_tokens=[0.002],
+            time_e2e_requests=[0.1],
+            spec_acceptance_rate=0.75,
+            step_phase_times={"execute": 0.005, "schedule": 0.001},
+            step_time=0.007)
+        logger.log(stats)          # must not raise
+        logger.log(stats)          # crosses local_interval: logs breakdown
+    finally:
+        monkeypatch.undo()
+        restored = importlib.reload(metrics_mod)
+        assert restored._PROMETHEUS is True
+        restored._Metrics.reset_for_testing()
+
+
+def test_spec_acceptance_rate_optional():
+    from intellillm_tpu.engine.metrics import Stats
+    stats = Stats(now=0.0, num_running=0, num_swapped=0, num_waiting=0,
+                  device_cache_usage=0.0, cpu_cache_usage=0.0,
+                  num_prompt_tokens=0, num_generation_tokens=0)
+    assert stats.spec_acceptance_rate is None
+    assert stats.step_phase_times == {}
+    assert stats.step_time == 0.0

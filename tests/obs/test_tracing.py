@@ -1,0 +1,86 @@
+"""StepTracer unit tests: span timing, exclusive nesting, drain
+semantics, and the disabled fast path."""
+import time
+
+from intellillm_tpu.obs.tracing import (PHASES, _NULL_SPAN, StepTracer,
+                                        get_step_tracer)
+
+
+def test_span_measures_elapsed_time():
+    tracer = StepTracer(enabled=True)
+    tracer.begin_step()
+    with tracer.span("execute"):
+        time.sleep(0.02)
+    phases, total = tracer.end_step()
+    assert set(phases) == {"execute"}
+    assert 0.015 <= phases["execute"] <= 0.2
+    assert total >= phases["execute"]
+
+
+def test_nested_spans_are_exclusive():
+    """A child's time must be subtracted from its parent so the phase sum
+    never double-counts (and stays comparable to step wall time)."""
+    tracer = StepTracer(enabled=True)
+    tracer.begin_step()
+    with tracer.span("schedule"):
+        time.sleep(0.01)
+        with tracer.span("execute"):
+            time.sleep(0.02)
+        time.sleep(0.01)
+    phases, total = tracer.end_step()
+    assert 0.015 <= phases["execute"] <= 0.2
+    # Exclusive parent time is ~20ms, NOT ~40ms (child excluded).
+    assert 0.015 <= phases["schedule"] <= 0.035
+    assert sum(phases.values()) <= total + 1e-6
+
+
+def test_same_phase_accumulates_across_spans():
+    tracer = StepTracer(enabled=True)
+    with tracer.span("sample"):
+        time.sleep(0.005)
+    with tracer.span("sample"):
+        time.sleep(0.005)
+    phases, _ = tracer.end_step()
+    assert phases["sample"] >= 0.008
+
+
+def test_end_step_drains():
+    tracer = StepTracer(enabled=True)
+    tracer.begin_step()
+    with tracer.span("schedule"):
+        pass
+    phases, total = tracer.end_step()
+    assert "schedule" in phases
+    # Second drain: everything was consumed.
+    phases2, total2 = tracer.end_step()
+    assert phases2 == {}
+    assert total2 == 0.0
+
+
+def test_end_step_without_begin_degrades_to_phase_sum():
+    tracer = StepTracer(enabled=True)
+    with tracer.span("detokenize"):
+        time.sleep(0.005)
+    phases, total = tracer.end_step()
+    assert total == sum(phases.values())
+
+
+def test_disabled_tracer_is_noop():
+    tracer = StepTracer(enabled=False)
+    assert tracer.span("execute") is _NULL_SPAN
+    tracer.begin_step()
+    with tracer.span("execute"):
+        time.sleep(0.002)
+    assert tracer.end_step() == ({}, 0.0)
+
+
+def test_known_phases_exported():
+    assert PHASES == ("schedule", "prepare_inputs", "execute", "sample",
+                      "swap_copy", "detokenize")
+
+
+def test_global_tracer_singleton():
+    t = get_step_tracer()
+    assert get_step_tracer() is t
+    t.reset_for_testing()
+    assert t.end_step()[0] == {}
