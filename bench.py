@@ -64,15 +64,44 @@ def _fail_record(reason: str, exit_code: int | None = None):
         os._exit(exit_code)
 
 
-def probe_backend(attempts: int = 3, backoff_s: float = 60.0,
-                  probe_timeout_s: float = 300.0) -> bool:
+def _probe_child_code(probe_timeout_s: float) -> str:
+    """Child program for the backend probe. faulthandler dumps every
+    thread's stack to stderr and self-exits shortly BEFORE the parent's
+    kill, so a hung `jax.devices()` leaves a diagnosable trace (BENCH_r05
+    burned 3x300s on a hang with zero evidence of where it was stuck)."""
+    dump_after = max(probe_timeout_s - 10.0, 1.0)
+    return ("import faulthandler\n"
+            f"faulthandler.dump_traceback_later({dump_after:.1f}, "
+            "exit=True)\n"
+            "import jax\n"
+            "d = jax.devices()\n"
+            "print(d[0].platform)\n")
+
+
+def _extract_probe_stack(stderr_text: str | bytes | None) -> str | None:
+    """Pull the faulthandler dump (from its 'Timeout (' marker) out of
+    the probe child's stderr; None when no dump is present."""
+    if stderr_text is None:
+        return None
+    if isinstance(stderr_text, bytes):
+        stderr_text = stderr_text.decode(errors="replace")
+    idx = stderr_text.rfind("Timeout (")
+    if idx == -1:
+        return None
+    return stderr_text[idx:idx + 2000]
+
+
+def probe_backend(attempts: int = 2, backoff_s: float = 30.0,
+                  probe_timeout_s: float = 120.0) -> bool:
     """Probe the TPU backend in a SUBPROCESS with retry + backoff.
 
     A wedged axon tunnel makes `jax.devices()` hang indefinitely with no
     way to interrupt it in-process, and a failed in-process init is cached
     by jax — so the probe runs out-of-process (also respecting the
     one-TPU-process-at-a-time constraint: the probe fully exits before the
-    main process initializes the backend).
+    main process initializes the backend). Fail-fast defaults (2x120s,
+    was 3x300s): a healthy probe answers in seconds, and each hung
+    attempt now carries its own stack dump, so long retries buy nothing.
     """
     attempts = int(os.environ.get("INTELLILLM_BENCH_PROBE_ATTEMPTS",
                                   attempts))
@@ -83,21 +112,25 @@ def probe_backend(attempts: int = 3, backoff_s: float = 60.0,
     for i in range(attempts):
         t0 = time.time()
         rec = {"attempt": i + 1, "ok": False, "elapsed_s": 0.0, "err": ""}
+        stack = None
         try:
             r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; d = jax.devices(); print(d[0].platform)"],
+                [sys.executable, "-c", _probe_child_code(probe_timeout_s)],
                 capture_output=True, text=True, timeout=probe_timeout_s)
             rec["ok"] = r.returncode == 0
             if not rec["ok"]:
                 tail = (r.stderr.strip().splitlines() or ["unknown"])[-1]
                 rec["err"] = tail[:300]
+                stack = _extract_probe_stack(r.stderr)
             else:
                 rec["platform"] = r.stdout.strip()
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
             rec["err"] = f"probe hung > {probe_timeout_s:.0f}s (killed)"
+            stack = _extract_probe_stack(e.stderr)
         except Exception as e:  # noqa: BLE001 - record and retry
             rec["err"] = repr(e)[:300]
+        if stack:
+            rec["stack"] = stack
         rec["elapsed_s"] = round(time.time() - t0, 1)
         _PROGRESS["probe"].append(rec)
         print(f"[bench] backend probe {rec}", file=sys.stderr, flush=True)

@@ -23,6 +23,7 @@ import subprocess
 import sys
 import tempfile
 import time
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -113,6 +114,23 @@ def snapshot_observability(base: str) -> dict:
     return out
 
 
+def snapshot_slo(base: str) -> dict:
+    """Scrape the server-side rolling SLO summary (p50/p90/p99
+    queue-wait/TTFT/TPOT + goodput ratio) from /health/detail. A 503
+    still carries the body (stalled server — worth recording)."""
+    try:
+        with urllib.request.urlopen(base + "/health/detail", timeout=5) as r:
+            detail = json.loads(r.read().decode(errors="replace"))
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json.loads(e.read().decode(errors="replace"))
+        except Exception:
+            return {"error": f"health/detail scrape failed: {e}"}
+    except Exception as e:
+        return {"error": f"health/detail scrape failed: {e}"}
+    return detail.get("slo") or {}
+
+
 def wait_healthy(proc: subprocess.Popen, base: str, timeout: float,
                  server_log: str) -> None:
     deadline = time.monotonic() + timeout
@@ -193,6 +211,7 @@ def main(args) -> dict:
             print(json.dumps({"serve_bench_rate": rate_s, **m}),
                   flush=True)
         summary["observability"] = snapshot_observability(base)
+        summary["slo"] = snapshot_slo(base)
     finally:
         proc.send_signal(signal.SIGKILL)
         proc.wait()

@@ -32,7 +32,8 @@ from intellillm_tpu.config import CacheConfig, LoRAConfig, SchedulerConfig
 from intellillm_tpu.core.block_manager import AllocStatus, BlockSpaceManager
 from intellillm_tpu.core.policy import Policy, PolicyFactory
 from intellillm_tpu.logger import init_logger
-from intellillm_tpu.obs import get_flight_recorder, get_step_tracer
+from intellillm_tpu.obs import (get_flight_recorder, get_slo_tracker,
+                                get_step_tracer)
 from intellillm_tpu.prefix import PrefixPool
 from intellillm_tpu.sequence import (Sequence, SequenceData, SequenceGroup,
                                      SequenceGroupMetadata, SequenceStatus)
@@ -138,6 +139,10 @@ class Scheduler:
                 and len(curr_loras) >= self.lora_config.max_loras)
 
     def add_seq_group(self, seq_group: SequenceGroup) -> None:
+        # `queued` marks scheduler admission (vs `arrived` at engine
+        # entry, before tokenization) so SLO queue-wait = scheduled -
+        # queued measures scheduler wait only.
+        self._flight.record(seq_group.request_id, "queued")
         self.waiting.append(seq_group)
 
     def abort_seq_group(self, request_id: Union[str, Iterable[str]]) -> None:
@@ -154,7 +159,11 @@ class Scheduler:
                     request_ids.remove(seq_group.request_id)
             for seq_group in aborted:
                 state_queue.remove(seq_group)
-                self._flight.record(seq_group.request_id, "aborted")
+                if self._flight.record(seq_group.request_id, "aborted"):
+                    get_slo_tracker().record_finish(
+                        seq_group.request_id,
+                        sum(s.get_output_len()
+                            for s in seq_group.get_seqs()))
                 for seq in seq_group.get_seqs():
                     if seq.is_finished():
                         continue

@@ -57,6 +57,10 @@ class EngineArgs:
     max_cpu_loras: Optional[int] = None
     # Logging
     disable_log_stats: bool = False
+    # SLO telemetry (obs/slo.py): None -> INTELLILLM_SLO_*_MS env /
+    # built-in defaults.
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
 
     def __post_init__(self) -> None:
         if self.tokenizer is None:
@@ -116,6 +120,14 @@ class EngineArgs:
         parser.add_argument("--lora-dtype", type=str, default="auto")
         parser.add_argument("--max-cpu-loras", type=int, default=None)
         parser.add_argument("--disable-log-stats", action="store_true")
+        parser.add_argument("--slo-ttft-ms", type=float, default=None,
+                            help="time-to-first-token SLO for the goodput "
+                            "gauge (default: INTELLILLM_SLO_TTFT_MS or "
+                            "1000)")
+        parser.add_argument("--slo-tpot-ms", type=float, default=None,
+                            help="time-per-output-token SLO for the "
+                            "goodput gauge (default: INTELLILLM_SLO_TPOT_MS "
+                            "or 200)")
         parser.add_argument("--speculative-model", type=str, default=None)
         parser.add_argument("--num-speculative-tokens", type=int,
                             default=5)
@@ -127,6 +139,10 @@ class EngineArgs:
         return cls(**{a: getattr(args, a) for a in attrs if hasattr(args, a)})
 
     def create_engine_configs(self):
+        if self.slo_ttft_ms is not None or self.slo_tpot_ms is not None:
+            from intellillm_tpu.obs import get_slo_tracker
+            get_slo_tracker().configure(slo_ttft_ms=self.slo_ttft_ms,
+                                        slo_tpot_ms=self.slo_tpot_ms)
         model_config = ModelConfig(
             model=self.model,
             tokenizer=self.tokenizer,

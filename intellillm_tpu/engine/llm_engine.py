@@ -22,7 +22,8 @@ from intellillm_tpu.core.scheduler import Scheduler, SchedulerOutputs
 from intellillm_tpu.engine.arg_utils import EngineArgs
 from intellillm_tpu.engine.metrics import StatLogger, Stats
 from intellillm_tpu.logger import init_logger
-from intellillm_tpu.obs import (get_flight_recorder, get_step_tracer,
+from intellillm_tpu.obs import (get_flight_recorder, get_slo_tracker,
+                                get_step_tracer, get_watchdog,
                                 request_context)
 from intellillm_tpu.outputs import RequestOutput
 from intellillm_tpu.sampling_params import SamplingParams
@@ -125,6 +126,7 @@ class LLMEngine:
         # log_stats off.
         self._tracer = get_step_tracer()
         self._flight = get_flight_recorder()
+        self._slo = get_slo_tracker()
         self.last_step_phases: dict = {}
         self.last_step_time: float = 0.0
 
@@ -152,6 +154,33 @@ class LLMEngine:
         # them are capped (see _cont_budget_ok).
         self._joiners_pending = False
         self._conts_past_prompt = 0
+
+        # Stall watchdog (obs/watchdog.py): heartbeat at every step
+        # boundary; the monitor thread uses these callbacks to decide
+        # whether silence means "idle" or "wedged" and to enrich the
+        # stall report.
+        self._watchdog = get_watchdog()
+        self._watchdog.attach(
+            has_work=lambda: (self.scheduler.has_unfinished_seqs()
+                              or bool(self._inflight)),
+            queue_depths=lambda: {
+                "waiting": len(self.scheduler.waiting),
+                "running": len(self.scheduler.running),
+                "swapped": len(self.scheduler.swapped),
+            },
+            kv_usage=self.kv_cache_usage)
+
+    def kv_cache_usage(self) -> dict:
+        """KV-cache fill fractions (device HBM + CPU swap), 0..1."""
+        num_total = self.cache_config.num_device_blocks
+        num_free = self.scheduler.block_manager.get_num_free_device_blocks()
+        num_total_cpu = self.cache_config.num_cpu_blocks
+        free_cpu = self.scheduler.block_manager.get_num_free_cpu_blocks()
+        return {
+            "device": round(1.0 - num_free / max(num_total, 1), 4),
+            "cpu": round(1.0 - free_cpu / num_total_cpu, 4)
+            if num_total_cpu > 0 else 0.0,
+        }
 
     # --- init ------------------------------------------------------------
 
@@ -649,8 +678,15 @@ class LLMEngine:
                     r for r in (SequenceStatus.get_finished_reason(s.status)
                                 for s in seq_group.get_seqs())
                     if r is not None})
-                self._flight.record(seq_group.request_id, "finished",
-                                    detail=",".join(reasons) or None)
+                # record() returns False for sealed traces (zombie rows
+                # re-reported by pipelined steps), so the SLO finish hook
+                # fires exactly once per request.
+                if self._flight.record(seq_group.request_id, "finished",
+                                       detail=",".join(reasons) or None):
+                    self._slo.record_finish(
+                        seq_group.request_id,
+                        sum(s.get_output_len()
+                            for s in seq_group.get_seqs()))
             request_outputs.append(RequestOutput.from_seq_group(seq_group))
 
         # Flip freshly computed prefixes (reference llm_engine.py:727-731).
@@ -672,6 +708,7 @@ class LLMEngine:
             if phases or step_time:
                 self.last_step_phases = phases
                 self.last_step_time = step_time
+            self._watchdog.heartbeat_step()
 
         if self.stat_logger is not None:
             stats = self._get_stats(scheduler_outputs)

@@ -203,9 +203,33 @@ class StatLogger:
                 parts.append("other %.1fms" % (other / self.num_steps * 1e3))
                 logger.info("Step breakdown over %d steps (avg/step): %s",
                             self.num_steps, ", ".join(parts))
+            self._log_slo_summary()
             self.num_prompt_tokens = []
             self.num_generation_tokens = []
             self.phase_seconds = {}
             self.step_seconds = 0.0
             self.num_steps = 0
             self.last_local_log = stats.now
+
+    def _log_slo_summary(self) -> None:
+        """Rolling per-request percentiles + goodput (obs/slo.py), logged
+        alongside the throughput line each interval."""
+        from intellillm_tpu.obs.slo import get_slo_tracker
+        s = get_slo_tracker().summary()
+        if not s["window"]:
+            return
+
+        def fmt(d: Optional[Dict[str, float]]) -> str:
+            if not d:
+                return "n/a"
+            return "%.0f/%.0f/%.0f" % (d["p50"], d["p90"], d["p99"])
+
+        goodput = ("%.1f%%" % (s["goodput_ratio"] * 100)
+                   if s["goodput_ratio"] is not None else "n/a")
+        logger.info(
+            "Request SLO over last %d finishes (p50/p90/p99 ms): "
+            "queue-wait %s, TTFT %s, TPOT %s, e2e %s; goodput %s "
+            "(TTFT<=%.0fms, TPOT<=%.0fms)",
+            s["window"], fmt(s["queue_wait_ms"]), fmt(s["ttft_ms"]),
+            fmt(s["tpot_ms"]), fmt(s["e2e_ms"]), goodput,
+            s["slo_ttft_ms"], s["slo_tpot_ms"])

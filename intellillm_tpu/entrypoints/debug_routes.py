@@ -4,6 +4,15 @@
                                         request (404 if unknown/evicted)
     GET  /debug/trace                   live request ids + recently
                                         finished traces (?limit=N)
+    GET  /debug/stall                   watchdog state + ring of stall
+                                        reports (thread stacks, queue
+                                        depths, compile snapshot)
+    GET  /health/detail                 structured liveness: last-step
+                                        age, watchdog state, queue
+                                        depths, KV usage, SLO summary;
+                                        503 while the watchdog has a
+                                        stall declared (and before the
+                                        engine is up)
     POST /debug/profiler/start?dir=...  begin a jax.profiler device trace
     POST /debug/profiler/stop           end it (writes the trace to disk)
 
@@ -22,7 +31,8 @@ from typing import Callable, Optional
 
 from aiohttp import web
 
-from intellillm_tpu.obs import get_flight_recorder
+from intellillm_tpu.obs import (get_compile_tracker, get_flight_recorder,
+                                get_slo_tracker, get_watchdog)
 
 
 def add_debug_routes(app: web.Application,
@@ -53,6 +63,42 @@ def add_debug_routes(app: web.Application,
             "recent_finished": recorder.recent_finished(limit),
         })
 
+    async def debug_stall(request: web.Request) -> web.Response:
+        watchdog = get_watchdog()
+        return web.json_response({
+            "watchdog": watchdog.snapshot(),
+            "reports": watchdog.reports(),
+        })
+
+    async def health_detail(request: web.Request) -> web.Response:
+        """Deep liveness, as opposed to the LB-cheap bare-200 /health:
+        503 while the watchdog has declared a stall (or before engine
+        startup), 200 with the same body otherwise."""
+        watchdog = get_watchdog()
+        body = {
+            "watchdog": watchdog.snapshot(),
+            "slo": get_slo_tracker().summary(),
+            "compiles": get_compile_tracker().snapshot(),
+            "live_requests": len(get_flight_recorder().live_request_ids()),
+        }
+        engine = get_engine()
+        if engine is None:
+            body["status"] = "initializing"
+            return web.json_response(body, status=503)
+        scheduler = engine.scheduler
+        body["queue_depths"] = {
+            "waiting": len(scheduler.waiting),
+            "running": len(scheduler.running),
+            "swapped": len(scheduler.swapped),
+        }
+        try:
+            body["kv_cache_usage"] = engine.kv_cache_usage()
+        except Exception:
+            body["kv_cache_usage"] = None
+        stalled = watchdog.state == "stalled"
+        body["status"] = "stalled" if stalled else "ok"
+        return web.json_response(body, status=503 if stalled else 200)
+
     async def profiler_start(request: web.Request) -> web.Response:
         engine = get_engine()
         if engine is None:
@@ -77,6 +123,8 @@ def add_debug_routes(app: web.Application,
         return web.json_response({"ok": True})
 
     app.router.add_get("/debug/trace", debug_trace)
+    app.router.add_get("/debug/stall", debug_stall)
+    app.router.add_get("/health/detail", health_detail)
     if enable_profiling:
         app.router.add_post("/debug/profiler/start", profiler_start)
         app.router.add_post("/debug/profiler/stop", profiler_stop)

@@ -95,7 +95,11 @@ async def create_completion(request: web.Request) -> web.StreamResponse:
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
     api_key = request.app.get("api_key")
-    if api_key is not None and not request.path.startswith("/health"):
+    # Exact-match the unauthenticated health endpoints: a prefix check
+    # would silently exempt any future route that happens to start with
+    # /health.
+    if api_key is not None and request.path not in ("/health",
+                                                    "/health/detail"):
         auth = request.headers.get("Authorization", "")
         if auth != f"Bearer {api_key}":
             return web.json_response({"error": "Unauthorized"}, status=401)

@@ -1,22 +1,31 @@
 """Observability subsystem: step-phase tracing, XLA compile tracking,
-and the per-request flight recorder. See docs/observability.md."""
+the per-request flight recorder, request SLO telemetry, and the engine
+stall watchdog. See docs/observability.md."""
 from intellillm_tpu.obs.compile_tracker import (CompileTracker,
                                                 get_compile_tracker,
                                                 record_kernel_dispatch)
 from intellillm_tpu.obs.flight_recorder import (EVENTS, FlightRecorder,
                                                 get_flight_recorder)
+from intellillm_tpu.obs.slo import (SLOTracker, derive_request_metrics,
+                                    get_slo_tracker)
 from intellillm_tpu.obs.tracing import (PHASES, StepTracer, get_step_tracer,
                                         request_context)
+from intellillm_tpu.obs.watchdog import EngineWatchdog, get_watchdog
 
 __all__ = [
     "CompileTracker",
     "EVENTS",
+    "EngineWatchdog",
     "FlightRecorder",
     "PHASES",
+    "SLOTracker",
     "StepTracer",
+    "derive_request_metrics",
     "get_compile_tracker",
     "get_flight_recorder",
+    "get_slo_tracker",
     "get_step_tracer",
+    "get_watchdog",
     "record_kernel_dispatch",
     "request_context",
 ]

@@ -15,8 +15,12 @@ finished requests move to a separate finished ring (default 256) so
 
 Event names used by the engine/scheduler wiring:
 
-    arrived, scheduled, prefill_start, preempted, swapped_out,
+    arrived, queued, scheduled, prefill_start, preempted, swapped_out,
     swapped_in, first_token, finished, aborted
+
+`queued` is recorded at scheduler admission (after tokenization), so
+queue-wait derived as `scheduled - queued` (obs/slo.py) measures
+scheduler wait only, not tokenization time.
 """
 from __future__ import annotations
 
@@ -27,7 +31,7 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 # Canonical event names (wiring sites pass these strings).
-EVENTS = ("arrived", "scheduled", "prefill_start", "preempted",
+EVENTS = ("arrived", "queued", "scheduled", "prefill_start", "preempted",
           "swapped_out", "swapped_in", "first_token", "finished", "aborted")
 
 _TERMINAL = ("finished", "aborted")
@@ -49,15 +53,18 @@ class FlightRecorder:
         self._finished: "OrderedDict[str, deque]" = OrderedDict()
 
     def record(self, request_id: str, event: str,
-               detail: Optional[str] = None) -> None:
+               detail: Optional[str] = None) -> bool:
+        """Append one event; returns True iff it was accepted (False when
+        disabled, or when the trace is already sealed — callers use this
+        to fire exactly-once side effects like the SLO finish hook)."""
         if not self.enabled:
-            return
+            return False
         ts = time.time()
         with self._lock:
             if request_id in self._finished:
                 # Pipelined steps can re-report groups already finalized
                 # (zombie rows); their trace is sealed.
-                return
+                return False
             buf = self._live.get(request_id)
             if buf is None:
                 buf = deque(maxlen=self.max_events_per_request)
@@ -70,6 +77,7 @@ class FlightRecorder:
                 self._finished[request_id] = buf
                 while len(self._finished) > self.max_finished_requests:
                     self._finished.popitem(last=False)
+        return True
 
     def get_trace(self, request_id: str) -> Optional[List[Dict[str, Any]]]:
         """Events for one request in arrival order, or None if unknown

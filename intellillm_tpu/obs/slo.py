@@ -1,0 +1,349 @@
+"""Per-request SLO telemetry derived from flight-recorder events.
+
+Aggregate step metrics (engine/metrics.py) say how fast iterations run;
+this module says whether *requests* are meeting their latency targets.
+When a request reaches a terminal event the engine/scheduler hands its
+id here; the tracker replays the flight-recorder trace and derives:
+
+    queue_wait  scheduled - queued   (scheduler wait only — `queued` is
+                                      recorded at scheduler admission,
+                                      after tokenization)
+    ttft        first_token - arrived
+    tpot        (terminal - first_token) / max(gen_tokens - 1, 1)
+    e2e         terminal - arrived
+    preemptions count per mode (recompute / swap) + finish reason
+
+Exported (when `prometheus_client` is installed — silently skipped
+otherwise):
+
+    intellillm_request_queue_time_seconds      histogram
+    intellillm_request_preemptions_total{mode} counter
+    intellillm_request_finished_total{reason}  counter
+    intellillm_request_generation_tokens       histogram
+    intellillm_slo_goodput_ratio               gauge
+
+Goodput is the fraction of the rolling window (default 512 finishes)
+whose TTFT and TPOT are both within the configured SLOs (`--slo-ttft-ms`
+/ `--slo-tpot-ms`, or INTELLILLM_SLO_TTFT_MS / INTELLILLM_SLO_TPOT_MS).
+A request exactly at the threshold counts as good. Requests that never
+produced a first token (e.g. aborted while queued) are excluded from
+the goodput window but still counted in the finished/preemption series.
+
+SLO derivation requires the flight recorder: with
+INTELLILLM_FLIGHT_RECORDER off there are no events to replay and the
+tracker records nothing.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter, Gauge, Histogram
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+_DEFAULT_TTFT_MS = 1000.0
+_DEFAULT_TPOT_MS = 200.0
+_DEFAULT_WINDOW = 512
+
+_QUEUE_TIME_BUCKETS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 10.0, 30.0, 60.0]
+_GEN_TOKEN_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                      2048, 4096]
+
+
+class _SLOMetrics:
+    """Prometheus collectors for request SLO telemetry (process-global,
+    built once — same singleton pattern as compile_tracker)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.histogram_queue_time = Histogram(
+            "intellillm_request_queue_time_seconds",
+            "Scheduler queue wait per request (queued -> scheduled).",
+            buckets=_QUEUE_TIME_BUCKETS)
+        self.counter_preemptions = Counter(
+            "intellillm_request_preemptions_total",
+            "Request preemptions by mode (recompute | swap).", ["mode"])
+        self.counter_finished = Counter(
+            "intellillm_request_finished_total",
+            "Finished requests by reason (stop | length | abort | ...).",
+            ["reason"])
+        self.histogram_generation_tokens = Histogram(
+            "intellillm_request_generation_tokens",
+            "Generation tokens per finished request.",
+            buckets=_GEN_TOKEN_BUCKETS)
+        self.gauge_goodput = Gauge(
+            "intellillm_slo_goodput_ratio",
+            "Fraction of the rolling finish window meeting both the TTFT "
+            "and TPOT SLOs.")
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+def _env_ms(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("Ignoring invalid %s=%r (want a float, ms).",
+                       name, raw)
+        return default
+
+
+def derive_request_metrics(events: List[Dict[str, Any]],
+                           num_generation_tokens: int
+                           ) -> Optional[Dict[str, Any]]:
+    """Replay one flight-recorder trace into an SLO record, or None if
+    the trace has no terminal event (request still in flight)."""
+    first_ts: Dict[str, float] = {}
+    preemptions: Dict[str, int] = {}
+    terminal_ts = None
+    terminal_event = None
+    terminal_detail = None
+    for ev in events:
+        name = ev["event"]
+        if name not in first_ts:
+            first_ts[name] = ev["ts"]
+        if name == "preempted":
+            mode = ev.get("detail") or "unknown"
+            preemptions[mode] = preemptions.get(mode, 0) + 1
+        if name in ("finished", "aborted"):
+            terminal_ts = ev["ts"]
+            terminal_event = name
+            terminal_detail = ev.get("detail")
+    if terminal_ts is None:
+        return None
+
+    arrived = first_ts.get("arrived", first_ts.get("queued"))
+    queued = first_ts.get("queued", arrived)
+    scheduled = first_ts.get("scheduled")
+    first_token = first_ts.get("first_token")
+
+    queue_wait = None
+    if queued is not None:
+        # A request aborted while still waiting never got scheduled; its
+        # whole life was queue wait.
+        queue_wait = max((scheduled if scheduled is not None
+                          else terminal_ts) - queued, 0.0)
+    ttft = (max(first_token - arrived, 0.0)
+            if first_token is not None and arrived is not None else None)
+    tpot = (max(terminal_ts - first_token, 0.0)
+            / max(num_generation_tokens - 1, 1)
+            if first_token is not None else None)
+    e2e = (max(terminal_ts - arrived, 0.0)
+           if arrived is not None else None)
+
+    if terminal_event == "aborted":
+        reason = "abort"
+    else:
+        reason = terminal_detail or "unknown"
+    return {
+        "queue_wait_s": queue_wait,
+        "ttft_s": ttft,
+        "tpot_s": tpot,
+        "e2e_s": e2e,
+        "generation_tokens": max(int(num_generation_tokens), 0),
+        "preemptions": preemptions,
+        "reason": reason,
+    }
+
+
+def _percentile(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    idx = max(int(math.ceil(p / 100.0 * len(sorted_vals))) - 1, 0)
+    return sorted_vals[min(idx, len(sorted_vals) - 1)]
+
+
+class SLOTracker:
+    """Rolling-window tracker of per-request latency records.
+
+    Thread-safe: finishes land from the engine step loop while the
+    scheduler abort path and HTTP handlers read summaries."""
+
+    def __init__(self, enabled: bool = True,
+                 window: Optional[int] = None,
+                 slo_ttft_ms: Optional[float] = None,
+                 slo_tpot_ms: Optional[float] = None) -> None:
+        self.enabled = enabled
+        self.window_size = (window if window is not None else max(
+            int(os.environ.get("INTELLILLM_SLO_WINDOW", _DEFAULT_WINDOW)), 1))
+        self.slo_ttft_ms = (slo_ttft_ms if slo_ttft_ms is not None
+                            else _env_ms("INTELLILLM_SLO_TTFT_MS",
+                                         _DEFAULT_TTFT_MS))
+        self.slo_tpot_ms = (slo_tpot_ms if slo_tpot_ms is not None
+                            else _env_ms("INTELLILLM_SLO_TPOT_MS",
+                                         _DEFAULT_TPOT_MS))
+        self._lock = threading.Lock()
+        self._window: deque = deque()
+        self._good = 0
+        self._eligible = 0
+        self._finished_total: Dict[str, int] = {}
+        self._preemptions_total: Dict[str, int] = {}
+        self._metrics = _SLOMetrics() if _PROMETHEUS else None
+
+    def configure(self, slo_ttft_ms: Optional[float] = None,
+                  slo_tpot_ms: Optional[float] = None,
+                  window: Optional[int] = None) -> None:
+        """Override thresholds (--slo-ttft-ms / --slo-tpot-ms)."""
+        with self._lock:
+            if slo_ttft_ms is not None:
+                self.slo_ttft_ms = float(slo_ttft_ms)
+            if slo_tpot_ms is not None:
+                self.slo_tpot_ms = float(slo_tpot_ms)
+            if window is not None:
+                self.window_size = max(int(window), 1)
+
+    def record_finish(self, request_id: str,
+                      num_generation_tokens: int) -> None:
+        """Derive + record SLO metrics for a request that just reached a
+        terminal flight-recorder event."""
+        if not self.enabled:
+            return
+        from intellillm_tpu.obs.flight_recorder import get_flight_recorder
+        events = get_flight_recorder().get_trace(request_id)
+        if not events:
+            return
+        rec = derive_request_metrics(events, num_generation_tokens)
+        if rec is not None:
+            self.observe(rec)
+
+    def observe(self, rec: Dict[str, Any]) -> None:
+        """Record one derived request record (see derive_request_metrics
+        for the expected keys)."""
+        if not self.enabled:
+            return
+        ttft = rec.get("ttft_s")
+        tpot = rec.get("tpot_s")
+        # Goodput judges only requests that produced a first token; a
+        # single-token request (tpot None) is judged on TTFT alone.
+        good: Optional[bool] = None
+        if ttft is not None:
+            good = ttft * 1e3 <= self.slo_ttft_ms and (
+                tpot is None or tpot * 1e3 <= self.slo_tpot_ms)
+        with self._lock:
+            reason = rec.get("reason") or "unknown"
+            self._finished_total[reason] = (
+                self._finished_total.get(reason, 0) + 1)
+            for mode, n in (rec.get("preemptions") or {}).items():
+                self._preemptions_total[mode] = (
+                    self._preemptions_total.get(mode, 0) + n)
+            self._window.append({
+                "queue_wait_s": rec.get("queue_wait_s"),
+                "ttft_s": ttft,
+                "tpot_s": tpot,
+                "e2e_s": rec.get("e2e_s"),
+                "good": good,
+            })
+            if good is not None:
+                self._eligible += 1
+                self._good += int(good)
+            while len(self._window) > self.window_size:
+                old = self._window.popleft()
+                if old["good"] is not None:
+                    self._eligible -= 1
+                    self._good -= int(old["good"])
+            goodput = (self._good / self._eligible
+                       if self._eligible else None)
+        if self._metrics is not None:
+            m = self._metrics
+            if rec.get("queue_wait_s") is not None:
+                m.histogram_queue_time.observe(rec["queue_wait_s"])
+            for mode, n in (rec.get("preemptions") or {}).items():
+                m.counter_preemptions.labels(mode).inc(n)
+            m.counter_finished.labels(reason).inc()
+            m.histogram_generation_tokens.observe(
+                rec.get("generation_tokens") or 0)
+            if goodput is not None:
+                m.gauge_goodput.set(goodput)
+
+    def summary(self) -> Dict[str, Any]:
+        """Rolling-window percentiles + goodput, as a plain dict (works
+        without prometheus_client; served in /health/detail and embedded
+        in serve_bench's summary JSON)."""
+        with self._lock:
+            window = list(self._window)
+            goodput = (self._good / self._eligible
+                       if self._eligible else None)
+            finished = dict(self._finished_total)
+            preempted = dict(self._preemptions_total)
+        out: Dict[str, Any] = {
+            "window": len(window),
+            "goodput_ratio": (round(goodput, 4)
+                              if goodput is not None else None),
+            "slo_ttft_ms": self.slo_ttft_ms,
+            "slo_tpot_ms": self.slo_tpot_ms,
+            "finished_total": finished,
+            "preemptions_total": preempted,
+        }
+        for key, out_key in (("queue_wait_s", "queue_wait_ms"),
+                             ("ttft_s", "ttft_ms"),
+                             ("tpot_s", "tpot_ms"),
+                             ("e2e_s", "e2e_ms")):
+            vals = sorted(r[key] * 1e3 for r in window
+                          if r.get(key) is not None)
+            out[out_key] = ({
+                "p50": round(_percentile(vals, 50), 3),
+                "p90": round(_percentile(vals, 90), 3),
+                "p99": round(_percentile(vals, 99), 3),
+            } if vals else None)
+        return out
+
+    def reset_for_testing(self) -> None:
+        with self._lock:
+            self._window = deque()
+            self._good = 0
+            self._eligible = 0
+            self._finished_total = {}
+            self._preemptions_total = {}
+            self.window_size = max(
+                int(os.environ.get("INTELLILLM_SLO_WINDOW",
+                                   _DEFAULT_WINDOW)), 1)
+            self.slo_ttft_ms = _env_ms("INTELLILLM_SLO_TTFT_MS",
+                                       _DEFAULT_TTFT_MS)
+            self.slo_tpot_ms = _env_ms("INTELLILLM_SLO_TPOT_MS",
+                                       _DEFAULT_TPOT_MS)
+
+
+# Built lazily (not at import) so the no-prometheus reload tests can
+# rebuild the module without re-registering collectors; the engine
+# constructs it during __init__, well before any server traffic.
+_SLO_TRACKER: Optional[SLOTracker] = None
+_SLO_LOCK = threading.Lock()
+
+
+def get_slo_tracker() -> SLOTracker:
+    global _SLO_TRACKER
+    if _SLO_TRACKER is None:
+        with _SLO_LOCK:
+            if _SLO_TRACKER is None:
+                _SLO_TRACKER = SLOTracker()
+    return _SLO_TRACKER

@@ -1,0 +1,360 @@
+"""Engine stall watchdog: detects a wedged serving loop and says why.
+
+`/health` stays a bare 200 for load balancers; this module is the part
+of the stack that notices the engine has stopped making progress. Two
+heartbeats feed it:
+
+    heartbeat_step()   engine step boundary (LLMEngine._process_model_outputs)
+    dispatch(program)  context manager around every jitted device call
+                       (worker/model_runner._guarded_call)
+
+A daemon monitor thread (started when the engine attaches) checks two
+stall conditions:
+
+    no_step_progress   work is pending, no dispatch is in flight, and no
+                       step has completed in INTELLILLM_WATCHDOG_STALL_S
+                       (default 60 s)
+    dispatch_blocked   a single jitted dispatch has been blocked for
+                       INTELLILLM_WATCHDOG_DISPATCH_S (default 300 s —
+                       above any sane XLA compile)
+
+A dispatch within its own threshold suppresses `no_step_progress`, so a
+long-but-legitimate cold compile doesn't page anyone. When a condition
+trips, the watchdog fires **once per stall episode**: a structured
+report — all thread stacks (`sys._current_frames`), live
+flight-recorder ids, compile-tracker snapshot, scheduler queue depths,
+KV-cache usage — is logged and pushed to a small ring buffer served at
+`GET /debug/stall`. A subsequently completed step clears the stall (and
+`/health/detail` flips back from 503 to 200).
+
+INTELLILLM_WATCHDOG=0 disables everything (all hooks become no-ops).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+_DEFAULT_STALL_S = 60.0
+_DEFAULT_DISPATCH_S = 300.0
+_MAX_REPORTS = 8
+
+
+class _WatchdogMetrics:
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.counter_stalls = Counter(
+            "intellillm_engine_stalls_total",
+            "Stall episodes declared by the engine watchdog.", ["reason"])
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+def _env_s(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("Ignoring invalid %s=%r (want seconds).", name, raw)
+        return default
+
+
+def _enabled_from_env() -> bool:
+    from intellillm_tpu.utils import parse_env_flag
+    flag = parse_env_flag(os.environ.get("INTELLILLM_WATCHDOG"))
+    return True if flag is None else flag
+
+
+def _thread_stacks() -> Dict[str, str]:
+    """Formatted stack per live thread, keyed "name (tid)" — the
+    faulthandler-style dump, but as a JSON-friendly dict."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks = {}
+    for tid, frame in sys._current_frames().items():
+        label = f"{names.get(tid, '?')} ({tid})"
+        stacks[label] = "".join(traceback.format_stack(frame))
+    return stacks
+
+
+class EngineWatchdog:
+    """Process-global stall detector (one engine per process)."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 stall_s: Optional[float] = None,
+                 dispatch_s: Optional[float] = None,
+                 poll_s: Optional[float] = None) -> None:
+        self.enabled = (_enabled_from_env() if enabled is None else enabled)
+        self.stall_s = (stall_s if stall_s is not None
+                        else _env_s("INTELLILLM_WATCHDOG_STALL_S",
+                                    _DEFAULT_STALL_S))
+        self.dispatch_s = (dispatch_s if dispatch_s is not None
+                           else _env_s("INTELLILLM_WATCHDOG_DISPATCH_S",
+                                       _DEFAULT_DISPATCH_S))
+        self.poll_s = poll_s
+        self._lock = threading.Lock()
+        self._last_step = time.monotonic()
+        self._steps = 0
+        self._stalls_fired = 0
+        # thread ident -> (program, t0): concurrent dispatches (executor
+        # thread + warm-up) each get their own slot.
+        self._dispatches: Dict[int, Any] = {}
+        self._stalled = False
+        self._stall_reason: Optional[str] = None
+        self._reports: deque = deque(maxlen=_MAX_REPORTS)
+        self._has_work: Optional[Callable[[], bool]] = None
+        self._queue_depths: Optional[Callable[[], Dict[str, int]]] = None
+        self._kv_usage: Optional[Callable[[], Dict[str, float]]] = None
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._metrics = _WatchdogMetrics() if _PROMETHEUS else None
+
+    # --- heartbeats (hot path) -------------------------------------------
+
+    def heartbeat_step(self) -> None:
+        """Engine completed one step boundary; clears any active stall."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._last_step = time.monotonic()
+            self._steps += 1
+            was_stalled, reason = self._stalled, self._stall_reason
+            self._stalled = False
+            self._stall_reason = None
+        if was_stalled:
+            logger.warning("Engine stall (%s) cleared: step completed.",
+                           reason)
+
+    @contextmanager
+    def dispatch(self, program: str):
+        """Mark a jitted device call in flight for the calling thread."""
+        if not self.enabled:
+            yield
+            return
+        tid = threading.get_ident()
+        with self._lock:
+            self._dispatches[tid] = (program, time.monotonic())
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._dispatches.pop(tid, None)
+
+    # --- engine attachment ------------------------------------------------
+
+    def attach(self, has_work: Optional[Callable[[], bool]] = None,
+               queue_depths: Optional[Callable[[], Dict[str, int]]] = None,
+               kv_usage: Optional[Callable[[], Dict[str, float]]] = None,
+               start_monitor: bool = True) -> None:
+        """Engine registers introspection callbacks; starts the monitor
+        thread unless disabled (or start_monitor=False, for tests that
+        drive check_now() by hand)."""
+        self._has_work = has_work
+        self._queue_depths = queue_depths
+        self._kv_usage = kv_usage
+        with self._lock:
+            self._last_step = time.monotonic()
+        if self.enabled and start_monitor:
+            self._start_monitor()
+
+    def configure(self, stall_s: Optional[float] = None,
+                  dispatch_s: Optional[float] = None,
+                  poll_s: Optional[float] = None) -> None:
+        if stall_s is not None:
+            self.stall_s = float(stall_s)
+        if dispatch_s is not None:
+            self.dispatch_s = float(dispatch_s)
+        if poll_s is not None:
+            self.poll_s = float(poll_s)
+        self._wake.set()  # re-poll promptly with the new thresholds
+
+    def _start_monitor(self) -> None:
+        with self._lock:
+            if self._monitor is not None and self._monitor.is_alive():
+                return
+            self._stop.clear()
+            self._monitor = threading.Thread(
+                target=self._monitor_loop,
+                name="intellillm-watchdog", daemon=True)
+            self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            interval = self.poll_s or max(
+                min(self.stall_s, self.dispatch_s) / 4.0, 0.05)
+            self._wake.wait(interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.check_now()
+            except Exception:
+                logger.exception("Watchdog check failed.")
+
+    # --- detection --------------------------------------------------------
+
+    def _call(self, fn: Optional[Callable[[], Any]]) -> Any:
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:
+            return None
+
+    def check_now(self) -> Optional[Dict[str, Any]]:
+        """Evaluate stall conditions once; returns the report iff this
+        call declared a new stall (one-shot per episode)."""
+        if not self.enabled:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            dispatches = list(self._dispatches.values())
+            last_step = self._last_step
+            already_stalled = self._stalled
+        reason = None
+        detail: Dict[str, Any] = {}
+        blocked = [(p, now - t0) for p, t0 in dispatches
+                   if now - t0 > self.dispatch_s]
+        if blocked:
+            program, age = max(blocked, key=lambda x: x[1])
+            reason = "dispatch_blocked"
+            detail = {"program": program, "blocked_for_s": round(age, 3),
+                      "threshold_s": self.dispatch_s}
+        elif (not dispatches and now - last_step > self.stall_s
+                and self._call(self._has_work)):
+            reason = "no_step_progress"
+            detail = {"threshold_s": self.stall_s}
+        if reason is None or already_stalled:
+            return None
+        # Build the report BEFORE publishing the stall, so a reader that
+        # sees state == "stalled" is guaranteed a non-empty report ring.
+        report = self._build_report(reason, detail, now, last_step,
+                                    dispatches)
+        with self._lock:
+            if self._stalled:  # raced with another checker
+                return None
+            self._stalled = True
+            self._stall_reason = reason
+            self._stalls_fired += 1
+            self._reports.append(report)
+        if self._metrics is not None:
+            self._metrics.counter_stalls.labels(reason).inc()
+        logger.error(
+            "ENGINE STALL (%s): no step for %.1fs, detail=%s, "
+            "queue_depths=%s. Full report at GET /debug/stall. "
+            "Thread stacks:\n%s",
+            reason, report["last_step_age_s"], detail,
+            report["queue_depths"],
+            "\n".join(f"--- {k}\n{v}"
+                      for k, v in report["thread_stacks"].items()))
+        return report
+
+    def _build_report(self, reason: str, detail: Dict[str, Any],
+                      now: float, last_step: float,
+                      dispatches: List[Any]) -> Dict[str, Any]:
+        from intellillm_tpu.obs.compile_tracker import get_compile_tracker
+        from intellillm_tpu.obs.flight_recorder import get_flight_recorder
+        return {
+            "ts": time.time(),
+            "reason": reason,
+            "detail": detail,
+            "last_step_age_s": round(now - last_step, 3),
+            "steps_completed": self._steps,
+            "dispatch_in_flight": [
+                {"program": p, "age_s": round(now - t0, 3)}
+                for p, t0 in dispatches],
+            "queue_depths": self._call(self._queue_depths),
+            "kv_cache_usage": self._call(self._kv_usage),
+            "live_request_ids":
+                get_flight_recorder().live_request_ids()[:64],
+            "compile_tracker": get_compile_tracker().snapshot(),
+            "thread_stacks": _thread_stacks(),
+        }
+
+    # --- read side (endpoints / StatLogger) -------------------------------
+
+    @property
+    def state(self) -> str:
+        return "stalled" if self._stalled else "ok"
+
+    def last_step_age_s(self) -> float:
+        with self._lock:
+            return time.monotonic() - self._last_step
+
+    def reports(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._reports)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Cheap status dict for /debug/stall and /health/detail."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "state": "stalled" if self._stalled else "ok",
+                "stall_reason": self._stall_reason,
+                "last_step_age_s": round(now - self._last_step, 3),
+                "steps_completed": self._steps,
+                "stalls_fired": self._stalls_fired,
+                "stall_after_s": self.stall_s,
+                "dispatch_stall_after_s": self.dispatch_s,
+                "dispatch_in_flight": [
+                    {"program": p, "age_s": round(now - t0, 3)}
+                    for p, t0 in self._dispatches.values()],
+            }
+
+    def reset_for_testing(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        monitor = self._monitor
+        if monitor is not None and monitor.is_alive():
+            monitor.join(timeout=2.0)
+        self.__init__()
+
+
+_WATCHDOG: Optional[EngineWatchdog] = None
+_WATCHDOG_LOCK = threading.Lock()
+
+
+def get_watchdog() -> EngineWatchdog:
+    global _WATCHDOG
+    if _WATCHDOG is None:
+        with _WATCHDOG_LOCK:
+            if _WATCHDOG is None:
+                _WATCHDOG = EngineWatchdog()
+    return _WATCHDOG

@@ -220,6 +220,16 @@ class ModelRunner:
             donate_argnames=("kv_caches", ),
         )
 
+    def _guarded_call(self, program, key, fn, /, *args, **kwargs):
+        """Every jitted dispatch goes through here: compile tracking
+        (obs/compile_tracker.py) plus the watchdog dispatch guard — a
+        dispatch blocked past INTELLILLM_WATCHDOG_DISPATCH_S fires the
+        stall report (obs/watchdog.py)."""
+        from intellillm_tpu.obs import get_watchdog
+        with get_watchdog().dispatch(program):
+            return self._compile_tracker.call(program, key, fn,
+                                              *args, **kwargs)
+
     # --- packing helpers --------------------------------------------------
 
     @staticmethod
@@ -891,7 +901,7 @@ class ModelRunner:
                       attn_metadata.sp is not None,
                       tuple(sorted(common.items())))
             with self._tracer.span("execute"):
-                result = self._compile_tracker.call(
+                result = self._guarded_call(
                     "prefill", bucket, self._jit_prefill,
                     self.params, kv_caches,
                     place(arrays["token_ids"]), place(arrays["positions"]),
@@ -933,7 +943,7 @@ class ModelRunner:
                       tuple(sorted(common.items())))
             if num_steps == 1:
                 with self._tracer.span("execute"):
-                    result = self._compile_tracker.call(
+                    result = self._guarded_call(
                         "decode_single", bucket, self._jit_decode_single,
                         *decode_args,
                         place(fetch_indices) if fetch_indices is not None
@@ -947,7 +957,7 @@ class ModelRunner:
                     "logits_processors present in a fused K>1 decode batch; "
                     "the scheduler should have forced K=1")
                 with self._tracer.span("execute"):
-                    packed, new_caches = self._compile_tracker.call(
+                    packed, new_caches = self._guarded_call(
                         "decode_fused", bucket, self._jit_decode,
                         *decode_args, num_steps=num_steps, **common)
             t1 = t2 = num_steps
@@ -1024,7 +1034,7 @@ class ModelRunner:
         bucket = (b, w, prev_t1, num_steps, lora_state is not None,
                   tuple(sorted(flags.items())))
         with self._tracer.span("execute"):
-            packed, new_caches = self._compile_tracker.call(
+            packed, new_caches = self._guarded_call(
                 "decode_cont", bucket, self._jit_decode_cont,
                 self.params, kv_caches, prev_packed, place(positions),
                 place(block_tables), place(ctx), *sampling_args, lora_state,
@@ -1078,7 +1088,7 @@ class ModelRunner:
         bucket = (padded_n, arrays["block_tables"].shape[1], num_steps,
                   lora_state is not None, tuple(sorted(flags.items())))
         with self._tracer.span("execute"):
-            packed, new_caches = self._compile_tracker.call(
+            packed, new_caches = self._guarded_call(
                 "decode_teacher", bucket, self._jit_decode_teacher,
                 self.params, kv_caches, place(teacher),
                 place(arrays["positions"]), place(arrays["block_tables"]),

@@ -11,7 +11,7 @@ import pytest
 from intellillm_tpu import LLM, SamplingParams
 from intellillm_tpu.engine.metrics import _Metrics, _PROMETHEUS
 from intellillm_tpu.obs import (get_compile_tracker, get_flight_recorder,
-                                get_step_tracer)
+                                get_slo_tracker, get_step_tracer)
 
 
 @pytest.fixture
@@ -21,8 +21,10 @@ def fresh_obs():
     get_step_tracer().reset_for_testing()
     get_compile_tracker().reset_for_testing()
     get_flight_recorder().reset_for_testing()
+    get_slo_tracker().reset_for_testing()
     _Metrics.reset_for_testing()
     yield
+    get_slo_tracker().reset_for_testing()
     _Metrics.reset_for_testing()
 
 
@@ -117,9 +119,10 @@ def test_flight_recorder_traces_request_lifecycle(tiny_opt_dir, fresh_obs):
     trace = get_flight_recorder().get_trace("21")
     assert trace is not None
     events = [e["event"] for e in trace]
-    # Ordered lifecycle: arrival → admission → prefill → first token →
-    # finish, with monotonically nondecreasing timestamps.
-    for a, b in [("arrived", "scheduled"), ("scheduled", "prefill_start"),
+    # Ordered lifecycle: arrival → admission → scheduling → prefill →
+    # first token → finish, with monotonically nondecreasing timestamps.
+    for a, b in [("arrived", "queued"), ("queued", "scheduled"),
+                 ("scheduled", "prefill_start"),
                  ("prefill_start", "first_token"),
                  ("first_token", "finished")]:
         assert events.index(a) < events.index(b), events
@@ -130,3 +133,11 @@ def test_flight_recorder_traces_request_lifecycle(tiny_opt_dir, fresh_obs):
     assert "21" not in get_flight_recorder().live_request_ids()
     assert any(x["request_id"] == "21"
                for x in get_flight_recorder().recent_finished())
+
+    # The finish fed the SLO tracker exactly once, with metrics derived
+    # from this trace.
+    s = get_slo_tracker().summary()
+    assert s["window"] == 1
+    assert s["finished_total"] == {"length": 1}
+    assert s["ttft_ms"]["p50"] > 0.0
+    assert s["tpot_ms"]["p50"] >= 0.0

@@ -1,0 +1,139 @@
+"""Acceptance test for the engine stall watchdog, end to end on a real
+CPU engine: wedge the engine by blocking a jitted dispatch past the
+(dropped) threshold, observe the watchdog fire exactly once with a full
+report at GET /debug/stall, see /health/detail flip to 503 — then
+release the wedge and watch a completed step clear everything back to
+200/ok.
+"""
+import asyncio
+import threading
+import time
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from intellillm_tpu import LLM, SamplingParams
+from intellillm_tpu.entrypoints.debug_routes import add_debug_routes
+from intellillm_tpu.obs import (get_compile_tracker, get_flight_recorder,
+                                get_slo_tracker, get_watchdog)
+
+
+def _get(app, *paths):
+    """Serve `app` in-process and GET each path; returns a list of
+    (status, json_body)."""
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            out = []
+            for path in paths:
+                resp = await client.get(path)
+                out.append((resp.status, await resp.json()))
+            return out
+        finally:
+            await client.close()
+    return asyncio.run(go())
+
+
+def test_wedged_dispatch_fires_watchdog_and_health_detail(tiny_opt_dir):
+    get_flight_recorder().reset_for_testing()
+    get_slo_tracker().reset_for_testing()
+    wd = get_watchdog()
+    # Fresh watchdog BEFORE the engine builds: warm-up compiles run under
+    # the default 300s dispatch threshold and must not trip anything.
+    wd.reset_for_testing()
+
+    llm = LLM(model=tiny_opt_dir, dtype="float32",
+              num_device_blocks_override=128, max_model_len=128,
+              max_num_seqs=8, max_paddings=512, swap_space=0.01)
+    engine = llm.llm_engine
+
+    def make_app():
+        # A fresh Application per asyncio.run: aiohttp pins the app to
+        # the first event loop it serves on.
+        app = web.Application()
+        add_debug_routes(app, lambda: engine)
+        return app
+
+    tracker = get_compile_tracker()
+    orig_call = tracker.call  # bound method, survives the shadow below
+    release = threading.Event()
+    wedged = threading.Event()
+    state = {"blocked": False}
+
+    def blocked_call(program, key, fn, *args, **kwargs):
+        # Wedge only the first dispatch; later ones (the drain after
+        # release) go straight through.
+        if not state["blocked"]:
+            state["blocked"] = True
+            wedged.set()
+            release.wait(timeout=60.0)
+        return orig_call(program, key, fn, *args, **kwargs)
+
+    tracker.call = blocked_call
+    runner = None
+    try:
+        engine.add_request("31", "hello my name is",
+                           SamplingParams(temperature=0.0, max_tokens=8,
+                                          ignore_eos=True))
+        # Tight thresholds only now that warm-up is done: a dispatch
+        # blocked > 0.2s is a stall, polled every 50ms. stall_s stays
+        # high so only dispatch_blocked can fire.
+        wd.configure(stall_s=30.0, dispatch_s=0.2, poll_s=0.05)
+        runner = threading.Thread(target=llm._run_engine,
+                                  kwargs={"use_tqdm": False},
+                                  name="wedge-runner")
+        runner.start()
+        assert wedged.wait(timeout=30.0), "dispatch never reached"
+
+        deadline = time.monotonic() + 10.0
+        while wd.state != "stalled" and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert wd.state == "stalled", "watchdog never declared the stall"
+
+        (health_status, health), (stall_status, stall) = _get(
+            make_app(), "/health/detail", "/debug/stall")
+        assert health_status == 503
+        assert health["status"] == "stalled"
+        assert health["watchdog"]["state"] == "stalled"
+        assert health["queue_depths"] is not None
+
+        assert stall_status == 200
+        reports = stall["reports"]
+        assert len(reports) == 1  # one-shot per episode
+        report = reports[0]
+        assert report["reason"] == "dispatch_blocked"
+        assert report["detail"]["blocked_for_s"] >= 0.2
+        assert report["queue_depths"] is not None
+        assert "31" in report["live_request_ids"]
+        assert "compile_tracker" in report
+        # The report names the culprit: some thread is parked in our
+        # wedge, visible in the faulthandler-style stack dump.
+        assert any("blocked_call" in stack
+                   for stack in report["thread_stacks"].values()), (
+            list(report["thread_stacks"]))
+    finally:
+        # Restore a sane threshold BEFORE releasing: the drain will
+        # compile fresh decode buckets, and a legitimate >0.2s CPU
+        # compile would (correctly) fire a second episode.
+        wd.configure(stall_s=60.0, dispatch_s=300.0)
+        release.set()
+        if runner is not None:
+            runner.join(timeout=120.0)
+            assert not runner.is_alive(), "engine never drained"
+        del tracker.call  # un-shadow the bound method
+
+    try:
+        # The drain completed steps, which must have cleared the stall.
+        assert wd.state == "ok"
+        snap = wd.snapshot()
+        assert snap["stalls_fired"] == 1
+        (health_status, health), = _get(make_app(), "/health/detail")
+        assert health_status == 200
+        assert health["status"] == "ok"
+        # The wedged request still finished and fed the SLO window.
+        assert get_slo_tracker().summary()["window"] == 1
+    finally:
+        wd.reset_for_testing()
+        get_flight_recorder().reset_for_testing()
+        get_slo_tracker().reset_for_testing()

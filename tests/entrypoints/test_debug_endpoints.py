@@ -9,7 +9,8 @@ from aiohttp.test_utils import TestClient, TestServer
 from intellillm_tpu.engine.metrics import _Metrics, _PROMETHEUS
 from intellillm_tpu.entrypoints import api_server as demo_server
 from intellillm_tpu.entrypoints.openai import api_server as openai_server
-from intellillm_tpu.obs import get_flight_recorder
+from intellillm_tpu.obs import (get_flight_recorder, get_slo_tracker,
+                                get_watchdog)
 
 
 def _seed_recorder():
@@ -39,6 +40,8 @@ def _run(app, scenario):
 def test_openai_server_observability_surface():
     _Metrics.reset_for_testing()
     _Metrics(["model_name"])  # register the intellillm_ collectors
+    get_slo_tracker()         # register the SLO collectors
+    get_watchdog()            # register the stall counter
     _seed_recorder()
     try:
         async def scenario(client):
@@ -51,6 +54,14 @@ def test_openai_server_observability_surface():
             assert "intellillm_" in body
             assert "intellillm_step_phase_seconds" in body
             assert "intellillm_xla_compiles_total" in body
+            # SLO + watchdog collectors registered via the singletons
+            # the engine constructs at init.
+            assert "intellillm_request_queue_time_seconds" in body
+            assert "intellillm_request_generation_tokens" in body
+            assert "intellillm_request_preemptions_total" in body
+            assert "intellillm_request_finished_total" in body
+            assert "intellillm_slo_goodput_ratio" in body
+            assert "intellillm_engine_stalls_total" in body
 
             # Completed request: ordered lifecycle events.
             resp = await client.get("/debug/trace",
@@ -97,13 +108,47 @@ def test_openai_server_debug_routes_require_api_key():
     async def scenario(client):
         resp = await client.get("/debug/trace")
         assert resp.status == 401
+        resp = await client.get("/debug/stall")
+        assert resp.status == 401
         resp = await client.get(
             "/debug/trace", headers={"Authorization": "Bearer sekrit"})
         assert resp.status == 200
         resp = await client.get("/health")
         assert resp.status == 200  # health stays open
+        # /health/detail is a liveness probe too: exempt, and 503 (not
+        # 401) because this test app has no engine behind it.
+        resp = await client.get("/health/detail")
+        assert resp.status == 503
+        # The exemption is an exact match, not a prefix: /healthfoo must
+        # NOT slip past auth (it 401s before routing can 404 it).
+        resp = await client.get("/healthfoo")
+        assert resp.status == 401
 
     _run(openai_server.build_app(api_key="sekrit"), scenario)
+
+
+def test_health_detail_and_stall_without_engine():
+    """Both servers serve the deep-health surface even before (or
+    without) an engine: /health/detail reports "initializing" with 503,
+    /debug/stall returns the watchdog snapshot and an empty ring."""
+    wd = get_watchdog()
+
+    async def scenario(client):
+        resp = await client.get("/health/detail")
+        assert resp.status == 503
+        data = await resp.json()
+        assert data["status"] == "initializing"
+        assert data["watchdog"]["state"] == "ok"
+        assert "slo" in data
+
+        resp = await client.get("/debug/stall")
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["watchdog"]["enabled"] is wd.enabled
+        assert data["reports"] == []
+
+    _run(openai_server.build_app(), scenario)
+    _run(demo_server.build_app(), scenario)
 
 
 def test_profiler_routes_registered_only_with_opt_in():

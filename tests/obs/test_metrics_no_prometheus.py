@@ -1,9 +1,88 @@
-"""StatLogger must degrade gracefully when prometheus_client is absent
-(the engine never requires it — `serve` extra only)."""
+"""StatLogger, SLOTracker, and EngineWatchdog must degrade gracefully
+when prometheus_client is absent (the engine never requires it —
+`serve` extra only) — plus coverage of the StatLogger interval log
+lines (step breakdown + SLO percentiles/goodput)."""
 import importlib
 import sys
 
+import pytest
+
 import intellillm_tpu.engine.metrics as metrics_mod
+import intellillm_tpu.obs.slo as slo_mod
+import intellillm_tpu.obs.watchdog as watchdog_mod
+
+
+def _stats(reloaded, now):
+    return reloaded.Stats(
+        now=now, num_running=1, num_swapped=0, num_waiting=2,
+        device_cache_usage=0.5, cpu_cache_usage=0.0,
+        num_prompt_tokens=16, num_generation_tokens=4,
+        time_to_first_tokens=[0.01],
+        time_per_output_tokens=[0.002],
+        time_e2e_requests=[0.1],
+        spec_acceptance_rate=0.75,
+        step_phase_times={"execute": 0.005, "schedule": 0.001},
+        step_time=0.007)
+
+
+def test_statlogger_interval_log_lines(monkeypatch):
+    """Crossing local_interval must emit the throughput line, the step
+    breakdown line, and (when the SLO window is non-empty) the rolling
+    percentile/goodput line."""
+    tracker = slo_mod.get_slo_tracker()
+    tracker.reset_for_testing()
+    tracker.configure(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+    tracker.observe({"queue_wait_s": 0.02, "ttft_s": 0.05,
+                     "tpot_s": 0.005, "e2e_s": 0.5,
+                     "generation_tokens": 8, "preemptions": {},
+                     "reason": "stop"})
+    tracker.observe({"queue_wait_s": 0.04, "ttft_s": 0.5,
+                     "tpot_s": 0.005, "e2e_s": 1.0,
+                     "generation_tokens": 8, "preemptions": {},
+                     "reason": "stop"})
+    lines = []
+    monkeypatch.setattr(metrics_mod.logger, "info",
+                        lambda msg, *args: lines.append(msg % args))
+    try:
+        stat_logger = metrics_mod.StatLogger(local_interval=0.0,
+                                             labels={"model_name": "m"})
+        # last_local_log is initialized to time.monotonic(); pin it so the
+        # synthetic stats.now deterministically crosses the interval.
+        stat_logger.last_local_log = 999.0
+        stat_logger.log(_stats(metrics_mod, now=1000.0))
+        breakdown = [ln for ln in lines if "Step breakdown" in ln]
+        assert breakdown and "execute" in breakdown[0]
+        slo_lines = [ln for ln in lines if "Request SLO" in ln]
+        assert slo_lines, lines
+        line = slo_lines[0]
+        assert "last 2 finishes" in line
+        assert "queue-wait 20/40/40" in line
+        assert "TTFT 50/500/500" in line
+        # One of two finishes blew the 100ms TTFT SLO.
+        assert "goodput 50.0%" in line
+        assert "TTFT<=100ms, TPOT<=10ms" in line
+    finally:
+        tracker.reset_for_testing()
+        if metrics_mod._PROMETHEUS:
+            metrics_mod._Metrics.reset_for_testing()
+
+
+def test_statlogger_slo_line_skipped_when_window_empty(monkeypatch):
+    tracker = slo_mod.get_slo_tracker()
+    tracker.reset_for_testing()
+    lines = []
+    monkeypatch.setattr(metrics_mod.logger, "info",
+                        lambda msg, *args: lines.append(msg % args))
+    try:
+        stat_logger = metrics_mod.StatLogger(local_interval=0.0,
+                                             labels={"model_name": "m"})
+        stat_logger.last_local_log = 999.0
+        stat_logger.log(_stats(metrics_mod, now=1000.0))
+        assert [ln for ln in lines if "Avg prompt throughput" in ln]
+        assert not [ln for ln in lines if "Request SLO" in ln]
+    finally:
+        if metrics_mod._PROMETHEUS:
+            metrics_mod._Metrics.reset_for_testing()
 
 
 def test_statlogger_without_prometheus(monkeypatch):
@@ -37,6 +116,67 @@ def test_statlogger_without_prometheus(monkeypatch):
         restored = importlib.reload(metrics_mod)
         assert restored._PROMETHEUS is True
         restored._Metrics.reset_for_testing()
+
+
+def test_slo_tracker_without_prometheus(monkeypatch):
+    """Every new SLO metric path (queue-time histogram, preemption and
+    finished counters, generation-tokens histogram, goodput gauge) must
+    work — including the goodput math — with prometheus_client absent."""
+    slo_mod._SLOMetrics.reset_for_testing()
+    monkeypatch.setitem(sys.modules, "prometheus_client", None)
+    try:
+        reloaded = importlib.reload(slo_mod)
+        assert reloaded._PROMETHEUS is False
+
+        tracker = reloaded.SLOTracker(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        assert tracker._metrics is None
+        tracker.observe({"queue_wait_s": 0.02, "ttft_s": 0.100,
+                         "tpot_s": 0.010, "e2e_s": 0.5,
+                         "generation_tokens": 8,
+                         "preemptions": {"swap": 1}, "reason": "stop"})
+        tracker.observe({"queue_wait_s": 0.02, "ttft_s": 0.200,
+                         "tpot_s": 0.010, "e2e_s": 0.5,
+                         "generation_tokens": 8, "preemptions": {},
+                         "reason": "length"})
+        s = tracker.summary()
+        # Boundary math intact: exactly-at-SLO is good, over is not.
+        assert s["goodput_ratio"] == pytest.approx(0.5)
+        assert s["window"] == 2
+        assert s["finished_total"] == {"stop": 1, "length": 1}
+        assert s["preemptions_total"] == {"swap": 1}
+        assert s["queue_wait_ms"]["p50"] == pytest.approx(20.0)
+    finally:
+        monkeypatch.undo()
+        restored = importlib.reload(slo_mod)
+        assert restored._PROMETHEUS is True
+        restored._SLOMetrics.reset_for_testing()
+
+
+def test_watchdog_without_prometheus(monkeypatch):
+    """A stall must still fire (report + state flip) without the
+    intellillm_engine_stalls_total counter."""
+    import time
+
+    watchdog_mod._WatchdogMetrics.reset_for_testing()
+    monkeypatch.setitem(sys.modules, "prometheus_client", None)
+    try:
+        reloaded = importlib.reload(watchdog_mod)
+        assert reloaded._PROMETHEUS is False
+
+        wd = reloaded.EngineWatchdog(enabled=True, stall_s=0.02,
+                                     dispatch_s=30.0)
+        wd.attach(has_work=lambda: True, start_monitor=False)
+        assert wd._metrics is None
+        time.sleep(0.04)
+        report = wd.check_now()
+        assert report is not None
+        assert report["reason"] == "no_step_progress"
+        assert wd.state == "stalled"
+    finally:
+        monkeypatch.undo()
+        restored = importlib.reload(watchdog_mod)
+        assert restored._PROMETHEUS is True
+        restored._WatchdogMetrics.reset_for_testing()
 
 
 def test_spec_acceptance_rate_optional():

@@ -1,0 +1,197 @@
+"""SLOTracker unit tests: trace-replay derivation, rolling-window
+goodput math (including the exact-boundary case), percentile summary,
+and the flight-recorder integration used by the engine finish path."""
+import pytest
+
+from intellillm_tpu.obs import get_flight_recorder
+from intellillm_tpu.obs.slo import (SLOTracker, _percentile,
+                                    derive_request_metrics)
+
+
+def _ev(ts, event, detail=None):
+    out = {"ts": ts, "event": event}
+    if detail is not None:
+        out["detail"] = detail
+    return out
+
+
+class TestDerive:
+
+    def test_full_lifecycle(self):
+        rec = derive_request_metrics([
+            _ev(10.0, "arrived"),
+            _ev(10.2, "queued"),
+            _ev(11.2, "scheduled"),
+            _ev(11.3, "prefill_start"),
+            _ev(11.5, "first_token"),
+            _ev(13.5, "finished", "stop"),
+        ], num_generation_tokens=5)
+        assert rec["queue_wait_s"] == pytest.approx(1.0)   # scheduled-queued
+        assert rec["ttft_s"] == pytest.approx(1.5)         # first_token-arrived
+        assert rec["tpot_s"] == pytest.approx(2.0 / 4)     # (fin-ft)/(gen-1)
+        assert rec["e2e_s"] == pytest.approx(3.5)
+        assert rec["reason"] == "stop"
+        assert rec["preemptions"] == {}
+
+    def test_queue_wait_excludes_tokenization(self):
+        # 0.8s between arrived and queued is tokenization, not queue wait.
+        rec = derive_request_metrics([
+            _ev(0.0, "arrived"), _ev(0.8, "queued"),
+            _ev(1.0, "scheduled"), _ev(1.1, "first_token"),
+            _ev(2.0, "finished", "length"),
+        ], num_generation_tokens=2)
+        assert rec["queue_wait_s"] == pytest.approx(0.2)
+
+    def test_preemption_counts_by_mode(self):
+        rec = derive_request_metrics([
+            _ev(0.0, "queued"), _ev(0.1, "scheduled"),
+            _ev(0.2, "preempted", "recompute"),
+            _ev(0.3, "preempted", "swap"),
+            _ev(0.4, "preempted", "swap"),
+            _ev(0.5, "first_token"),
+            _ev(1.0, "finished", "stop"),
+        ], num_generation_tokens=3)
+        assert rec["preemptions"] == {"recompute": 1, "swap": 2}
+
+    def test_aborted_while_queued(self):
+        rec = derive_request_metrics([
+            _ev(0.0, "arrived"), _ev(0.1, "queued"),
+            _ev(5.1, "aborted"),
+        ], num_generation_tokens=0)
+        assert rec["reason"] == "abort"
+        assert rec["ttft_s"] is None
+        assert rec["tpot_s"] is None
+        # Never scheduled: the whole life was queue wait.
+        assert rec["queue_wait_s"] == pytest.approx(5.0)
+
+    def test_unterminated_trace_is_none(self):
+        assert derive_request_metrics(
+            [_ev(0.0, "queued"), _ev(0.1, "scheduled")], 0) is None
+
+    def test_single_token_request(self):
+        rec = derive_request_metrics([
+            _ev(0.0, "queued"), _ev(0.1, "first_token"),
+            _ev(0.1, "finished", "length"),
+        ], num_generation_tokens=1)
+        assert rec["tpot_s"] == pytest.approx(0.0)
+
+
+def _record(ttft_s, tpot_s, reason="stop", **kwargs):
+    return {"queue_wait_s": kwargs.get("queue_wait_s", 0.01),
+            "ttft_s": ttft_s, "tpot_s": tpot_s,
+            "e2e_s": kwargs.get("e2e_s", 1.0),
+            "generation_tokens": kwargs.get("generation_tokens", 8),
+            "preemptions": kwargs.get("preemptions", {}),
+            "reason": reason}
+
+
+class TestGoodput:
+
+    def test_exact_boundary_counts_as_good(self):
+        t = SLOTracker(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        t.observe(_record(ttft_s=0.100, tpot_s=0.010))   # exactly at SLO
+        t.observe(_record(ttft_s=0.1001, tpot_s=0.010))  # TTFT over
+        t.observe(_record(ttft_s=0.100, tpot_s=0.0101))  # TPOT over
+        t.observe(_record(ttft_s=0.050, tpot_s=0.005))   # well under
+        assert t.summary()["goodput_ratio"] == pytest.approx(0.5)
+
+    def test_no_first_token_excluded_from_goodput(self):
+        t = SLOTracker(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        t.observe(_record(ttft_s=None, tpot_s=None, reason="abort"))
+        s = t.summary()
+        assert s["goodput_ratio"] is None
+        assert s["window"] == 1
+        assert s["finished_total"] == {"abort": 1}
+
+    def test_single_token_judged_on_ttft_alone(self):
+        t = SLOTracker(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        t.observe(_record(ttft_s=0.05, tpot_s=None))
+        assert t.summary()["goodput_ratio"] == pytest.approx(1.0)
+
+    def test_window_eviction_updates_goodput(self):
+        t = SLOTracker(window=2, slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        t.observe(_record(ttft_s=1.0, tpot_s=1.0))    # bad
+        t.observe(_record(ttft_s=0.01, tpot_s=0.001))  # good
+        assert t.summary()["goodput_ratio"] == pytest.approx(0.5)
+        t.observe(_record(ttft_s=0.01, tpot_s=0.001))  # evicts the bad one
+        assert t.summary()["goodput_ratio"] == pytest.approx(1.0)
+        assert t.summary()["window"] == 2
+
+    def test_configure_overrides_thresholds(self):
+        t = SLOTracker(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        t.configure(slo_ttft_ms=500.0, slo_tpot_ms=50.0)
+        t.observe(_record(ttft_s=0.3, tpot_s=0.03))
+        assert t.summary()["goodput_ratio"] == pytest.approx(1.0)
+
+
+class TestSummary:
+
+    def test_percentile_nearest_rank(self):
+        vals = sorted(float(v) for v in range(1, 101))
+        assert _percentile(vals, 50) == 50.0
+        assert _percentile(vals, 90) == 90.0
+        assert _percentile(vals, 99) == 99.0
+        assert _percentile([7.0], 99) == 7.0
+
+    def test_summary_percentiles_ordered_and_ms(self):
+        t = SLOTracker(slo_ttft_ms=1000.0, slo_tpot_ms=200.0)
+        for i in range(1, 51):
+            t.observe(_record(ttft_s=i / 1000.0, tpot_s=i / 10000.0,
+                              queue_wait_s=i / 100.0))
+        s = t.summary()
+        for key in ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
+            d = s[key]
+            assert d["p50"] <= d["p90"] <= d["p99"]
+        assert s["ttft_ms"]["p50"] == pytest.approx(25.0)
+        assert s["queue_wait_ms"]["p99"] == pytest.approx(500.0)
+
+    def test_empty_summary(self):
+        t = SLOTracker()
+        s = t.summary()
+        assert s["window"] == 0
+        assert s["goodput_ratio"] is None
+        assert s["ttft_ms"] is None
+
+    def test_preemption_totals_accumulate(self):
+        t = SLOTracker()
+        t.observe(_record(ttft_s=0.1, tpot_s=0.01,
+                          preemptions={"swap": 2}))
+        t.observe(_record(ttft_s=0.1, tpot_s=0.01,
+                          preemptions={"swap": 1, "recompute": 1}))
+        assert t.summary()["preemptions_total"] == {"swap": 3,
+                                                    "recompute": 1}
+
+
+class TestRecordFinish:
+
+    def test_replays_flight_recorder_trace(self):
+        recorder = get_flight_recorder()
+        recorder.reset_for_testing()
+        t = SLOTracker(slo_ttft_ms=60000.0, slo_tpot_ms=60000.0)
+        try:
+            recorder.record("slo-req", "arrived")
+            recorder.record("slo-req", "queued")
+            recorder.record("slo-req", "scheduled")
+            recorder.record("slo-req", "preempted", "swap")
+            recorder.record("slo-req", "first_token")
+            recorder.record("slo-req", "finished", "stop")
+            t.record_finish("slo-req", 4)
+            s = t.summary()
+            assert s["window"] == 1
+            assert s["finished_total"] == {"stop": 1}
+            assert s["preemptions_total"] == {"swap": 1}
+            assert s["goodput_ratio"] == pytest.approx(1.0)
+        finally:
+            recorder.reset_for_testing()
+
+    def test_unknown_request_is_a_noop(self):
+        recorder = get_flight_recorder()
+        recorder.reset_for_testing()
+        t = SLOTracker()
+        t.record_finish("never-seen", 3)
+        assert t.summary()["window"] == 0
+
+    def test_disabled_tracker_records_nothing(self):
+        t = SLOTracker(enabled=False)
+        t.observe(_record(ttft_s=0.1, tpot_s=0.01))
+        assert t.summary()["window"] == 0

@@ -1,0 +1,151 @@
+"""EngineWatchdog unit tests: stall detection (both conditions),
+one-shot firing, recovery on a completed step, report contents, and the
+disabled path — all driven via check_now(), no monitor thread."""
+import threading
+import time
+
+from intellillm_tpu.obs.watchdog import EngineWatchdog, _thread_stacks
+
+
+def make_watchdog(**kwargs):
+    kwargs.setdefault("enabled", True)
+    kwargs.setdefault("stall_s", 0.05)
+    kwargs.setdefault("dispatch_s", 0.05)
+    wd = EngineWatchdog(**kwargs)
+    wd.attach(has_work=lambda: True,
+              queue_depths=lambda: {"waiting": 2, "running": 1,
+                                    "swapped": 0},
+              kv_usage=lambda: {"device": 0.5, "cpu": 0.0},
+              start_monitor=False)
+    return wd
+
+
+def test_no_stall_while_idle():
+    wd = make_watchdog()
+    wd._has_work = lambda: False
+    wd.heartbeat_step()
+    time.sleep(0.08)
+    assert wd.check_now() is None
+    assert wd.state == "ok"
+
+
+def test_no_stall_before_threshold():
+    wd = make_watchdog(stall_s=30.0, dispatch_s=30.0)
+    wd.heartbeat_step()
+    assert wd.check_now() is None
+
+
+def test_step_stall_fires_once_then_recovers():
+    wd = make_watchdog()
+    wd.heartbeat_step()
+    time.sleep(0.08)
+    report = wd.check_now()
+    assert report is not None
+    assert report["reason"] == "no_step_progress"
+    assert report["queue_depths"] == {"waiting": 2, "running": 1,
+                                      "swapped": 0}
+    assert report["kv_cache_usage"] == {"device": 0.5, "cpu": 0.0}
+    assert report["thread_stacks"]  # at least this thread
+    assert any("test_step_stall_fires_once" in stack
+               for stack in report["thread_stacks"].values())
+    assert wd.state == "stalled"
+    # One-shot per episode: a second check does not re-fire.
+    assert wd.check_now() is None
+    assert len(wd.reports()) == 1
+
+    # A completed step clears the episode; a fresh stall fires again.
+    wd.heartbeat_step()
+    assert wd.state == "ok"
+    time.sleep(0.08)
+    report2 = wd.check_now()
+    assert report2 is not None
+    assert len(wd.reports()) == 2
+    assert wd.snapshot()["stalls_fired"] == 2
+
+
+def test_dispatch_blocked_stall():
+    wd = make_watchdog(stall_s=30.0, dispatch_s=0.05)
+    wd.heartbeat_step()
+    with wd.dispatch("decode_fused"):
+        time.sleep(0.08)
+        report = wd.check_now()
+    assert report is not None
+    assert report["reason"] == "dispatch_blocked"
+    assert report["detail"]["program"] == "decode_fused"
+    assert report["detail"]["blocked_for_s"] >= 0.05
+    assert report["dispatch_in_flight"][0]["program"] == "decode_fused"
+
+
+def test_inflight_dispatch_suppresses_step_stall():
+    """A dispatch still within its own (long) threshold explains the
+    missing step heartbeats — e.g. a cold XLA compile — so
+    no_step_progress must not fire."""
+    wd = make_watchdog(stall_s=0.05, dispatch_s=30.0)
+    wd.heartbeat_step()
+    with wd.dispatch("prefill"):
+        time.sleep(0.08)
+        assert wd.check_now() is None
+    # Dispatch done but still no step: now it IS a stall.
+    time.sleep(0.01)
+    report = wd.check_now()
+    assert report is not None and report["reason"] == "no_step_progress"
+
+
+def test_disabled_watchdog_is_inert():
+    wd = EngineWatchdog(enabled=False, stall_s=0.0, dispatch_s=0.0)
+    wd.attach(has_work=lambda: True, start_monitor=False)
+    wd.heartbeat_step()
+    with wd.dispatch("prefill"):
+        pass
+    time.sleep(0.02)
+    assert wd.check_now() is None
+    assert wd.state == "ok"
+    assert wd.snapshot()["enabled"] is False
+
+
+def test_callback_failure_does_not_break_detection():
+    def boom():
+        raise RuntimeError("scheduler gone")
+    wd = make_watchdog()
+    wd._queue_depths = boom
+    wd._kv_usage = boom
+    time.sleep(0.08)
+    report = wd.check_now()
+    assert report is not None
+    assert report["queue_depths"] is None
+    assert report["kv_cache_usage"] is None
+
+
+def test_monitor_thread_detects_stall():
+    wd = make_watchdog(poll_s=0.02)
+    wd.attach(has_work=lambda: True, start_monitor=True)
+    try:
+        deadline = time.monotonic() + 5.0
+        while wd.state != "stalled" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert wd.state == "stalled"
+        assert len(wd.reports()) == 1
+    finally:
+        wd.reset_for_testing()
+
+
+def test_thread_stacks_cover_other_threads():
+    done = threading.Event()
+    t = threading.Thread(target=done.wait, name="stuck-worker")
+    t.start()
+    try:
+        stacks = _thread_stacks()
+        assert any("stuck-worker" in label for label in stacks)
+    finally:
+        done.set()
+        t.join()
+
+
+def test_snapshot_shape():
+    wd = make_watchdog(stall_s=1.0, dispatch_s=2.0)
+    snap = wd.snapshot()
+    assert snap["state"] == "ok"
+    assert snap["stall_after_s"] == 1.0
+    assert snap["dispatch_stall_after_s"] == 2.0
+    assert snap["dispatch_in_flight"] == []
+    assert snap["last_step_age_s"] >= 0.0
