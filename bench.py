@@ -38,6 +38,23 @@ BASELINE_TOK_S_PER_CHIP = 250.0
 _PROGRESS = {"phase": "start", "probe": [], "warmup_tok_s": None}
 
 
+def _device_snapshot():
+    """Last-ditch HBM state for the failure record (obs device
+    telemetry): where memory stood when the bench died. Only attempted
+    once jax is already imported (a failed probe means touching jax could
+    hang again), and never allowed to mask the original failure."""
+    if "jax" not in sys.modules:
+        return None
+    try:
+        from intellillm_tpu.obs.device_telemetry import get_device_telemetry
+        telemetry = get_device_telemetry()
+        telemetry.poll_once()
+        snap = telemetry.snapshot()
+        return snap if snap.get("devices") else None
+    except Exception:
+        return None
+
+
 def _fail_record(reason: str, exit_code: int | None = None):
     """Print the structured failure record (one JSON line, driver-parseable).
 
@@ -57,6 +74,9 @@ def _fail_record(reason: str, exit_code: int | None = None):
         "phase": _PROGRESS["phase"],
         "probe_attempts": _PROGRESS["probe"],
     }
+    snap = _device_snapshot()
+    if snap is not None:
+        rec["device_telemetry"] = snap
     print(json.dumps(rec), flush=True)
     if exit_code is not None:
         # os._exit: the watchdog fires when the process is wedged inside a

@@ -114,21 +114,33 @@ def snapshot_observability(base: str) -> dict:
     return out
 
 
-def snapshot_slo(base: str) -> dict:
-    """Scrape the server-side rolling SLO summary (p50/p90/p99
-    queue-wait/TTFT/TPOT + goodput ratio) from /health/detail. A 503
-    still carries the body (stalled server — worth recording)."""
+def snapshot_health_detail(base: str) -> dict:
+    """Scrape the server-side /health/detail body (rolling SLO summary,
+    device telemetry, watchdog state). A 503 still carries the body
+    (stalled server — worth recording)."""
     try:
         with urllib.request.urlopen(base + "/health/detail", timeout=5) as r:
-            detail = json.loads(r.read().decode(errors="replace"))
+            return json.loads(r.read().decode(errors="replace"))
     except urllib.error.HTTPError as e:
         try:
-            detail = json.loads(e.read().decode(errors="replace"))
+            return json.loads(e.read().decode(errors="replace"))
         except Exception:
             return {"error": f"health/detail scrape failed: {e}"}
     except Exception as e:
         return {"error": f"health/detail scrape failed: {e}"}
-    return detail.get("slo") or {}
+
+
+def distill_device_telemetry(detail: dict) -> dict:
+    """Compact memory-state record for the summary JSON: per-device
+    peak/in-use bytes, the ledger, headroom, and total swap traffic."""
+    dt = detail.get("device_telemetry") or {}
+    return {
+        "devices": dt.get("devices") or {},
+        "ledger_bytes": dt.get("ledger_bytes") or {},
+        "headroom_ratio": dt.get("headroom_ratio"),
+        "low_hbm_warnings": dt.get("low_hbm_warnings"),
+        "swap_bytes_total": dt.get("swap_bytes_total") or {},
+    }
 
 
 def wait_healthy(proc: subprocess.Popen, base: str, timeout: float,
@@ -211,7 +223,9 @@ def main(args) -> dict:
             print(json.dumps({"serve_bench_rate": rate_s, **m}),
                   flush=True)
         summary["observability"] = snapshot_observability(base)
-        summary["slo"] = snapshot_slo(base)
+        detail = snapshot_health_detail(base)
+        summary["slo"] = detail.get("slo") or {}
+        summary["device_telemetry"] = distill_device_telemetry(detail)
     finally:
         proc.send_signal(signal.SIGKILL)
         proc.wait()

@@ -61,6 +61,9 @@ class EngineArgs:
     # built-in defaults.
     slo_ttft_ms: Optional[float] = None
     slo_tpot_ms: Optional[float] = None
+    # Device telemetry (obs/device_telemetry.py): None ->
+    # INTELLILLM_HBM_HEADROOM_WARN env / built-in 0.05.
+    hbm_headroom_warn: Optional[float] = None
 
     def __post_init__(self) -> None:
         if self.tokenizer is None:
@@ -128,6 +131,11 @@ class EngineArgs:
                             help="time-per-output-token SLO for the "
                             "goodput gauge (default: INTELLILLM_SLO_TPOT_MS "
                             "or 200)")
+        parser.add_argument("--hbm-headroom-warn", type=float, default=None,
+                            help="warn once per episode when the min "
+                            "device HBM headroom ratio drops below this "
+                            "(default: INTELLILLM_HBM_HEADROOM_WARN or "
+                            "0.05)")
         parser.add_argument("--speculative-model", type=str, default=None)
         parser.add_argument("--num-speculative-tokens", type=int,
                             default=5)
@@ -143,6 +151,10 @@ class EngineArgs:
             from intellillm_tpu.obs import get_slo_tracker
             get_slo_tracker().configure(slo_ttft_ms=self.slo_ttft_ms,
                                         slo_tpot_ms=self.slo_tpot_ms)
+        if self.hbm_headroom_warn is not None:
+            from intellillm_tpu.obs import get_device_telemetry
+            get_device_telemetry().configure(
+                headroom_warn=self.hbm_headroom_warn)
         model_config = ModelConfig(
             model=self.model,
             tokenizer=self.tokenizer,

@@ -22,9 +22,9 @@ from intellillm_tpu.core.scheduler import Scheduler, SchedulerOutputs
 from intellillm_tpu.engine.arg_utils import EngineArgs
 from intellillm_tpu.engine.metrics import StatLogger, Stats
 from intellillm_tpu.logger import init_logger
-from intellillm_tpu.obs import (get_flight_recorder, get_slo_tracker,
-                                get_step_tracer, get_watchdog,
-                                request_context)
+from intellillm_tpu.obs import (get_device_telemetry, get_flight_recorder,
+                                get_slo_tracker, get_step_tracer,
+                                get_watchdog, request_context)
 from intellillm_tpu.outputs import RequestOutput
 from intellillm_tpu.sampling_params import SamplingParams
 from intellillm_tpu.sequence import (SamplerOutput, Sequence, SequenceGroup,
@@ -130,6 +130,16 @@ class LLMEngine:
         self.last_step_phases: dict = {}
         self.last_step_time: float = 0.0
 
+        # Device/HBM telemetry (obs/device_telemetry.py): install the
+        # static memory ledger and start the HBM poller. Best-effort —
+        # telemetry must never block engine startup.
+        self._device_telemetry = get_device_telemetry()
+        try:
+            self._device_telemetry.set_ledger(self.worker.memory_ledger())
+        except Exception:
+            logger.warning("Memory ledger unavailable.", exc_info=True)
+        self._device_telemetry.attach()
+
         self.scheduler = Scheduler(scheduler_config, cache_config, lora_config)
         self.stat_logger = StatLogger(
             local_interval=_LOG_STATS_INTERVAL,
@@ -227,6 +237,14 @@ class LLMEngine:
         logger.info("KV cache: %d device blocks, %d CPU (swap) blocks",
                     num_device, num_cpu)
         self.worker.init_cache_engine(cc)
+        # Per-block byte sizes for the absolute used/total figures in
+        # Stats (physical device bytes; unpadded host bytes for swap).
+        from intellillm_tpu.worker.cache_engine import CacheEngine
+        self._kv_block_bytes = CacheEngine.get_cache_block_size(
+            cc.block_size, cc.cache_dtype, self.model_config,
+            self.parallel_config)
+        self._cpu_block_bytes = CacheEngine.get_logical_cache_block_size(
+            cc.block_size, cc.cache_dtype, self.model_config)
         self.worker.warm_up_model()
 
     @classmethod
@@ -923,13 +941,17 @@ class LLMEngine:
 
     def _get_stats(self, scheduler_outputs: SchedulerOutputs) -> Stats:
         now = time.monotonic()
-        num_total_blocks = self.cache_config.num_device_blocks
+        num_total_blocks = self.cache_config.num_device_blocks or 0
         num_free = self.scheduler.block_manager.get_num_free_device_blocks()
         device_cache_usage = 1.0 - num_free / max(num_total_blocks, 1)
-        num_total_cpu = self.cache_config.num_cpu_blocks
+        num_total_cpu = self.cache_config.num_cpu_blocks or 0
         free_cpu = self.scheduler.block_manager.get_num_free_cpu_blocks()
         cpu_cache_usage = (1.0 - free_cpu / num_total_cpu
                            if num_total_cpu > 0 else 0.0)
+        kv_block_bytes = getattr(self, "_kv_block_bytes", 0)
+        cpu_block_bytes = getattr(self, "_cpu_block_bytes", 0)
+        device_used = max(num_total_blocks - num_free, 0) * kv_block_bytes
+        cpu_used = max(num_total_cpu - free_cpu, 0) * cpu_block_bytes
 
         prompt_tokens = (scheduler_outputs.num_batched_tokens
                          if scheduler_outputs.prompt_run else 0)
@@ -977,6 +999,10 @@ class LLMEngine:
             num_waiting=len(self.scheduler.waiting),
             device_cache_usage=device_cache_usage,
             cpu_cache_usage=cpu_cache_usage,
+            device_cache_bytes_used=device_used,
+            device_cache_bytes_total=num_total_blocks * kv_block_bytes,
+            cpu_cache_bytes_used=cpu_used,
+            cpu_cache_bytes_total=num_total_cpu * cpu_block_bytes,
             num_prompt_tokens=prompt_tokens,
             num_generation_tokens=generation_tokens,
             time_to_first_tokens=time_to_first,

@@ -32,6 +32,13 @@ class Stats:
     cpu_cache_usage: float
     num_prompt_tokens: int
     num_generation_tokens: int
+    # Absolute KV-pool byte figures (0 when block sizing is unknown, e.g.
+    # synthetic Stats in tests) — the log line shows used/total alongside
+    # the percentages.
+    device_cache_bytes_used: int = 0
+    device_cache_bytes_total: int = 0
+    cpu_cache_bytes_used: int = 0
+    cpu_cache_bytes_total: int = 0
     time_to_first_tokens: List[float] = field(default_factory=list)
     time_per_output_tokens: List[float] = field(default_factory=list)
     time_e2e_requests: List[float] = field(default_factory=list)
@@ -43,6 +50,13 @@ class Stats:
     # tracing is disabled.
     step_phase_times: Dict[str, float] = field(default_factory=dict)
     step_time: float = 0.0
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit, scale in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f}{unit}"
+    return f"{int(n)}B"
 
 
 class _Metrics:
@@ -183,13 +197,25 @@ class StatLogger:
         if stats.now - self.last_local_log > self.local_interval:
             prompt_tps = self._throughput(self.num_prompt_tokens, stats.now)
             gen_tps = self._throughput(self.num_generation_tokens, stats.now)
+
+            def usage(frac: float, used: int, total: int) -> str:
+                pct = "%.1f%%" % (frac * 100)
+                if total <= 0:  # byte sizing unknown (synthetic Stats)
+                    return pct
+                return "%s (%s/%s)" % (pct, _fmt_bytes(used),
+                                       _fmt_bytes(total))
+
             logger.info(
                 "Avg prompt throughput: %.1f tokens/s, Avg generation "
                 "throughput: %.1f tokens/s, Running: %d reqs, Swapped: %d "
-                "reqs, Pending: %d reqs, HBM KV cache usage: %.1f%%, CPU KV "
-                "cache usage: %.1f%%", prompt_tps, gen_tps,
+                "reqs, Pending: %d reqs, HBM KV cache usage: %s, CPU KV "
+                "cache usage: %s", prompt_tps, gen_tps,
                 stats.num_running, stats.num_swapped, stats.num_waiting,
-                stats.device_cache_usage * 100, stats.cpu_cache_usage * 100)
+                usage(stats.device_cache_usage,
+                      stats.device_cache_bytes_used,
+                      stats.device_cache_bytes_total),
+                usage(stats.cpu_cache_usage, stats.cpu_cache_bytes_used,
+                      stats.cpu_cache_bytes_total))
             if self.num_steps > 0 and self.phase_seconds:
                 from intellillm_tpu.obs.tracing import PHASES
                 ordered = [p for p in PHASES if p in self.phase_seconds]

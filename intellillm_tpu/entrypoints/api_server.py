@@ -7,6 +7,8 @@ image; aiohttp provides the same surface.
 Endpoints:
     GET  /health       → 200
     POST /generate     → {"text": [...]} or newline-delimited JSON stream
+plus the shared observability surface from entrypoints/debug_routes.py
+(/metrics, /health/detail, /debug/*).
 """
 from __future__ import annotations
 

@@ -1,5 +1,8 @@
 """Debug/observability HTTP routes shared by both API servers.
 
+    GET  /metrics                       Prometheus scrape endpoint (501
+                                        when prometheus_client is not
+                                        installed — `serve` extra)
     GET  /debug/trace?request_id=<id>   flight-recorder events for one
                                         request (404 if unknown/evicted)
     GET  /debug/trace                   live request ids + recently
@@ -31,8 +34,22 @@ from typing import Callable, Optional
 
 from aiohttp import web
 
-from intellillm_tpu.obs import (get_compile_tracker, get_flight_recorder,
-                                get_slo_tracker, get_watchdog)
+from intellillm_tpu.obs import (get_compile_tracker, get_device_telemetry,
+                                get_flight_recorder, get_slo_tracker,
+                                get_watchdog)
+
+
+async def metrics(request: web.Request) -> web.Response:
+    """Prometheus scrape endpoint — ONE handler shared by both servers
+    (the demo server used to lack it entirely)."""
+    try:
+        from prometheus_client import REGISTRY, generate_latest
+    except ImportError:
+        return web.Response(
+            status=501,
+            text="prometheus_client is not installed (serve extra)")
+    return web.Response(body=generate_latest(REGISTRY),
+                        content_type="text/plain")
 
 
 def add_debug_routes(app: web.Application,
@@ -79,6 +96,7 @@ def add_debug_routes(app: web.Application,
             "watchdog": watchdog.snapshot(),
             "slo": get_slo_tracker().summary(),
             "compiles": get_compile_tracker().snapshot(),
+            "device_telemetry": get_device_telemetry().snapshot(),
             "live_requests": len(get_flight_recorder().live_request_ids()),
         }
         engine = get_engine()
@@ -122,6 +140,7 @@ def add_debug_routes(app: web.Application,
         await loop.run_in_executor(None, engine.stop_profile)
         return web.json_response({"ok": True})
 
+    app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/trace", debug_trace)
     app.router.add_get("/debug/stall", debug_stall)
     app.router.add_get("/health/detail", health_detail)

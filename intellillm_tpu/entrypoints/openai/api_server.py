@@ -41,12 +41,6 @@ async def health(request: web.Request) -> web.Response:
     return web.Response(status=200)
 
 
-async def metrics(request: web.Request) -> web.Response:
-    from prometheus_client import REGISTRY, generate_latest
-    return web.Response(body=generate_latest(REGISTRY),
-                        content_type="text/plain")
-
-
 async def show_available_models(request: web.Request) -> web.Response:
     models = await openai_serving_chat.show_available_models()
     return web.json_response(models.model_dump())
@@ -132,7 +126,8 @@ def build_app(api_key: Optional[str] = None,
     app = web.Application(middlewares=[auth_middleware])
     app["api_key"] = api_key
     app.router.add_get("/health", health)
-    app.router.add_get("/metrics", metrics)
+    # /metrics is registered by add_debug_routes (shared with the demo
+    # server).
     app.router.add_get("/v1/models", show_available_models)
     app.router.add_post("/v1/chat/completions", create_chat_completion)
     app.router.add_post("/v1/completions", create_completion)

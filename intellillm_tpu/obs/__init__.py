@@ -4,6 +4,8 @@ stall watchdog. See docs/observability.md."""
 from intellillm_tpu.obs.compile_tracker import (CompileTracker,
                                                 get_compile_tracker,
                                                 record_kernel_dispatch)
+from intellillm_tpu.obs.device_telemetry import (DeviceTelemetry,
+                                                 get_device_telemetry)
 from intellillm_tpu.obs.flight_recorder import (EVENTS, FlightRecorder,
                                                 get_flight_recorder)
 from intellillm_tpu.obs.slo import (SLOTracker, derive_request_metrics,
@@ -14,6 +16,7 @@ from intellillm_tpu.obs.watchdog import EngineWatchdog, get_watchdog
 
 __all__ = [
     "CompileTracker",
+    "DeviceTelemetry",
     "EVENTS",
     "EngineWatchdog",
     "FlightRecorder",
@@ -22,6 +25,7 @@ __all__ = [
     "StepTracer",
     "derive_request_metrics",
     "get_compile_tracker",
+    "get_device_telemetry",
     "get_flight_recorder",
     "get_slo_tracker",
     "get_step_tracer",

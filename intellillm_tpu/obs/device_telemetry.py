@@ -1,0 +1,421 @@
+"""Device & HBM memory telemetry: where the memory went.
+
+The serving engine is memory-bound — the block pool exists because KV
+cache dominates HBM — yet the time-axis instruments (tracing, SLO,
+watchdog) say nothing about *space*. This module closes that gap with
+three pieces:
+
+**Device poller.** A daemon thread samples `jax.Device.memory_stats()`
+for every addressable device on a configurable interval
+(`INTELLILLM_DEVICE_POLL_S`, default 10 s) and exports per-device
+gauges plus a derived headroom ratio (min over devices of
+`1 - bytes_in_use / bytes_limit`). Backends whose `memory_stats()`
+returns None or raises (the CPU tier-1 backend) still get a per-device
+entry — with null byte fields — so readers never have to special-case
+the backend.
+
+**Memory ledger.** At engine init the worker hands over a static
+breakdown — per-chip param bytes from the sharded param tree, device
+KV-pool bytes from `CacheEngine.get_cache_block_size()` × block count,
+host swap-pool bytes — exported as
+`intellillm_hbm_ledger_bytes{component}` and logged once as a
+human-readable table. A live poll adds the residual `other` component
+(in-use bytes the ledger can't attribute: activations, XLA workspace,
+fragmentation), so ledger + gauges answer "params vs KV vs everything
+else" at a glance.
+
+**Swap accounting.** `CacheEngine.swap_in/swap_out/copy` report block
+counts × per-block bytes into `intellillm_swap_bytes_total{direction}`
+(`in` | `out` | `copy`). Swap directions count host↔device payload
+(logical, unpadded) bytes; `copy` counts on-device (physical, tiled)
+bytes moved by CoW block copies. Totals are also kept as a plain dict
+so `/health/detail` and `serve_bench` report them without Prometheus.
+
+**Low-HBM watchdog hook.** When the headroom ratio drops below
+`--hbm-headroom-warn` (`INTELLILLM_HBM_HEADROOM_WARN`, default 0.05)
+the poller logs ONE structured warning per low-HBM episode — same
+one-shot pattern as `obs/watchdog.py` — carrying the ledger and the
+oldest live flight-recorder requests, then stays quiet until headroom
+recovers. This is the "about to OOM" signal that otherwise only
+arrives as an allocator abort.
+
+INTELLILLM_DEVICE_TELEMETRY=0 disables everything (poller never
+starts; record hooks become no-ops).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter, Gauge
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+_DEFAULT_POLL_S = 10.0
+_DEFAULT_HEADROOM_WARN = 0.05
+SWAP_DIRECTIONS = ("in", "out", "copy")
+
+
+class _DeviceMetrics:
+    """Prometheus collectors for device telemetry (process-global, built
+    once — same singleton pattern as engine/metrics._Metrics)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.gauge_hbm_in_use = Gauge(
+            "intellillm_device_hbm_bytes_in_use",
+            "Live HBM bytes in use per device (jax memory_stats).",
+            ["device"])
+        self.gauge_hbm_limit = Gauge(
+            "intellillm_device_hbm_bytes_limit",
+            "HBM byte limit per device (jax memory_stats).", ["device"])
+        self.gauge_hbm_peak = Gauge(
+            "intellillm_device_hbm_peak_bytes",
+            "Peak HBM bytes in use per device since process start.",
+            ["device"])
+        self.gauge_headroom = Gauge(
+            "intellillm_hbm_headroom_ratio",
+            "Min over devices of 1 - bytes_in_use/bytes_limit (0 = full).")
+        self.gauge_ledger = Gauge(
+            "intellillm_hbm_ledger_bytes",
+            "Static per-chip memory ledger (params | kv_pool | "
+            "cpu_swap_pool | other).", ["component"])
+        self.counter_swap_bytes = Counter(
+            "intellillm_swap_bytes_total",
+            "KV-block bytes moved by swap/copy plans (direction: in | "
+            "out | copy).", ["direction"])
+        # Pre-create the direction children so the series exist (at 0)
+        # from the first scrape, before any swap happens.
+        for direction in SWAP_DIRECTIONS:
+            self.counter_swap_bytes.labels(direction)
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+def _env_f(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("Ignoring invalid %s=%r (want a float).", name, raw)
+        return default
+
+
+def _enabled_from_env() -> bool:
+    from intellillm_tpu.utils import parse_env_flag
+    flag = parse_env_flag(os.environ.get("INTELLILLM_DEVICE_TELEMETRY"))
+    return True if flag is None else flag
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "n/a"
+    for unit, scale in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f}{unit}"
+    return f"{int(n)}B"
+
+
+class DeviceTelemetry:
+    """Process-global device/HBM telemetry (one engine per process)."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 poll_s: Optional[float] = None,
+                 headroom_warn: Optional[float] = None) -> None:
+        self.enabled = (_enabled_from_env() if enabled is None else enabled)
+        self.poll_s = (poll_s if poll_s is not None
+                       else _env_f("INTELLILLM_DEVICE_POLL_S",
+                                   _DEFAULT_POLL_S))
+        self.headroom_warn = (headroom_warn if headroom_warn is not None
+                              else _env_f("INTELLILLM_HBM_HEADROOM_WARN",
+                                          _DEFAULT_HEADROOM_WARN))
+        self._lock = threading.Lock()
+        self._devices: Dict[str, Dict[str, Optional[int]]] = {}
+        self._headroom: Optional[float] = None
+        self._ledger: Dict[str, int] = {}
+        self._swap_bytes: Dict[str, int] = {d: 0 for d in SWAP_DIRECTIONS}
+        self._last_poll: Optional[float] = None
+        self._low_hbm = False
+        self._low_hbm_warnings = 0
+        self._poller: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._metrics = _DeviceMetrics() if _PROMETHEUS else None
+
+    # --- sampling ---------------------------------------------------------
+
+    def poll_once(self) -> Dict[str, Dict[str, Optional[int]]]:
+        """Sample memory_stats() for every addressable device. Never
+        raises: a backend without stats (CPU) still yields one entry per
+        device with null byte fields."""
+        if not self.enabled:
+            return {}
+        try:
+            import jax
+            devices = jax.local_devices()
+        except Exception as e:
+            logger.debug("Device telemetry: no devices (%s).", e)
+            devices = []
+        sample: Dict[str, Dict[str, Optional[int]]] = {}
+        headroom: Optional[float] = None
+        for dev in devices:
+            label = f"{getattr(dev, 'platform', 'dev')}:" \
+                    f"{getattr(dev, 'id', len(sample))}"
+            stats = None
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                sample[label] = {"bytes_in_use": None, "bytes_limit": None,
+                                 "peak_bytes": None}
+                continue
+            in_use = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit")
+            peak = stats.get("peak_bytes_in_use", in_use)
+            entry = {
+                "bytes_in_use": int(in_use) if in_use is not None else None,
+                "bytes_limit": int(limit) if limit is not None else None,
+                "peak_bytes": int(peak) if peak is not None else None,
+            }
+            sample[label] = entry
+            if self._metrics is not None:
+                m = self._metrics
+                if entry["bytes_in_use"] is not None:
+                    m.gauge_hbm_in_use.labels(label).set(
+                        entry["bytes_in_use"])
+                if entry["bytes_limit"] is not None:
+                    m.gauge_hbm_limit.labels(label).set(
+                        entry["bytes_limit"])
+                if entry["peak_bytes"] is not None:
+                    m.gauge_hbm_peak.labels(label).set(entry["peak_bytes"])
+            if entry["bytes_in_use"] is not None and entry["bytes_limit"]:
+                dev_headroom = max(
+                    1.0 - entry["bytes_in_use"] / entry["bytes_limit"], 0.0)
+                headroom = (dev_headroom if headroom is None
+                            else min(headroom, dev_headroom))
+        with self._lock:
+            self._devices = sample
+            self._headroom = headroom
+            self._last_poll = time.monotonic()
+        if self._metrics is not None:
+            # NaN (not 0.0) when the backend reports no memory stats —
+            # a default of 0 would read as "out of HBM" and trip alerts.
+            self._metrics.gauge_headroom.set(
+                headroom if headroom is not None else float("nan"))
+        self._update_residual(sample)
+        self._check_headroom(headroom)
+        return sample
+
+    def _update_residual(self, sample: Dict[str, Dict[str, Any]]) -> None:
+        """Derive the ledger's `other` component (workspace/activations/
+        fragmentation) from the live sample: worst-device in-use bytes
+        minus what the static ledger accounts for on-device."""
+        with self._lock:
+            if not self._ledger:
+                return
+            in_use = [e["bytes_in_use"] for e in sample.values()
+                      if e.get("bytes_in_use") is not None]
+            if not in_use:
+                return
+            accounted = (self._ledger.get("params", 0)
+                         + self._ledger.get("kv_pool", 0))
+            other = max(max(in_use) - accounted, 0)
+            self._ledger["other"] = other
+        if self._metrics is not None:
+            self._metrics.gauge_ledger.labels("other").set(other)
+
+    def _check_headroom(self, headroom: Optional[float]) -> None:
+        """One structured warning per low-HBM episode (one-shot pattern
+        as obs/watchdog.py), cleared when headroom recovers."""
+        if headroom is None or self.headroom_warn is None:
+            return
+        if headroom < self.headroom_warn:
+            fire = False
+            with self._lock:
+                if not self._low_hbm:
+                    self._low_hbm = True
+                    self._low_hbm_warnings += 1
+                    fire = True
+                ledger = dict(self._ledger)
+            if fire:
+                from intellillm_tpu.obs.flight_recorder import (
+                    get_flight_recorder)
+                residents = get_flight_recorder().live_request_ids()[:16]
+                logger.warning(
+                    "LOW HBM HEADROOM: %.1f%% free (< warn threshold "
+                    "%.1f%%) — allocator OOM risk. Ledger: %s. Oldest "
+                    "live requests: %s. Full snapshot at "
+                    "GET /health/detail (device_telemetry).",
+                    headroom * 100, self.headroom_warn * 100,
+                    {k: _fmt_bytes(v) for k, v in ledger.items()},
+                    residents)
+        else:
+            with self._lock:
+                was_low = self._low_hbm
+                self._low_hbm = False
+            if was_low:
+                logger.info("HBM headroom recovered: %.1f%% free.",
+                            headroom * 100)
+
+    # --- ledger -----------------------------------------------------------
+
+    def set_ledger(self, components: Dict[str, int],
+                   log_table: bool = True) -> None:
+        """Install the static memory ledger (engine init). Components are
+        per-chip bytes; `other` is recomputed from live polls."""
+        if not self.enabled:
+            return
+        clean = {k: int(v) for k, v in components.items() if v is not None}
+        with self._lock:
+            self._ledger = dict(clean)
+        if self._metrics is not None:
+            for component, nbytes in clean.items():
+                self._metrics.gauge_ledger.labels(component).set(nbytes)
+        if log_table and clean:
+            width = max(len(k) for k in clean)
+            rows = "\n".join(f"  {k.ljust(width)}  {_fmt_bytes(v):>10}"
+                             for k, v in clean.items())
+            logger.info("Memory ledger (per chip):\n%s\n  %s  %10s",
+                        rows, "total".ljust(width),
+                        _fmt_bytes(sum(clean.values())))
+
+    # --- swap accounting --------------------------------------------------
+
+    def record_swap(self, direction: str, num_blocks: int,
+                    block_bytes: int) -> None:
+        """Account one executed block-op plan (CacheEngine hot path)."""
+        if not self.enabled or num_blocks <= 0:
+            return
+        nbytes = int(num_blocks) * int(block_bytes)
+        with self._lock:
+            self._swap_bytes[direction] = (
+                self._swap_bytes.get(direction, 0) + nbytes)
+        if self._metrics is not None:
+            self._metrics.counter_swap_bytes.labels(direction).inc(nbytes)
+
+    # --- poller lifecycle -------------------------------------------------
+
+    def attach(self, start_poller: bool = True) -> None:
+        """Engine registers itself at init: take an immediate sample (so
+        /health/detail is populated before the first interval elapses)
+        and start the daemon poller."""
+        if not self.enabled:
+            return
+        self.poll_once()
+        if start_poller:
+            self._start_poller()
+
+    def configure(self, poll_s: Optional[float] = None,
+                  headroom_warn: Optional[float] = None) -> None:
+        if poll_s is not None:
+            self.poll_s = float(poll_s)
+        if headroom_warn is not None:
+            self.headroom_warn = float(headroom_warn)
+        self._wake.set()  # re-poll promptly with the new settings
+
+    def _start_poller(self) -> None:
+        with self._lock:
+            if self._poller is not None and self._poller.is_alive():
+                return
+            self._stop.clear()
+            self._poller = threading.Thread(
+                target=self._poll_loop,
+                name="intellillm-device-telemetry", daemon=True)
+            self._poller.start()
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(max(self.poll_s, 0.05))
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("Device telemetry poll failed.")
+
+    # --- read side (endpoints / StatLogger / serve_bench) -----------------
+
+    def last_sample(self) -> Dict[str, Dict[str, Optional[int]]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._devices.items()}
+
+    def headroom_ratio(self) -> Optional[float]:
+        with self._lock:
+            return self._headroom
+
+    def ledger(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._ledger)
+
+    def swap_bytes_total(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._swap_bytes)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Cheap status dict for /health/detail and serve_bench."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "poll_interval_s": self.poll_s,
+                "last_poll_age_s": (round(now - self._last_poll, 3)
+                                    if self._last_poll is not None else None),
+                "devices": {k: dict(v) for k, v in self._devices.items()},
+                "headroom_ratio": (round(self._headroom, 4)
+                                   if self._headroom is not None else None),
+                "headroom_warn": self.headroom_warn,
+                "low_hbm": self._low_hbm,
+                "low_hbm_warnings": self._low_hbm_warnings,
+                "ledger_bytes": dict(self._ledger),
+                "swap_bytes_total": dict(self._swap_bytes),
+            }
+
+    def reset_for_testing(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        poller = self._poller
+        if poller is not None and poller.is_alive():
+            poller.join(timeout=2.0)
+        self.__init__()
+
+
+_TELEMETRY: Optional[DeviceTelemetry] = None
+_TELEMETRY_LOCK = threading.Lock()
+
+
+def get_device_telemetry() -> DeviceTelemetry:
+    global _TELEMETRY
+    if _TELEMETRY is None:
+        with _TELEMETRY_LOCK:
+            if _TELEMETRY is None:
+                _TELEMETRY = DeviceTelemetry()
+    return _TELEMETRY

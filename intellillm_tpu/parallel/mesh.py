@@ -131,6 +131,25 @@ def shard_params(host_params: Any, mesh: Mesh, model) -> Any:
     return jax.tree_util.tree_map_with_path(place, host_params)
 
 
+def leaf_shard_bytes(x: Any) -> int:
+    """Per-chip bytes of one (possibly sharded) array: the shard shape
+    under its NamedSharding, the full shape when unsharded/host-side."""
+    try:
+        shape = x.sharding.shard_shape(x.shape)
+    except Exception:
+        shape = x.shape
+    n = 1
+    for s in shape:
+        n *= s
+    return n * x.dtype.itemsize
+
+
+def param_shard_bytes(tree: Any) -> int:
+    """Per-chip resident bytes of a sharded param pytree — used both by
+    the worker's memory profile and the obs memory ledger."""
+    return sum(leaf_shard_bytes(x) for x in jax.tree.leaves(tree))
+
+
 def shard_kv_cache(mesh: Mesh,
                    num_kv_heads: Optional[int] = None
                    ) -> Optional[NamedSharding]:

@@ -1,0 +1,1 @@
+"""Operator-facing CLI tools (`python -m intellillm_tpu.tools.<name>`)."""

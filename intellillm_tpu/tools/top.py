@@ -1,0 +1,249 @@
+"""intellillm-top: a terminal dashboard for a running intellillm server.
+
+    python -m intellillm_tpu.tools.top [--url http://host:8000]
+                                       [--interval 2.0] [--once]
+                                       [--api-key KEY]
+
+Polls `GET /health/detail` and `GET /metrics` and renders per-device HBM
+bars, the memory ledger, swap traffic, queue depths, KV-cache usage, and
+goodput/SLO percentiles. Curses-free: each frame clears the screen with
+ANSI escapes, so it works over any dumb tty / kubectl exec. `--once`
+prints a single frame and exits (scriptable health check).
+
+Rendering is stdlib-only and defensive: every field may be missing or
+null (CPU backends report null HBM gauges; prometheus_client may not be
+installed server-side, in which case /metrics returns 501 and the
+metrics-derived rows are skipped).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+_BAR_WIDTH = 30
+_METRIC_LINE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _request(url: str, timeout: float, api_key: Optional[str]) -> Tuple[
+        int, bytes]:
+    req = urllib.request.Request(url)
+    if api_key:
+        req.add_header("Authorization", f"Bearer {api_key}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        # /health/detail deliberately 503s while stalled/initializing but
+        # still carries the JSON body — surface it, don't throw it away.
+        return e.code, e.read()
+
+
+def fetch_json(url: str, timeout: float = 5.0,
+               api_key: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    try:
+        _status, body = _request(url, timeout, api_key)
+        return json.loads(body.decode("utf-8", "replace"))
+    except Exception:
+        return None
+
+
+def fetch_metrics(url: str, timeout: float = 5.0,
+                  api_key: Optional[str] = None
+                  ) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """Parse a Prometheus exposition into name -> [(labels, value)]."""
+    try:
+        status, body = _request(url, timeout, api_key)
+        if status != 200:
+            return {}
+        text = body.decode("utf-8", "replace")
+    except Exception:
+        return {}
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _METRIC_LINE_RE.match(line)
+        if not m:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = dict(_LABEL_RE.findall(raw_labels)) if raw_labels else {}
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def format_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "n/a"
+    for unit, scale in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f}{unit}"
+    return f"{int(n)}B"
+
+
+def _bar(frac: Optional[float], width: int = _BAR_WIDTH) -> str:
+    if frac is None:
+        return "[" + "." * width + "]"
+    frac = min(max(frac, 0.0), 1.0)
+    filled = int(round(frac * width))
+    return "[" + "#" * filled + "-" * (width - filled) + "]"
+
+
+def _device_lines(devices: Dict[str, Any]) -> List[str]:
+    lines: List[str] = []
+    for label in sorted(devices):
+        entry = devices[label] or {}
+        in_use = entry.get("bytes_in_use")
+        limit = entry.get("bytes_limit")
+        peak = entry.get("peak_bytes")
+        frac = (in_use / limit) if in_use is not None and limit else None
+        pct = f"{frac * 100:5.1f}%" if frac is not None else "  n/a "
+        lines.append(
+            f"  {label:<10} {_bar(frac)} {pct}  "
+            f"{format_bytes(in_use)}/{format_bytes(limit)} "
+            f"(peak {format_bytes(peak)})")
+    return lines
+
+
+def render_frame(health: Optional[Dict[str, Any]],
+                 metrics: Dict[str, List[Tuple[Dict[str, str], float]]],
+                 base: str) -> str:
+    lines: List[str] = []
+    now = time.strftime("%H:%M:%S")
+    if health is None:
+        lines.append(f"intellillm-top  {base}  {now}  [UNREACHABLE]")
+        lines.append("  could not fetch /health/detail")
+        return "\n".join(lines)
+
+    status = health.get("status", "unknown")
+    wd = health.get("watchdog") or {}
+    age = wd.get("last_step_age_s")
+    age_s = f"{age:.1f}s" if isinstance(age, (int, float)) else "n/a"
+    lines.append(f"intellillm-top  {base}  {now}  status={status}  "
+                 f"last-step {age_s}  live-requests "
+                 f"{health.get('live_requests', 'n/a')}")
+
+    dt = health.get("device_telemetry") or {}
+    devices = dt.get("devices") or {}
+    lines.append("")
+    lines.append("Devices (HBM):")
+    if devices:
+        lines.extend(_device_lines(devices))
+    else:
+        lines.append("  (no device sample yet)")
+    headroom = dt.get("headroom_ratio")
+    if headroom is not None:
+        low = "  ** LOW HBM **" if dt.get("low_hbm") else ""
+        lines.append(f"  headroom {headroom * 100:.1f}% "
+                     f"(warn < {(dt.get('headroom_warn') or 0) * 100:.0f}%)"
+                     f"{low}")
+
+    ledger = dt.get("ledger_bytes") or {}
+    if ledger:
+        lines.append("")
+        lines.append("Memory ledger (per chip):")
+        width = max(len(k) for k in ledger)
+        for component in ("params", "kv_pool", "cpu_swap_pool", "other"):
+            if component in ledger:
+                lines.append(f"  {component.ljust(width)}  "
+                             f"{format_bytes(ledger[component]):>10}")
+        for component in sorted(set(ledger) - {"params", "kv_pool",
+                                               "cpu_swap_pool", "other"}):
+            lines.append(f"  {component.ljust(width)}  "
+                         f"{format_bytes(ledger[component]):>10}")
+
+    swaps = dt.get("swap_bytes_total") or {}
+    if swaps:
+        lines.append("")
+        lines.append("Swap traffic (cumulative): " + "  ".join(
+            f"{d}={format_bytes(swaps.get(d, 0))}"
+            for d in ("in", "out", "copy")))
+
+    depths = health.get("queue_depths") or {}
+    kv = health.get("kv_cache_usage") or {}
+    lines.append("")
+    lines.append(
+        f"Queues: waiting={depths.get('waiting', 'n/a')} "
+        f"running={depths.get('running', 'n/a')} "
+        f"swapped={depths.get('swapped', 'n/a')}   "
+        f"KV usage: device={_pct(kv.get('device'))} "
+        f"cpu={_pct(kv.get('cpu'))}")
+
+    slo = health.get("slo") or {}
+    if slo.get("window"):
+        goodput = slo.get("goodput_ratio")
+        lines.append(
+            f"SLO (last {slo['window']} finishes): "
+            f"goodput={_pct(goodput)}  "
+            f"TTFT p50/p99 {_p(slo.get('ttft_ms'))}ms  "
+            f"TPOT p50/p99 {_p(slo.get('tpot_ms'))}ms  "
+            f"queue-wait p50/p99 {_p(slo.get('queue_wait_ms'))}ms")
+
+    tok_parts = []
+    for kind in ("prompt", "generation"):
+        series = metrics.get(f"intellillm_{kind}_tokens_total")
+        if series:
+            tok_parts.append(f"{kind}={int(sum(v for _, v in series))}")
+    if tok_parts:
+        lines.append("Tokens (cumulative): " + "  ".join(tok_parts))
+    return "\n".join(lines)
+
+
+def _pct(x: Optional[float]) -> str:
+    return f"{x * 100:.1f}%" if isinstance(x, (int, float)) else "n/a"
+
+
+def _p(d: Optional[Dict[str, float]]) -> str:
+    if not d:
+        return "n/a"
+    return f"{d.get('p50', 0):.0f}/{d.get('p99', 0):.0f}"
+
+
+def run_once(base: str, api_key: Optional[str] = None,
+             timeout: float = 5.0) -> str:
+    health = fetch_json(f"{base}/health/detail", timeout, api_key)
+    metrics = fetch_metrics(f"{base}/metrics", timeout, api_key)
+    return render_frame(health, metrics, base)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m intellillm_tpu.tools.top",
+        description="terminal dashboard for a running intellillm server")
+    parser.add_argument("--url", default="http://127.0.0.1:8000",
+                        help="server base URL")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh interval in seconds")
+    parser.add_argument("--once", action="store_true",
+                        help="print one frame and exit")
+    parser.add_argument("--api-key", default=None,
+                        help="bearer token (--api-key on the server)")
+    args = parser.parse_args(argv)
+    base = args.url.rstrip("/")
+
+    if args.once:
+        print(run_once(base, args.api_key))
+        return 0
+    try:
+        while True:
+            frame = run_once(base, args.api_key)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,17 @@ class CacheEngine:
         self.cpu_cache: List[Tuple[np.ndarray, np.ndarray]] = \
             self._allocate_cpu_cache()
 
+        # Byte sizes for the obs swap accounting: swaps move host↔device
+        # payload (unpadded logical bytes); CoW copies move on-device
+        # (lane-padded physical) bytes.
+        self.device_block_bytes = self.get_cache_block_size(
+            self.block_size, cache_config.cache_dtype, model_config,
+            parallel_config)
+        self.logical_block_bytes = self.get_logical_cache_block_size(
+            self.block_size, cache_config.cache_dtype, model_config)
+        from intellillm_tpu.obs.device_telemetry import get_device_telemetry
+        self._telemetry = get_device_telemetry()
+
     def _block_shape(self, num_blocks: int) -> Tuple[int, ...]:
         # [num_blocks, kv_heads, block_size, head_size]: (block, head) pairs
         # are (block_size × head_size) tiles for the Pallas decode kernel;
@@ -107,6 +118,8 @@ class CacheEngine:
             k_dev = swap_blocks(k_cpu, k_dev, src_to_dst, direction="in")
             v_dev = swap_blocks(v_cpu, v_dev, src_to_dst, direction="in")
             self.device_cache[i] = (k_dev, v_dev)
+        self._telemetry.record_swap("in", len(src_to_dst),
+                                    self.logical_block_bytes)
 
     def swap_out(self, src_to_dst: Dict[int, int]) -> None:
         for i in range(self.num_layers):
@@ -114,9 +127,14 @@ class CacheEngine:
             k_cpu, v_cpu = self.cpu_cache[i]
             swap_blocks(k_dev, k_cpu, src_to_dst, direction="out")
             swap_blocks(v_dev, v_cpu, src_to_dst, direction="out")
+        self._telemetry.record_swap("out", len(src_to_dst),
+                                    self.logical_block_bytes)
 
     def copy(self, src_to_dsts: Dict[int, List[int]]) -> None:
         self.device_cache = copy_blocks(self.device_cache, src_to_dsts)
+        self._telemetry.record_swap(
+            "copy", sum(len(dsts) for dsts in src_to_dsts.values()),
+            self.device_block_bytes)
 
     # --- sizing ----------------------------------------------------------
 

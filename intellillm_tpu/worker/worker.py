@@ -26,7 +26,9 @@ from intellillm_tpu.config import (CacheConfig, LoRAConfig, ModelConfig,
                                    ParallelConfig, SchedulerConfig)
 from intellillm_tpu.logger import init_logger
 from intellillm_tpu.models.model_loader import get_model
-from intellillm_tpu.parallel.mesh import build_mesh, shard_params, shard_kv_cache
+from intellillm_tpu.parallel.mesh import (build_mesh, leaf_shard_bytes,
+                                          param_shard_bytes, shard_params,
+                                          shard_kv_cache)
 from intellillm_tpu.sequence import SamplerOutput, SequenceGroupMetadata
 from intellillm_tpu.utils import (get_device_memory_bytes,
                                   get_used_device_memory_bytes)
@@ -117,19 +119,8 @@ class Worker:
         # sharded over the mesh, so one chip holds only its shard.
         total = get_device_memory_bytes()
 
-        def shard_bytes(x) -> int:
-            try:
-                shape = x.sharding.shard_shape(x.shape)
-            except Exception:
-                shape = x.shape
-            n = 1
-            for s in shape:
-                n *= s
-            return n * x.dtype.itemsize
-
-        weights_bytes = sum(
-            shard_bytes(x) for x in jax.tree.leaves(self.params))
-        weights_bytes += self._extra_weights_bytes(shard_bytes)
+        weights_bytes = param_shard_bytes(self.params)
+        weights_bytes += self._extra_weights_bytes(leaf_shard_bytes)
 
         # KV pool shards by kv-head over the "model" axis when divisible.
         tp = self.parallel_config.tensor_parallel_size
@@ -238,6 +229,31 @@ class Worker:
         self.cache_engine = CacheEngine(cache_config, self.model_config,
                                         self.parallel_config,
                                         sharding=kv_sharding)
+
+    def memory_ledger(self) -> Dict[str, int]:
+        """Static per-chip memory breakdown for the obs device telemetry
+        (obs/device_telemetry.py): sharded param bytes, the device KV
+        pool, and the host swap pool. The residual `other` component is
+        derived from live poller samples, not here."""
+        ledger: Dict[str, int] = {}
+        if self.params is not None:
+            ledger["params"] = param_shard_bytes(self.params)
+        cc = self.cache_config
+        if self.cache_engine is not None and cc.num_device_blocks:
+            block_bytes = CacheEngine.get_cache_block_size(
+                cc.block_size, cc.cache_dtype, self.model_config,
+                self.parallel_config)
+            # Same per-chip division as the memory profile: the pool
+            # shards by kv-head over "model" only when divisible.
+            tp = self.parallel_config.tensor_parallel_size
+            nkv = self.model_config.get_total_num_kv_heads()
+            if tp > 1 and nkv % tp == 0:
+                block_bytes //= tp
+            ledger["kv_pool"] = block_bytes * cc.num_device_blocks
+            logical = CacheEngine.get_logical_cache_block_size(
+                cc.block_size, cc.cache_dtype, self.model_config)
+            ledger["cpu_swap_pool"] = logical * (cc.num_cpu_blocks or 0)
+        return ledger
 
     def warm_up_model(self):
         """Pre-compile the steady-state decode executables (CUDA-graph-

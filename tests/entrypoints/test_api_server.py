@@ -97,6 +97,66 @@ def test_generate_stream(api_server):
         assert b.startswith(a[:len(a) - 8] if len(a) > 8 else a[:1])
 
 
+def test_demo_server_serves_metrics(api_server):
+    """The demo server gets /metrics via the shared debug_routes handler
+    (it used to have no scrape endpoint at all) — including the device
+    telemetry series."""
+    r = requests.get(BASE + "/metrics")
+    assert r.status_code == 200
+    body = r.text
+    assert "intellillm_" in body
+    assert "intellillm_device_hbm_bytes_in_use" in body
+    assert "intellillm_device_hbm_bytes_limit" in body
+    assert "intellillm_device_hbm_peak_bytes" in body
+    assert "intellillm_hbm_ledger_bytes" in body
+    # The direction children are pre-created, so the series exist at 0
+    # before any swap happens.
+    assert 'intellillm_swap_bytes_total{direction="in"}' in body
+    assert 'intellillm_swap_bytes_total{direction="out"}' in body
+
+
+def test_health_detail_device_telemetry_block(api_server):
+    """On the CPU backend /health/detail must still carry a
+    device_telemetry block: per-device entries (null byte fields) and a
+    non-empty ledger with params + kv components."""
+    r = requests.get(BASE + "/health/detail")
+    assert r.status_code == 200
+    dt = r.json()["device_telemetry"]
+    assert dt["enabled"] is True
+    assert dt["devices"], dt
+    for entry in dt["devices"].values():
+        assert set(entry) == {"bytes_in_use", "bytes_limit", "peak_bytes"}
+    ledger = dt["ledger_bytes"]
+    assert ledger["params"] > 0
+    assert ledger["kv_pool"] > 0
+    assert "cpu_swap_pool" in ledger
+    assert set(dt["swap_bytes_total"]) == {"in", "out", "copy"}
+
+
+def test_top_renders_one_frame(api_server):
+    """`python -m intellillm_tpu.tools.top --once` against the live
+    server must render a frame without error (acceptance criterion)."""
+    from intellillm_tpu.tools import top
+
+    frame = top.run_once(BASE)
+    assert "intellillm-top" in frame
+    assert "Devices (HBM):" in frame
+    assert "Memory ledger" in frame
+    assert "params" in frame and "kv_pool" in frame
+    assert "UNREACHABLE" not in frame
+
+    # The module entry point end-to-end (imports the heavy package, so
+    # give it a generous timeout on cold CPU).
+    out = subprocess.run(
+        [sys.executable, "-m", "intellillm_tpu.tools.top", "--once",
+         "--url", BASE],
+        capture_output=True, timeout=180, text=True,
+        env={**os.environ, "INTELLILLM_JAX_PLATFORM": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "intellillm-top" in out.stdout
+    assert "Queues:" in out.stdout
+
+
 def test_client_disconnect_aborts(api_server):
     """Closing the HTTP connection mid-stream must abort the request
     server-side (failure-detection parity: abort-on-disconnect), leaving

@@ -140,6 +140,10 @@ def test_health_detail_and_stall_without_engine():
         assert data["status"] == "initializing"
         assert data["watchdog"]["state"] == "ok"
         assert "slo" in data
+        # Present even before the engine exists (poller/ledger just
+        # haven't sampled yet).
+        assert "device_telemetry" in data
+        assert "devices" in data["device_telemetry"]
 
         resp = await client.get("/debug/stall")
         assert resp.status == 200
@@ -172,6 +176,29 @@ def test_profiler_routes_registered_only_with_opt_in():
     _run(openai_server.build_app(enable_profiling=True), gated)
     _run(demo_server.build_app(enable_profiling=True), gated)
     _run(demo_server.build_app(), absent)
+
+
+@pytest.mark.skipif(not _PROMETHEUS, reason="needs prometheus_client")
+def test_both_servers_serve_metrics_from_shared_handler():
+    """/metrics comes from ONE handler in debug_routes — the demo server
+    (which used to lack it) and the OpenAI server must both serve the
+    device-telemetry series."""
+    from intellillm_tpu.obs import get_device_telemetry
+
+    get_device_telemetry().poll_once()  # ensure the collectors exist
+
+    async def scenario(client):
+        resp = await client.get("/metrics")
+        assert resp.status == 200
+        body = await resp.text()
+        assert "intellillm_device_hbm_bytes_in_use" in body
+        assert "intellillm_hbm_ledger_bytes" in body
+        assert 'intellillm_swap_bytes_total{direction="in"}' in body
+        assert 'intellillm_swap_bytes_total{direction="out"}' in body
+        assert 'intellillm_swap_bytes_total{direction="copy"}' in body
+
+    _run(demo_server.build_app(), scenario)
+    _run(openai_server.build_app(), scenario)
 
 
 def test_demo_server_has_debug_routes():

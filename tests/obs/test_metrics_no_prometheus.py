@@ -8,6 +8,7 @@ import sys
 import pytest
 
 import intellillm_tpu.engine.metrics as metrics_mod
+import intellillm_tpu.obs.device_telemetry as devtel_mod
 import intellillm_tpu.obs.slo as slo_mod
 import intellillm_tpu.obs.watchdog as watchdog_mod
 
@@ -177,6 +178,37 @@ def test_watchdog_without_prometheus(monkeypatch):
         restored = importlib.reload(watchdog_mod)
         assert restored._PROMETHEUS is True
         restored._WatchdogMetrics.reset_for_testing()
+
+
+def test_device_telemetry_without_prometheus(monkeypatch):
+    """Every device-telemetry path — poll, headroom, ledger, swap
+    accounting, snapshot — must work with prometheus_client absent (the
+    plain-dict state backs /health/detail and serve_bench)."""
+    devtel_mod._DeviceMetrics.reset_for_testing()
+    monkeypatch.setitem(sys.modules, "prometheus_client", None)
+    try:
+        reloaded = importlib.reload(devtel_mod)
+        assert reloaded._PROMETHEUS is False
+
+        t = reloaded.DeviceTelemetry(enabled=True, poll_s=60.0,
+                                     headroom_warn=0.05)
+        assert t._metrics is None
+        sample = t.poll_once()           # real CPU poll: null byte fields
+        assert sample
+        t.set_ledger({"params": 1000, "kv_pool": 2000}, log_table=False)
+        t.record_swap("out", 2, 100)
+        t.record_swap("in", 2, 100)
+        t.record_swap("copy", 1, 300)
+        snap = t.snapshot()
+        assert snap["ledger_bytes"] == {"params": 1000, "kv_pool": 2000}
+        assert snap["swap_bytes_total"] == {"in": 200, "out": 200,
+                                            "copy": 300}
+        assert snap["devices"]
+    finally:
+        monkeypatch.undo()
+        restored = importlib.reload(devtel_mod)
+        assert restored._PROMETHEUS is True
+        restored._DeviceMetrics.reset_for_testing()
 
 
 def test_spec_acceptance_rate_optional():

@@ -1,0 +1,54 @@
+"""Static registry-hygiene guard over every Prometheus metric
+constructor in the package: names must carry the `intellillm_` prefix
+(one grafana namespace, no collisions with other exporters), and any
+module that registers collectors must expose a `reset_for_testing` hook
+so tests can rebuild engines without duplicate-registration errors."""
+import pathlib
+import re
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+PACKAGE_DIR = REPO_ROOT / "intellillm_tpu"
+
+# A prometheus_client collector construction: the metric name is the
+# first (string literal) argument.
+CONSTRUCTOR_RE = re.compile(
+    r"\b(?:Counter|Gauge|Histogram|Summary)\(\s*[\"']([^\"']+)[\"']")
+
+
+def _metric_constructors():
+    """(path, metric_name) for every collector constructed in-package."""
+    found = []
+    for path in sorted(PACKAGE_DIR.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for match in CONSTRUCTOR_RE.finditer(text):
+            found.append((path, match.group(1)))
+    return found
+
+
+def test_constructors_are_found():
+    # Guard the guard: the scrape must keep seeing the known collectors,
+    # or the assertions below pass vacuously.
+    names = {name for _, name in _metric_constructors()}
+    assert len(names) >= 25, sorted(names)
+    assert "intellillm_step_phase_seconds" in names
+    assert "intellillm_device_hbm_bytes_in_use" in names
+    assert "intellillm_swap_bytes_total" in names
+
+
+def test_every_metric_name_is_prefixed():
+    bad = [(str(p.relative_to(REPO_ROOT)), name)
+           for p, name in _metric_constructors()
+           if not name.startswith("intellillm_")]
+    assert not bad, (
+        f"metrics without the intellillm_ prefix: {bad} — all exported "
+        "series share one namespace")
+
+
+def test_every_metrics_module_has_reset_hook():
+    modules = {p for p, _ in _metric_constructors()}
+    missing = [str(p.relative_to(REPO_ROOT)) for p in sorted(modules)
+               if "reset_for_testing" not in p.read_text(encoding="utf-8")]
+    assert not missing, (
+        f"modules registering Prometheus collectors without a "
+        f"reset_for_testing hook: {missing} — tests cannot unregister "
+        "their collectors between engine rebuilds")
