@@ -33,6 +33,12 @@ import numpy as np
 
 BASELINE_TOK_S_PER_CHIP = 250.0
 
+# Hard fail-fast budget for the backend probe: a healthy probe answers
+# in seconds and each hung attempt carries its own stack dump, so
+# anything beyond 2x120s only delays the verdict.
+_MAX_PROBE_ATTEMPTS = 2
+_MAX_PROBE_S = 120.0
+
 # Filled in as the bench progresses so the failure/watchdog paths can
 # report how far we got (warmup throughput, phase reached, retries).
 _PROGRESS = {"phase": "start", "probe": [], "warmup_tok_s": None}
@@ -84,6 +90,22 @@ def _fail_record(reason: str, exit_code: int | None = None):
         os._exit(exit_code)
 
 
+def _skip_record(reason: str):
+    """Print a structured `skipped` record: no TPU backend is an
+    environment condition, not a code failure — trajectory plots must be
+    able to tell "unavailable" from "broken" (`metric: error`)."""
+    rec = {
+        "metric": "skipped",
+        "value": 0,
+        "unit": "tok/s/chip",
+        "vs_baseline": 0.0,
+        "reason": reason[:500],
+        "phase": _PROGRESS["phase"],
+        "probe_attempts": _PROGRESS["probe"],
+    }
+    print(json.dumps(rec), flush=True)
+
+
 def _probe_child_code(probe_timeout_s: float) -> str:
     """Child program for the backend probe. faulthandler dumps every
     thread's stack to stderr and self-exits shortly BEFORE the parent's
@@ -129,6 +151,16 @@ def probe_backend(attempts: int = 2, backoff_s: float = 30.0,
                                      backoff_s))
     probe_timeout_s = float(os.environ.get(
         "INTELLILLM_BENCH_PROBE_TIMEOUT", probe_timeout_s))
+    # Enforce the fail-fast budget IN the loop, env overrides included:
+    # BENCH_r05 burned 3x300s on a hung backend because the env carried
+    # the old generous budget past the fail-fast defaults.
+    if attempts > _MAX_PROBE_ATTEMPTS or probe_timeout_s > _MAX_PROBE_S:
+        print(f"[bench] clamping probe budget to "
+              f"{_MAX_PROBE_ATTEMPTS}x{_MAX_PROBE_S:.0f}s (was "
+              f"{attempts}x{probe_timeout_s:.0f}s)", file=sys.stderr,
+              flush=True)
+    attempts = min(attempts, _MAX_PROBE_ATTEMPTS)
+    probe_timeout_s = min(probe_timeout_s, _MAX_PROBE_S)
     for i in range(attempts):
         t0 = time.time()
         rec = {"attempt": i + 1, "ok": False, "elapsed_s": 0.0, "err": ""}
@@ -308,8 +340,8 @@ def main():
 
     _PROGRESS["phase"] = "probe"
     if not probe_backend():
-        _fail_record("TPU backend unavailable after all probe retries")
-        sys.exit(1)
+        _skip_record("TPU backend unavailable after all probe retries")
+        sys.exit(0)
 
     _PROGRESS["phase"] = "build_engine"
     try:

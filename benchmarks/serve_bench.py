@@ -130,6 +130,19 @@ def snapshot_health_detail(base: str) -> dict:
         return {"error": f"health/detail scrape failed: {e}"}
 
 
+def snapshot_efficiency(base: str) -> dict:
+    """Scrape the full compute-efficiency ledger (/debug/efficiency):
+    real/pad token totals, per-axis fill ratios, MFU, and the per-bucket
+    pad-waste attribution — the numbers every bucketing/scheduler perf
+    change is judged against."""
+    try:
+        with urllib.request.urlopen(base + "/debug/efficiency",
+                                    timeout=5) as r:
+            return json.loads(r.read().decode(errors="replace"))
+    except Exception as e:
+        return {"error": f"efficiency scrape failed: {e}"}
+
+
 def distill_device_telemetry(detail: dict) -> dict:
     """Compact memory-state record for the summary JSON: per-device
     peak/in-use bytes, the ledger, headroom, and total swap traffic."""
@@ -226,6 +239,7 @@ def main(args) -> dict:
         detail = snapshot_health_detail(base)
         summary["slo"] = detail.get("slo") or {}
         summary["device_telemetry"] = distill_device_telemetry(detail)
+        summary["efficiency"] = snapshot_efficiency(base)
     finally:
         proc.send_signal(signal.SIGKILL)
         proc.wait()

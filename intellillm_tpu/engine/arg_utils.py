@@ -64,6 +64,10 @@ class EngineArgs:
     # Device telemetry (obs/device_telemetry.py): None ->
     # INTELLILLM_HBM_HEADROOM_WARN env / built-in 0.05.
     hbm_headroom_warn: Optional[float] = None
+    # Compute-efficiency telemetry (obs/efficiency.py): per-chip peak
+    # FLOPs for the MFU gauge. None -> INTELLILLM_PEAK_FLOPS env / the
+    # built-in per-chip table (NaN MFU when the chip is unknown).
+    peak_flops: Optional[float] = None
 
     def __post_init__(self) -> None:
         if self.tokenizer is None:
@@ -136,6 +140,11 @@ class EngineArgs:
                             "device HBM headroom ratio drops below this "
                             "(default: INTELLILLM_HBM_HEADROOM_WARN or "
                             "0.05)")
+        parser.add_argument("--peak-flops", type=float, default=None,
+                            help="per-chip peak FLOPs used as the MFU "
+                            "denominator, e.g. 918e12 for v6e (default: "
+                            "INTELLILLM_PEAK_FLOPS or a built-in "
+                            "per-chip table; unknown chips report NaN)")
         parser.add_argument("--speculative-model", type=str, default=None)
         parser.add_argument("--num-speculative-tokens", type=int,
                             default=5)
@@ -155,6 +164,9 @@ class EngineArgs:
             from intellillm_tpu.obs import get_device_telemetry
             get_device_telemetry().configure(
                 headroom_warn=self.hbm_headroom_warn)
+        if self.peak_flops is not None:
+            from intellillm_tpu.obs import get_efficiency_tracker
+            get_efficiency_tracker().configure(peak_flops=self.peak_flops)
         model_config = ModelConfig(
             model=self.model,
             tokenizer=self.tokenizer,

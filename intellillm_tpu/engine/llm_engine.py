@@ -22,9 +22,11 @@ from intellillm_tpu.core.scheduler import Scheduler, SchedulerOutputs
 from intellillm_tpu.engine.arg_utils import EngineArgs
 from intellillm_tpu.engine.metrics import StatLogger, Stats
 from intellillm_tpu.logger import init_logger
-from intellillm_tpu.obs import (get_device_telemetry, get_flight_recorder,
-                                get_slo_tracker, get_step_tracer,
-                                get_watchdog, request_context)
+from intellillm_tpu.obs import (get_device_telemetry,
+                                get_efficiency_tracker,
+                                get_flight_recorder, get_slo_tracker,
+                                get_step_tracer, get_watchdog,
+                                request_context)
 from intellillm_tpu.outputs import RequestOutput
 from intellillm_tpu.sampling_params import SamplingParams
 from intellillm_tpu.sequence import (SamplerOutput, Sequence, SequenceGroup,
@@ -117,6 +119,17 @@ class LLMEngine:
                 "sliding window" if model_config.get_sliding_window()
                 is not None else "ALiBi")
             scheduler_config.num_decode_steps = 1
+
+        # Compute-efficiency ledger (obs/efficiency.py): derive the
+        # analytic FLOPs model and this chip's peak FLOPs BEFORE warm-up
+        # (inside _init_cache) so its dispatches hit a configured tracker
+        # — warm-up wraps itself in warmup() to stay excluded.
+        self._efficiency = get_efficiency_tracker()
+        try:
+            self._efficiency.configure_model(model_config)
+        except Exception:
+            logger.warning("Efficiency telemetry unavailable.",
+                           exc_info=True)
 
         self._init_cache()
 
@@ -727,6 +740,9 @@ class LLMEngine:
                 self.last_step_phases = phases
                 self.last_step_time = step_time
             self._watchdog.heartbeat_step()
+            # Fold this step's wall time into the rolling MFU (works
+            # with stats logging off — benches read the gauge/ledger).
+            self._efficiency.record_step(step_time)
 
         if self.stat_logger is not None:
             stats = self._get_stats(scheduler_outputs)

@@ -149,6 +149,10 @@ class StatLogger:
         self.last_local_log = time.monotonic()
         self.num_prompt_tokens: List[int] = []
         self.num_generation_tokens: List[int] = []
+        # Last-seen cumulative real/pad token totals from the efficiency
+        # tracker (obs/efficiency.py) — interval deltas drive the
+        # prefill/decode tok/s split and pad% in the periodic line.
+        self._eff_tokens_prev: Dict[str, Dict[str, int]] = {}
         # Interval accumulators for the "step breakdown" log line.
         self.phase_seconds: Dict[str, float] = {}
         self.step_seconds: float = 0.0
@@ -195,8 +199,8 @@ class StatLogger:
             self.num_steps += 1
 
         if stats.now - self.last_local_log > self.local_interval:
-            prompt_tps = self._throughput(self.num_prompt_tokens, stats.now)
-            gen_tps = self._throughput(self.num_generation_tokens, stats.now)
+            prefill_tps, decode_tps, mfu_str, pad_str = \
+                self._efficiency_interval(stats.now)
 
             def usage(frac: float, used: int, total: int) -> str:
                 pct = "%.1f%%" % (frac * 100)
@@ -206,10 +210,11 @@ class StatLogger:
                                        _fmt_bytes(total))
 
             logger.info(
-                "Avg prompt throughput: %.1f tokens/s, Avg generation "
-                "throughput: %.1f tokens/s, Running: %d reqs, Swapped: %d "
-                "reqs, Pending: %d reqs, HBM KV cache usage: %s, CPU KV "
-                "cache usage: %s", prompt_tps, gen_tps,
+                "Avg prefill throughput: %.1f tok/s, Avg decode "
+                "throughput: %.1f tok/s, MFU: %s, pad: %s, Running: %d "
+                "reqs, Swapped: %d reqs, Pending: %d reqs, HBM KV cache "
+                "usage: %s, CPU KV cache usage: %s",
+                prefill_tps, decode_tps, mfu_str, pad_str,
                 stats.num_running, stats.num_swapped, stats.num_waiting,
                 usage(stats.device_cache_usage,
                       stats.device_cache_bytes_used,
@@ -236,6 +241,39 @@ class StatLogger:
             self.step_seconds = 0.0
             self.num_steps = 0
             self.last_local_log = stats.now
+
+    def _efficiency_interval(self, now: float):
+        """Prefill/decode real-token tok/s, rolling MFU, and pad%% for
+        the periodic line, from the efficiency tracker's cumulative
+        counters (obs/efficiency.py). When the tracker recorded nothing
+        this interval (disabled, or synthetic Stats in tests) the split
+        falls back to the engine-side accumulators and pad%% reads
+        n/a."""
+        from intellillm_tpu.obs.efficiency import get_efficiency_tracker
+        eff = get_efficiency_tracker()
+        tok = eff.tokens_total()
+        prev, self._eff_tokens_prev = self._eff_tokens_prev, tok
+        elapsed = now - self.last_local_log
+
+        def delta(phase: str, kind: str) -> int:
+            return (tok.get(phase, {}).get(kind, 0)
+                    - prev.get(phase, {}).get(kind, 0))
+
+        d_prefill = delta("prefill", "real")
+        d_decode = delta("decode", "real")
+        d_pad = delta("prefill", "pad") + delta("decode", "pad")
+        if d_prefill or d_decode or d_pad:
+            prefill_tps = d_prefill / elapsed if elapsed > 0 else 0.0
+            decode_tps = d_decode / elapsed if elapsed > 0 else 0.0
+            pad_str = "%.1f%%" % (
+                d_pad / (d_prefill + d_decode + d_pad) * 100)
+        else:
+            prefill_tps = self._throughput(self.num_prompt_tokens, now)
+            decode_tps = self._throughput(self.num_generation_tokens, now)
+            pad_str = "n/a"
+        mfu = eff.rolling_mfu()
+        mfu_str = "%.1f%%" % (mfu * 100) if mfu is not None else "n/a"
+        return prefill_tps, decode_tps, mfu_str, pad_str
 
     def _log_slo_summary(self) -> None:
         """Rolling per-request percentiles + goodput (obs/slo.py), logged

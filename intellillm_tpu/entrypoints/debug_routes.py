@@ -10,6 +10,12 @@
     GET  /debug/stall                   watchdog state + ring of stall
                                         reports (thread stacks, queue
                                         depths, compile snapshot)
+    GET  /debug/efficiency              cumulative compute-efficiency
+                                        ledger: real/pad token totals,
+                                        per-axis fill ratios, rolling
+                                        MFU, and the per-bucket pad-
+                                        FLOPs waste attribution
+                                        (?top=N trims the waste list)
     GET  /health/detail                 structured liveness: last-step
                                         age, watchdog state, queue
                                         depths, KV usage, SLO summary;
@@ -35,6 +41,7 @@ from typing import Callable, Optional
 from aiohttp import web
 
 from intellillm_tpu.obs import (get_compile_tracker, get_device_telemetry,
+                                get_efficiency_tracker,
                                 get_flight_recorder, get_slo_tracker,
                                 get_watchdog)
 
@@ -87,6 +94,15 @@ def add_debug_routes(app: web.Application,
             "reports": watchdog.reports(),
         })
 
+    async def debug_efficiency(request: web.Request) -> web.Response:
+        try:
+            top_n = int(request.query.get("top", "8"))
+        except ValueError:
+            return web.json_response({"error": "top must be an integer"},
+                                     status=400)
+        return web.json_response(
+            get_efficiency_tracker().snapshot(top_n=top_n))
+
     async def health_detail(request: web.Request) -> web.Response:
         """Deep liveness, as opposed to the LB-cheap bare-200 /health:
         503 while the watchdog has declared a stall (or before engine
@@ -97,6 +113,10 @@ def add_debug_routes(app: web.Application,
             "slo": get_slo_tracker().summary(),
             "compiles": get_compile_tracker().snapshot(),
             "device_telemetry": get_device_telemetry().snapshot(),
+            # Compact: the full per-bucket ledger lives at
+            # /debug/efficiency.
+            "efficiency": get_efficiency_tracker().snapshot(
+                top_n=4, include_buckets=False),
             "live_requests": len(get_flight_recorder().live_request_ids()),
         }
         engine = get_engine()
@@ -143,6 +163,7 @@ def add_debug_routes(app: web.Application,
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/trace", debug_trace)
     app.router.add_get("/debug/stall", debug_stall)
+    app.router.add_get("/debug/efficiency", debug_efficiency)
     app.router.add_get("/health/detail", health_detail)
     if enable_profiling:
         app.router.add_post("/debug/profiler/start", profiler_start)

@@ -1,11 +1,14 @@
 """Observability subsystem: step-phase tracing, XLA compile tracking,
-the per-request flight recorder, request SLO telemetry, and the engine
-stall watchdog. See docs/observability.md."""
+the per-request flight recorder, request SLO telemetry, the engine
+stall watchdog, device/HBM telemetry, and the compute-efficiency
+ledger. See docs/observability.md."""
 from intellillm_tpu.obs.compile_tracker import (CompileTracker,
                                                 get_compile_tracker,
                                                 record_kernel_dispatch)
 from intellillm_tpu.obs.device_telemetry import (DeviceTelemetry,
                                                  get_device_telemetry)
+from intellillm_tpu.obs.efficiency import (EfficiencyTracker,
+                                           get_efficiency_tracker)
 from intellillm_tpu.obs.flight_recorder import (EVENTS, FlightRecorder,
                                                 get_flight_recorder)
 from intellillm_tpu.obs.slo import (SLOTracker, derive_request_metrics,
@@ -18,6 +21,7 @@ __all__ = [
     "CompileTracker",
     "DeviceTelemetry",
     "EVENTS",
+    "EfficiencyTracker",
     "EngineWatchdog",
     "FlightRecorder",
     "PHASES",
@@ -26,6 +30,7 @@ __all__ = [
     "derive_request_metrics",
     "get_compile_tracker",
     "get_device_telemetry",
+    "get_efficiency_tracker",
     "get_flight_recorder",
     "get_slo_tracker",
     "get_step_tracer",

@@ -1,0 +1,462 @@
+"""Compute-efficiency telemetry: where the FLOPs went.
+
+Every dispatch is padded to (batch, seq-len, block-table-width) buckets
+(`worker/model_runner.py`), so a slice of every step's FLOPs is spent on
+pad rows and pad tokens. PRs 1-3 instrumented *time* (step phases, SLO
+latencies, stalls) and *memory* (HBM, swap bytes); this module closes
+the *compute* axis with three pieces:
+
+**Padding-waste accounting.** The model runner reports every dispatch's
+real vs padded extent along all three bucket axes, split by
+prefill/decode. Exported as `intellillm_tokens_total{kind=real|pad,
+phase=prefill|decode}` plus per-axis fill-ratio histograms
+(`intellillm_fill_ratio{phase, axis}`), and kept as a plain cumulative
+ledger — waste attributed per (batch bucket, len/width bucket) pair —
+served at `GET /debug/efficiency` so operators can see which buckets
+burn the most pad FLOPs. Warm-up dispatches are excluded: the worker
+wraps `warm_up_model()` in `warmup()`, which suppresses recording and
+counts the suppressed dispatches instead.
+
+**MFU gauge.** `intellillm_mfu` = achieved model FLOPs / hardware peak,
+rolling over the last `INTELLILLM_MFU_WINDOW` (default 64) engine steps.
+Achieved FLOPs use an analytic per-token model derived from ModelConfig
+dims (layers, hidden, kv heads, ffn, vocab): matmul FLOPs only, i.e.
+2 x (attention projections + MLP + LM head) per token. Known error
+bars: attention score/AV FLOPs (context-length dependent), embeddings,
+and norms are ignored, so the model UNDERcounts at long context —
+treat MFU as a lower-bound trend line, not an absolute. Peak FLOPs come
+from a per-chip table keyed on the jax device kind, overridable with
+`INTELLILLM_PEAK_FLOPS`; on backends with no table entry (the CPU
+tier-1 backend) the gauge degrades to NaN — not 0, which would read as
+"completely stalled" — the same convention as
+`intellillm_hbm_headroom_ratio` in device telemetry.
+
+**Read side.** The StatLogger periodic line gains `MFU`/`pad`,
+`/health/detail` gains an `efficiency` block, `/debug/efficiency`
+serves the full ledger on both servers, `tools/top.py` renders an
+efficiency panel, and `benchmarks/serve_bench.py` embeds the summary.
+
+INTELLILLM_EFFICIENCY=0 disables everything (recorders become no-ops).
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter, Gauge, Histogram
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+PHASES = ("prefill", "decode")
+TOKEN_KINDS = ("real", "pad")
+AXES = ("batch", "len", "block_width")
+_DEFAULT_MFU_WINDOW = 64
+_FILL_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+                 0.95, 1.0)
+
+# Dense bf16 matmul peak per chip, matched as a lowercase substring of
+# jax's Device.device_kind. Override with INTELLILLM_PEAK_FLOPS (e.g.
+# for int8 serving or future chips).
+_PEAK_FLOPS_BY_KIND = (
+    ("v6e", 918e12),
+    ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+class _EfficiencyMetrics:
+    """Prometheus collectors for compute efficiency (process-global,
+    built once — same singleton pattern as engine/metrics._Metrics)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.counter_tokens = Counter(
+            "intellillm_tokens_total",
+            "Tokens dispatched to the device, split into real work vs "
+            "bucket padding (kind: real | pad; phase: prefill | decode).",
+            ["kind", "phase"])
+        self.hist_fill_ratio = Histogram(
+            "intellillm_fill_ratio",
+            "Per-dispatch fill ratio (real/padded extent) along each "
+            "bucket axis (axis: batch | len | block_width).",
+            ["phase", "axis"],
+            buckets=_FILL_BUCKETS)
+        self.gauge_mfu = Gauge(
+            "intellillm_mfu",
+            "Rolling model FLOPs utilization: analytic per-token FLOPs x "
+            "real tokens / (step wall-time x per-chip peak FLOPs). NaN "
+            "when the chip's peak is unknown (e.g. CPU backend).")
+        # Pre-create the label children so the series exist (at 0) from
+        # the first scrape, before any dispatch happens.
+        for kind in TOKEN_KINDS:
+            for phase in PHASES:
+                self.counter_tokens.labels(kind, phase)
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+def _enabled_from_env() -> bool:
+    from intellillm_tpu.utils import parse_env_flag
+    flag = parse_env_flag(os.environ.get("INTELLILLM_EFFICIENCY"))
+    return True if flag is None else flag
+
+
+def _env_f(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("Ignoring invalid %s=%r (want a float).", name, raw)
+        return None
+
+
+def analytic_flops_per_token(model_config) -> Optional[float]:
+    """Matmul FLOPs per token: 2 x (attention projections + MLP + LM
+    head) weights touched. Ignores attention score/AV FLOPs (context
+    dependent), embeddings, and norms — see module docstring for the
+    error bars this implies."""
+    try:
+        h = int(model_config.get_hidden_size())
+        layers = int(model_config.get_num_layers())
+        vocab = int(model_config.get_vocab_size())
+        kv_dim = (int(model_config.get_total_num_kv_heads())
+                  * int(model_config.get_head_size()))
+        hf = model_config.hf_config
+        inter = getattr(hf, "intermediate_size", None) \
+            or getattr(hf, "ffn_dim", None) or 4 * h
+        act = str(getattr(hf, "hidden_act", "")
+                  or getattr(hf, "activation_function", "")).lower()
+        # Gated MLPs (SwiGLU-family) carry a third h x inter matrix.
+        mlp_mats = 3 if ("silu" in act or "swish" in act
+                         or "glu" in act) else 2
+        attn = 2 * h * h + 2 * h * kv_dim      # q,o + k,v projections
+        mlp = mlp_mats * h * int(inter)
+        return float(2 * (layers * (attn + mlp) + h * vocab))
+    except Exception as e:
+        logger.warning("Efficiency: cannot derive a FLOPs model from the "
+                       "HF config (%s); MFU will read NaN.", e)
+        return None
+
+
+def resolve_peak_flops(device_kind: Optional[str]) -> Optional[float]:
+    """Env override first, then the per-chip table; None (-> NaN MFU)
+    when neither matches — same degradation as device telemetry."""
+    env = _env_f("INTELLILLM_PEAK_FLOPS")
+    if env is not None:
+        return env
+    if device_kind:
+        kind = device_kind.lower()
+        for marker, peak in _PEAK_FLOPS_BY_KIND:
+            if marker in kind:
+                return peak
+    return None
+
+
+class EfficiencyTracker:
+    """Process-global compute-efficiency ledger (one engine per
+    process). All recorders are cheap dict/deque updates guarded by one
+    lock; everything works without prometheus_client."""
+
+    def __init__(self, enabled: Optional[bool] = None) -> None:
+        self.enabled = (_enabled_from_env() if enabled is None else enabled)
+        self._lock = threading.Lock()
+        self._warmup_depth = 0
+        self._warmup_excluded = 0
+        self._tokens: Dict[str, Dict[str, int]] = {
+            phase: {kind: 0 for kind in TOKEN_KINDS} for phase in PHASES}
+        self._dispatches: Dict[str, int] = {phase: 0 for phase in PHASES}
+        # (phase, axis) -> [sum of fill ratios, observations]
+        self._fill: Dict[Tuple[str, str], List[float]] = {}
+        # (phase, batch_bucket, inner_bucket) -> cumulative waste row;
+        # inner bucket is the padded seq-len for prefill, the padded
+        # block-table width for decode.
+        self._buckets: Dict[Tuple[str, int, int], Dict[str, int]] = {}
+        self._flops_per_token: Optional[float] = None
+        self._model_dims: Dict[str, int] = {}
+        self._peak_flops: Optional[float] = None
+        self._device_kind: Optional[str] = None
+        window = _env_f("INTELLILLM_MFU_WINDOW")
+        self._mfu_window = int(window) if window else _DEFAULT_MFU_WINDOW
+        # (real tokens, step seconds) per engine step, rolling.
+        self._steps: deque = deque(maxlen=max(self._mfu_window, 1))
+        self._num_steps = 0
+        self._pending_tokens = 0
+        self._mfu: Optional[float] = None
+        self._metrics = _EfficiencyMetrics() if _PROMETHEUS else None
+        if self._metrics is not None:
+            self._metrics.gauge_mfu.set(float("nan"))
+
+    # --- configuration ----------------------------------------------------
+
+    def configure_model(self, model_config) -> None:
+        """Engine init: derive the analytic FLOPs model from the model's
+        dims and resolve this chip's peak FLOPs."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._flops_per_token = analytic_flops_per_token(model_config)
+            try:
+                self._model_dims = {
+                    "layers": int(model_config.get_num_layers()),
+                    "hidden": int(model_config.get_hidden_size()),
+                    "heads": int(model_config.get_num_attention_heads()),
+                    "vocab": int(model_config.get_vocab_size()),
+                }
+            except Exception:
+                self._model_dims = {}
+        self.attach_device()
+
+    def attach_device(self) -> None:
+        """Resolve peak FLOPs for the local chip (env override wins;
+        unknown chip -> None -> NaN MFU)."""
+        kind = None
+        try:
+            import jax
+            devices = jax.local_devices()
+            if devices:
+                kind = getattr(devices[0], "device_kind", None) \
+                    or getattr(devices[0], "platform", None)
+        except Exception:
+            kind = None
+        with self._lock:
+            self._device_kind = kind
+            if self._explicit_peak() is None:
+                self._peak_flops = resolve_peak_flops(kind)
+
+    def _explicit_peak(self) -> Optional[float]:
+        return getattr(self, "_peak_override", None)
+
+    def configure(self, peak_flops: Optional[float] = None,
+                  mfu_window: Optional[int] = None) -> None:
+        """Operator overrides (--peak-flops CLI flag / tests)."""
+        with self._lock:
+            if peak_flops is not None:
+                self._peak_override = float(peak_flops)
+                self._peak_flops = float(peak_flops)
+            if mfu_window is not None and mfu_window > 0:
+                self._mfu_window = int(mfu_window)
+                self._steps = deque(self._steps, maxlen=self._mfu_window)
+
+    # --- warm-up exclusion ------------------------------------------------
+
+    @contextlib.contextmanager
+    def warmup(self):
+        """Suppress recording for the duration (worker warm-up sweeps
+        dispatch every decode bucket; counting them would charge steady
+        -state series with synthetic all-pad batches). Suppressed
+        dispatches are counted so the ledger shows they were excluded,
+        not lost."""
+        with self._lock:
+            self._warmup_depth += 1
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._warmup_depth -= 1
+
+    # --- record side (model runner / engine hot path) ---------------------
+
+    def record_dispatch(self, phase: str, real_rows: int, padded_rows: int,
+                        *, real_tokens: int, padded_tokens: int,
+                        len_real: Optional[int] = None,
+                        len_padded: Optional[int] = None,
+                        width_real: Optional[int] = None,
+                        width_padded: Optional[int] = None) -> None:
+        """Account one device dispatch. Extents are pre-padding vs
+        post-padding; token counts are what the device actually
+        computes (prefill: rows x padded len; decode: rows x substeps)."""
+        if not self.enabled:
+            return
+        real_tokens = int(real_tokens)
+        pad_tokens = max(int(padded_tokens) - real_tokens, 0)
+        fills: List[Tuple[str, float]] = []
+        if padded_rows > 0:
+            fills.append(("batch", min(real_rows / padded_rows, 1.0)))
+        if len_padded and len_real is not None:
+            fills.append(("len", min(len_real / len_padded, 1.0)))
+        if width_padded and width_real is not None:
+            fills.append(("block_width",
+                          min(width_real / width_padded, 1.0)))
+        inner = (len_padded if phase == "prefill" else width_padded) or 0
+        with self._lock:
+            if self._warmup_depth > 0:
+                self._warmup_excluded += 1
+                return
+            tok = self._tokens.setdefault(
+                phase, {kind: 0 for kind in TOKEN_KINDS})
+            tok["real"] += real_tokens
+            tok["pad"] += pad_tokens
+            self._dispatches[phase] = self._dispatches.get(phase, 0) + 1
+            self._pending_tokens += real_tokens
+            for axis, ratio in fills:
+                cell = self._fill.setdefault((phase, axis), [0.0, 0])
+                cell[0] += ratio
+                cell[1] += 1
+            row = self._buckets.setdefault(
+                (phase, int(padded_rows), int(inner)),
+                {"dispatches": 0, "real_tokens": 0, "pad_tokens": 0})
+            row["dispatches"] += 1
+            row["real_tokens"] += real_tokens
+            row["pad_tokens"] += pad_tokens
+        if self._metrics is not None:
+            m = self._metrics
+            m.counter_tokens.labels("real", phase).inc(real_tokens)
+            m.counter_tokens.labels("pad", phase).inc(pad_tokens)
+            for axis, ratio in fills:
+                m.hist_fill_ratio.labels(phase, axis).observe(ratio)
+
+    def record_step(self, step_time: float) -> Optional[float]:
+        """Engine step boundary: fold the real tokens dispatched since
+        the previous boundary with this step's wall time into the
+        rolling MFU. Returns the rolling value (None when peak or FLOPs
+        model is unknown)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            tokens = self._pending_tokens
+            self._pending_tokens = 0
+            if step_time is None or step_time <= 0:
+                return self._mfu
+            self._steps.append((tokens, float(step_time)))
+            self._num_steps += 1
+            mfu = self._rolling_mfu_locked()
+            self._mfu = mfu
+        if self._metrics is not None:
+            self._metrics.gauge_mfu.set(
+                mfu if mfu is not None else float("nan"))
+        return mfu
+
+    def _rolling_mfu_locked(self) -> Optional[float]:
+        if (self._flops_per_token is None or self._peak_flops is None
+                or not self._steps):
+            return None
+        total_s = sum(dt for _, dt in self._steps)
+        if total_s <= 0:
+            return None
+        total_tokens = sum(t for t, _ in self._steps)
+        return (total_tokens * self._flops_per_token
+                / (total_s * self._peak_flops))
+
+    # --- read side (endpoints / StatLogger / serve_bench / top) -----------
+
+    def rolling_mfu(self) -> Optional[float]:
+        with self._lock:
+            return self._mfu
+
+    def tokens_total(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {phase: dict(kinds)
+                    for phase, kinds in self._tokens.items()}
+
+    def warmup_excluded(self) -> int:
+        with self._lock:
+            return self._warmup_excluded
+
+    def _bucket_rows_locked(self) -> List[Dict[str, Any]]:
+        fpt = self._flops_per_token
+        rows = []
+        for (phase, batch_bucket, inner), row in self._buckets.items():
+            rows.append({
+                "phase": phase,
+                "batch_bucket": batch_bucket,
+                "axis": "len" if phase == "prefill" else "block_width",
+                "inner_bucket": inner,
+                "dispatches": row["dispatches"],
+                "real_tokens": row["real_tokens"],
+                "pad_tokens": row["pad_tokens"],
+                "pad_flops": (row["pad_tokens"] * fpt
+                              if fpt is not None else None),
+            })
+        rows.sort(key=lambda r: r["pad_tokens"], reverse=True)
+        return rows
+
+    def snapshot(self, top_n: int = 8,
+                 include_buckets: bool = True) -> Dict[str, Any]:
+        """JSON-safe ledger for /debug/efficiency, /health/detail and
+        serve_bench (mfu is None — never NaN — when unknown)."""
+        with self._lock:
+            real = sum(k["real"] for k in self._tokens.values())
+            pad = sum(k["pad"] for k in self._tokens.values())
+            fill_avg: Dict[str, Dict[str, Optional[float]]] = {
+                phase: {axis: None for axis in AXES} for phase in PHASES}
+            for (phase, axis), (total, count) in self._fill.items():
+                if count:
+                    fill_avg.setdefault(phase, {})[axis] = round(
+                        total / count, 4)
+            buckets = self._bucket_rows_locked()
+            mfu = self._mfu
+            body = {
+                "enabled": self.enabled,
+                "device_kind": self._device_kind,
+                "peak_flops": self._peak_flops,
+                "flops_per_token": self._flops_per_token,
+                "model_dims": dict(self._model_dims),
+                "mfu": (round(mfu, 6)
+                        if mfu is not None and math.isfinite(mfu)
+                        else None),
+                "mfu_window_steps": self._mfu_window,
+                "steps": self._num_steps,
+                "tokens_total": {phase: dict(kinds)
+                                 for phase, kinds in self._tokens.items()},
+                "pad_fraction": (round(pad / (real + pad), 4)
+                                 if real + pad else None),
+                "fill_ratio_avg": fill_avg,
+                "dispatches": dict(self._dispatches),
+                "warmup_excluded_dispatches": self._warmup_excluded,
+                "top_waste": buckets[:top_n],
+            }
+            if include_buckets:
+                body["per_bucket"] = buckets
+            return body
+
+    def reset_for_testing(self) -> None:
+        if hasattr(self, "_peak_override"):
+            del self._peak_override
+        self.__init__()
+
+
+_TRACKER: Optional[EfficiencyTracker] = None
+_TRACKER_LOCK = threading.Lock()
+
+
+def get_efficiency_tracker() -> EfficiencyTracker:
+    global _TRACKER
+    if _TRACKER is None:
+        with _TRACKER_LOCK:
+            if _TRACKER is None:
+                _TRACKER = EfficiencyTracker()
+    return _TRACKER

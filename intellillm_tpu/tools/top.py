@@ -5,8 +5,9 @@
                                        [--api-key KEY]
 
 Polls `GET /health/detail` and `GET /metrics` and renders per-device HBM
-bars, the memory ledger, swap traffic, queue depths, KV-cache usage, and
-goodput/SLO percentiles. Curses-free: each frame clears the screen with
+bars, the memory ledger, swap traffic, queue depths, KV-cache usage,
+goodput/SLO percentiles, and the compute-efficiency panel (MFU, pad%,
+per-axis bucket fill, top-waste bucket). Curses-free: each frame clears the screen with
 ANSI escapes, so it works over any dumb tty / kubectl exec. `--once`
 prints a single frame and exits (scriptable health check).
 
@@ -190,6 +191,8 @@ def render_frame(health: Optional[Dict[str, Any]],
             f"TPOT p50/p99 {_p(slo.get('tpot_ms'))}ms  "
             f"queue-wait p50/p99 {_p(slo.get('queue_wait_ms'))}ms")
 
+    lines.extend(_efficiency_lines(health.get("efficiency") or {}))
+
     tok_parts = []
     for kind in ("prompt", "generation"):
         series = metrics.get(f"intellillm_{kind}_tokens_total")
@@ -198,6 +201,45 @@ def render_frame(health: Optional[Dict[str, Any]],
     if tok_parts:
         lines.append("Tokens (cumulative): " + "  ".join(tok_parts))
     return "\n".join(lines)
+
+
+def _efficiency_lines(eff: Dict[str, Any]) -> List[str]:
+    """Compute-efficiency panel from the /health/detail `efficiency`
+    block (obs/efficiency.py). Every field may be missing/null: MFU is
+    null on chips without a peak-FLOPs entry (CPU), fills are null for
+    axes never exercised (e.g. prefill block_width without prefix
+    caching)."""
+    tokens = eff.get("tokens_total") or {}
+    if not eff or not any((tokens.get(p) or {}).get(k)
+                          for p in ("prefill", "decode")
+                          for k in ("real", "pad")):
+        return []
+    lines = ["", "Efficiency:"]
+    mfu = eff.get("mfu")
+    pad = eff.get("pad_fraction")
+    lines.append(f"  MFU {_pct(mfu)}  pad {_pct(pad)}  "
+                 f"(peak={eff.get('peak_flops') or 'n/a'}, "
+                 f"steps={eff.get('steps', 0)}, warm-up excluded "
+                 f"{eff.get('warmup_excluded_dispatches', 0)})")
+    fills = eff.get("fill_ratio_avg") or {}
+    for phase in ("prefill", "decode"):
+        tok = tokens.get(phase) or {}
+        fill = fills.get(phase) or {}
+        lines.append(
+            f"  {phase:<8} real={tok.get('real', 0)} pad={tok.get('pad', 0)}"
+            f"  fill batch={_pct(fill.get('batch'))} "
+            f"len={_pct(fill.get('len'))} "
+            f"width={_pct(fill.get('block_width'))}")
+    waste = eff.get("top_waste") or []
+    if waste:
+        worst = waste[0]
+        lines.append(
+            f"  top waste: {worst.get('phase')} bucket "
+            f"b={worst.get('batch_bucket')}x"
+            f"{worst.get('axis')}={worst.get('inner_bucket')} "
+            f"({worst.get('pad_tokens', 0)} pad tokens over "
+            f"{worst.get('dispatches', 0)} dispatches)")
+    return lines
 
 
 def _pct(x: Optional[float]) -> str:

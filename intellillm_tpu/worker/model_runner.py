@@ -43,7 +43,8 @@ from intellillm_tpu.layers.sampler import (LOGPROB_K_BUCKETS,
                                            sample, sample_row_host)
 from intellillm_tpu.logger import init_logger
 from intellillm_tpu.native import build_decode_batch, build_prompt_slots
-from intellillm_tpu.obs import get_compile_tracker, get_step_tracer
+from intellillm_tpu.obs import (get_compile_tracker,
+                                get_efficiency_tracker, get_step_tracer)
 from intellillm_tpu.ops.kv_cache import PAD_SLOT_ID
 from intellillm_tpu.sampling_params import SamplingParams, SamplingType
 from intellillm_tpu.sequence import (SamplerOutput, SequenceGroupMetadata,
@@ -155,6 +156,7 @@ class ModelRunner:
         self._dp = (mesh.shape.get("data", 1) if mesh is not None else 1)
         self._tracer = get_step_tracer()
         self._compile_tracker = get_compile_tracker()
+        self._efficiency = get_efficiency_tracker()
 
         self.block_size = cache_config.block_size
         self.sliding_window = model_config.get_sliding_window()
@@ -720,6 +722,16 @@ class ModelRunner:
         )
         arrays = {"token_ids": token_ids, "positions": positions,
                   "logits_indices": logits_indices}
+        # Real-vs-padded extents for the efficiency ledger; popped (and
+        # recorded with the dispatch shape) by execute_model.
+        arrays["_eff"] = {
+            "real_rows": len(rows),
+            "real_tokens": sum(len(t) for t in token_rows),
+            "len_real": max_new, "len_padded": l,
+            "width_real": (max(len(t) for t in block_tables)
+                           if use_prefix else None),
+            "width_padded": bt.shape[1] if bt is not None else None,
+        }
         return arrays, attn_metadata, rows
 
     def _prepare_decode(
@@ -752,6 +764,11 @@ class ModelRunner:
 
         arrays = {"token_ids": token_ids, "positions": positions,
                   "context_lens": context_lens, "block_tables": block_tables}
+        arrays["_eff"] = {
+            "real_rows": len(rows),
+            "width_real": max(len(t) for t in tables),
+            "width_padded": w,
+        }
         return arrays, rows
 
     def _place_batch_array(self, arr):
@@ -831,6 +848,7 @@ class ModelRunner:
             else:
                 arrays, rows = self._prepare_decode(seq_group_metadata_list)
 
+            eff_info = arrays.pop("_eff")
             padded_n = arrays["token_ids"].shape[0]
 
             # Per-row sampling params / seeds / token histories.
@@ -962,6 +980,24 @@ class ModelRunner:
                         *decode_args, num_steps=num_steps, **common)
             t1 = t2 = num_steps
 
+        if is_prompt:
+            self._efficiency.record_dispatch(
+                "prefill", eff_info["real_rows"], padded_n,
+                real_tokens=eff_info["real_tokens"],
+                padded_tokens=padded_n * arrays["token_ids"].shape[1],
+                len_real=eff_info["len_real"],
+                len_padded=eff_info["len_padded"],
+                width_real=eff_info["width_real"],
+                width_padded=eff_info["width_padded"])
+        else:
+            # Each substep computes one token per row, pad rows included.
+            self._efficiency.record_dispatch(
+                "decode", eff_info["real_rows"], padded_n,
+                real_tokens=eff_info["real_rows"] * num_steps,
+                padded_tokens=padded_n * num_steps,
+                width_real=eff_info["width_real"],
+                width_padded=eff_info["width_padded"])
+
         # ONE device→host transfer for everything, performed by
         # InflightStep.finalize() — immediately on the eager path, or
         # overlapped with later dispatches on the pipelined path.
@@ -1040,6 +1076,14 @@ class ModelRunner:
                 place(block_tables), place(ctx), *sampling_args, lora_state,
                 prev_t1=prev_t1, num_steps=num_steps, **flags)
 
+        live_rows = int((cont.ctx0 > 0).sum())
+        self._efficiency.record_dispatch(
+            "decode", live_rows, b,
+            real_tokens=live_rows * num_steps,
+            padded_tokens=b * num_steps,
+            width_real=max((len(t) for t in tables), default=1),
+            width_padded=w)
+
         step = InflightStep(self, packed, cont.metas, cont.rows, num_steps,
                             num_steps, st.logprob_k, False, num_steps)
         step.cont_state = cont
@@ -1061,6 +1105,7 @@ class ModelRunner:
         choices in the usual per-substep SamplerOutput shape."""
         with self._tracer.span("prepare_inputs"):
             arrays, rows = self._prepare_decode(seq_group_metadata_list)
+        eff_info = arrays.pop("_eff")
         padded_n = arrays["token_ids"].shape[0]
         teacher = np.zeros((padded_n, num_steps), np.int32)
         for i, toks in enumerate(teacher_rows):
@@ -1094,6 +1139,12 @@ class ModelRunner:
                 place(arrays["positions"]), place(arrays["block_tables"]),
                 place(arrays["context_lens"]), *sampling_args, lora_state,
                 num_steps=num_steps, **flags)
+        self._efficiency.record_dispatch(
+            "decode", eff_info["real_rows"], padded_n,
+            real_tokens=eff_info["real_rows"] * num_steps,
+            padded_tokens=padded_n * num_steps,
+            width_real=eff_info["width_real"],
+            width_padded=eff_info["width_padded"])
         step = InflightStep(self, packed, seq_group_metadata_list, rows,
                             num_steps, num_steps, st.logprob_k, False,
                             num_steps)

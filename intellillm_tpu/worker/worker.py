@@ -279,6 +279,16 @@ class Worker:
         runner = self.model_runner
         if runner is None or self.cache_engine is None:
             return
+        from intellillm_tpu.obs import get_efficiency_tracker
+
+        # Warm-up dispatches are synthetic all-pad batches; exclude them
+        # from the efficiency ledger (they would read as 0% fill and
+        # poison steady-state pad accounting) — suppressed dispatches
+        # are counted, not silently dropped.
+        with get_efficiency_tracker().warmup():
+            return self._warm_up_model_inner(runner)
+
+    def _warm_up_model_inner(self, runner):
         import time as _time
 
         from intellillm_tpu.utils import parse_env_flag, pad_to_bucket

@@ -1,0 +1,204 @@
+"""Unit coverage for obs/efficiency.py: the analytic FLOPs model, the
+peak-FLOPs resolution order (explicit > env > per-chip table > None),
+warm-up exclusion, and the rolling-MFU NaN/finite transitions."""
+import math
+
+import pytest
+
+from intellillm_tpu.obs import efficiency as eff_mod
+from intellillm_tpu.obs.efficiency import (EfficiencyTracker,
+                                           analytic_flops_per_token,
+                                           resolve_peak_flops)
+
+
+class _FakeHF:
+    def __init__(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+
+class _FakeModelConfig:
+    """Just the ModelConfig surface analytic_flops_per_token touches."""
+
+    def __init__(self, hidden=64, layers=2, heads=4, kv_heads=4,
+                 head_size=16, vocab=100, **hf_kwargs):
+        self._h, self._l, self._heads = hidden, layers, heads
+        self._kv, self._hs, self._v = kv_heads, head_size, vocab
+        self.hf_config = _FakeHF(**hf_kwargs)
+
+    def get_hidden_size(self):
+        return self._h
+
+    def get_num_layers(self):
+        return self._l
+
+    def get_num_attention_heads(self):
+        return self._heads
+
+    def get_total_num_kv_heads(self):
+        return self._kv
+
+    def get_head_size(self):
+        return self._hs
+
+    def get_vocab_size(self):
+        return self._v
+
+
+@pytest.fixture
+def tracker(monkeypatch):
+    monkeypatch.delenv("INTELLILLM_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("INTELLILLM_MFU_WINDOW", raising=False)
+    monkeypatch.delenv("INTELLILLM_EFFICIENCY", raising=False)
+    return EfficiencyTracker(enabled=True)
+
+
+def test_analytic_flops_per_token_ungated():
+    # h=64, layers=2, kv_dim=64, ffn_dim=128, vocab=100, relu MLP (2
+    # mats): 2 * (2*(2*64*64 + 2*64*64 + 2*64*128) + 64*100) = 143872.
+    cfg = _FakeModelConfig(ffn_dim=128, activation_function="relu")
+    assert analytic_flops_per_token(cfg) == pytest.approx(143872.0)
+
+
+def test_analytic_flops_per_token_gated_mlp_counts_third_matrix():
+    base = _FakeModelConfig(intermediate_size=128, hidden_act="gelu")
+    gated = _FakeModelConfig(intermediate_size=128, hidden_act="silu")
+    # SwiGLU carries one extra h x inter matmul per layer:
+    # delta = 2 * layers * h * inter = 2 * 2 * 64 * 128 = 32768.
+    assert (analytic_flops_per_token(gated)
+            - analytic_flops_per_token(base)) == pytest.approx(32768.0)
+
+
+def test_analytic_flops_defaults_inter_to_4h():
+    cfg = _FakeModelConfig()  # no intermediate_size/ffn_dim on hf_config
+    # inter = 4 * 64 = 256, relu-style: 2*(2*(16384 + 2*64*256) + 6400)
+    assert analytic_flops_per_token(cfg) == pytest.approx(209408.0)
+
+
+def test_analytic_flops_none_on_broken_config():
+    class Broken:
+        hf_config = None
+
+        def get_hidden_size(self):
+            raise RuntimeError("no dims")
+
+    assert analytic_flops_per_token(Broken()) is None
+
+
+def test_resolve_peak_flops_table_and_env(monkeypatch):
+    monkeypatch.delenv("INTELLILLM_PEAK_FLOPS", raising=False)
+    # Substring match over lowercase device_kind.
+    assert resolve_peak_flops("TPU v6e") == pytest.approx(918e12)
+    assert resolve_peak_flops("TPU v5p") == pytest.approx(459e12)
+    assert resolve_peak_flops("TPU v5 lite") == pytest.approx(197e12)
+    assert resolve_peak_flops("cpu") is None
+    assert resolve_peak_flops(None) is None
+    # Env override beats the table (int8 serving / future chips).
+    monkeypatch.setenv("INTELLILLM_PEAK_FLOPS", "2e15")
+    assert resolve_peak_flops("TPU v6e") == pytest.approx(2e15)
+    # Garbage env is ignored, not fatal.
+    monkeypatch.setenv("INTELLILLM_PEAK_FLOPS", "fast")
+    assert resolve_peak_flops("TPU v4") == pytest.approx(275e12)
+
+
+def test_warmup_excludes_dispatches_from_ledger(tracker):
+    """Acceptance: warm-up dispatches must not pollute the ledger —
+    suppressed entirely, but counted as excluded."""
+    with tracker.warmup():
+        tracker.record_dispatch("decode", 1, 64, real_tokens=1,
+                                padded_tokens=64, width_real=1,
+                                width_padded=16)
+        with tracker.warmup():  # nesting must not unsuppress early
+            tracker.record_dispatch("decode", 1, 32, real_tokens=1,
+                                    padded_tokens=32)
+        tracker.record_dispatch("prefill", 1, 8, real_tokens=16,
+                                padded_tokens=128, len_real=16,
+                                len_padded=16)
+    snap = tracker.snapshot()
+    assert snap["tokens_total"]["decode"] == {"real": 0, "pad": 0}
+    assert snap["tokens_total"]["prefill"] == {"real": 0, "pad": 0}
+    assert snap["dispatches"] == {"prefill": 0, "decode": 0}
+    assert snap["fill_ratio_avg"]["decode"]["block_width"] is None
+    assert snap["top_waste"] == []
+    assert snap["warmup_excluded_dispatches"] == 3
+    assert tracker.warmup_excluded() == 3
+    # After the context exits, recording resumes.
+    tracker.record_dispatch("decode", 2, 4, real_tokens=2, padded_tokens=4)
+    assert tracker.tokens_total()["decode"] == {"real": 2, "pad": 2}
+
+
+def test_mfu_nan_without_peak_then_finite_with_override(tracker):
+    cfg = _FakeModelConfig(ffn_dim=128)
+    tracker.configure_model(cfg)  # CPU: no table entry -> peak None
+    tracker.record_dispatch("decode", 4, 4, real_tokens=4, padded_tokens=4)
+    assert tracker.record_step(0.01) is None
+    assert tracker.rolling_mfu() is None
+    snap = tracker.snapshot()
+    assert snap["peak_flops"] is None
+    assert snap["mfu"] is None          # JSON-safe: None, never NaN
+    assert snap["flops_per_token"] == pytest.approx(143872.0)
+    if tracker._metrics is not None:    # the gauge itself carries NaN
+        assert math.isnan(tracker._metrics.gauge_mfu._value.get())
+
+    tracker.configure(peak_flops=1e9)
+    tracker.record_dispatch("decode", 4, 4, real_tokens=4, padded_tokens=4)
+    mfu = tracker.record_step(0.01)
+    # Window holds two steps: 8 real tokens over 0.02 s against 1e9
+    # peak -> 8 * 143872 / (0.02 * 1e9).
+    assert mfu == pytest.approx(8 * 143872.0 / (0.02 * 1e9))
+    assert tracker.snapshot()["mfu"] == pytest.approx(mfu, abs=1e-6)
+
+
+def test_explicit_peak_survives_attach_device(tracker):
+    tracker.configure(peak_flops=5e12)
+    tracker.attach_device()  # CPU would otherwise reset peak to None
+    assert tracker.snapshot()["peak_flops"] == pytest.approx(5e12)
+    # reset_for_testing drops the override (fresh resolution order).
+    tracker.reset_for_testing()
+    assert not hasattr(tracker, "_peak_override")
+
+
+def test_mfu_window_is_rolling(monkeypatch):
+    monkeypatch.delenv("INTELLILLM_PEAK_FLOPS", raising=False)
+    monkeypatch.setenv("INTELLILLM_MFU_WINDOW", "2")
+    t = EfficiencyTracker(enabled=True)
+    t.configure(peak_flops=1e6)
+    t._flops_per_token = 100.0
+    t.record_dispatch("decode", 10, 10, real_tokens=10, padded_tokens=10)
+    t.record_step(1.0)
+    t.record_dispatch("decode", 10, 10, real_tokens=10, padded_tokens=10)
+    t.record_step(1.0)
+    # A third step evicts the first: only the last 2 steps count.
+    t.record_dispatch("decode", 40, 40, real_tokens=40, padded_tokens=40)
+    mfu = t.record_step(1.0)
+    assert mfu == pytest.approx((10 + 40) * 100.0 / (2.0 * 1e6))
+
+
+def test_disabled_tracker_is_a_noop(monkeypatch):
+    monkeypatch.setenv("INTELLILLM_EFFICIENCY", "0")
+    t = EfficiencyTracker()          # enabled resolved from env
+    assert t.enabled is False
+    t.record_dispatch("prefill", 4, 8, real_tokens=40, padded_tokens=128)
+    assert t.record_step(0.01) is None
+    snap = t.snapshot()
+    assert snap["enabled"] is False
+    assert snap["tokens_total"]["prefill"] == {"real": 0, "pad": 0}
+    assert snap["steps"] == 0
+
+
+def test_record_dispatch_clamps_and_attributes_buckets(tracker):
+    # real > padded (defensive): pad clamps to 0, fill to 1.0.
+    tracker.record_dispatch("prefill", 9, 8, real_tokens=130,
+                            padded_tokens=128, len_real=20, len_padded=16)
+    tracker.record_dispatch("prefill", 2, 8, real_tokens=20,
+                            padded_tokens=128, len_real=10, len_padded=16)
+    snap = tracker.snapshot()
+    assert snap["tokens_total"]["prefill"] == {"real": 150, "pad": 108}
+    assert snap["pad_fraction"] == pytest.approx(108 / 258, abs=1e-4)
+    # Both dispatches share the (batch=8, len=16) bucket pair.
+    assert len(snap["per_bucket"]) == 1
+    worst = snap["top_waste"][0]
+    assert (worst["phase"], worst["batch_bucket"],
+            worst["inner_bucket"]) == ("prefill", 8, 16)
+    assert worst["axis"] == "len"
+    assert worst["dispatches"] == 2
+    assert worst["pad_tokens"] == 108

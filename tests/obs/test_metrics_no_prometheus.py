@@ -9,6 +9,7 @@ import pytest
 
 import intellillm_tpu.engine.metrics as metrics_mod
 import intellillm_tpu.obs.device_telemetry as devtel_mod
+import intellillm_tpu.obs.efficiency as eff_mod
 import intellillm_tpu.obs.slo as slo_mod
 import intellillm_tpu.obs.watchdog as watchdog_mod
 
@@ -79,7 +80,7 @@ def test_statlogger_slo_line_skipped_when_window_empty(monkeypatch):
                                              labels={"model_name": "m"})
         stat_logger.last_local_log = 999.0
         stat_logger.log(_stats(metrics_mod, now=1000.0))
-        assert [ln for ln in lines if "Avg prompt throughput" in ln]
+        assert [ln for ln in lines if "Avg prefill throughput" in ln]
         assert not [ln for ln in lines if "Request SLO" in ln]
     finally:
         if metrics_mod._PROMETHEUS:
@@ -209,6 +210,106 @@ def test_device_telemetry_without_prometheus(monkeypatch):
         restored = importlib.reload(devtel_mod)
         assert restored._PROMETHEUS is True
         restored._DeviceMetrics.reset_for_testing()
+
+
+def test_statlogger_line_splits_throughput_and_adds_efficiency(
+        monkeypatch):
+    """The periodic line reports prefill/decode tok/s from the
+    efficiency tracker's real-token counters, plus pad%% and MFU (n/a
+    until a FLOPs model + peak are configured)."""
+    from intellillm_tpu.obs.efficiency import get_efficiency_tracker
+    slo_mod.get_slo_tracker().reset_for_testing()
+    eff = get_efficiency_tracker()
+    eff.reset_for_testing()
+    eff.record_dispatch("prefill", 3, 4, real_tokens=30, padded_tokens=64,
+                        len_real=10, len_padded=16)
+    eff.record_dispatch("decode", 6, 8, real_tokens=6, padded_tokens=8,
+                        width_real=3, width_padded=16)
+    lines = []
+    monkeypatch.setattr(metrics_mod.logger, "info",
+                        lambda msg, *args: lines.append(msg % args))
+    try:
+        stat_logger = metrics_mod.StatLogger(local_interval=0.0,
+                                             labels={"model_name": "m"})
+        stat_logger.last_local_log = 999.0
+        stat_logger.log(_stats(metrics_mod, now=1000.0))
+        tline = [ln for ln in lines if "Avg prefill throughput" in ln]
+        assert tline, lines
+        line = tline[0]
+        # Interval spans exactly 1 s, so the tracker's real-token deltas
+        # are the rates verbatim.
+        assert "Avg prefill throughput: 30.0 tok/s" in line
+        assert "Avg decode throughput: 6.0 tok/s" in line
+        # pad = (64-30) + (8-6) = 36 of 72 total tokens.
+        assert "pad: 50.0%" in line
+        assert "MFU: n/a" in line
+    finally:
+        eff.reset_for_testing()
+        if metrics_mod._PROMETHEUS:
+            metrics_mod._Metrics.reset_for_testing()
+
+
+def test_statlogger_falls_back_without_tracker_data(monkeypatch):
+    """Synthetic Stats with an empty efficiency tracker (disabled, or
+    unit tests): the split falls back to the engine-side accumulators
+    and pad%% reads n/a instead of a bogus 0."""
+    from intellillm_tpu.obs.efficiency import get_efficiency_tracker
+    slo_mod.get_slo_tracker().reset_for_testing()
+    eff = get_efficiency_tracker()
+    eff.reset_for_testing()
+    lines = []
+    monkeypatch.setattr(metrics_mod.logger, "info",
+                        lambda msg, *args: lines.append(msg % args))
+    try:
+        stat_logger = metrics_mod.StatLogger(local_interval=0.0,
+                                             labels={"model_name": "m"})
+        stat_logger.last_local_log = 999.0
+        stat_logger.log(_stats(metrics_mod, now=1000.0))
+        line = [ln for ln in lines if "Avg prefill throughput" in ln][0]
+        assert "Avg prefill throughput: 16.0 tok/s" in line
+        assert "Avg decode throughput: 4.0 tok/s" in line
+        assert "pad: n/a" in line
+    finally:
+        eff.reset_for_testing()
+        if metrics_mod._PROMETHEUS:
+            metrics_mod._Metrics.reset_for_testing()
+
+
+def test_efficiency_without_prometheus(monkeypatch):
+    """Every efficiency path — dispatch accounting, warm-up exclusion,
+    MFU roll-up, snapshot — must work with prometheus_client absent
+    (the plain-dict ledger backs /debug/efficiency and serve_bench)."""
+    eff_mod._EfficiencyMetrics.reset_for_testing()
+    monkeypatch.setitem(sys.modules, "prometheus_client", None)
+    try:
+        reloaded = importlib.reload(eff_mod)
+        assert reloaded._PROMETHEUS is False
+
+        t = reloaded.EfficiencyTracker(enabled=True)
+        assert t._metrics is None
+        t.record_dispatch("prefill", 3, 4, real_tokens=30,
+                          padded_tokens=64, len_real=10, len_padded=16)
+        t.record_dispatch("decode", 6, 8, real_tokens=6, padded_tokens=8,
+                          width_real=3, width_padded=16)
+        with t.warmup():
+            t.record_dispatch("decode", 1, 8, real_tokens=1,
+                              padded_tokens=8)
+        t.record_step(0.01)              # must not raise
+        snap = t.snapshot()
+        assert snap["tokens_total"]["prefill"] == {"real": 30, "pad": 34}
+        assert snap["tokens_total"]["decode"] == {"real": 6, "pad": 2}
+        assert snap["warmup_excluded_dispatches"] == 1
+        assert snap["fill_ratio_avg"]["prefill"]["batch"] == \
+            pytest.approx(0.75)
+        assert snap["fill_ratio_avg"]["decode"]["block_width"] == \
+            pytest.approx(3 / 16)
+        assert snap["top_waste"]
+        assert snap["mfu"] is None       # no FLOPs model / peak known
+    finally:
+        monkeypatch.undo()
+        restored = importlib.reload(eff_mod)
+        assert restored._PROMETHEUS is True
+        restored._EfficiencyMetrics.reset_for_testing()
 
 
 def test_spec_acceptance_rate_optional():

@@ -1,0 +1,64 @@
+"""Unit tests for the bucket ladders every dispatch shape flows
+through (utils.pad_to_bucket / default_batch_buckets /
+default_len_buckets) — the padding source the efficiency ledger
+(obs/efficiency.py) accounts against."""
+import pytest
+
+from intellillm_tpu.utils import (default_batch_buckets,
+                                  default_len_buckets, pad_to_bucket)
+
+
+def test_pad_to_bucket_picks_smallest_cover():
+    buckets = [1, 2, 4, 8, 16]
+    assert pad_to_bucket(1, buckets) == 1
+    assert pad_to_bucket(3, buckets) == 4
+    assert pad_to_bucket(4, buckets) == 4
+    assert pad_to_bucket(9, buckets) == 16
+    assert pad_to_bucket(16, buckets) == 16
+
+
+def test_pad_to_bucket_overflow_clamps_to_top_bucket():
+    # Callers bound x by max_num_seqs / max_model_len upstream; the
+    # function itself must stay total rather than raise.
+    assert pad_to_bucket(99, [1, 2, 4, 8, 16]) == 16
+
+
+def test_pad_to_bucket_zero_maps_to_first_bucket():
+    assert pad_to_bucket(0, [1, 2, 4]) == 1
+
+
+@pytest.mark.parametrize("max_num_seqs", [1, 2, 3, 8, 96, 100, 256])
+def test_default_batch_buckets_shape(max_num_seqs):
+    buckets = default_batch_buckets(max_num_seqs)
+    assert buckets, "bucket ladder must never be empty"
+    assert buckets == sorted(set(buckets)), "strictly ascending"
+    assert buckets[0] >= 1
+    # Top bucket covers the configured maximum exactly: every legal
+    # batch pads to some bucket, and no bucket exceeds max_num_seqs.
+    assert buckets[-1] == max_num_seqs
+    for b in range(1, max_num_seqs + 1):
+        assert b <= pad_to_bucket(b, buckets) <= max_num_seqs
+
+
+@pytest.mark.parametrize("max_len", [16, 17, 128, 512, 2048, 4096])
+def test_default_len_buckets_shape(max_len):
+    buckets = default_len_buckets(max_len)
+    assert buckets
+    assert buckets == sorted(set(buckets))
+    assert buckets[0] >= 1
+    assert buckets[-1] == max_len
+    for length in (1, max_len // 2 or 1, max_len):
+        assert length <= pad_to_bucket(length, buckets) <= max_len
+
+
+def test_default_len_buckets_respects_start():
+    assert default_len_buckets(128, start=32) == [32, 64, 128]
+    # start >= max_len degenerates to the single max bucket.
+    assert default_len_buckets(16, start=16) == [16]
+    assert default_len_buckets(8, start=16) == [8]
+
+
+def test_batch_buckets_are_powers_of_two_plus_max():
+    assert default_batch_buckets(96) == [1, 2, 4, 8, 16, 32, 64, 96]
+    assert default_batch_buckets(64) == [1, 2, 4, 8, 16, 32, 64]
+    assert default_batch_buckets(1) == [1]
