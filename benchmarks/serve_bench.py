@@ -53,6 +53,11 @@ def launch_server(model_dir: str, args) -> subprocess.Popen:
         cmd += ["--quantization", args.quantization]
     if args.num_device_blocks:
         cmd += ["--num-device-blocks-override", str(args.num_device_blocks)]
+    if args.enable_chunked_prefill:
+        cmd += ["--enable-chunked-prefill"]
+    if args.max_num_batched_tokens:
+        cmd += ["--max-num-batched-tokens",
+                str(args.max_num_batched_tokens)]
     env = dict(os.environ)
     env.setdefault("HF_HUB_OFFLINE", "1")
     # Server logs go to a file, not an undrained pipe (a full pipe buffer
@@ -172,6 +177,83 @@ def wait_healthy(proc: subprocess.Popen, base: str, timeout: float,
     raise TimeoutError(f"server not healthy after {timeout:.0f}s")
 
 
+async def _ttft_under_load(api_url: str, model_name: str, background,
+                           probe, probe_delay: float):
+    """Steady decode stream + one long-prompt probe injected mid-run.
+
+    The background requests all start at once (short prompts, long
+    outputs) so the engine is in pure decode when the probe's long
+    prefill arrives. With legacy homogeneous scheduling that prefill
+    monopolizes whole steps — decode TPOT spikes and the probe still
+    waits behind the running batch; with chunked prefill the prompt
+    rides the per-step slack. Returns (elapsed_s, bg_results,
+    probe_results)."""
+    import aiohttp
+
+    from benchmarks.benchmark_serving import send_request
+
+    bg_results, probe_results = [], []
+    conn = aiohttp.TCPConnector(limit=0)
+    timeout = aiohttp.ClientTimeout(total=6 * 3600)
+    start = time.perf_counter()
+    async with aiohttp.ClientSession(connector=conn,
+                                     timeout=timeout) as session:
+        bg_tasks = [
+            asyncio.create_task(send_request(
+                session, "openai", api_url, model_name, prompt,
+                prompt_len, output_len, 1, bg_results))
+            for prompt, prompt_len, output_len in background
+        ]
+        await asyncio.sleep(probe_delay)
+        prompt, prompt_len, output_len = probe
+        await send_request(session, "openai", api_url, model_name, prompt,
+                           prompt_len, output_len, 1, probe_results)
+        await asyncio.gather(*bg_tasks)
+    return time.perf_counter() - start, bg_results, probe_results
+
+
+def run_ttft_under_load(args, api_url: str, model_name: str, tokenizer,
+                        requests) -> dict:
+    """The ttft-under-load scenario: report the probe's TTFT next to the
+    background stream's P99 TPOT — the pair of numbers chunked prefill
+    trades against each other."""
+    import copy
+
+    probe_args = copy.copy(args)
+    probe_args.num_prompts = 1
+    probe_args.input_len = (args.probe_input_len
+                            or max(args.input_len,
+                                   args.max_model_len
+                                   - args.probe_output_len - 1))
+    probe_args.output_len = args.probe_output_len
+    probe_args.seed = args.seed + 1
+    (probe,) = build_requests(probe_args, tokenizer)
+
+    # Warm the probe-shaped prefill program so the measured TTFT is
+    # scheduling delay, not a first-compile stall.
+    asyncio.run(run_benchmark("openai", api_url, model_name, [probe],
+                              float("inf")))
+
+    elapsed, bg_results, probe_results = asyncio.run(_ttft_under_load(
+        api_url, model_name, requests, probe, args.probe_delay))
+    bg = compute_metrics(bg_results, elapsed)
+    (pr,) = probe_results
+    return {
+        "scenario": "ttft-under-load",
+        "chunked_prefill": bool(args.enable_chunked_prefill),
+        "max_num_batched_tokens": args.max_num_batched_tokens,
+        "probe_input_len": probe[1],
+        "probe_output_len": probe[2],
+        "probe_delay_s": args.probe_delay,
+        "probe_ttft_ms": round(pr.ttft * 1e3, 1),
+        "probe_latency_s": round(pr.latency, 3),
+        "background_completed": bg["completed"],
+        "background_tpot_p99_ms": bg["tpot_percentiles_ms"]["p99"],
+        "background_ttft_p99_ms": bg["ttft_percentiles_ms"]["p99"],
+        "background": bg,
+    }
+
+
 def main(args) -> dict:
     from transformers import AutoTokenizer
 
@@ -226,15 +308,22 @@ def main(args) -> dict:
             warm[:max(4, min(args.max_num_seqs, len(warm)))],
             float("inf")))
 
-        for rate_s in args.rates.split(","):
-            rate = float(rate_s)
-            elapsed, results = asyncio.run(run_benchmark(
-                "openai", api_url, model_name, requests, rate))
-            m = compute_metrics(results, elapsed)
-            m["request_rate"] = rate_s
+        if args.scenario == "ttft-under-load":
+            m = run_ttft_under_load(args, api_url, model_name, tokenizer,
+                                    requests)
             summary["results"].append(m)
-            print(json.dumps({"serve_bench_rate": rate_s, **m}),
+            print(json.dumps({"serve_bench_ttft_under_load": m}),
                   flush=True)
+        else:
+            for rate_s in args.rates.split(","):
+                rate = float(rate_s)
+                elapsed, results = asyncio.run(run_benchmark(
+                    "openai", api_url, model_name, requests, rate))
+                m = compute_metrics(results, elapsed)
+                m["request_rate"] = rate_s
+                summary["results"].append(m)
+                print(json.dumps({"serve_bench_rate": rate_s, **m}),
+                      flush=True)
         summary["observability"] = snapshot_observability(base)
         detail = snapshot_health_detail(base)
         summary["slo"] = detail.get("slo") or {}
@@ -273,6 +362,28 @@ def make_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--init-timeout", type=float, default=1800.0)
     p.add_argument("--server-log", type=str,
                    default="/tmp/serve_bench_server.log")
+    p.add_argument("--scenario", type=str, default="rate-sweep",
+                   choices=["rate-sweep", "ttft-under-load"],
+                   help="rate-sweep: Poisson sweep over --rates (the "
+                        "default). ttft-under-load: start --num-prompts "
+                        "short-prompt requests at once (steady decode "
+                        "stream), inject one long-prompt probe after "
+                        "--probe-delay, and report the probe's TTFT plus "
+                        "the stream's P99 TPOT — the interference pair "
+                        "chunked prefill is designed to improve.")
+    p.add_argument("--probe-input-len", type=int, default=None,
+                   help="probe prompt length for ttft-under-load "
+                        "(default: max-model-len - probe-output-len - 1)")
+    p.add_argument("--probe-output-len", type=int, default=16)
+    p.add_argument("--probe-delay", type=float, default=2.0,
+                   help="seconds after the background burst before the "
+                        "probe is sent")
+    p.add_argument("--enable-chunked-prefill", action="store_true",
+                   help="pass --enable-chunked-prefill to the server")
+    p.add_argument("--max-num-batched-tokens", type=int, default=None,
+                   help="pass --max-num-batched-tokens to the server "
+                        "(per-step token budget; with chunked prefill "
+                        "this caps mixed-step compute)")
     return p
 
 

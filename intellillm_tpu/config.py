@@ -285,9 +285,18 @@ class SchedulerConfig:
         max_paddings: int = 256,
         policy: str = "fcfs",
         num_decode_steps: int = 8,
+        enable_chunked_prefill: bool = False,
     ) -> None:
+        self.enable_chunked_prefill = enable_chunked_prefill
         if max_num_batched_tokens is not None:
             self.max_num_batched_tokens = max_num_batched_tokens
+        elif enable_chunked_prefill:
+            # Chunked mode: the budget is a per-step compute knob, not a
+            # prompt-length ceiling (prompts longer than the budget are
+            # split into chunks). Default to a batch that keeps decode
+            # latency low while still amortizing weight reads
+            # (Sarathi-Serve picks 256-512 on A100-class parts).
+            self.max_num_batched_tokens = max(512, max_num_seqs)
         else:
             self.max_num_batched_tokens = max(max_model_len, 2048)
         self.max_num_seqs = max_num_seqs
@@ -303,10 +312,13 @@ class SchedulerConfig:
         self._verify_args()
 
     def _verify_args(self) -> None:
-        if self.max_num_batched_tokens < self.max_model_len:
+        if (self.max_num_batched_tokens < self.max_model_len
+                and not self.enable_chunked_prefill):
             raise ValueError(
                 f"max_num_batched_tokens ({self.max_num_batched_tokens}) must "
-                f"be >= max_model_len ({self.max_model_len}).")
+                f"be >= max_model_len ({self.max_model_len}). Enable chunked "
+                "prefill (--enable-chunked-prefill) to use a per-step token "
+                "budget smaller than the longest admissible prompt.")
         if self.max_num_batched_tokens < self.max_num_seqs:
             raise ValueError(
                 "max_num_batched_tokens must be >= max_num_seqs")

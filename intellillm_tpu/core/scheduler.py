@@ -37,6 +37,8 @@ from intellillm_tpu.obs import (get_flight_recorder, get_slo_tracker,
 from intellillm_tpu.prefix import PrefixPool
 from intellillm_tpu.sequence import (Sequence, SequenceData, SequenceGroup,
                                      SequenceGroupMetadata, SequenceStatus)
+from intellillm_tpu.utils import (default_batch_buckets, default_len_buckets,
+                                  pad_to_bucket)
 
 logger = init_logger(__name__)
 
@@ -62,6 +64,9 @@ class SchedulerOutputs:
         blocks_to_copy: Dict[int, List[int]],
         ignored_seq_groups: List[SequenceGroup],
         num_decode_steps: int = 1,
+        chunked_prefills: Optional[Dict[str, Tuple[int, int, bool]]] = None,
+        num_prefill_tokens: int = 0,
+        num_mixed_decode_tokens: int = 0,
     ) -> None:
         self.scheduled_seq_groups = scheduled_seq_groups
         self.prompt_run = prompt_run
@@ -72,7 +77,18 @@ class SchedulerOutputs:
         self.ignored_seq_groups = ignored_seq_groups
         # Fused decode iterations this batch (slots already reserved).
         self.num_decode_steps = num_decode_steps
+        # Mixed (chunked-prefill) step bookkeeping: request_id ->
+        # (start, chunk_size, is_final_chunk) for every group running a
+        # prefill chunk this step. None on homogeneous steps. The token
+        # split feeds per-phase stats/telemetry (no double counting).
+        self.chunked_prefills = chunked_prefills
+        self.num_prefill_tokens = num_prefill_tokens
+        self.num_mixed_decode_tokens = num_mixed_decode_tokens
         assert not (blocks_to_swap_in and blocks_to_swap_out)
+
+    @property
+    def is_mixed(self) -> bool:
+        return self.chunked_prefills is not None
 
     def is_empty(self) -> bool:
         return (not self.scheduled_seq_groups and not self.blocks_to_swap_in
@@ -91,8 +107,30 @@ class Scheduler:
         self.cache_config = cache_config
         self.lora_config = lora_config
 
-        self.prompt_limit = min(scheduler_config.max_model_len,
-                                scheduler_config.max_num_batched_tokens)
+        if scheduler_config.enable_chunked_prefill:
+            # Chunked mode: the token budget caps per-step compute, not
+            # prompt length — prompts longer than the budget are split.
+            self.prompt_limit = scheduler_config.max_model_len
+            # Non-chunkable prompts (beam / best_of>1 / prompt_logprobs /
+            # prefix) still prefill homogeneously; give that fallback a
+            # budget that can hold any admissible prompt.
+            self._prefill_token_budget = max(
+                scheduler_config.max_num_batched_tokens,
+                scheduler_config.max_model_len)
+        else:
+            self.prompt_limit = min(scheduler_config.max_model_len,
+                                    scheduler_config.max_num_batched_tokens)
+            self._prefill_token_budget = (
+                scheduler_config.max_num_batched_tokens)
+
+        # Bucketed-shape mirrors of the runner's padding (utils
+        # default_*_buckets — the runner builds its buckets from the same
+        # helpers), so max_paddings is charged against the shape the
+        # device actually runs, not the raw longest-prompt delta.
+        self._batch_buckets = default_batch_buckets(
+            scheduler_config.max_num_seqs)
+        self._len_buckets = default_len_buckets(
+            scheduler_config.max_model_len)
 
         self.policy: Policy = PolicyFactory.get_policy(scheduler_config.policy)
         self.block_manager = BlockSpaceManager(
@@ -186,6 +224,16 @@ class Scheduler:
 
         now = time.monotonic()
 
+        # Chunked prefill: decode-first mixed steps whenever the state
+        # allows them. A None return means the mixed path does not apply
+        # right now (e.g. only non-chunkable prompts waiting) and the
+        # legacy homogeneous pass below should run instead.
+        if (self.scheduler_config.enable_chunked_prefill
+                and not prefill_only):
+            mixed = self._schedule_chunked(now)
+            if mixed is not None:
+                return mixed
+
         # Prefill-first: admit waiting prompts while nothing is swapped out
         # (swapped groups have priority — they were already admitted once).
         if not self.swapped:
@@ -247,7 +295,7 @@ class Scheduler:
                 # reference scheduler.py:230-245).
                 new_seq_lens = seq_lens + [num_prompt_tokens]
                 num_batched_tokens = len(new_seq_lens) * max(new_seq_lens)
-                if num_batched_tokens > self.scheduler_config.max_num_batched_tokens:
+                if num_batched_tokens > self._prefill_token_budget:
                     break
 
                 num_new_seqs = seq_group.get_max_num_running_seqs()
@@ -255,8 +303,16 @@ class Scheduler:
                         > self.scheduler_config.max_num_seqs):
                     break
 
-                num_paddings = num_batched_tokens - sum(new_seq_lens)
-                if num_paddings > self.scheduler_config.max_paddings:
+                # Padding waste counted against the *bucketed* shape the
+                # runner actually pads to (batch bucket x length bucket),
+                # not the raw longest-prompt delta. A lone prompt is always
+                # admitted: its bucket padding is intrinsic — no admission
+                # decision can shrink it.
+                num_paddings = (
+                    pad_to_bucket(len(new_seq_lens), self._batch_buckets)
+                    * pad_to_bucket(max(new_seq_lens), self._len_buckets)
+                    - sum(new_seq_lens))
+                if seq_lens and num_paddings > self.scheduler_config.max_paddings:
                     break
                 seq_lens = new_seq_lens
 
@@ -391,6 +447,270 @@ class Scheduler:
             num_decode_steps=num_steps,
         )
 
+    # --- chunked prefill (mixed decode+prefill steps) ---------------------
+
+    @staticmethod
+    def _mixed_safe(seq_group: SequenceGroup) -> bool:
+        """Whether this group can decode inside a mixed flat batch: one
+        row per live stream, no host work between rows. Beam search and
+        best_of fan-out need the homogeneous multi-sample panels;
+        logits_processors need host round-trips."""
+        sp = seq_group.sampling_params
+        return (not sp.use_beam_search and sp.best_of == 1
+                and not sp.logits_processors)
+
+    @staticmethod
+    def _chunkable(seq_group: SequenceGroup) -> bool:
+        """Whether this prompt may be split into chunks. On top of
+        mixed-safety: prompt_logprobs needs the full-prompt logits panel
+        and prefix caching keys its reuse off whole-prompt prefills, so
+        both keep the legacy homogeneous path."""
+        return (Scheduler._mixed_safe(seq_group)
+                and seq_group.sampling_params.prompt_logprobs is None
+                and seq_group.prefix is None)
+
+    @staticmethod
+    def _is_prefilling(seq_group: SequenceGroup) -> bool:
+        return any(not s.data.prefill_complete
+                   for s in seq_group.get_unfinished_seqs())
+
+    def _schedule_chunked(self, now: float) -> Optional[SchedulerOutputs]:
+        """Decide whether this step should be a mixed (decode-first) step.
+
+        Invariant: once any admitted sequence is mid-prefill, every step
+        MUST go through the chunked pass until all prefills drain — the
+        legacy decode pass would treat a partially-prefilled sequence as a
+        decode row over garbage KV. The chunked pass maintains the
+        invariant by only *starting* chunked prefills from a state where
+        all resident groups are mixed-safe and nothing is swapped out, and
+        by admitting only chunkable prompts while prefilling.
+        """
+        prefilling = any(
+            self._is_prefilling(sg)
+            for sg in list(self.running) + list(self.swapped))
+        if prefilling:
+            return self._chunked_pass(now)
+
+        # Not currently prefilling: only enter the mixed path when it can
+        # actually start a new chunked prefill this step — otherwise the
+        # legacy pass is strictly better (fused multi-step decode).
+        if self.swapped or not self.waiting:
+            return None
+        if any(not self._mixed_safe(sg) for sg in self.running):
+            return None
+        if self.scheduler_config.policy != "fcfs":
+            self.waiting = deque(
+                self.policy.sort_by_priority(now, self.waiting))
+        head = self.waiting[0]
+        if not self._chunkable(head):
+            return None
+        head_seqs = head.get_seqs(status=SequenceStatus.WAITING)
+        if (len(head_seqs) != 1
+                or head_seqs[0].get_len() > self.prompt_limit):
+            return None  # legacy pass owns the ignore/warn bookkeeping
+        if self.block_manager.can_allocate(head) != AllocStatus.OK:
+            return None
+        num_curr_seqs = sum(sg.get_max_num_running_seqs()
+                            for sg in self.running)
+        if num_curr_seqs + 1 > self.scheduler_config.max_num_seqs:
+            return None
+        if self._lora_cap_exceeded(self._running_loras(),
+                                   head.lora_int_id):
+            return None
+        decode_rows = sum(sg.num_seqs(status=SequenceStatus.RUNNING)
+                          for sg in self.running)
+        if decode_rows >= self.scheduler_config.max_num_batched_tokens:
+            return None  # no slack for even a 1-token chunk
+        return self._chunked_pass(now)
+
+    def _chunked_pass(self, now: float) -> SchedulerOutputs:
+        """One mixed step: admit every runnable decode first (preempting
+        as needed), then spend the remaining token-budget slack on prefill
+        chunks — continuing in-flight chunked prefills before admitting
+        new prompts (Sarathi-Serve style decode-maximal batching)."""
+        blocks_to_swap_in: Dict[int, int] = {}
+        blocks_to_swap_out: Dict[int, int] = {}
+        blocks_to_copy: Dict[int, List[int]] = {}
+        ignored_seq_groups: List[SequenceGroup] = []
+        budget = self.scheduler_config.max_num_batched_tokens
+        chunks: Dict[str, Tuple[int, int, bool]] = {}
+
+        # Pass 1: decodes. Mid-prefill groups pass straight through — their
+        # prompt blocks were fully allocated at admission, and they emit no
+        # token this step, so no slot growth either.
+        self.running = deque(self.policy.sort_by_priority(now, self.running))
+        running: Deque[SequenceGroup] = deque()
+        decode_groups: List[SequenceGroup] = []
+        prefilling_groups: List[SequenceGroup] = []
+        preempted: List[SequenceGroup] = []
+        decode_rows = 0
+        while self.running:
+            seq_group = self.running.popleft()
+            if self._is_prefilling(seq_group):
+                prefilling_groups.append(seq_group)
+                running.append(seq_group)
+                continue
+            while not self.block_manager.can_append_slots(seq_group, 1):
+                if self.running:
+                    victim = self.running.pop()  # lowest priority
+                    self._preempt(victim, blocks_to_swap_out)
+                    preempted.append(victim)
+                else:
+                    self._preempt(seq_group, blocks_to_swap_out)
+                    preempted.append(seq_group)
+                    break
+            else:
+                self._append_slots(seq_group, 1, blocks_to_copy)
+                running.append(seq_group)
+                decode_groups.append(seq_group)
+                decode_rows += seq_group.num_seqs(
+                    status=SequenceStatus.RUNNING)
+        self.running = running
+        # A preempted victim may have been mid-prefill; drop stale entries.
+        prefilling_groups = [sg for sg in prefilling_groups
+                             if sg in self.running]
+
+        # Pass 2: swap-in (decode-ready groups join the batch, mid-prefill
+        # groups resume chunking where their KV left off).
+        self.swapped = deque(self.policy.sort_by_priority(now, self.swapped))
+        if not preempted:
+            num_curr_seqs = sum(sg.get_max_num_running_seqs()
+                                for sg in self.running)
+            curr_loras = self._running_loras()
+            lora_deferred_swap: List[SequenceGroup] = []
+            while self.swapped:
+                seq_group = self.swapped[0]
+                if not self.block_manager.can_swap_in(seq_group, 1):
+                    break
+                lora_id = seq_group.lora_int_id
+                if self._lora_cap_exceeded(curr_loras, lora_id):
+                    self.swapped.popleft()
+                    lora_deferred_swap.append(seq_group)
+                    continue
+                num_new_seqs = seq_group.get_max_num_running_seqs()
+                if (num_curr_seqs + num_new_seqs
+                        > self.scheduler_config.max_num_seqs):
+                    break
+                self.swapped.popleft()
+                self._swap_in(seq_group, blocks_to_swap_in)
+                if self._is_prefilling(seq_group):
+                    prefilling_groups.append(seq_group)
+                else:
+                    self._append_slots(seq_group, 1, blocks_to_copy)
+                    decode_groups.append(seq_group)
+                    decode_rows += seq_group.num_seqs(
+                        status=SequenceStatus.RUNNING)
+                num_curr_seqs += num_new_seqs
+                if curr_loras is not None and lora_id > 0:
+                    curr_loras.add(lora_id)
+                self.running.append(seq_group)
+            for sg in reversed(lora_deferred_swap):
+                self.swapped.appendleft(sg)
+
+        # Pass 3: spend the slack on prefill chunks — in-flight first.
+        slack = budget - decode_rows
+        chunk_groups: List[SequenceGroup] = []
+        for seq_group in prefilling_groups:
+            if slack <= 0:
+                break
+            seq = seq_group.get_seqs(status=SequenceStatus.RUNNING)[0]
+            remaining = seq.data.get_num_uncomputed_tokens()
+            size = min(remaining, slack)
+            start = seq.data.get_num_computed_tokens()
+            final = size == remaining
+            seq.data.update_num_computed_tokens(size)
+            if final:
+                seq.data.mark_prefill_complete()
+            chunks[seq_group.request_id] = (start, size, final)
+            chunk_groups.append(seq_group)
+            slack -= size
+
+        # Pass 4: admit new chunkable prompts into whatever slack is left.
+        # Same gating as the legacy prefill pass (swapped groups keep
+        # priority; a preempting step admits nothing new).
+        if not preempted and not self.swapped:
+            num_curr_seqs = sum(sg.get_max_num_running_seqs()
+                                for sg in self.running)
+            curr_loras = self._running_loras()
+            lora_deferred: List[SequenceGroup] = []
+            while self.waiting and slack > 0:
+                seq_group = self.waiting[0]
+                if not self._chunkable(seq_group):
+                    break  # keeps policy order; legacy pass admits it later
+                waiting_seqs = seq_group.get_seqs(
+                    status=SequenceStatus.WAITING)
+                assert len(waiting_seqs) == 1, (
+                    "Waiting sequence group should have only one prompt "
+                    "sequence.")
+                num_prompt_tokens = waiting_seqs[0].get_len()
+                if num_prompt_tokens > self.prompt_limit:
+                    logger.warning(
+                        "Input prompt (%d tokens) is too long and exceeds "
+                        "limit of %d", num_prompt_tokens, self.prompt_limit)
+                    for seq in waiting_seqs:
+                        seq.status = SequenceStatus.FINISHED_IGNORED
+                    ignored_seq_groups.append(seq_group)
+                    self.waiting.popleft()
+                    continue
+                can_allocate = self.block_manager.can_allocate(seq_group)
+                if can_allocate == AllocStatus.LATER:
+                    break
+                if can_allocate == AllocStatus.NEVER:
+                    logger.warning(
+                        "Input prompt (%d tokens) cannot be allocated even "
+                        "with an empty KV cache; ignoring.",
+                        num_prompt_tokens)
+                    for seq in waiting_seqs:
+                        seq.status = SequenceStatus.FINISHED_IGNORED
+                    ignored_seq_groups.append(seq_group)
+                    self.waiting.popleft()
+                    continue
+                lora_id = seq_group.lora_int_id
+                if self._lora_cap_exceeded(curr_loras, lora_id):
+                    self.waiting.popleft()
+                    lora_deferred.append(seq_group)
+                    continue
+                if num_curr_seqs + 1 > self.scheduler_config.max_num_seqs:
+                    break
+                self.waiting.popleft()
+                self._allocate(seq_group, mark_prefilled=False)
+                seq = seq_group.get_seqs(status=SequenceStatus.RUNNING)[0]
+                size = min(num_prompt_tokens, slack)
+                final = size == num_prompt_tokens
+                seq.data.update_num_computed_tokens(size)
+                if final:
+                    seq.data.mark_prefill_complete()
+                chunks[seq_group.request_id] = (0, size, final)
+                chunk_groups.append(seq_group)
+                slack -= size
+                self.running.append(seq_group)
+                num_curr_seqs += 1
+                if curr_loras is not None and lora_id > 0:
+                    curr_loras.add(lora_id)
+                if seq_group.first_scheduled_time is None:
+                    seq_group.first_scheduled_time = now
+                    self._flight.record(seq_group.request_id, "scheduled")
+                self._flight.record(
+                    seq_group.request_id, "prefill_start",
+                    detail=f"tokens={num_prompt_tokens},chunked=1")
+            for sg in reversed(lora_deferred):
+                self.waiting.appendleft(sg)
+
+        num_prefill_tokens = sum(size for _, size, _ in chunks.values())
+        return SchedulerOutputs(
+            scheduled_seq_groups=decode_groups + chunk_groups,
+            prompt_run=False,
+            num_batched_tokens=decode_rows + num_prefill_tokens,
+            blocks_to_swap_in=blocks_to_swap_in,
+            blocks_to_swap_out=blocks_to_swap_out,
+            blocks_to_copy=blocks_to_copy,
+            ignored_seq_groups=ignored_seq_groups,
+            num_decode_steps=1,
+            chunked_prefills=chunks,
+            num_prefill_tokens=num_prefill_tokens,
+            num_mixed_decode_tokens=decode_rows,
+        )
+
     def schedule(
         self, prefill_only: bool = False,
     ) -> Tuple[List[SequenceGroupMetadata], SchedulerOutputs]:
@@ -405,15 +725,24 @@ class Scheduler:
                     seq_data[seq.seq_id] = seq.data
                     block_tables[seq.seq_id] = (
                         self.block_manager.get_block_table(seq))
+                chunk = None
+                if scheduler_outputs.chunked_prefills:
+                    chunk = scheduler_outputs.chunked_prefills.get(
+                        seq_group.request_id)
                 seq_group_metadata_list.append(
                     SequenceGroupMetadata(
                         request_id=seq_group.request_id,
-                        is_prompt=scheduler_outputs.prompt_run,
+                        is_prompt=(True if chunk is not None
+                                   else scheduler_outputs.prompt_run),
                         seq_data=seq_data,
                         sampling_params=seq_group.sampling_params,
                         block_tables=block_tables,
                         lora_request=seq_group.lora_request,
                         prefix=seq_group.prefix,
+                        token_chunk_size=(chunk[1] if chunk is not None
+                                          else None),
+                        num_computed_tokens=(chunk[0] if chunk is not None
+                                             else 0),
                     ))
         return seq_group_metadata_list, scheduler_outputs
 
@@ -449,15 +778,24 @@ class Scheduler:
     def can_continue_decode(self) -> bool:
         """Whether the current decode batch may be extended in place (same
         rows, host state lagging) without a fresh scheduling pass: nothing
-        waiting for admission, nothing swapped out awaiting swap-in."""
-        return not self.waiting and not self.swapped
+        waiting for admission, nothing swapped out awaiting swap-in.
+        Chunked mode never extends in place — mixed steps are scheduled
+        one at a time (the engine disables pipelining with chunked
+        prefill anyway; this is defense in depth)."""
+        return (not self.waiting and not self.swapped
+                and not self.scheduler_config.enable_chunked_prefill)
 
     # --- internals -------------------------------------------------------
 
-    def _allocate(self, seq_group: SequenceGroup) -> None:
+    def _allocate(self, seq_group: SequenceGroup,
+                  mark_prefilled: bool = True) -> None:
         self.block_manager.allocate(seq_group)
         for seq in seq_group.get_seqs(status=SequenceStatus.WAITING):
             seq.status = SequenceStatus.RUNNING
+            if mark_prefilled:
+                # Homogeneous admission computes the whole history this
+                # step; chunked admission advances per chunk instead.
+                seq.data.mark_prefill_complete()
 
     def _clamped_steps(self, seq_group: SequenceGroup,
                        num_steps: int) -> int:
@@ -522,6 +860,9 @@ class Scheduler:
             assert self._free_guard.get(seq.seq_id, 0) == 0, (
                 "preempt-by-recompute hit a pipeline-guarded sequence")
             seq.status = SequenceStatus.WAITING
+            # All KV pages are discarded — chunked-prefill progress resets
+            # with them (re-prefill covers prompt + generated tail).
+            seq.data.reset_num_computed_tokens()
             self.block_manager.free(seq)
         # Highest-priority among waiting: front of the queue.
         self.waiting.appendleft(seq_group)

@@ -39,6 +39,7 @@ class EngineArgs:
     max_paddings: int = 256
     scheduling_policy: str = "fcfs"
     num_decode_steps: int = 8
+    enable_chunked_prefill: bool = False
     # Model
     dtype: str = "auto"
     load_format: str = "auto"
@@ -111,6 +112,14 @@ class EngineArgs:
                             help="fcfs | sjf | sjf_remaining")
         parser.add_argument("--num-decode-steps", type=int, default=8,
                             help="decode iterations fused per device call")
+        parser.add_argument("--enable-chunked-prefill", action="store_true",
+                            help="split long prompts into token-budget-sized "
+                            "chunks and piggyback them onto decode batches "
+                            "(mixed steps); running decodes are admitted "
+                            "first, so a long prompt no longer stalls "
+                            "generation. --max-num-batched-tokens becomes a "
+                            "per-step compute budget (default 512) instead "
+                            "of a prompt-length ceiling")
         parser.add_argument("--dtype", type=str, default="auto",
                             choices=["auto", "bfloat16", "float32", "float16"])
         parser.add_argument("--load-format", type=str, default="auto",
@@ -201,6 +210,7 @@ class EngineArgs:
             max_paddings=self.max_paddings,
             policy=self.scheduling_policy,
             num_decode_steps=self.num_decode_steps,
+            enable_chunked_prefill=self.enable_chunked_prefill,
         )
         lora_config = None
         if self.enable_lora:

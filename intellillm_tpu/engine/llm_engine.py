@@ -120,6 +120,22 @@ class LLMEngine:
                 is not None else "ALiBi")
             scheduler_config.num_decode_steps = 1
 
+        # Chunked prefill constraints, decided HERE (like the K clamp
+        # above) so scheduler and runner agree. Speculative decoding owns
+        # its own dispatch pattern (draft + verify) — mixing chunk rows in
+        # is unsupported. Sliding-window attention needs whole-prompt
+        # prefill (the windowed ring layout is laid down in one pass).
+        if scheduler_config.enable_chunked_prefill:
+            if speculative_config is not None:
+                raise ValueError(
+                    "Chunked prefill (--enable-chunked-prefill) is "
+                    "incompatible with speculative decoding.")
+            if model_config.get_sliding_window() is not None:
+                logger.info(
+                    "Disabling chunked prefill: sliding-window attention "
+                    "requires whole-prompt prefill.")
+                scheduler_config.enable_chunked_prefill = False
+
         # Compute-efficiency ledger (obs/efficiency.py): derive the
         # analytic FLOPs model and this chip's peak FLOPs BEFORE warm-up
         # (inside _init_cache) so its dispatches hit a configured tracker
@@ -166,8 +182,12 @@ class LLMEngine:
         from intellillm_tpu.utils import pipeline_enabled_env
         # Speculative decoding owns its own dispatch pattern (draft +
         # teacher-forced verify per step) — no pipelined continuations.
+        # Chunked prefill schedules every mixed step fresh (chunk sizes
+        # depend on the live decode set), so it is serial too.
         self.pipeline_enabled = (pipeline_enabled_env()
-                                 and speculative_config is None)
+                                 and speculative_config is None
+                                 and not scheduler_config.
+                                 enable_chunked_prefill)
         self._pipeline_depth = max(
             1, int(_os.environ.get("INTELLILLM_PIPELINE_DEPTH", "2")))
         self._inflight: deque = deque()
@@ -969,22 +989,31 @@ class LLMEngine:
         device_used = max(num_total_blocks - num_free, 0) * kv_block_bytes
         cpu_used = max(num_total_cpu - free_cpu, 0) * cpu_block_bytes
 
-        prompt_tokens = (scheduler_outputs.num_batched_tokens
-                         if scheduler_outputs.prompt_run else 0)
         # A decode pass generates num_decode_steps tokens PER ROW
         # (num_batched_tokens counts rows); without the multiplier the
         # throughput log and Prometheus counter under-report by K.
         # Speculative passes emit a VARIABLE count (accepted+1 per row) —
-        # use the worker's actual emission, not K+1.
+        # use the worker's actual emission, not K+1. Mixed
+        # (chunked-prefill) steps split the batch by phase: chunk tokens
+        # count as prompt tokens, decode rows as generation (K=1) — the
+        # per-phase counts come from the scheduler, so nothing is double
+        # counted or misattributed.
         k_eff = scheduler_outputs.num_decode_steps
-        if scheduler_outputs.prompt_run:
+        if scheduler_outputs.is_mixed:
+            prompt_tokens = scheduler_outputs.num_prefill_tokens
+            generation_tokens = scheduler_outputs.num_mixed_decode_tokens
+            k_eff = 1
+        elif scheduler_outputs.prompt_run:
+            prompt_tokens = scheduler_outputs.num_batched_tokens
             generation_tokens = 0
         elif self.speculative_config is not None:
+            prompt_tokens = 0
             generation_tokens = getattr(self.worker, "last_pass_emitted",
                                         scheduler_outputs.num_batched_tokens)
             rows = max(scheduler_outputs.num_batched_tokens, 1)
             k_eff = max(generation_tokens / rows, 1e-6)
         else:
+            prompt_tokens = 0
             generation_tokens = (scheduler_outputs.num_batched_tokens *
                                  scheduler_outputs.num_decode_steps)
 
@@ -992,7 +1021,20 @@ class LLMEngine:
         time_per_output: List[float] = []
         e2e: List[float] = []
         k = max(k_eff, 1e-6)
+        chunks = scheduler_outputs.chunked_prefills or {}
         for sg in scheduler_outputs.scheduled_seq_groups:
+            chunk = chunks.get(sg.request_id)
+            if chunk is not None:
+                # Mid-prefill groups emit no token: TTFT is recorded at
+                # the FINAL chunk (when the first token actually samples)
+                # and last_token_time starts there so the first TPOT
+                # sample doesn't absorb prefill time.
+                if chunk[2]:
+                    time_to_first.append(now - sg.arrival_time)
+                    sg.last_token_time = now
+                if sg.is_finished():
+                    e2e.append(now - sg.arrival_time)
+                continue
             if scheduler_outputs.prompt_run and sg.first_scheduled_time:
                 time_to_first.append(now - sg.arrival_time)
             elif not scheduler_outputs.prompt_run and sg.last_token_time:

@@ -152,6 +152,15 @@ class PagedAttention:
                                         self.scale, self.sliding_window,
                                         self.alibi_slopes)
         else:
+            # This branch also serves CHUNKED-CONTEXT PREFILL (mixed
+            # steps, worker/model_runner._execute_mixed): each prefill
+            # chunk arrives as flat rows with per-token context_lens =
+            # position + 1. Because reshape_and_cache above writes every
+            # row's K/V into the pool BEFORE this read, a chunk-k query at
+            # position p attends to chunks 0..k-1 (already paged in from
+            # earlier steps) plus the in-flight chunk's rows <= p — exact
+            # causal attention per sequence, one block table per row, no
+            # separate chunked kernel needed.
             out = _decode_dispatch(query, k_cache, v_cache,
                                    attn_metadata.block_tables,
                                    attn_metadata.context_lens, self.scale,

@@ -185,6 +185,14 @@ class ModelRunner:
         self.block_width_buckets = default_len_buckets(
             max(max_blocks, _MIN_BLOCK_TABLE_WIDTH),
             start=_MIN_BLOCK_TABLE_WIDTH)
+        # Chunked-prefill mixed steps: decode rows + prefill-chunk rows
+        # flatten into ONE (token_budget,)-bucketed batch, so the shape
+        # zoo collapses to a handful of flat-row executables regardless of
+        # the prompt-length mix.
+        self.mixed_token_buckets = default_len_buckets(
+            max(scheduler_config.max_num_batched_tokens,
+                _MIN_BLOCK_TABLE_WIDTH),
+            start=_MIN_BLOCK_TABLE_WIDTH)
 
         self._jit_prefill = jax.jit(
             self._prefill_fn,
@@ -838,7 +846,23 @@ class ModelRunner:
         if not seq_group_metadata_list:
             return [], kv_caches
 
+        if any(m.token_chunk_size is not None
+               for m in seq_group_metadata_list):
+            assert not defer_fetch, (
+                "mixed chunked-prefill steps cannot be pipelined")
+            assert num_decode_steps == 1, (
+                "mixed chunked-prefill steps are single-step")
+            return self._execute_mixed(seq_group_metadata_list, kv_caches)
+
         is_prompt = seq_group_metadata_list[0].is_prompt
+        if any(m.is_prompt != is_prompt
+               for m in seq_group_metadata_list[1:]):
+            raise ValueError(
+                "seq_group_metadata_list mixes prefill and decode entries "
+                "but carries no chunked-prefill metadata; the homogeneous "
+                "execute path batches a single phase. Schedule mixed "
+                "batches through chunked prefill (--enable-chunked-prefill) "
+                "instead.")
         place = self._place_batch_array
 
         with self._tracer.span("prepare_inputs"):
@@ -1015,6 +1039,160 @@ class ModelRunner:
         if defer_fetch:
             return step, new_caches
         return step.finalize(), new_caches
+
+    def _execute_mixed(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        kv_caches,
+    ) -> Tuple[List[SamplerOutput], Any]:
+        """Chunked-prefill mixed step: decode tokens and prefill-chunk
+        tokens lie in ONE flat (token_budget,)-bucketed batch of the
+        single-step decode program. Each row is one token with its own
+        absolute position, block table, and context_lens = position + 1;
+        the program writes every row's KV to its pool slot BEFORE
+        attention reads, so a chunk token at position p attends to the
+        prompt's earlier chunks (already in the pool) plus the in-flight
+        chunk's earlier rows — exact per-sequence causal attention with no
+        cross-sequence leakage (each row reads only its own block table).
+        Only decode rows and the final chunk's last row emit samples."""
+        assert self.sliding_window is None, (
+            "chunked prefill is disabled for sliding-window models; the "
+            "engine should not have scheduled a mixed step")
+        place = self._place_batch_array
+
+        with self._tracer.span("prepare_inputs"):
+            rows: List[Tuple[str, int]] = []
+            tokens: List[int] = []
+            poss: List[int] = []
+            ctxs: List[int] = []
+            tables: List[List[int]] = []
+            row_params: List[SamplingParams] = []
+            row_seeds: List[int] = []
+            row_tokens: List[Tuple[np.ndarray, np.ndarray]] = []
+            row_loras_src: List[Any] = []
+            # Per metadata entry: the (row, seq_id) pairs that emit a
+            # sample this step (all decode rows; only the LAST row of a
+            # FINAL chunk — mid-prompt rows' samples are meaningless).
+            emit_rows: List[List[Tuple[int, int]]] = []
+            n_chunk_tokens = 0
+            n_chunk_groups = 0
+            n_decode_rows = 0
+
+            for meta in seq_group_metadata_list:
+                sp = meta.sampling_params
+                assert not sp.logits_processors, (
+                    "logits_processors row scheduled into a mixed step")
+                if meta.token_chunk_size is not None:
+                    (seq_id,) = meta.seq_data.keys()
+                    data = meta.seq_data[seq_id]
+                    start = meta.num_computed_tokens
+                    size = meta.token_chunk_size
+                    final = start + size == data.get_len()
+                    all_ids = data.get_token_ids()
+                    table = list(meta.block_tables[seq_id])
+                    # Same (seed, penalty-window) a homogeneous prefill of
+                    # this prompt would use, so the final chunk's sample
+                    # reproduces legacy output exactly.
+                    seed = self._row_seed(seq_id, data.get_output_len())
+                    views = data.token_views()
+                    for j in range(size):
+                        pos = start + j
+                        rows.append((meta.request_id, seq_id))
+                        tokens.append(int(all_ids[pos]))
+                        poss.append(pos)
+                        ctxs.append(pos + 1)
+                        tables.append(table)
+                        row_params.append(sp)
+                        row_seeds.append(seed)
+                        row_tokens.append(views)
+                        row_loras_src.append(meta.lora_request)
+                    n_chunk_tokens += size
+                    n_chunk_groups += 1
+                    emit_rows.append([(len(rows) - 1, seq_id)]
+                                     if final else [])
+                else:
+                    group_rows: List[Tuple[int, int]] = []
+                    for seq_id, data in meta.seq_data.items():
+                        n = data.get_len()
+                        rows.append((meta.request_id, seq_id))
+                        tokens.append(data.get_last_token_id())
+                        poss.append(n - 1)
+                        ctxs.append(n)
+                        tables.append(list(meta.block_tables[seq_id]))
+                        row_params.append(sp)
+                        row_seeds.append(
+                            self._row_seed(seq_id, data.get_output_len()))
+                        row_tokens.append(data.token_views())
+                        row_loras_src.append(meta.lora_request)
+                        group_rows.append((len(rows) - 1, seq_id))
+                        n_decode_rows += 1
+                    emit_rows.append(group_rows)
+
+            padded_n = pad_to_bucket(len(rows), self.mixed_token_buckets)
+            w = pad_to_bucket(max(max(len(t) for t in tables),
+                                  _MIN_BLOCK_TABLE_WIDTH),
+                              self.block_width_buckets)
+            token_ids, positions, context_lens, block_tables = \
+                build_decode_batch(tables, tokens, poss, ctxs, padded_n, w)
+
+            row_loras = (row_loras_src if self.lora_manager is not None
+                         else None)
+            lora_state, eff_vocab = self._activate_lora(row_loras, padded_n)
+            st = SamplingTensors.build(row_params, row_seeds, row_tokens,
+                                       eff_vocab, padded_n)
+            common = dict(
+                logprob_k=st.logprob_k,
+                do_topk=st.do_topk, do_topp=st.do_topp, do_minp=st.do_minp,
+                do_penalties=st.do_penalties, do_random=st.do_random,
+            )
+            sampling_args = self._sampling_args_device(st, padded_n)
+
+        bucket = (padded_n, w, 1, None, lora_state is not None,
+                  tuple(sorted(common.items())))
+        with self._tracer.span("execute"):
+            packed, new_caches = self._guarded_call(
+                "mixed", bucket, self._jit_decode_single,
+                self.params, kv_caches,
+                place(token_ids), place(positions),
+                place(block_tables), place(context_lens),
+                *sampling_args, lora_state, None, **common)
+
+        # Per-phase efficiency attribution: each real token is counted
+        # exactly once under its own phase; the flat batch's bucket
+        # padding is charged to the decode side (whose row count it
+        # extends) unless the step is chunk-only.
+        pad_rows = padded_n - len(rows)
+        if n_chunk_groups:
+            self._efficiency.record_dispatch(
+                "prefill", n_chunk_groups, n_chunk_groups,
+                real_tokens=n_chunk_tokens,
+                padded_tokens=(n_chunk_tokens
+                               + (0 if n_decode_rows else pad_rows)))
+        if n_decode_rows:
+            self._efficiency.record_dispatch(
+                "decode", n_decode_rows, padded_n - n_chunk_tokens,
+                real_tokens=n_decode_rows,
+                padded_tokens=padded_n - n_chunk_tokens,
+                width_real=max(len(t) for t in tables),
+                width_padded=w)
+
+        with self._tracer.span("sample"):
+            sampled, sampled_lp, topk_ids, topk_lp = self._unpack(
+                np.asarray(packed), 1, 1, st.logprob_k)
+            output: SamplerOutput = []
+            for mi, meta in enumerate(seq_group_metadata_list):
+                sp = meta.sampling_params
+                samples: List[SequenceOutput] = []
+                for row, seq_id in emit_rows[mi]:
+                    tok = int(sampled[row, 0])
+                    d = {tok: float(sampled_lp[row, 0])}
+                    if sp.logprobs:
+                        for tt, lp in zip(topk_ids[row, 0, :sp.logprobs],
+                                          topk_lp[row, 0, :sp.logprobs]):
+                            d.setdefault(int(tt), float(lp))
+                    samples.append(SequenceOutput(seq_id, tok, d))
+                output.append(SequenceGroupOutput(samples))
+        return [output], new_caches
 
     def execute_decode_cont(
         self,

@@ -1,0 +1,301 @@
+"""Chunked-prefill scheduler unit tests (CPU-only, no model).
+
+Covers the acceptance properties of the mixed decode+prefill pass:
+decode-first admission (decodes are never starved by prefill chunks),
+per-step token budget is respected by chunk sizing, and
+`num_computed_tokens` survives preemption — recompute resets it, swap
+preserves it. Plus a golden step-trace test pinning the exact chunk
+schedule, and the bucketed-padding admission accounting (the legacy
+pass charges max_paddings against the runner's bucket shapes).
+"""
+import pytest
+
+from intellillm_tpu.config import CacheConfig, SchedulerConfig
+from intellillm_tpu.core.scheduler import PreemptionMode, Scheduler
+from intellillm_tpu.sampling_params import SamplingParams
+from intellillm_tpu.sequence import Sequence, SequenceGroup, SequenceStatus
+
+
+def make_chunked_scheduler(num_blocks=64, block_size=4, max_num_seqs=8,
+                           budget=8, max_model_len=64, max_paddings=256,
+                           num_cpu_blocks=32):
+    cache_config = CacheConfig(block_size=block_size, swap_space_gib=0.001)
+    cache_config.num_device_blocks = num_blocks
+    cache_config.num_cpu_blocks = num_cpu_blocks
+    scheduler_config = SchedulerConfig(
+        max_num_batched_tokens=budget,
+        max_num_seqs=max_num_seqs,
+        max_model_len=max_model_len,
+        max_paddings=max_paddings,
+        enable_chunked_prefill=True)
+    return Scheduler(scheduler_config, cache_config)
+
+
+def add_request(scheduler, rid, prompt_len, block_size=4, **sp_kwargs):
+    seq = Sequence(int(rid), "x", list(range(prompt_len)), block_size)
+    sp = SamplingParams(**sp_kwargs) if sp_kwargs else SamplingParams(
+        temperature=0.0, max_tokens=16)
+    group = SequenceGroup(rid, [seq], sp, arrival_time=float(rid))
+    scheduler.add_seq_group(group)
+    return group, seq
+
+
+def append_token(group):
+    for seq in group.get_seqs(SequenceStatus.RUNNING):
+        seq.append_token_id(1, {1: 0.0})
+
+
+def run_step(scheduler):
+    """One schedule() pass plus the host-side effects of a model step:
+    every final-chunk / decode group appends one token."""
+    metas, out = scheduler.schedule()
+    chunks = out.chunked_prefills or {}
+    for meta in metas:
+        chunk = chunks.get(meta.request_id)
+        if chunk is not None and not chunk[2]:
+            continue  # mid-prefill: no token emitted
+        for sd in meta.seq_data.values():
+            sd.append_token_id(1, 0.0)
+    return metas, out
+
+
+def test_prompt_splits_across_steps_within_budget():
+    s = make_chunked_scheduler(budget=8)
+    _, seq = add_request(s, "0", 20)
+    metas, out = s.schedule()
+    assert out.is_mixed
+    assert out.chunked_prefills["0"] == (0, 8, False)
+    assert seq.data.get_num_computed_tokens() == 8
+    assert not seq.data.prefill_complete
+    # Mid-prefill metadata carries the chunk window.
+    assert metas[0].is_prompt and metas[0].token_chunk_size == 8
+    assert metas[0].num_computed_tokens == 0
+
+    _, out = s.schedule()
+    assert out.chunked_prefills["0"] == (8, 8, False)
+    _, out = s.schedule()
+    assert out.chunked_prefills["0"] == (16, 4, True)
+    assert seq.data.prefill_complete
+    assert seq.data.get_num_computed_tokens() == 20
+
+
+def test_decodes_never_starved_and_budget_respected():
+    """Steady decode stream + a long prompt arriving late: every step with
+    runnable decodes must schedule ALL of them, and decode rows + chunk
+    tokens must never exceed the budget."""
+    s = make_chunked_scheduler(budget=8, num_blocks=64)
+    decode_groups = []
+    for i in range(4):
+        g, _ = add_request(s, str(i), 4)
+        decode_groups.append(g)
+    # Admit + fully prefill the short prompts (budget 8 → two at a time,
+    # then a split tail for the last one).
+    for _ in range(3):
+        run_step(s)
+    assert all(g.get_seqs()[0].data.prefill_complete for g in decode_groups)
+    g_long, seq_long = add_request(s, "9", 30)
+
+    seen_chunks = []
+    for _ in range(6):
+        metas, out = run_step(s)
+        assert out.is_mixed
+        scheduled_ids = {m.request_id for m in metas}
+        # Decode-first: every live decode group is in the step.
+        for g in decode_groups:
+            assert g.request_id in scheduled_ids, (
+                f"decode group {g.request_id} starved by prefill chunks")
+        assert (out.num_mixed_decode_tokens + out.num_prefill_tokens
+                <= s.scheduler_config.max_num_batched_tokens)
+        assert out.num_mixed_decode_tokens == 4
+        chunk = (out.chunked_prefills or {}).get("9")
+        if chunk is not None:
+            seen_chunks.append(chunk)
+        if seq_long.data.prefill_complete:
+            break
+    # 30 tokens at 4 tokens/step of slack → 8 chunks; we ran 6 steps, so
+    # progress must be strictly monotone and budget-shaped.
+    assert seen_chunks, "long prompt never got a chunk"
+    assert all(size <= 4 for _, size, _ in seen_chunks)
+    starts = [start for start, _, _ in seen_chunks]
+    assert starts == sorted(starts)
+    assert starts[0] == 0
+
+
+def test_golden_chunk_trace():
+    """Pin the exact mixed-step schedule for a fixed arrival pattern —
+    catches silent regressions in admission order or chunk sizing."""
+    s = make_chunked_scheduler(budget=8)
+    add_request(s, "0", 10)
+    add_request(s, "1", 7)
+    trace = []
+    for _ in range(4):
+        metas, out = run_step(s)
+        trace.append((sorted((rid, c) for rid, c in
+                             (out.chunked_prefills or {}).items()),
+                      out.num_mixed_decode_tokens))
+    assert trace == [
+        # Step 1: "0" takes the full budget; "1" gets nothing.
+        ([("0", (0, 8, False))], 0),
+        # Step 2: "0" finishes (2 tokens), "1" starts into the slack (6).
+        ([("0", (8, 2, True)), ("1", (0, 6, False))], 0),
+        # Step 3: "0" decodes (1 row), "1" finishes its last token.
+        ([("1", (6, 1, True))], 1),
+        # Step 4: both decode, nothing left to prefill → legacy decode
+        # pass (not mixed).
+        ([], 0),
+    ]
+
+
+def test_chunked_off_never_produces_mixed_steps():
+    """Legacy mode golden property: with the flag off the scheduler never
+    emits chunk metadata — the runner's homogeneous paths see exactly the
+    pre-chunking inputs."""
+    cache_config = CacheConfig(block_size=4, swap_space_gib=0.001)
+    cache_config.num_device_blocks = 64
+    cache_config.num_cpu_blocks = 8
+    s = Scheduler(SchedulerConfig(
+        max_num_batched_tokens=64, max_num_seqs=8, max_model_len=64,
+        max_paddings=256), cache_config)
+    add_request(s, "0", 20)
+    add_request(s, "1", 5)
+    for _ in range(3):
+        metas, out = run_step(s)
+        assert not out.is_mixed
+        assert out.chunked_prefills is None
+        assert all(m.token_chunk_size is None for m in metas)
+        assert all(m.num_computed_tokens == 0 for m in metas)
+
+
+def test_recompute_preemption_resets_computed_tokens():
+    """A mid-prefill victim of recompute preemption loses its KV pages —
+    its chunk progress must reset with them, and the re-admission must
+    re-chunk from token 0."""
+    s = make_chunked_scheduler(budget=8, num_blocks=11, block_size=4,
+                               max_model_len=32)
+    g0, seq0 = add_request(s, "0", 7)
+    _, out = run_step(s)
+    assert out.chunked_prefills["0"] == (0, 7, True)
+
+    # g1's 32-token prompt fills the remaining pool exactly; g0's decode
+    # growth eventually needs a block while g1 is still mid-chunk → g1
+    # (lowest priority, single-seq) is recomputed away.
+    g1, seq1 = add_request(s, "1", 32)
+    admitted_mid = False
+    completed_ever = False
+    preempted = False
+    for _ in range(12):
+        run_step(s)
+        completed_ever = completed_ever or seq1.data.prefill_complete
+        if (seq1.status == SequenceStatus.RUNNING
+                and seq1.data.get_num_computed_tokens() > 0):
+            admitted_mid = True
+        if admitted_mid and seq1.status == SequenceStatus.WAITING:
+            preempted = True
+            break
+    assert preempted, "recompute preemption never hit the prefilling group"
+    assert not completed_ever, (
+        "construction error: prefill completed before preemption — "
+        "this no longer tests the mid-chunk reset")
+    assert seq1.data.get_num_computed_tokens() == 0
+    assert not seq1.data.prefill_complete
+    assert g1 in list(s.waiting)
+
+    # Finish g0 → pool frees → g1 re-chunks from scratch.
+    for seq in g0.get_seqs():
+        seq.status = SequenceStatus.FINISHED_STOPPED
+        s.free_seq(seq)
+    s.free_finished_seq_groups()
+    _, out = s.schedule()
+    assert out.is_mixed
+    assert out.chunked_prefills["1"][0] == 0
+
+
+def test_swap_preemption_preserves_computed_tokens():
+    """Forced SWAP of a mid-prefill group keeps its KV (and therefore its
+    chunk progress); swap-in must resume chunking exactly where it
+    stopped."""
+    s = make_chunked_scheduler(budget=8, num_blocks=64)
+    g, seq = add_request(s, "0", 20)
+    _, out = s.schedule()
+    assert out.chunked_prefills["0"] == (0, 8, False)
+    assert seq.data.get_num_computed_tokens() == 8
+
+    blocks_to_swap_out = {}
+    s.running.remove(g)
+    s._preempt(g, blocks_to_swap_out, PreemptionMode.SWAP)
+    assert blocks_to_swap_out
+    assert seq.status == SequenceStatus.SWAPPED
+    assert seq.data.get_num_computed_tokens() == 8
+    assert not seq.data.prefill_complete
+
+    # The chunked pass owns swapped mid-prefill groups: swap-in, then
+    # resume the chunk at start=8.
+    _, out = s.schedule()
+    assert out.is_mixed
+    assert out.blocks_to_swap_in
+    assert out.chunked_prefills["0"] == (8, 8, False)
+
+
+def test_non_chunkable_prompts_fall_back_to_legacy_prefill():
+    """prompt_logprobs needs the full-prompt logits panel → the prompt
+    must be scheduled as a homogeneous prefill even in chunked mode."""
+    s = make_chunked_scheduler(budget=8, max_model_len=32)
+    add_request(s, "0", 12, temperature=0.0, max_tokens=4,
+                prompt_logprobs=5)
+    metas, out = s.schedule()
+    assert not out.is_mixed
+    assert out.prompt_run
+    assert metas[0].token_chunk_size is None
+
+
+def test_mixed_pass_not_entered_while_nonchunkable_decodes_run():
+    """best_of>1 groups cannot share a mixed flat batch; a waiting
+    chunkable prompt must wait for the homogeneous path instead."""
+    s = make_chunked_scheduler(budget=16, max_num_seqs=8)
+    g_multi, _ = add_request(s, "0", 4, temperature=0.8, best_of=2, n=2,
+                             max_tokens=8)
+    _, out = s.schedule()   # homogeneous prefill of the best_of group
+    assert not out.is_mixed
+    for seq in g_multi.get_seqs(SequenceStatus.RUNNING):
+        seq.append_token_id(1, {1: 0.0})
+    add_request(s, "1", 10)
+    _, out = s.schedule()
+    # Must NOT be mixed: the running group is not mixed-safe. The legacy
+    # pass runs a homogeneous prefill for the new prompt instead.
+    assert not out.is_mixed
+
+
+def test_legacy_padding_budget_counts_bucketed_shapes():
+    """The legacy prefill pass charges max_paddings against the bucketed
+    (batch x len) shape the runner pads to, not the raw longest-prompt
+    delta — and a lone prompt is always admitted (its bucket padding is
+    intrinsic)."""
+    cache_config = CacheConfig(block_size=4, swap_space_gib=0.001)
+    cache_config.num_device_blocks = 64
+    cache_config.num_cpu_blocks = 8
+    s = Scheduler(SchedulerConfig(
+        max_num_batched_tokens=128, max_num_seqs=8, max_model_len=64,
+        max_paddings=48), cache_config)
+    # Prompt 0: 60 tokens → len bucket 64, batch bucket 1 → 4 paddings,
+    # admitted (and would be even if it exceeded the cap: lone-prompt
+    # exemption). Prompt 1: 5 tokens → batch becomes 2x64=128 padded
+    # tokens vs 65 real = 63 paddings > 48 → deferred to its own step.
+    add_request(s, "0", 60)
+    add_request(s, "1", 5)
+    metas, out = s.schedule()
+    assert out.prompt_run
+    assert [m.request_id for m in metas] == ["0"]
+    metas, out = s.schedule()
+    assert [m.request_id for m in metas] == ["1"]
+
+
+def test_lone_prompt_exempt_from_padding_cap():
+    cache_config = CacheConfig(block_size=4, swap_space_gib=0.001)
+    cache_config.num_device_blocks = 64
+    cache_config.num_cpu_blocks = 8
+    s = Scheduler(SchedulerConfig(
+        max_num_batched_tokens=128, max_num_seqs=8, max_model_len=64,
+        max_paddings=2), cache_config)
+    add_request(s, "0", 33)  # bucket 64 → 31 paddings > cap, but lone
+    metas, out = s.schedule()
+    assert [m.request_id for m in metas] == ["0"]

@@ -95,3 +95,110 @@ def test_swap_preemption_preserves_outputs(tiny_opt_dir, example_prompts,
         r_tok = sorted(c.token_ids for c in r.outputs)
         t_tok = sorted(c.token_ids for c in t.outputs)
         assert r_tok == t_tok, f"prompt {i} diverged under swap"
+
+
+# --- chunked prefill: preemption of partially-prefilled sequences -------
+
+_LONG_PROMPTS = [
+    " ".join(["the cat runs fast and the dog"] * 7),      # 49 tokens
+    " ".join(["the president of the united states is"] * 6),  # 42 tokens
+    " ".join(["the capital of france is paris"] * 7),     # 42 tokens
+    " ".join(["hello my name is"] * 10),                  # 40 tokens
+]
+
+
+def test_chunked_recompute_preemption_preserves_greedy(tiny_opt_dir,
+                                                       monkeypatch):
+    """Chunked prefill + tight pool: recompute preemption must hit at
+    least one PARTIALLY-prefilled group (num_computed_tokens mid-prompt),
+    and the re-chunked re-prefill must reproduce the unpressured chunked
+    run's tokens exactly."""
+    from intellillm_tpu.core import scheduler as sched_mod
+
+    params = [SamplingParams(temperature=0.0, max_tokens=24,
+                             ignore_eos=True)
+              for _ in _LONG_PROMPTS]
+    chunked_kw = dict(enable_chunked_prefill=True,
+                      max_num_batched_tokens=16)
+
+    roomy = _generate(_llm(tiny_opt_dir, 128, **chunked_kw),
+                      _LONG_PROMPTS, params)
+
+    hits = {"total": 0, "mid_chunk": 0}
+    orig = sched_mod.Scheduler._preempt_by_recompute
+
+    def counting(self, seq_group):
+        hits["total"] += 1
+        if any(not s.data.prefill_complete
+               for s in seq_group.get_unfinished_seqs()):
+            hits["mid_chunk"] += 1
+        return orig(self, seq_group)
+
+    monkeypatch.setattr(sched_mod.Scheduler, "_preempt_by_recompute",
+                        counting)
+    tight = _generate(_llm(tiny_opt_dir, 10, **chunked_kw),
+                      _LONG_PROMPTS, params)
+
+    assert hits["mid_chunk"] > 0, (
+        "pool was sized so recompute preemption hits a mid-chunk group "
+        f"but none did — hits={hits}")
+    for i, (r, t) in enumerate(zip(roomy, tight)):
+        assert r.outputs[0].token_ids == t.outputs[0].token_ids, (
+            f"prompt {i} diverged under mid-chunk recompute preemption")
+
+
+def test_chunked_swap_preemption_preserves_greedy(tiny_opt_dir,
+                                                  monkeypatch):
+    """Force SWAP preemption (instead of the single-seq recompute
+    default) under chunked prefill: a swapped-out mid-chunk group keeps
+    its num_computed_tokens, and swap-in must resume chunking exactly
+    where the KV left off — outputs must match the unpressured run."""
+    from intellillm_tpu.core import scheduler as sched_mod
+    from intellillm_tpu.worker import cache_engine as ce
+
+    params = [SamplingParams(temperature=0.0, max_tokens=24,
+                             ignore_eos=True)
+              for _ in _LONG_PROMPTS]
+    chunked_kw = dict(enable_chunked_prefill=True,
+                      max_num_batched_tokens=16)
+
+    roomy = _generate(_llm(tiny_opt_dir, 128, **chunked_kw),
+                      _LONG_PROMPTS, params)
+
+    hits = {"swap_out": 0, "swap_in": 0, "mid_chunk": 0}
+    orig_preempt = sched_mod.Scheduler._preempt
+
+    def forced_swap(self, seq_group, blocks_to_swap_out,
+                    preemption_mode=None):
+        if any(not s.data.prefill_complete
+               for s in seq_group.get_unfinished_seqs()):
+            hits["mid_chunk"] += 1
+        return orig_preempt(self, seq_group, blocks_to_swap_out,
+                            sched_mod.PreemptionMode.SWAP)
+
+    orig_out = ce.CacheEngine.swap_out
+    orig_in = ce.CacheEngine.swap_in
+
+    def counting_out(self, mapping):
+        hits["swap_out"] += 1
+        return orig_out(self, mapping)
+
+    def counting_in(self, mapping):
+        hits["swap_in"] += 1
+        return orig_in(self, mapping)
+
+    monkeypatch.setattr(sched_mod.Scheduler, "_preempt", forced_swap)
+    monkeypatch.setattr(ce.CacheEngine, "swap_out", counting_out)
+    monkeypatch.setattr(ce.CacheEngine, "swap_in", counting_in)
+
+    tight = _generate(_llm(tiny_opt_dir, 10, **chunked_kw),
+                      _LONG_PROMPTS, params)
+
+    assert hits["swap_out"] > 0 and hits["swap_in"] > 0, (
+        f"pool was sized to force swap preemption but none ran — {hits}")
+    assert hits["mid_chunk"] > 0, (
+        "no swap preemption hit a mid-chunk group — the resume-from-"
+        f"num_computed_tokens path went unexercised — {hits}")
+    for i, (r, t) in enumerate(zip(roomy, tight)):
+        assert r.outputs[0].token_ids == t.outputs[0].token_ids, (
+            f"prompt {i} diverged under mid-chunk swap preemption")

@@ -1,0 +1,115 @@
+"""Mixed-step (chunked prefill) telemetry attribution.
+
+Acceptance: a chunked-prefill run must report real prefill vs decode
+token counts with NO double counting — every prompt token appears under
+`intellillm_tokens_total{phase=prefill,kind=real}` exactly once (across
+however many chunks it was split into), every decode row exactly once
+under phase=decode — plus sane fill ratios and MFU inputs, and the
+mixed flat-batch program tracked under its own "mixed" label in the
+XLA compile tracker.
+"""
+import pytest
+
+from intellillm_tpu import LLM, SamplingParams
+from intellillm_tpu.obs import get_compile_tracker, get_efficiency_tracker
+
+PROMPTS = [
+    "hello my name is",
+    "the president of the united states is",
+    "the capital of france is",
+    " ".join(["the cat runs fast and the dog"] * 4),  # 28 tokens
+]
+MAX_TOKENS = 8
+
+
+@pytest.fixture
+def trackers():
+    eff = get_efficiency_tracker()
+    comp = get_compile_tracker()
+    eff.reset_for_testing()
+    comp.reset_for_testing()
+    yield eff, comp
+    eff.reset_for_testing()
+    comp.reset_for_testing()
+
+
+def test_mixed_steps_attribute_tokens_exactly_once(tiny_opt_dir, trackers):
+    eff, comp = trackers
+    llm = LLM(model=tiny_opt_dir, dtype="float32",
+              num_device_blocks_override=128, max_model_len=128,
+              max_num_seqs=8, max_paddings=512, num_decode_steps=1,
+              enable_chunked_prefill=True, max_num_batched_tokens=8)
+    # Drop warm-up dispatches: only the serving steps should be counted.
+    # The reset also wipes the FLOPs model derived at engine init, so
+    # re-derive it — the MFU assertions below need a denominator input.
+    eff.reset_for_testing()
+    comp.reset_for_testing()
+    eff.configure_model(llm.llm_engine.model_config)
+
+    engine = llm.llm_engine
+    tok = engine.tokenizer
+    prompt_lens = [len(tok.encode(p)) for p in PROMPTS]
+    for i, p in enumerate(PROMPTS):
+        engine.add_request(str(i), p, SamplingParams(
+            temperature=0.0, max_tokens=MAX_TOKENS, ignore_eos=True))
+    outs = list(llm._run_engine(use_tqdm=False))
+    assert all(len(o.outputs[0].token_ids) == MAX_TOKENS for o in outs)
+
+    snap = eff.snapshot()
+    tokens = snap["tokens_total"]
+
+    # Every prompt token prefilled exactly once across all its chunks
+    # (roomy pool → no preemption → no re-prefill), despite prompts
+    # being split by the 8-token budget and sharing flat batches with
+    # decode rows.
+    assert tokens["prefill"]["real"] == sum(prompt_lens), (
+        f"prefill real tokens {tokens['prefill']['real']} != "
+        f"prompt tokens {sum(prompt_lens)} — chunk tokens double- or "
+        "under-counted")
+
+    # Each generated token except the final-chunk sample comes from one
+    # real decode row in exactly one step.
+    expected_decode = sum(MAX_TOKENS - 1 for _ in PROMPTS)
+    assert tokens["decode"]["real"] == expected_decode, (
+        f"decode real tokens {tokens['decode']['real']} != "
+        f"{expected_decode} — decode rows double-counted or chunk rows "
+        "leaked into the decode phase")
+
+    # Flat-batch padding is accounted (pad > 0: budget 8 pads to the
+    # 16-row token bucket) and ratios stay in range.
+    assert tokens["decode"]["pad"] > 0 or tokens["prefill"]["pad"] > 0
+    assert snap["pad_fraction"] is not None and 0 < snap["pad_fraction"] < 1
+    fills = snap["fill_ratio_avg"]
+    assert 0 < fills["prefill"]["batch"] <= 1
+    assert 0 < fills["decode"]["batch"] <= 1
+    # MFU inputs: steps counted, FLOPs model derived.
+    assert snap["steps"] > 0
+    assert snap["flops_per_token"] and snap["flops_per_token"] > 0
+
+    # The mixed flat-batch program is tracked under its own label.
+    csnap = comp.snapshot()
+    mixed_programs = [p for p in csnap["compiles"] if p == "mixed"]
+    assert mixed_programs, (
+        f"no 'mixed' program in compile tracker: {csnap['compiles']}")
+
+
+def test_legacy_run_records_no_mixed_program(tiny_opt_dir, trackers):
+    """Chunked off: no mixed program may be dispatched, and prefill
+    tokens still attribute exactly once (the legacy homogeneous path)."""
+    eff, comp = trackers
+    llm = LLM(model=tiny_opt_dir, dtype="float32",
+              num_device_blocks_override=128, max_model_len=128,
+              max_num_seqs=8, max_paddings=512, num_decode_steps=1)
+    eff.reset_for_testing()
+    comp.reset_for_testing()
+    engine = llm.llm_engine
+    tok = engine.tokenizer
+    prompt_lens = [len(tok.encode(p)) for p in PROMPTS]
+    for i, p in enumerate(PROMPTS):
+        engine.add_request(str(i), p, SamplingParams(
+            temperature=0.0, max_tokens=MAX_TOKENS, ignore_eos=True))
+    list(llm._run_engine(use_tqdm=False))
+
+    assert "mixed" not in comp.snapshot()["compiles"]
+    tokens = get_efficiency_tracker().snapshot()["tokens_total"]
+    assert tokens["prefill"]["real"] == sum(prompt_lens)
