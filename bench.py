@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -133,6 +134,38 @@ def _extract_probe_stack(stderr_text: str | bytes | None) -> str | None:
     return stderr_text[idx:idx + 2000]
 
 
+def _run_probe_child(code: str, timeout_s: float):
+    """Run the probe child in its OWN process group; on timeout SIGKILL
+    the whole group, not just the direct child.
+
+    `subprocess.run(timeout=...)` only kills the child itself: a TPU
+    runtime that forked helper processes leaves them holding the device
+    (and the stderr pipe — the post-kill `communicate()` then blocks
+    forever, which is exactly the "hung probe hangs the whole run" dark
+    trajectory of BENCH_r04/r05). Returns (returncode, stdout, stderr);
+    raises TimeoutExpired carrying whatever stderr (the faulthandler
+    dump) was produced before the kill.
+    """
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            out, err = proc.communicate(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        raise subprocess.TimeoutExpired(
+            cmd=proc.args, timeout=timeout_s, output=out, stderr=err)
+
+
 def probe_backend(attempts: int = 2, backoff_s: float = 30.0,
                   probe_timeout_s: float = 120.0) -> bool:
     """Probe the TPU backend in a SUBPROCESS with retry + backoff.
@@ -166,16 +199,15 @@ def probe_backend(attempts: int = 2, backoff_s: float = 30.0,
         rec = {"attempt": i + 1, "ok": False, "elapsed_s": 0.0, "err": ""}
         stack = None
         try:
-            r = subprocess.run(
-                [sys.executable, "-c", _probe_child_code(probe_timeout_s)],
-                capture_output=True, text=True, timeout=probe_timeout_s)
-            rec["ok"] = r.returncode == 0
+            returncode, stdout, stderr = _run_probe_child(
+                _probe_child_code(probe_timeout_s), probe_timeout_s)
+            rec["ok"] = returncode == 0
             if not rec["ok"]:
-                tail = (r.stderr.strip().splitlines() or ["unknown"])[-1]
+                tail = (stderr.strip().splitlines() or ["unknown"])[-1]
                 rec["err"] = tail[:300]
-                stack = _extract_probe_stack(r.stderr)
+                stack = _extract_probe_stack(stderr)
             else:
-                rec["platform"] = r.stdout.strip()
+                rec["platform"] = stdout.strip()
         except subprocess.TimeoutExpired as e:
             rec["err"] = f"probe hung > {probe_timeout_s:.0f}s (killed)"
             stack = _extract_probe_stack(e.stderr)

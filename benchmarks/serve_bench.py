@@ -119,6 +119,51 @@ def snapshot_observability(base: str) -> dict:
     return out
 
 
+def snapshot_router_metrics(base: str) -> dict:
+    """Distill the router's `intellillm_router_*` families into a compact
+    dict: per-replica request counts / predicted load / health, decision
+    and failover counters."""
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            text = r.read().decode(errors="replace")
+    except Exception as e:
+        return {"error": f"router metrics scrape failed: {e}"}
+
+    out = {"requests_total": {}, "decisions": {}, "failovers": {},
+           "predicted_load_tokens": {}, "replica_healthy": {},
+           "queue_depth": {}}
+    families = {
+        "intellillm_router_requests_total": ("requests_total", "replica"),
+        "intellillm_router_routing_decisions_total":
+            ("decisions", "decision"),
+        "intellillm_router_failovers_total": ("failovers", "replica"),
+        "intellillm_router_predicted_load_tokens":
+            ("predicted_load_tokens", "replica"),
+        "intellillm_router_replica_healthy": ("replica_healthy", "replica"),
+    }
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        try:
+            name_labels, value = line.rsplit(None, 1)
+            value = float(value)
+            name, _, labels = name_labels.partition("{")
+            labels = dict(
+                kv.split("=", 1) for kv in labels.rstrip("}").split(",")
+                if "=" in kv) if labels else {}
+            labels = {k: v.strip('"') for k, v in labels.items()}
+        except ValueError:
+            continue
+        if name in families:
+            key, label = families[name]
+            out[key][labels.get(label, "?")] = value
+        elif name == "intellillm_router_replica_queue_depth":
+            out["queue_depth"].setdefault(
+                labels.get("replica", "?"), {})[
+                    labels.get("queue", "?")] = value
+    return out
+
+
 def snapshot_health_detail(base: str) -> dict:
     """Scrape the server-side /health/detail body (rolling SLO summary,
     device telemetry, watchdog state). A 503 still carries the body
@@ -254,6 +299,129 @@ def run_ttft_under_load(args, api_url: str, model_name: str, tokenizer,
     }
 
 
+def launch_generate_replica(model_dir: str, args, port: int,
+                            log_path: str) -> subprocess.Popen:
+    """Launch one demo api_server replica (plain /generate protocol —
+    the surface the router fronts)."""
+    cmd = [
+        sys.executable, "-m", "intellillm_tpu.entrypoints.api_server",
+        "--model", model_dir,
+        "--load-format", "dummy",
+        "--host", "127.0.0.1",
+        "--port", str(port),
+        "--max-model-len", str(args.max_model_len),
+        "--max-num-seqs", str(args.max_num_seqs),
+        "--num-decode-steps", str(args.num_decode_steps),
+        "--block-size", str(args.block_size),
+        "--kv-cache-dtype", args.kv_cache_dtype,
+        "--max-paddings", "4096",
+        "--swap-space", "0.05",
+        "--disable-log-requests",
+    ]
+    if args.quantization:
+        cmd += ["--quantization", args.quantization]
+    if args.num_device_blocks:
+        cmd += ["--num-device-blocks-override", str(args.num_device_blocks)]
+    env = dict(os.environ)
+    env.setdefault("HF_HUB_OFFLINE", "1")
+    log = open(log_path, "wb")
+    return subprocess.Popen(cmd, env=env, stdout=log,
+                            stderr=subprocess.STDOUT)
+
+
+def run_fleet(args, model_dir: str, tokenizer) -> dict:
+    """The fleet scenario: N generate-protocol replicas behind the
+    router, rate sweep through the router, and a per-replica SLO split
+    (each replica's own /health/detail SLO summary) next to the router's
+    routing counters — the view that shows whether affinity is
+    concentrating work and the predictor is balancing it."""
+    router_base = f"http://127.0.0.1:{args.port}"
+    api_url = router_base + "/generate"
+    summary = {"scenario": "fleet", "size": args.size,
+               "num_replicas": args.num_replicas,
+               "input_len": args.input_len, "output_len": args.output_len,
+               "num_prompts": args.num_prompts,
+               "max_num_seqs": args.max_num_seqs,
+               "quantization": args.quantization,
+               "kv_cache_dtype": args.kv_cache_dtype, "results": []}
+    replicas = []     # (name, base_url, proc, log_path)
+    router_proc = None
+    try:
+        for i in range(args.num_replicas):
+            port = args.replica_base_port + i
+            log_path = f"{args.server_log}.replica{i}"
+            proc = launch_generate_replica(model_dir, args, port, log_path)
+            replicas.append((f"replica-{i}", f"http://127.0.0.1:{port}",
+                             proc, log_path))
+        for name, base, proc, log_path in replicas:
+            wait_healthy(proc, base, args.init_timeout, log_path)
+
+        router_log = args.server_log + ".router"
+        router_cmd = [
+            sys.executable, "-m", "intellillm_tpu.router.server",
+            "--host", "127.0.0.1", "--port", str(args.port),
+            "--replica-urls", ",".join(b for _, b, _, _ in replicas),
+            "--tokenizer", model_dir,
+            "--block-size", str(args.block_size),
+            "--health-interval", "1.0",
+        ]
+        env = dict(os.environ)
+        env.setdefault("HF_HUB_OFFLINE", "1")
+        log = open(router_log, "wb")
+        router_proc = subprocess.Popen(router_cmd, env=env, stdout=log,
+                                       stderr=subprocess.STDOUT)
+        # Router /health goes 200 once its first poll sees a healthy
+        # replica, so this also proves the poll loop works.
+        wait_healthy(router_proc, router_base, 120.0, router_log)
+
+        requests = build_requests(args, tokenizer)
+        # Warm every replica's compile ladder through the router (two
+        # all-at-once passes spread load over the fleet).
+        for _ in range(2):
+            asyncio.run(run_benchmark("generate", api_url, None, requests,
+                                      float("inf")))
+
+        for rate_s in args.rates.split(","):
+            rate = float(rate_s)
+            elapsed, results = asyncio.run(run_benchmark(
+                "generate", api_url, None, requests, rate))
+            m = compute_metrics(results, elapsed)
+            m["request_rate"] = rate_s
+            summary["results"].append(m)
+            print(json.dumps({"serve_bench_fleet_rate": rate_s, **m}),
+                  flush=True)
+
+        summary["router"] = {
+            "metrics": snapshot_router_metrics(router_base),
+            "health_detail": snapshot_health_detail(router_base),
+        }
+        per_replica = {}
+        for name, base, proc, log_path in replicas:
+            detail = snapshot_health_detail(base)
+            per_replica[name] = {
+                "base": base,
+                "status": detail.get("status"),
+                "slo": detail.get("slo") or {},
+                "queue_depths": detail.get("queue_depths"),
+                "kv_cache_usage": detail.get("kv_cache_usage"),
+            }
+        summary["per_replica_slo"] = per_replica
+        print(json.dumps({"serve_bench_fleet": {
+            "per_replica_slo": per_replica,
+            "router": summary["router"],
+        }}), flush=True)
+    finally:
+        if router_proc is not None:
+            router_proc.send_signal(signal.SIGKILL)
+            router_proc.wait()
+        for _, _, proc, _ in replicas:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+
+    print(json.dumps({"serve_bench_summary": summary}), flush=True)
+    return summary
+
+
 def main(args) -> dict:
     from transformers import AutoTokenizer
 
@@ -263,6 +431,9 @@ def main(args) -> dict:
         # clobber an existing checkpoint passed via --model-dir.
         save_dummy_checkpoint(f"dummy:{args.size}", model_dir)
     tokenizer = AutoTokenizer.from_pretrained(model_dir)
+
+    if args.scenario == "fleet":
+        return run_fleet(args, model_dir, tokenizer)
 
     proc = launch_server(model_dir, args)
     base = f"http://127.0.0.1:{args.port}"
@@ -363,14 +534,22 @@ def make_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--server-log", type=str,
                    default="/tmp/serve_bench_server.log")
     p.add_argument("--scenario", type=str, default="rate-sweep",
-                   choices=["rate-sweep", "ttft-under-load"],
+                   choices=["rate-sweep", "ttft-under-load", "fleet"],
                    help="rate-sweep: Poisson sweep over --rates (the "
                         "default). ttft-under-load: start --num-prompts "
                         "short-prompt requests at once (steady decode "
                         "stream), inject one long-prompt probe after "
                         "--probe-delay, and report the probe's TTFT plus "
                         "the stream's P99 TPOT — the interference pair "
-                        "chunked prefill is designed to improve.")
+                        "chunked prefill is designed to improve. fleet: "
+                        "boot --num-replicas demo servers behind the "
+                        "multi-replica router, sweep --rates through the "
+                        "router, and report per-replica SLO splits plus "
+                        "the router's routing counters.")
+    p.add_argument("--num-replicas", type=int, default=2,
+                   help="fleet scenario: engine replicas to launch")
+    p.add_argument("--replica-base-port", type=int, default=8300,
+                   help="fleet scenario: replica i listens on base+i")
     p.add_argument("--probe-input-len", type=int, default=None,
                    help="probe prompt length for ttft-under-load "
                         "(default: max-model-len - probe-output-len - 1)")

@@ -1,0 +1,68 @@
+"""Stable prefix-affinity keys, shared by the prefix pool and the router.
+
+`PrefixPool` (prefix.py) deduplicates shared prompt prefixes inside ONE
+engine; the multi-replica router (router/) must agree with it about what
+counts as "the same prefix" so cache-aware routing actually lands a
+request on the replica whose pool holds its prefix KV. Both therefore
+key on the same tuple — `(token_ids, lora_int_id)` — through this one
+helper.
+
+The key is a 64-bit blake2b digest, NOT Python's builtin `hash()`:
+routing decisions cross process boundaries (router process vs engine
+replicas, restarts, multiple router instances behind DNS), and builtin
+`hash()` is only stable within one interpreter run. blake2b over the
+packed token ids is deterministic across processes, machines, and
+Python versions, which also makes pool keying reproducible in tests.
+"""
+from __future__ import annotations
+
+import hashlib
+from array import array
+from typing import Optional, Sequence, Tuple
+
+
+def affinity_key(token_ids: Sequence[int], lora_int_id: int = 0) -> int:
+    """Stable 64-bit key over `(token_ids, lora_int_id)`.
+
+    A prefix computed under a LoRA adapter carries that adapter's q/k/v
+    deltas and must not be shared across adapters, so the adapter id is
+    part of the key (same rule as `PrefixPool`).
+    """
+    h = hashlib.blake2b(digest_size=8)
+    h.update(int(lora_int_id).to_bytes(8, "little", signed=True))
+    h.update(array("q", [int(t) for t in token_ids]).tobytes())
+    return int.from_bytes(h.digest(), "little")
+
+
+def stable_hash(data: bytes) -> int:
+    """Stable 64-bit hash of raw bytes (consistent-hash ring points)."""
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+
+def truncate_to_block(token_ids: Sequence[int],
+                      block_size: int) -> Tuple[int, ...]:
+    """Longest block-aligned prefix of `token_ids` (possibly empty)."""
+    n = len(token_ids) // block_size * block_size
+    return tuple(token_ids[:n])
+
+
+def prompt_affinity_key(token_ids: Sequence[int],
+                        block_size: int = 16,
+                        max_blocks: int = 4,
+                        lora_int_id: int = 0) -> Optional[int]:
+    """Routing affinity key for a prompt: the key of its FIRST
+    `max_blocks` block-aligned blocks (block-aligned because that is the
+    granularity at which prefix KV can be shared), or None when the
+    prompt is shorter than one block (nothing shareable — the caller
+    falls back to consistent hashing over the whole prompt).
+
+    Capping at `max_blocks` (default 4 blocks = 64 tokens at block 16)
+    is deliberate: prompts that share a long system preamble but diverge
+    later must still map to the SAME key, or the shared prefix never
+    concentrates on one replica.
+    """
+    prefix = truncate_to_block(token_ids, block_size)
+    if not prefix:
+        return None
+    return affinity_key(prefix[:max_blocks * block_size], lora_int_id)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from intellillm_tpu.affinity import affinity_key, truncate_to_block
 from intellillm_tpu.block import BlockTable
 
 
@@ -26,7 +27,9 @@ class Prefix:
         self.block_size = block_size
         self.length = len(token_ids)
         self.lora_int_id = lora_int_id
-        self.hash = hash((self.token_ids, lora_int_id))
+        # Stable across processes (affinity.py) so the router's
+        # prefix-affinity key agrees with the pool's dedup key.
+        self.hash = affinity_key(self.token_ids, lora_int_id)
         assert self.length % block_size == 0
         self.block_table: Optional[BlockTable] = None
         self.computed = False
@@ -60,8 +63,7 @@ class PrefixPool:
         self.block_size = block_size
 
     def _truncate_to_block(self, token_ids: Sequence[int]) -> Tuple[int, ...]:
-        n = len(token_ids) // self.block_size * self.block_size
-        return tuple(token_ids[:n])
+        return truncate_to_block(token_ids, self.block_size)
 
     def add_or_get_prefix(self, token_ids: Sequence[int],
                           lora_int_id: int = 0) -> Optional[Prefix]:

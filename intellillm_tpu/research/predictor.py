@@ -248,21 +248,48 @@ class LengthPredictor:
         x, xlen = self._encode(src)
         out = np.asarray(self._predict_jit(self.params, jnp.asarray(x),
                                            jnp.asarray(xlen)))[0]
+        # Midpoint of the predicted bucket for class tasks; the open-ended
+        # top bucket extrapolates to 4x the last threshold.
+        result = self._decode_output(out)
+        self.latencies_ms.append((time.monotonic() - t0) * 1e3)
+        return result
+
+    def predict_batch(self, prompts_or_ids: Sequence) -> List[int]:
+        """Batched `predict()` — one jitted forward for the whole batch.
+
+        Serve-time routers admit bursts of requests at once; per-item
+        `predict()` pays a host→device round trip each. Accepts a mixed
+        sequence of prompt strings and token-id sequences.
+        """
+        if not prompts_or_ids:
+            return []
+        t0 = time.monotonic()
+        x, xlen = self._encode(prompts_or_ids)
+        out = np.asarray(self._predict_jit(self.params, jnp.asarray(x),
+                                           jnp.asarray(xlen)))
+        results: List[int] = []
+        for row in out:
+            results.append(self._decode_output(row))
+        # One batch latency sample per item keeps latency_stats meaningful
+        # as a per-prediction cost.
+        per_item_ms = (time.monotonic() - t0) * 1e3 / len(results)
+        self.latencies_ms.extend([per_item_ms] * len(results))
+        return results
+
+    def _decode_output(self, out_row: np.ndarray) -> int:
+        """Model head output row → predicted response length (tokens)."""
         if self.config.task in ("classification", "ordinal"):
-            # Midpoint of the predicted bucket; the open-ended top bucket
-            # extrapolates to 4x the last threshold.
             if self.config.task == "classification":
-                cls = int(out.argmax())
+                cls = int(out_row.argmax())
             else:
-                cls = int(np.clip(np.round(out[0]), 0,
+                cls = int(np.clip(np.round(out_row[0]), 0,
                                   self.num_classes - 1))
             last = (self.config.class_thresholds[-1]
                     if self.config.class_thresholds else 128)
             edges = (0, ) + tuple(self.config.class_thresholds) + (4 * last, )
             result = int((edges[cls] + edges[cls + 1]) / 2)
         else:
-            result = int(np.expm1(out[0]))
-        self.latencies_ms.append((time.monotonic() - t0) * 1e3)
+            result = int(np.expm1(out_row[0]))
         return max(result, 1)
 
     def latency_stats(self) -> Dict[str, float]:
@@ -293,3 +320,72 @@ class LengthPredictor:
         data = np.load(os.path.join(path, "predictor.npz"))
         pred.params = {k: jnp.asarray(data[k]) for k in data.files}
         return pred
+
+
+class PromptLengthHeuristic:
+    """Predictor-less fallback with the `LengthPredictor` serve API.
+
+    When no trained checkpoint is available the router still needs SOME
+    outstanding-work estimate per request; prompt length is the strongest
+    single feature (reference's regression head uses it explicitly, and
+    `_forward` appends it as a feature). Estimate: `scale * prompt_tokens`
+    clipped to [min_len, max_len]. Deliberately simple and monotone so
+    least-loaded balancing remains stable without a model.
+    """
+
+    def __init__(self, scale: float = 1.0, min_len: int = 16,
+                 max_len: int = 512) -> None:
+        self.scale = scale
+        self.min_len = min_len
+        self.max_len = max_len
+        self.latencies_ms: List[float] = []
+
+    def _num_tokens(self, prompt: Optional[str],
+                    prompt_token_ids: Optional[Sequence[int]]) -> int:
+        if prompt_token_ids is not None:
+            return len(prompt_token_ids)
+        # No tokenizer here by design: ~4 chars/token is close enough for
+        # a load estimate and keeps the heuristic dependency-free.
+        return max(len(prompt or "") // 4, 1)
+
+    def predict(self, prompt: Optional[str],
+                prompt_token_ids: Optional[Sequence[int]] = None) -> int:
+        n = self._num_tokens(prompt, prompt_token_ids)
+        return int(np.clip(int(n * self.scale), self.min_len, self.max_len))
+
+    def predict_batch(self, prompts_or_ids: Sequence) -> List[int]:
+        out = []
+        for p in prompts_or_ids:
+            if isinstance(p, str):
+                out.append(self.predict(p))
+            else:
+                out.append(self.predict(None, p))
+        return out
+
+    def latency_stats(self) -> Dict[str, float]:
+        return {}
+
+
+def load_predictor(path: Optional[str], tokenizer=None):
+    """Load a trained `LengthPredictor`, degrading to
+    `PromptLengthHeuristic` when `path` is None, missing, or unloadable.
+
+    The serve path (router, engine admission) must never be blocked on a
+    predictor checkpoint — degraded length estimates are acceptable,
+    failing to serve is not.
+    """
+    if path:
+        try:
+            pred = LengthPredictor.load(path, tokenizer)
+            logger.info("loaded length predictor from %s (task=%s)", path,
+                        pred.config.task)
+            return pred
+        except (OSError, ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            logger.warning(
+                "failed to load length predictor from %s (%s); "
+                "falling back to prompt-length heuristic", path, e)
+    else:
+        logger.info("no predictor checkpoint configured; using "
+                    "prompt-length heuristic")
+    return PromptLengthHeuristic()

@@ -1,0 +1,30 @@
+"""Multi-replica front-end router.
+
+Serves `/generate` traffic across N engine replicas with prefix-affinity
+placement (shared affinity keys with `prefix.py`, see `affinity.py`) and
+predicted-length-aware least-loaded balancing (`research/predictor.py`).
+
+Layers:
+- `replica.py`  — Replica abstractions (in-process `AsyncLLMEngine` for
+                  CPU tests, HTTP replicas for real fleets) and the
+                  `ReplicaManager` liveness poller.
+- `policy.py`   — `RoutingPolicy`: consistent-hash ring + affinity map +
+                  predicted-load override.
+- `server.py`   — aiohttp front end: streaming passthrough, single
+                  retry-on-failure excluding the failed replica,
+                  aggregated `/metrics` and `/health/detail`.
+- `metrics.py`  — `intellillm_router_*` Prometheus families.
+"""
+
+from intellillm_tpu.router.policy import RouterConfig, RoutingPolicy
+from intellillm_tpu.router.replica import (HTTPReplica, InProcessReplica,
+                                           Replica, ReplicaManager)
+
+__all__ = [
+    "HTTPReplica",
+    "InProcessReplica",
+    "Replica",
+    "ReplicaManager",
+    "RouterConfig",
+    "RoutingPolicy",
+]

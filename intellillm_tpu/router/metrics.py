@@ -1,0 +1,90 @@
+"""`intellillm_router_*` Prometheus families.
+
+Per-replica series are labelled by replica id (the router's stable name
+for a replica, not its URL — URLs change across restarts). Exported
+(when `prometheus_client` is installed — silently skipped otherwise):
+
+    intellillm_router_requests_total{replica}           counter
+    intellillm_router_routing_decisions_total{decision} counter
+    intellillm_router_failovers_total{replica}          counter
+    intellillm_router_predicted_load_tokens{replica}    gauge
+    intellillm_router_inflight_requests{replica}        gauge
+    intellillm_router_replica_healthy{replica}          gauge
+    intellillm_router_replica_queue_depth{replica,queue} gauge
+
+Routing decisions: `affinity_hit` (known key, sticky replica taken),
+`affinity_new` (key seeded onto its ring replica), `load_balanced`
+(affinity overridden or no key — least predicted load won), `failover`
+(re-route after a replica failure).
+"""
+from __future__ import annotations
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter, Gauge
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+DECISIONS = ("affinity_hit", "affinity_new", "load_balanced", "failover")
+
+
+class _RouterMetrics:
+    """Prometheus collectors for the router (process-global, built once —
+    same singleton pattern as obs/slo.py)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.counter_requests = Counter(
+            "intellillm_router_requests_total",
+            "Requests routed, by target replica.", ["replica"])
+        self.counter_decisions = Counter(
+            "intellillm_router_routing_decisions_total",
+            "Routing decisions by kind (affinity_hit | affinity_new | "
+            "load_balanced | failover).", ["decision"])
+        self.counter_failovers = Counter(
+            "intellillm_router_failovers_total",
+            "Mid-request failovers, by FAILED replica.", ["replica"])
+        self.gauge_predicted_load = Gauge(
+            "intellillm_router_predicted_load_tokens",
+            "Outstanding predicted decode tokens per replica.", ["replica"])
+        self.gauge_inflight = Gauge(
+            "intellillm_router_inflight_requests",
+            "In-flight routed requests per replica.", ["replica"])
+        self.gauge_healthy = Gauge(
+            "intellillm_router_replica_healthy",
+            "1 when the replica's last health probe succeeded, else 0.",
+            ["replica"])
+        self.gauge_queue_depth = Gauge(
+            "intellillm_router_replica_queue_depth",
+            "Replica scheduler queue depths from its /health/detail "
+            "(queue = waiting | running | swapped).", ["replica", "queue"])
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+def get_router_metrics():
+    """The process-global router metric set, or None without prometheus."""
+    if not _PROMETHEUS:
+        return None
+    return _RouterMetrics()

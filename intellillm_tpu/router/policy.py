@@ -1,0 +1,169 @@
+"""Routing policy: prefix affinity + predicted-load least-loaded.
+
+Decision flow for one request (see docs/routing.md):
+
+1. Compute the prompt's affinity key — the shared `affinity.py` key over
+   its first `affinity_blocks` block-aligned blocks (same token+LoRA
+   keying as `PrefixPool`, so "same key" really means "same prefix KV").
+   Prompts shorter than one block have no shareable prefix → no key.
+2. Keyed requests stick to the replica the key last routed to
+   (`affinity_hit`) unless that replica's outstanding predicted decode
+   tokens exceed the least-loaded replica's by more than
+   `load_balance_slack` — then the key is REMAPPED to the least-loaded
+   replica (`load_balanced`). Slack biases toward cache reuse: a warm
+   prefix is worth re-prefilling only when the imbalance is real.
+3. Unseen keys are seeded from a consistent-hash ring (`affinity_new`)
+   so placement is stable across router restarts and independent of
+   arrival order; the same overload check applies.
+4. Keyless requests go to the least predicted load outright
+   (`load_balanced`).
+
+Load is *predicted outstanding decode tokens* (LengthPredictor /
+prompt-length heuristic), not request counts: ten 8-token completions
+are cheaper than one 2048-token one, and the paper's length predictor is
+exactly the signal that makes this distinction available at admission
+time.
+"""
+from __future__ import annotations
+
+import bisect
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from intellillm_tpu.affinity import stable_hash
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class NoReplicaAvailable(Exception):
+    """No healthy, non-excluded replica to route to."""
+
+
+@dataclass
+class RouterConfig:
+    block_size: int = 16           # must match the replicas' KV block size
+    affinity_blocks: int = 4       # prefix blocks hashed into the key
+    load_balance_slack: float = 256.0   # predicted tokens of tolerated skew
+    ring_vnodes: int = 64          # virtual nodes per replica on the ring
+    affinity_map_size: int = 8192  # LRU capacity (keys)
+    max_retries: int = 1           # re-routes after a replica failure
+    health_interval_s: float = 2.0
+
+
+class ConsistentHashRing:
+    """Classic consistent-hash ring with virtual nodes.
+
+    Stable placement for unseen affinity keys: adding/removing one
+    replica only remaps ~1/N of the key space, and the blake2b point
+    hashes make the layout identical across processes and restarts.
+    """
+
+    def __init__(self, vnodes: int = 64) -> None:
+        self.vnodes = vnodes
+        self._points: List[Tuple[int, str]] = []   # sorted (hash, replica)
+        self._hashes: List[int] = []
+        self._replicas: set = set()
+
+    def add(self, replica_id: str) -> None:
+        if replica_id in self._replicas:
+            return
+        self._replicas.add(replica_id)
+        for i in range(self.vnodes):
+            self._points.append(
+                (stable_hash(f"{replica_id}:{i}".encode()), replica_id))
+        self._points.sort()
+        self._hashes = [h for h, _ in self._points]
+
+    def remove(self, replica_id: str) -> None:
+        if replica_id not in self._replicas:
+            return
+        self._replicas.discard(replica_id)
+        self._points = [(h, r) for h, r in self._points if r != replica_id]
+        self._hashes = [h for h, _ in self._points]
+
+    def lookup(self, key: int, candidates) -> Optional[str]:
+        """First ring point clockwise from `key` owned by a candidate."""
+        if not self._points:
+            return None
+        start = bisect.bisect_left(self._hashes, key)
+        n = len(self._points)
+        for off in range(n):
+            replica = self._points[(start + off) % n][1]
+            if replica in candidates:
+                return replica
+        return None
+
+
+class _AffinityMap:
+    """Bounded LRU of affinity key → replica id."""
+
+    def __init__(self, max_entries: int) -> None:
+        self.max_entries = max_entries
+        self._map: "OrderedDict[int, str]" = OrderedDict()
+
+    def get(self, key: int) -> Optional[str]:
+        rid = self._map.get(key)
+        if rid is not None:
+            self._map.move_to_end(key)
+        return rid
+
+    def put(self, key: int, replica_id: str) -> None:
+        self._map[key] = replica_id
+        self._map.move_to_end(key)
+        while len(self._map) > self.max_entries:
+            self._map.popitem(last=False)
+
+    def drop_replica(self, replica_id: str) -> None:
+        stale = [k for k, r in self._map.items() if r == replica_id]
+        for k in stale:
+            del self._map[k]
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+class RoutingPolicy:
+    """Pure routing decisions over a load snapshot (no I/O, no clocks)."""
+
+    def __init__(self, config: RouterConfig) -> None:
+        self.config = config
+        self.ring = ConsistentHashRing(config.ring_vnodes)
+        self.affinity = _AffinityMap(config.affinity_map_size)
+
+    def add_replica(self, replica_id: str) -> None:
+        self.ring.add(replica_id)
+
+    def remove_replica(self, replica_id: str) -> None:
+        """Replica left the fleet (or failed): forget its placements so
+        its keys re-seed from the ring instead of pinning to a ghost."""
+        self.ring.remove(replica_id)
+        self.affinity.drop_replica(replica_id)
+
+    def choose(self, affinity_key: Optional[int],
+               loads: Dict[str, float]) -> Tuple[str, str]:
+        """Pick a replica from `loads` (healthy candidates → predicted
+        outstanding tokens). Returns (replica_id, decision)."""
+        if not loads:
+            raise NoReplicaAvailable("no healthy replica available")
+        # Deterministic tie-break on id keeps tests and reasoning simple.
+        least = min(loads, key=lambda r: (loads[r], r))
+        slack = self.config.load_balance_slack
+
+        if affinity_key is None:
+            return least, "load_balanced"
+
+        mapped = self.affinity.get(affinity_key)
+        if mapped is not None and mapped in loads:
+            if loads[mapped] <= loads[least] + slack:
+                return mapped, "affinity_hit"
+            self.affinity.put(affinity_key, least)
+            return least, "load_balanced"
+
+        seeded = self.ring.lookup(affinity_key, loads)
+        if seeded is not None and loads[seeded] <= loads[least] + slack:
+            self.affinity.put(affinity_key, seeded)
+            return seeded, "affinity_new"
+        self.affinity.put(affinity_key, least)
+        return least, "load_balanced"

@@ -1,0 +1,367 @@
+"""Replica abstractions + the `ReplicaManager` liveness poller.
+
+A Replica is something the router can stream a `/generate`-shaped
+request through and health-probe. Two concrete kinds:
+
+- `InProcessReplica` wraps an `AsyncLLMEngine` in this process — the CPU
+  test vehicle (and a future `data`-axis in-process fleet).
+- `HTTPReplica` fronts a separate engine-server process (the demo
+  `api_server`), speaking its exact wire protocol: POST `/generate`
+  with `stream=true` → newline-delimited JSON chunks whose `text` field
+  is CUMULATIVE (prompt + text so far). Cumulative chunks are what make
+  transparent mid-stream failover possible: a restarted request on
+  another replica simply resumes emitting supersets.
+
+The `ReplicaManager` owns the fleet: attach/launch, a background
+health-poll loop against each replica's `/health/detail`, per-replica
+predicted-load/in-flight accounting, and the per-replica gauges.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import subprocess
+import sys
+import time
+from typing import AsyncIterator, Dict, List, Optional, Tuple
+
+from intellillm_tpu.logger import init_logger
+from intellillm_tpu.router.metrics import get_router_metrics
+from intellillm_tpu.sampling_params import SamplingParams
+from intellillm_tpu.utils import random_uuid
+
+logger = init_logger(__name__)
+
+
+class ReplicaFailure(Exception):
+    """A replica failed while serving a request (connection drop,
+    mid-stream error, non-2xx). Routable to another replica."""
+
+
+class Replica:
+    """Base replica: identity, health state, and load accounting."""
+
+    def __init__(self, replica_id: str) -> None:
+        self.replica_id = replica_id
+        self.healthy = False
+        self.last_health: Optional[dict] = None
+        self.last_health_ts: Optional[float] = None
+        self.consecutive_failures = 0
+        # Router-side load model: outstanding predicted decode tokens and
+        # in-flight request count (decremented on completion OR failure).
+        self.predicted_load = 0.0
+        self.inflight = 0
+
+    async def generate(self, payload: dict,
+                       predicted_len: Optional[int] = None
+                       ) -> AsyncIterator[dict]:
+        raise NotImplementedError
+
+    async def health_detail(self) -> Tuple[int, dict]:
+        """(status_code, body) of the replica's /health/detail."""
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class InProcessReplica(Replica):
+    """Wraps an in-process `AsyncLLMEngine` (CPU tests, single-host
+    fleets). `kill()` simulates a replica crash: in-flight streams raise
+    `ReplicaFailure` at the next chunk and the replica goes unhealthy."""
+
+    def __init__(self, replica_id: str, engine) -> None:
+        super().__init__(replica_id)
+        self.engine = engine
+        self._killed = False
+
+    def kill(self) -> None:
+        self._killed = True
+        self.healthy = False
+
+    async def generate(self, payload: dict,
+                       predicted_len: Optional[int] = None
+                       ) -> AsyncIterator[dict]:
+        if self._killed:
+            raise ReplicaFailure(f"replica {self.replica_id} is down")
+        payload = dict(payload)
+        prompt = payload.pop("prompt")
+        prefix_pos = payload.pop("prefix_pos", None)
+        payload.pop("stream", None)
+        sampling_params = SamplingParams(**payload)
+        request_id = random_uuid()
+        gen = self.engine.generate(prompt, sampling_params, request_id,
+                                   prefix_pos=prefix_pos,
+                                   predicted_len=predicted_len)
+        async for request_output in gen:
+            if self._killed:
+                try:
+                    await self.engine.abort(request_id)
+                finally:
+                    pass
+                raise ReplicaFailure(
+                    f"replica {self.replica_id} died mid-stream")
+            yield {
+                "text": [
+                    request_output.prompt + output.text
+                    for output in request_output.outputs
+                ]
+            }
+
+    async def health_detail(self) -> Tuple[int, dict]:
+        if self._killed:
+            raise ReplicaFailure(f"replica {self.replica_id} is down")
+        llm_engine = getattr(self.engine, "engine", None)
+        if llm_engine is None:
+            return 503, {"status": "initializing"}
+        scheduler = llm_engine.scheduler
+        body = {
+            "status": "ok",
+            "queue_depths": {
+                "waiting": len(scheduler.waiting),
+                "running": len(scheduler.running),
+                "swapped": len(scheduler.swapped),
+            },
+        }
+        try:
+            body["kv_cache_usage"] = llm_engine.kv_cache_usage()
+        except Exception:
+            body["kv_cache_usage"] = None
+        return 200, body
+
+
+class HTTPReplica(Replica):
+    """Fronts an engine server over HTTP (demo api_server protocol).
+
+    Optionally owns the server subprocess (launched replicas); `close()`
+    then terminates it.
+    """
+
+    def __init__(self, replica_id: str, base_url: str,
+                 proc: Optional[subprocess.Popen] = None,
+                 request_timeout_s: float = 600.0) -> None:
+        super().__init__(replica_id)
+        self.base_url = base_url.rstrip("/")
+        self.proc = proc
+        self.request_timeout_s = request_timeout_s
+        self._session = None
+
+    def _get_session(self):
+        import aiohttp
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.request_timeout_s))
+        return self._session
+
+    async def generate(self, payload: dict,
+                       predicted_len: Optional[int] = None
+                       ) -> AsyncIterator[dict]:
+        # predicted_len stays router-side: the demo server's SamplingParams
+        # parsing rejects unknown fields.
+        import aiohttp
+        body = dict(payload)
+        body["stream"] = True
+        try:
+            async with self._get_session().post(
+                    f"{self.base_url}/generate", json=body) as resp:
+                if resp.status != 200:
+                    raise ReplicaFailure(
+                        f"replica {self.replica_id}: /generate -> "
+                        f"{resp.status}")
+                async for line in resp.content:
+                    line = line.strip()
+                    if line:
+                        yield json.loads(line)
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                ConnectionError, json.JSONDecodeError) as e:
+            raise ReplicaFailure(
+                f"replica {self.replica_id}: {type(e).__name__}: {e}"
+            ) from e
+
+    async def health_detail(self) -> Tuple[int, dict]:
+        import aiohttp
+        try:
+            async with self._get_session().get(
+                    f"{self.base_url}/health/detail",
+                    timeout=aiohttp.ClientTimeout(total=5.0)) as resp:
+                return resp.status, await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                ConnectionError, json.JSONDecodeError) as e:
+            raise ReplicaFailure(
+                f"replica {self.replica_id}: {type(e).__name__}: {e}"
+            ) from e
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def launch_http_replica(replica_id: str, port: int,
+                        engine_argv: List[str],
+                        host: str = "127.0.0.1") -> HTTPReplica:
+    """Launch a demo api_server subprocess as a replica (inherits this
+    process's environment, so INTELLILLM_JAX_PLATFORM etc. apply)."""
+    cmd = [
+        sys.executable, "-m", "intellillm_tpu.entrypoints.api_server",
+        "--host", host, "--port", str(port),
+    ] + list(engine_argv)
+    logger.info("launching replica %s: %s", replica_id, " ".join(cmd))
+    proc = subprocess.Popen(cmd)
+    return HTTPReplica(replica_id, f"http://{host}:{port}", proc=proc)
+
+
+class ReplicaManager:
+    """Owns the replica fleet: registration, background health polling,
+    and router-side load accounting (+ per-replica gauges)."""
+
+    def __init__(self, health_interval_s: float = 2.0,
+                 unhealthy_after: int = 2) -> None:
+        self.replicas: Dict[str, Replica] = {}
+        self.health_interval_s = health_interval_s
+        # Probes that must fail consecutively before a replica is marked
+        # unhealthy (one blip shouldn't drain it). Failures during
+        # serving bypass this via mark_failed().
+        self.unhealthy_after = unhealthy_after
+        self._poll_task: Optional[asyncio.Task] = None
+
+    # --- fleet membership -------------------------------------------------
+
+    def add(self, replica: Replica, healthy: bool = False) -> None:
+        assert replica.replica_id not in self.replicas, replica.replica_id
+        replica.healthy = healthy
+        self.replicas[replica.replica_id] = replica
+        self._export_gauges(replica)
+
+    def get(self, replica_id: str) -> Replica:
+        return self.replicas[replica_id]
+
+    def healthy_loads(self, exclude: Optional[set] = None
+                      ) -> Dict[str, float]:
+        """Routing candidates: healthy replicas (minus `exclude`) →
+        outstanding predicted decode tokens. Unhealthy replicas are
+        simply absent — in-flight work keeps draining, new work skips
+        them (drain-on-unhealthy)."""
+        exclude = exclude or set()
+        return {
+            rid: r.predicted_load
+            for rid, r in self.replicas.items()
+            if r.healthy and rid not in exclude
+        }
+
+    # --- load accounting --------------------------------------------------
+
+    def on_route(self, replica_id: str, predicted_len: int) -> None:
+        r = self.replicas[replica_id]
+        r.predicted_load += predicted_len
+        r.inflight += 1
+        m = get_router_metrics()
+        if m is not None:
+            m.counter_requests.labels(replica=replica_id).inc()
+        self._export_gauges(r)
+
+    def on_complete(self, replica_id: str, predicted_len: int) -> None:
+        r = self.replicas[replica_id]
+        r.predicted_load = max(r.predicted_load - predicted_len, 0.0)
+        r.inflight = max(r.inflight - 1, 0)
+        self._export_gauges(r)
+
+    def mark_failed(self, replica_id: str) -> None:
+        """Serving failure: drop the replica from candidates immediately
+        (don't wait for the next poll tick)."""
+        r = self.replicas[replica_id]
+        r.healthy = False
+        r.consecutive_failures += 1
+        self._export_gauges(r)
+
+    # --- health polling ---------------------------------------------------
+
+    async def poll_once(self) -> None:
+        for r in list(self.replicas.values()):
+            try:
+                status, body = await r.health_detail()
+            except Exception as e:
+                r.consecutive_failures += 1
+                if r.consecutive_failures >= self.unhealthy_after:
+                    if r.healthy:
+                        logger.warning("replica %s unhealthy: %s",
+                                       r.replica_id, e)
+                    r.healthy = False
+                self._export_gauges(r)
+                continue
+            r.last_health = body
+            r.last_health_ts = time.monotonic()
+            # A 503 "initializing" body is a live-but-not-ready replica;
+            # "stalled" (watchdog) is unhealthy like a probe failure.
+            ok = status == 200 and body.get("status") == "ok"
+            if ok:
+                if not r.healthy:
+                    logger.info("replica %s healthy", r.replica_id)
+                r.healthy = True
+                r.consecutive_failures = 0
+            else:
+                r.consecutive_failures += 1
+                if r.consecutive_failures >= self.unhealthy_after:
+                    r.healthy = False
+            self._export_gauges(r)
+
+    async def _poll_loop(self) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except Exception:
+                logger.exception("replica health poll failed")
+            await asyncio.sleep(self.health_interval_s)
+
+    def start_polling(self) -> None:
+        if self._poll_task is None or self._poll_task.done():
+            self._poll_task = asyncio.get_event_loop().create_task(
+                self._poll_loop())
+
+    async def stop(self) -> None:
+        if self._poll_task is not None:
+            self._poll_task.cancel()
+            try:
+                await self._poll_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._poll_task = None
+        for r in self.replicas.values():
+            await r.close()
+
+    # --- observability ----------------------------------------------------
+
+    def _export_gauges(self, r: Replica) -> None:
+        m = get_router_metrics()
+        if m is None:
+            return
+        m.gauge_predicted_load.labels(replica=r.replica_id).set(
+            r.predicted_load)
+        m.gauge_inflight.labels(replica=r.replica_id).set(r.inflight)
+        m.gauge_healthy.labels(replica=r.replica_id).set(
+            1 if r.healthy else 0)
+        depths = (r.last_health or {}).get("queue_depths") or {}
+        for queue, depth in depths.items():
+            m.gauge_queue_depth.labels(replica=r.replica_id,
+                                       queue=queue).set(depth)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-replica state for the router's aggregated /health/detail."""
+        out = {}
+        for rid, r in self.replicas.items():
+            out[rid] = {
+                "healthy": r.healthy,
+                "predicted_load_tokens": r.predicted_load,
+                "inflight": r.inflight,
+                "consecutive_failures": r.consecutive_failures,
+                "last_health_age_s": (
+                    round(time.monotonic() - r.last_health_ts, 3)
+                    if r.last_health_ts is not None else None),
+                "health": r.last_health,
+            }
+        return out

@@ -1,0 +1,303 @@
+"""Multi-replica router HTTP front end (aiohttp).
+
+Speaks the demo api_server's `/generate` protocol on the front side and
+streams through to a chosen replica on the back side:
+
+    GET  /health         200 when ≥1 replica is healthy, else 503
+    POST /generate       routed completion; same body as api_server
+    GET  /metrics        Prometheus scrape (intellillm_router_* + any
+                         in-process replica families)
+    GET  /health/detail  aggregated: router decision counters, policy
+                         state, per-replica health/load snapshots; 503
+                         when no healthy replica
+
+Failover: a `ReplicaFailure` mid-request marks the replica unhealthy,
+drops its affinity placements, and re-routes the request once to another
+replica (excluding the failed one). Because `/generate` stream chunks
+carry CUMULATIVE text, a client that already received chunks from the
+failed replica just keeps receiving (superset) chunks from the new one.
+
+Run: python -m intellillm_tpu.router.server --replica-urls ... | \
+         --launch-replicas N [engine args passed through to replicas]
+See docs/routing.md.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+from typing import AsyncIterator, Dict, List, Optional
+
+from aiohttp import web
+
+from intellillm_tpu.affinity import prompt_affinity_key
+from intellillm_tpu.logger import init_logger
+from intellillm_tpu.router.metrics import DECISIONS, get_router_metrics
+from intellillm_tpu.router.policy import (NoReplicaAvailable, RouterConfig,
+                                          RoutingPolicy)
+from intellillm_tpu.router.replica import (Replica, ReplicaFailure,
+                                           ReplicaManager,
+                                           launch_http_replica)
+
+logger = init_logger(__name__)
+
+TIMEOUT_KEEP_ALIVE = 5
+
+
+class Router:
+    """Ties the policy, the replica fleet, and the length predictor into
+    one request path. No HTTP here — `build_router_app` wraps it."""
+
+    def __init__(self, config: RouterConfig, manager: ReplicaManager,
+                 predictor=None, tokenizer=None) -> None:
+        self.config = config
+        self.manager = manager
+        self.predictor = predictor
+        self.tokenizer = tokenizer
+        self.policy = RoutingPolicy(config)
+        # Python-side decision counters so the aggregated /health/detail
+        # works without prometheus_client.
+        self.decisions: Dict[str, int] = {d: 0 for d in DECISIONS}
+
+    def add_replica(self, replica: Replica, healthy: bool = False) -> None:
+        self.manager.add(replica, healthy=healthy)
+        self.policy.add_replica(replica.replica_id)
+
+    # --- request path -----------------------------------------------------
+
+    def _token_ids(self, prompt: str) -> List[int]:
+        if self.tokenizer is not None:
+            return list(self.tokenizer.encode(prompt))
+        # Tokenizer-less routers still need affinity + length signals;
+        # UTF-8 bytes are a stable stand-in (keys just won't match a
+        # tokenized pool's — affinity still works ROUTER-side because
+        # equal prompts yield equal byte ids).
+        return list(prompt.encode("utf-8"))
+
+    def _predict_len(self, prompt: str, token_ids: List[int]) -> int:
+        if self.predictor is None:
+            return max(len(token_ids), 1)
+        try:
+            return int(self.predictor.predict(prompt, token_ids))
+        except Exception:
+            logger.exception("length predictor failed; using prompt length")
+            return max(len(token_ids), 1)
+
+    def _count_decision(self, decision: str) -> None:
+        self.decisions[decision] = self.decisions.get(decision, 0) + 1
+        m = get_router_metrics()
+        if m is not None:
+            m.counter_decisions.labels(decision=decision).inc()
+
+    async def stream_request(self, payload: dict) -> AsyncIterator[dict]:
+        """Route `payload` and yield its (cumulative-text) chunks,
+        failing over up to `max_retries` times."""
+        prompt = payload.get("prompt", "")
+        token_ids = self._token_ids(prompt)
+        key = prompt_affinity_key(token_ids, self.config.block_size,
+                                  self.config.affinity_blocks)
+        predicted_len = self._predict_len(prompt, token_ids)
+
+        excluded: set = set()
+        attempts = self.config.max_retries + 1
+        last_error: Optional[Exception] = None
+        for attempt in range(attempts):
+            loads = self.manager.healthy_loads(exclude=excluded)
+            replica_id, decision = self.policy.choose(key, loads)
+            if attempt > 0:
+                decision = "failover"
+            self._count_decision(decision)
+            self.manager.on_route(replica_id, predicted_len)
+            replica = self.manager.get(replica_id)
+            try:
+                async for chunk in replica.generate(
+                        payload, predicted_len=predicted_len):
+                    yield chunk
+                self.manager.on_complete(replica_id, predicted_len)
+                return
+            except ReplicaFailure as e:
+                last_error = e
+                logger.warning("replica %s failed serving request: %s",
+                               replica_id, e)
+                self.manager.on_complete(replica_id, predicted_len)
+                self.manager.mark_failed(replica_id)
+                # Its cached prefixes are gone with it: let its keys
+                # re-seed instead of pinning to a corpse.
+                self.policy.affinity.drop_replica(replica_id)
+                m = get_router_metrics()
+                if m is not None:
+                    m.counter_failovers.labels(replica=replica_id).inc()
+                excluded.add(replica_id)
+        raise last_error if last_error is not None else NoReplicaAvailable(
+            "request exhausted retries")
+
+    # --- observability ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        healthy = [rid for rid, r in self.manager.replicas.items()
+                   if r.healthy]
+        return {
+            "replicas": self.manager.snapshot(),
+            "healthy_replicas": sorted(healthy),
+            "decisions": dict(self.decisions),
+            "affinity_entries": len(self.policy.affinity),
+            "config": {
+                "block_size": self.config.block_size,
+                "affinity_blocks": self.config.affinity_blocks,
+                "load_balance_slack": self.config.load_balance_slack,
+                "max_retries": self.config.max_retries,
+            },
+        }
+
+    async def stop(self) -> None:
+        await self.manager.stop()
+
+
+def build_router_app(router: Router) -> web.Application:
+    from intellillm_tpu.entrypoints.debug_routes import metrics
+
+    async def health(request: web.Request) -> web.Response:
+        ok = any(r.healthy for r in router.manager.replicas.values())
+        return web.Response(status=200 if ok else 503)
+
+    async def generate(request: web.Request) -> web.StreamResponse:
+        request_dict = await request.json()
+        stream = bool(request_dict.pop("stream", False))
+        try:
+            chunk_iter = router.stream_request(request_dict)
+            if stream:
+                response = web.StreamResponse(
+                    headers={"Content-Type": "application/x-ndjson"})
+                prepared = False
+                async for chunk in chunk_iter:
+                    if not prepared:
+                        await response.prepare(request)
+                        prepared = True
+                    await response.write(
+                        (json.dumps(chunk) + "\n").encode())
+                if not prepared:
+                    await response.prepare(request)
+                await response.write_eof()
+                return response
+            final_chunk = None
+            async for chunk in chunk_iter:
+                final_chunk = chunk
+            assert final_chunk is not None
+            return web.json_response(final_chunk)
+        except NoReplicaAvailable as e:
+            return web.json_response({"error": str(e)}, status=503)
+        except ReplicaFailure as e:
+            # Retries exhausted. A prepared stream can't change status;
+            # aiohttp just closes it, which clients see as truncation.
+            return web.json_response({"error": str(e)}, status=502)
+
+    async def health_detail(request: web.Request) -> web.Response:
+        body = {"router": router.snapshot()}
+        ok = any(r.healthy for r in router.manager.replicas.values())
+        body["status"] = "ok" if ok else "no_healthy_replica"
+        return web.json_response(body, status=200 if ok else 503)
+
+    app = web.Application()
+    app.router.add_get("/health", health)
+    app.router.add_post("/generate", generate)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/health/detail", health_detail)
+
+    async def _start(app: web.Application) -> None:
+        router.manager.start_polling()
+
+    async def _cleanup(app: web.Application) -> None:
+        await router.stop()
+
+    app.on_startup.append(_start)
+    app.on_cleanup.append(_cleanup)
+    return app
+
+
+def make_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="intellillm-tpu multi-replica router")
+    parser.add_argument("--host", type=str, default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8100)
+    parser.add_argument("--replica-urls", type=str, default=None,
+                        help="comma-separated base URLs of already-running "
+                        "engine servers to attach")
+    parser.add_argument("--launch-replicas", type=int, default=0,
+                        help="launch N api_server replica subprocesses; "
+                        "unrecognized args are passed through to them")
+    parser.add_argument("--replica-base-port", type=int, default=8200,
+                        help="first port for --launch-replicas (replica i "
+                        "listens on base+i)")
+    parser.add_argument("--tokenizer", type=str, default=None,
+                        help="tokenizer for affinity keys + length "
+                        "prediction (omit for byte-level fallback)")
+    parser.add_argument("--predictor-path", type=str, default=None,
+                        help="trained LengthPredictor checkpoint dir; "
+                        "missing/invalid falls back to the prompt-length "
+                        "heuristic")
+    parser.add_argument("--block-size", type=int, default=16,
+                        help="KV block size of the replicas (affinity keys "
+                        "are block-aligned)")
+    parser.add_argument("--affinity-blocks", type=int, default=4,
+                        help="leading prompt blocks hashed into the "
+                        "affinity key")
+    parser.add_argument("--load-balance-slack", type=float, default=256.0,
+                        help="predicted-token imbalance tolerated before "
+                        "affinity is overridden")
+    parser.add_argument("--health-interval", type=float, default=2.0,
+                        help="replica /health/detail poll period, seconds")
+    parser.add_argument("--max-retries", type=int, default=1,
+                        help="re-routes after a replica failure")
+    return parser
+
+
+def build_router_from_args(args, engine_argv: List[str]) -> Router:
+    tokenizer = None
+    if args.tokenizer:
+        from intellillm_tpu.transformers_utils.tokenizer import get_tokenizer
+        tokenizer = get_tokenizer(args.tokenizer)
+
+    from intellillm_tpu.research.predictor import load_predictor
+    predictor = load_predictor(args.predictor_path, tokenizer)
+
+    config = RouterConfig(
+        block_size=args.block_size,
+        affinity_blocks=args.affinity_blocks,
+        load_balance_slack=args.load_balance_slack,
+        max_retries=args.max_retries,
+        health_interval_s=args.health_interval,
+    )
+    manager = ReplicaManager(health_interval_s=args.health_interval)
+    router = Router(config, manager, predictor=predictor,
+                    tokenizer=tokenizer)
+
+    urls = [u.strip() for u in (args.replica_urls or "").split(",")
+            if u.strip()]
+    for i, url in enumerate(urls):
+        from intellillm_tpu.router.replica import HTTPReplica
+        router.add_replica(HTTPReplica(f"replica-{i}", url))
+    for i in range(args.launch_replicas):
+        replica = launch_http_replica(
+            f"launched-{i}", args.replica_base_port + i, engine_argv)
+        router.add_replica(replica)
+    if not router.manager.replicas:
+        raise SystemExit(
+            "router needs replicas: pass --replica-urls or "
+            "--launch-replicas")
+    return router
+
+
+def main() -> None:
+    parser = make_arg_parser()
+    # Unknown args are engine flags for --launch-replicas subprocesses.
+    args, engine_argv = parser.parse_known_args()
+    if engine_argv and not args.launch_replicas:
+        parser.error(f"unrecognized arguments: {' '.join(engine_argv)} "
+                     "(only valid with --launch-replicas)")
+    router = build_router_from_args(args, engine_argv)
+    web.run_app(build_router_app(router), host=args.host, port=args.port,
+                keepalive_timeout=TIMEOUT_KEEP_ALIVE)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,13 +1,18 @@
 """Static doc-drift guard for observability CLI flags: every EngineArgs
 / server flag added after the growth seed must be documented in
-docs/observability.md (companion to test_registry_hygiene.py, which
-guards metric names, and test_docs_metrics.py, which guards the metrics
-reference table)."""
+docs/observability.md or docs/routing.md (companion to
+test_registry_hygiene.py, which guards metric names, and
+test_docs_metrics.py, which guards the metrics reference table)."""
 import pathlib
 import re
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
-DOCS = REPO_ROOT / "docs" / "observability.md"
+# A post-seed flag may be documented in either operator doc (router
+# flags live in docs/routing.md).
+DOC_FILES = (
+    REPO_ROOT / "docs" / "observability.md",
+    REPO_ROOT / "docs" / "routing.md",
+)
 
 # Files whose argparse surface is operator-facing engine/server config
 # (tools/top.py is a client, not a server — its flags live in its own
@@ -16,6 +21,7 @@ FLAG_SOURCES = (
     "intellillm_tpu/engine/arg_utils.py",
     "intellillm_tpu/entrypoints/api_server.py",
     "intellillm_tpu/entrypoints/openai/api_server.py",
+    "intellillm_tpu/router/server.py",
 )
 
 FLAG_RE = re.compile(r"add_argument\(\s*[\"'](--[a-z0-9-]+)[\"']")
@@ -62,14 +68,14 @@ def test_scrape_sees_known_flags():
 
 
 def test_post_seed_flags_are_documented():
-    docs = DOCS.read_text(encoding="utf-8")
+    docs = "\n".join(p.read_text(encoding="utf-8") for p in DOC_FILES)
     undocumented = sorted(
         flag for flag in _declared_flags() - SEED_FLAGS
         if flag not in docs)
     assert not undocumented, (
         f"flags added after the seed but missing from "
-        f"docs/observability.md: {undocumented} — document the flag "
-        "(semantics + default) in the relevant section")
+        f"docs/observability.md and docs/routing.md: {undocumented} — "
+        "document the flag (semantics + default) in the relevant section")
 
 
 def test_known_post_seed_flags_still_exist():
@@ -77,5 +83,7 @@ def test_known_post_seed_flags_still_exist():
     # is renamed, update the docs and this list together.
     flags = _declared_flags()
     for flag in ("--slo-ttft-ms", "--slo-tpot-ms", "--hbm-headroom-warn",
-                 "--enable-profiling", "--peak-flops"):
+                 "--enable-profiling", "--peak-flops", "--replica-urls",
+                 "--predictor-path", "--affinity-blocks",
+                 "--load-balance-slack"):
         assert flag in flags, flag

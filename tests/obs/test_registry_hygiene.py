@@ -33,6 +33,10 @@ def test_constructors_are_found():
     assert "intellillm_step_phase_seconds" in names
     assert "intellillm_device_hbm_bytes_in_use" in names
     assert "intellillm_swap_bytes_total" in names
+    # Router families (PR 6) are in-package and covered by this guard.
+    assert "intellillm_router_requests_total" in names
+    assert "intellillm_router_routing_decisions_total" in names
+    assert "intellillm_router_predicted_load_tokens" in names
 
 
 def test_every_metric_name_is_prefixed():

@@ -1,0 +1,178 @@
+"""Unit tests for the routing policy (no engines, no HTTP)."""
+import pytest
+
+from intellillm_tpu.router.metrics import _RouterMetrics
+from intellillm_tpu.router.policy import (ConsistentHashRing,
+                                          NoReplicaAvailable, RouterConfig,
+                                          RoutingPolicy, _AffinityMap)
+from intellillm_tpu.router.replica import Replica, ReplicaManager
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    _RouterMetrics.reset_for_testing()
+    yield
+    _RouterMetrics.reset_for_testing()
+
+
+# --- consistent-hash ring -------------------------------------------------
+
+
+def test_ring_is_deterministic_across_instances():
+    a = ConsistentHashRing(vnodes=32)
+    b = ConsistentHashRing(vnodes=32)
+    for ring in (a, b):
+        ring.add("r0")
+        ring.add("r1")
+        ring.add("r2")
+    candidates = {"r0", "r1", "r2"}
+    for key in range(0, 2**63, 2**57):
+        assert a.lookup(key, candidates) == b.lookup(key, candidates)
+
+
+def test_ring_remove_only_remaps_removed_keys():
+    ring = ConsistentHashRing(vnodes=64)
+    for r in ("r0", "r1", "r2"):
+        ring.add(r)
+    keys = list(range(0, 2**63, 2**53))
+    before = {k: ring.lookup(k, {"r0", "r1", "r2"}) for k in keys}
+    ring.remove("r1")
+    for k in keys:
+        after = ring.lookup(k, {"r0", "r2"})
+        if before[k] != "r1":
+            assert after == before[k]   # consistent hashing's whole point
+        else:
+            assert after in ("r0", "r2")
+
+
+def test_ring_lookup_skips_non_candidates():
+    ring = ConsistentHashRing(vnodes=8)
+    ring.add("r0")
+    ring.add("r1")
+    assert ring.lookup(123, {"r1"}) == "r1"
+    assert ring.lookup(123, set()) is None
+
+
+def test_empty_ring_lookup():
+    assert ConsistentHashRing().lookup(1, {"r0"}) is None
+
+
+# --- affinity map ---------------------------------------------------------
+
+
+def test_affinity_map_lru_eviction():
+    m = _AffinityMap(max_entries=2)
+    m.put(1, "a")
+    m.put(2, "b")
+    m.get(1)          # refresh 1 → 2 is now LRU
+    m.put(3, "c")
+    assert m.get(2) is None
+    assert m.get(1) == "a"
+    assert m.get(3) == "c"
+
+
+def test_affinity_map_drop_replica():
+    m = _AffinityMap(max_entries=8)
+    m.put(1, "a")
+    m.put(2, "b")
+    m.put(3, "a")
+    m.drop_replica("a")
+    assert m.get(1) is None and m.get(3) is None
+    assert m.get(2) == "b"
+
+
+# --- routing decisions ----------------------------------------------------
+
+
+def _policy(slack=256.0):
+    p = RoutingPolicy(RouterConfig(load_balance_slack=slack))
+    p.add_replica("r0")
+    p.add_replica("r1")
+    return p
+
+
+def test_keyless_goes_least_loaded():
+    p = _policy()
+    assert p.choose(None, {"r0": 50.0, "r1": 10.0}) == ("r1",
+                                                        "load_balanced")
+    # Deterministic tie-break on replica id.
+    assert p.choose(None, {"r0": 10.0, "r1": 10.0}) == ("r0",
+                                                        "load_balanced")
+
+
+def test_affinity_sticks_within_slack():
+    p = _policy(slack=100.0)
+    rid, decision = p.choose(42, {"r0": 0.0, "r1": 0.0})
+    assert decision == "affinity_new"
+    # Same key sticks even when the mapped replica is (mildly) busier.
+    other = "r1" if rid == "r0" else "r0"
+    loads = {rid: 90.0, other: 0.0}
+    assert p.choose(42, loads) == (rid, "affinity_hit")
+
+
+def test_affinity_overridden_beyond_slack_and_remapped():
+    p = _policy(slack=100.0)
+    rid, _ = p.choose(42, {"r0": 0.0, "r1": 0.0})
+    other = "r1" if rid == "r0" else "r0"
+    loads = {rid: 500.0, other: 0.0}
+    assert p.choose(42, loads) == (other, "load_balanced")
+    # The override REMAPPED the key: back under slack it sticks to the
+    # new replica (that's where the prefix KV is being rebuilt).
+    assert p.choose(42, {rid: 0.0, other: 0.0}) == (other, "affinity_hit")
+
+
+def test_new_key_seeded_from_ring_is_stable():
+    p1 = _policy()
+    p2 = _policy()
+    loads = {"r0": 0.0, "r1": 0.0}
+    for key in (7, 99, 12345, 2**60):
+        assert p1.choose(key, loads) == p2.choose(key, loads)
+
+
+def test_mapped_replica_gone_reseeds():
+    p = _policy()
+    rid, _ = p.choose(42, {"r0": 0.0, "r1": 0.0})
+    other = "r1" if rid == "r0" else "r0"
+    # Mapped replica excluded (failed): the key must land elsewhere.
+    got, decision = p.choose(42, {other: 0.0})
+    assert got == other
+    assert decision in ("affinity_new", "load_balanced")
+
+
+def test_no_candidates_raises():
+    p = _policy()
+    with pytest.raises(NoReplicaAvailable):
+        p.choose(None, {})
+
+
+# --- replica manager load accounting --------------------------------------
+
+
+def test_manager_load_accounting_and_exclusion():
+    mgr = ReplicaManager()
+    r0, r1 = Replica("r0"), Replica("r1")
+    mgr.add(r0, healthy=True)
+    mgr.add(r1, healthy=True)
+    mgr.on_route("r0", 100)
+    mgr.on_route("r0", 50)
+    mgr.on_route("r1", 10)
+    assert mgr.healthy_loads() == {"r0": 150.0, "r1": 10.0}
+    assert r0.inflight == 2
+    mgr.on_complete("r0", 100)
+    assert mgr.healthy_loads()["r0"] == 50.0
+    assert mgr.healthy_loads(exclude={"r1"}) == {"r0": 50.0}
+    mgr.mark_failed("r1")
+    assert "r1" not in mgr.healthy_loads()
+    # Load never goes negative (double-complete is clamped).
+    mgr.on_complete("r0", 1000)
+    assert mgr.healthy_loads()["r0"] == 0.0
+
+
+def test_manager_snapshot_shape():
+    mgr = ReplicaManager()
+    mgr.add(Replica("r0"), healthy=True)
+    snap = mgr.snapshot()
+    assert snap["r0"]["healthy"] is True
+    assert snap["r0"]["predicted_load_tokens"] == 0.0
+    assert snap["r0"]["inflight"] == 0
+    assert "health" in snap["r0"]

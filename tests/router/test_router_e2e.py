@@ -1,0 +1,219 @@
+"""E2E acceptance: router over 2 in-process replicas (tiny OPT, CPU).
+
+One event loop drives the whole scenario (engine background loops bind
+to it): affinity stickiness over HTTP, predicted-load balancing while a
+request is in flight, transparent mid-stream failover on a killed
+replica, and the router's /metrics + aggregated /health/detail.
+"""
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from intellillm_tpu.engine.arg_utils import AsyncEngineArgs
+from intellillm_tpu.engine.async_llm_engine import AsyncLLMEngine
+from intellillm_tpu.research.predictor import PromptLengthHeuristic
+from intellillm_tpu.router.metrics import _RouterMetrics
+from intellillm_tpu.router.policy import RouterConfig
+from intellillm_tpu.router.replica import InProcessReplica, ReplicaManager
+from intellillm_tpu.router.server import Router, build_router_app
+
+# Prompts use only tiny_opt_dir's word-level vocabulary. The router runs
+# tokenizer-less (byte ids): LONG_PROMPT is 37 bytes → has an affinity
+# key at block_size=8; SHORT_PROMPT is 5 bytes → keyless.
+LONG_PROMPT = "the president of the united states is"
+SHORT_PROMPT = "hello"
+OTHER_PROMPT = "the cat runs fast and the dog"
+
+
+def _build_engine(tiny_opt_dir):
+    args = AsyncEngineArgs(model=tiny_opt_dir, dtype="float32",
+                           max_model_len=128,
+                           num_device_blocks_override=128,
+                           max_num_seqs=4, max_paddings=512,
+                           swap_space=0.01, disable_log_stats=True,
+                           disable_log_requests=True)
+    return AsyncLLMEngine.from_engine_args(args)
+
+
+def _payload(prompt, max_tokens=8):
+    return {"prompt": prompt, "max_tokens": max_tokens,
+            "temperature": 0.0, "ignore_eos": True}
+
+
+def _serving_replica(router):
+    """The replica currently holding the single in-flight request."""
+    busy = [r for r in router.manager.replicas.values() if r.inflight > 0]
+    assert len(busy) == 1, [(r.replica_id, r.inflight)
+                            for r in router.manager.replicas.values()]
+    return busy[0]
+
+
+def test_router_e2e_two_inprocess_replicas(tiny_opt_dir):
+    _RouterMetrics.reset_for_testing()
+
+    async def run():
+        config = RouterConfig(block_size=8, affinity_blocks=2,
+                              load_balance_slack=0.0, max_retries=1,
+                              health_interval_s=0.2)
+        manager = ReplicaManager(health_interval_s=0.2)
+        router = Router(config, manager,
+                        predictor=PromptLengthHeuristic(scale=4.0),
+                        tokenizer=None)
+        r0 = InProcessReplica("r0", _build_engine(tiny_opt_dir))
+        r1 = InProcessReplica("r1", _build_engine(tiny_opt_dir))
+        router.add_replica(r0, healthy=True)
+        router.add_replica(r1, healthy=True)
+
+        client = TestClient(TestServer(build_router_app(router)))
+        await client.start_server()
+        try:
+            # --- 1. shared-prefix requests stick to one replica --------
+            first_texts = None
+            for i in range(3):
+                resp = await client.post("/generate",
+                                         json=_payload(LONG_PROMPT))
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["text"][0].startswith(LONG_PROMPT)
+                if first_texts is None:
+                    first_texts = body["text"]
+                else:
+                    # Same replica, greedy sampling → identical output.
+                    assert body["text"] == first_texts
+            assert router.decisions["affinity_new"] == 1
+            assert router.decisions["affinity_hit"] == 2
+
+            # --- 2. keyless prompt balances away from in-flight load ---
+            gen_a = router.stream_request(_payload(LONG_PROMPT,
+                                                   max_tokens=24))
+            await gen_a.__anext__()          # A is now in flight
+            loaded = _serving_replica(router)
+            gen_b = router.stream_request(_payload(SHORT_PROMPT))
+            await gen_b.__anext__()
+            busy = [r for r in router.manager.replicas.values()
+                    if r.inflight > 0]
+            assert len(busy) == 2
+            b_replica = next(r for r in busy if r is not loaded)
+            assert b_replica.replica_id != loaded.replica_id
+            assert router.decisions["load_balanced"] >= 1
+            async for _ in gen_b:
+                pass
+            async for _ in gen_a:
+                pass
+            assert all(r.inflight == 0
+                       for r in router.manager.replicas.values())
+
+            # --- 3. router /metrics exposes intellillm_router_* --------
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            scrape = await resp.text()
+            assert "intellillm_router_requests_total" in scrape
+            assert "intellillm_router_routing_decisions_total" in scrape
+            assert "intellillm_router_replica_healthy" in scrape
+            assert "intellillm_router_predicted_load_tokens" in scrape
+
+            # --- 4. aggregated /health/detail: per-replica health ------
+            resp = await client.get("/health/detail")
+            assert resp.status == 200
+            detail = await resp.json()
+            assert detail["status"] == "ok"
+            replicas = detail["router"]["replicas"]
+            assert set(replicas) == {"r0", "r1"}
+            assert all(replicas[rid]["healthy"] for rid in replicas)
+            # The poller has stored real replica health bodies.
+            await manager.poll_once()
+            resp = await client.get("/health/detail")
+            detail = await resp.json()
+            health0 = detail["router"]["replicas"]["r0"]["health"]
+            assert health0 is not None and "queue_depths" in health0
+
+            # --- 5. kill the sticky replica mid-stream: failover -------
+            gen = router.stream_request(_payload(LONG_PROMPT,
+                                                 max_tokens=16))
+            chunk = await gen.__anext__()
+            victim = _serving_replica(router)
+            victim.kill()
+            chunks = [chunk]
+            async for c in gen:
+                chunks.append(c)
+            # The re-routed replica replayed the request: cumulative
+            # chunks, final text is a full completion of the prompt.
+            assert chunks[-1]["text"][0].startswith(LONG_PROMPT)
+            assert len(chunks[-1]["text"][0]) > len(LONG_PROMPT)
+            assert router.decisions["failover"] == 1
+            assert not victim.healthy
+            survivor = next(r for r in router.manager.replicas.values()
+                            if r is not victim)
+            assert survivor.healthy
+
+            # --- 6. fleet state after the kill -------------------------
+            resp = await client.get("/health/detail")
+            assert resp.status == 200          # one replica still healthy
+            detail = await resp.json()
+            assert detail["router"]["replicas"][
+                victim.replica_id]["healthy"] is False
+            assert detail["router"]["decisions"]["failover"] == 1
+            # New traffic (including the victim's old keys) is served by
+            # the survivor.
+            resp = await client.post("/generate", json=_payload(
+                LONG_PROMPT))
+            assert resp.status == 200
+            resp = await client.post("/generate", json=_payload(
+                OTHER_PROMPT))
+            assert resp.status == 200
+
+            # --- 7. no healthy replica: clean 503s ---------------------
+            survivor.kill()
+            resp = await client.post("/generate",
+                                     json=_payload(SHORT_PROMPT))
+            assert resp.status in (502, 503)
+            resp = await client.get("/health")
+            assert resp.status == 503
+            resp = await client.get("/health/detail")
+            assert resp.status == 503
+            detail = await resp.json()
+            assert detail["status"] == "no_healthy_replica"
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+    _RouterMetrics.reset_for_testing()
+
+
+def test_router_streaming_http(tiny_opt_dir):
+    """HTTP streaming: ndjson chunks with cumulative text, final chunk is
+    the full completion."""
+    _RouterMetrics.reset_for_testing()
+
+    async def run():
+        config = RouterConfig(block_size=8, affinity_blocks=2)
+        router = Router(config, ReplicaManager(health_interval_s=0.5),
+                        predictor=PromptLengthHeuristic())
+        router.add_replica(
+            InProcessReplica("solo", _build_engine(tiny_opt_dir)),
+            healthy=True)
+        client = TestClient(TestServer(build_router_app(router)))
+        await client.start_server()
+        try:
+            payload = _payload(LONG_PROMPT, max_tokens=6)
+            payload["stream"] = True
+            resp = await client.post("/generate", json=payload)
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "application/x-ndjson")
+            chunks = []
+            async for line in resp.content:
+                line = line.strip()
+                if line:
+                    chunks.append(json.loads(line))
+            assert len(chunks) >= 2
+            for prev, cur in zip(chunks, chunks[1:]):
+                assert cur["text"][0].startswith(prev["text"][0])
+            assert chunks[-1]["text"][0].startswith(LONG_PROMPT)
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+    _RouterMetrics.reset_for_testing()

@@ -1,0 +1,100 @@
+"""Stability tests for the shared affinity-key helper.
+
+The router and the prefix pool key on the SAME function; these tests pin
+the exact key values so any change to the hashing scheme (which would
+silently break cross-process routing affinity and invalidate persisted
+routing state) fails loudly.
+"""
+import subprocess
+import sys
+
+from intellillm_tpu.affinity import (affinity_key, prompt_affinity_key,
+                                     stable_hash, truncate_to_block)
+from intellillm_tpu.prefix import Prefix, PrefixPool
+
+# Pinned constants: blake2b(digest_size=8) over little-endian int64
+# lora_int_id followed by the packed int64 token ids. These must NEVER
+# change across releases — routers and pools in different processes (and
+# different versions) must agree on them.
+PINNED = {
+    ((1, 2, 3, 4), 0): 2821693476514209883,
+    ((1, 2, 3, 4), 7): 1824364471692216556,
+    ((), 0): 1786884285633530058,
+    (tuple(range(32)), 0): 10393153729583416920,
+}
+
+
+def test_pinned_key_values():
+    for (token_ids, lora), expected in PINNED.items():
+        assert affinity_key(token_ids, lora) == expected
+
+
+def test_lora_id_separates_keys():
+    ids = (5, 6, 7, 8)
+    assert affinity_key(ids, 0) != affinity_key(ids, 1)
+
+
+def test_key_is_order_sensitive():
+    assert affinity_key((1, 2, 3, 4)) != affinity_key((4, 3, 2, 1))
+
+
+def test_key_stable_across_processes():
+    # The whole point vs builtin hash(): immune to PYTHONHASHSEED.
+    code = ("from intellillm_tpu.affinity import affinity_key;"
+            "print(affinity_key((1, 2, 3, 4), 0))")
+    for seed in ("0", "12345"):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={"PYTHONHASHSEED": seed, "PATH": "/usr/bin:/bin",
+                 "PYTHONPATH": "/root/repo"},
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert int(out.stdout.strip()) == PINNED[((1, 2, 3, 4), 0)]
+
+
+def test_truncate_to_block():
+    assert truncate_to_block(list(range(10)), 4) == tuple(range(8))
+    assert truncate_to_block([1, 2, 3], 4) == ()
+    assert truncate_to_block(list(range(8)), 4) == tuple(range(8))
+
+
+def test_prompt_affinity_key_caps_at_max_blocks():
+    # 40 tokens truncate to 2 blocks (32 tokens), below the 4-block cap,
+    # so the key equals the plain 32-token key...
+    k40 = prompt_affinity_key(list(range(40)), block_size=16, max_blocks=4)
+    assert k40 == PINNED[(tuple(range(32)), 0)]
+    # ...and prompts sharing the first 4 blocks collide regardless of tail.
+    base = list(range(64))
+    k_a = prompt_affinity_key(base + [100, 101] * 8, block_size=16,
+                              max_blocks=4)
+    k_b = prompt_affinity_key(base + [200, 201] * 20, block_size=16,
+                              max_blocks=4)
+    assert k_a == k_b == prompt_affinity_key(base, block_size=16,
+                                             max_blocks=4)
+
+
+def test_prompt_affinity_key_sub_block_is_none():
+    assert prompt_affinity_key([1, 2, 3], block_size=16) is None
+    assert prompt_affinity_key([], block_size=16) is None
+
+
+def test_stable_hash_bytes():
+    assert stable_hash(b"replica-0:0") == 6839600686454068614
+
+
+def test_prefix_uses_shared_key():
+    p = Prefix(tuple(range(32)), block_size=16, lora_int_id=0)
+    assert p.hash == PINNED[(tuple(range(32)), 0)]
+    # builtin hash() folds large ints mod 2**61-1; equal keys stay equal.
+    assert hash(p) == hash(p.hash)
+
+
+def test_prefix_pool_dedups_on_shared_key():
+    pool = PrefixPool(block_size=16)
+    a = pool.add_or_get_prefix(list(range(40)))
+    b = pool.add_or_get_prefix(list(range(32)))
+    assert a is b
+    assert a.hash == PINNED[(tuple(range(32)), 0)]
+    # Different adapters never share a pool entry.
+    c = pool.add_or_get_prefix(list(range(32)), lora_int_id=3)
+    assert c is not a

@@ -1,0 +1,116 @@
+"""bench.py backend-probe hardening (BENCH_r04/r05 dark trajectory).
+
+A hung probe must (a) be killed — process GROUP and all — within its
+budget, (b) leave a structured probe record with the faulthandler stack,
+and (c) let the bench emit a parseable `skipped` record instead of
+hanging the whole run.
+"""
+import json
+import time
+
+import pytest
+
+import bench
+
+
+@pytest.fixture(autouse=True)
+def _reset_progress():
+    bench._PROGRESS["phase"] = "start"
+    bench._PROGRESS["probe"] = []
+    bench._PROGRESS["warmup_tok_s"] = None
+    yield
+
+
+@pytest.fixture
+def fast_probe_env(monkeypatch):
+    monkeypatch.setenv("INTELLILLM_BENCH_PROBE_ATTEMPTS", "1")
+    monkeypatch.setenv("INTELLILLM_BENCH_PROBE_BACKOFF", "0")
+    monkeypatch.setenv("INTELLILLM_BENCH_PROBE_TIMEOUT", "3")
+
+
+def test_hung_probe_is_killed_within_budget(monkeypatch, fast_probe_env):
+    monkeypatch.setattr(bench, "_probe_child_code",
+                        lambda timeout_s: "import time; time.sleep(600)")
+    t0 = time.monotonic()
+    assert bench.probe_backend() is False
+    assert time.monotonic() - t0 < 30
+    [rec] = bench._PROGRESS["probe"]
+    assert rec["ok"] is False
+    assert "hung" in rec["err"]
+
+
+def test_hung_probe_with_grandchild_holding_pipe(monkeypatch,
+                                                 fast_probe_env):
+    """A child that forks a helper (TPU runtimes do) and hangs: the
+    helper inherits the stderr pipe, so a direct-child-only kill leaves
+    `communicate()` blocked forever. The process-group kill must reap
+    both within budget."""
+    child = ("import subprocess, sys, time\n"
+             "subprocess.Popen(['sleep', '600'], stderr=sys.stderr)\n"
+             "time.sleep(600)\n")
+    monkeypatch.setattr(bench, "_probe_child_code", lambda t: child)
+    t0 = time.monotonic()
+    assert bench.probe_backend() is False
+    assert time.monotonic() - t0 < 30
+    [rec] = bench._PROGRESS["probe"]
+    assert "hung" in rec["err"]
+
+
+def test_wedged_probe_captures_faulthandler_stack(monkeypatch,
+                                                  fast_probe_env):
+    """A child that self-dumps via faulthandler (the real probe's wedge
+    path) must yield a probe record carrying the stack."""
+    child = ("import faulthandler, time\n"
+             "faulthandler.dump_traceback_later(0.5, exit=True)\n"
+             "time.sleep(600)\n")
+    monkeypatch.setattr(bench, "_probe_child_code", lambda t: child)
+    assert bench.probe_backend() is False
+    [rec] = bench._PROGRESS["probe"]
+    assert "stack" in rec
+    assert "Timeout (" in rec["stack"]
+
+
+def test_probe_succeeds_on_cpu(monkeypatch):
+    """The real probe child against the CPU backend: exits 0, reports
+    the platform, one ok record."""
+    monkeypatch.setenv("INTELLILLM_BENCH_PROBE_ATTEMPTS", "1")
+    monkeypatch.setenv("INTELLILLM_BENCH_PROBE_BACKOFF", "0")
+    monkeypatch.setenv("INTELLILLM_BENCH_PROBE_TIMEOUT", "120")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert bench.probe_backend() is True
+    [rec] = bench._PROGRESS["probe"]
+    assert rec["ok"] is True
+    assert rec["platform"] == "cpu"
+
+
+def test_probe_budget_is_clamped(monkeypatch, capsys):
+    """Env overrides beyond the fail-fast budget are clamped IN the
+    loop (BENCH_r05 carried 3x300s through the env)."""
+    monkeypatch.setenv("INTELLILLM_BENCH_PROBE_ATTEMPTS", "5")
+    monkeypatch.setenv("INTELLILLM_BENCH_PROBE_BACKOFF", "0")
+    monkeypatch.setenv("INTELLILLM_BENCH_PROBE_TIMEOUT", "900")
+    monkeypatch.setattr(bench, "_probe_child_code",
+                        lambda t: "raise SystemExit(1)")
+    assert bench.probe_backend() is False
+    assert len(bench._PROGRESS["probe"]) == bench._MAX_PROBE_ATTEMPTS
+    assert "clamping probe budget" in capsys.readouterr().err
+
+
+def test_extract_probe_stack():
+    dump = "noise\nTimeout (0:00:50)!\nThread 0x1 (most recent call)\n"
+    assert bench._extract_probe_stack(dump).startswith("Timeout (")
+    assert bench._extract_probe_stack(dump.encode()).startswith("Timeout (")
+    assert bench._extract_probe_stack("no marker here") is None
+    assert bench._extract_probe_stack(None) is None
+
+
+def test_skip_record_is_structured(capsys):
+    bench._PROGRESS["phase"] = "probe"
+    bench._PROGRESS["probe"] = [{"attempt": 1, "ok": False,
+                                 "err": "probe hung > 3s (killed)"}]
+    bench._skip_record("TPU backend unavailable after all probe retries")
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["metric"] == "skipped"
+    assert rec["value"] == 0
+    assert rec["phase"] == "probe"
+    assert rec["probe_attempts"][0]["err"].startswith("probe hung")

@@ -55,6 +55,53 @@ def test_benchmark_serving_smoke():
     assert m["ttft_percentiles_ms"]["p50"] > 0
 
 
+def test_serve_bench_fleet_args_parse():
+    """The fleet scenario's CLI surface stays wired (cheap guard; the
+    full fleet boot lives in the slow smoke below)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from benchmarks.serve_bench import make_arg_parser
+    args = make_arg_parser().parse_args(
+        ["--scenario", "fleet", "--num-replicas", "3",
+         "--replica-base-port", "9000"])
+    assert args.scenario == "fleet"
+    assert args.num_replicas == 3
+    assert args.replica_base_port == 9000
+
+
+@pytest.mark.slow
+def test_serve_bench_fleet_smoke():
+    """Fleet scenario end to end: 2 demo-server replicas behind the
+    router, one rate through the router, per-replica SLO split + routing
+    counters in the output."""
+    import json
+    r = _run(["benchmarks/serve_bench.py", "--size", "tiny",
+              "--scenario", "fleet", "--num-replicas", "2",
+              "--num-prompts", "4", "--rates", "inf", "--input-len", "8",
+              "--output-len", "8", "--max-model-len", "64",
+              "--max-num-seqs", "4", "--num-decode-steps", "4",
+              "--num-device-blocks", "64", "--port", "8735",
+              "--replica-base-port", "8741", "--init-timeout", "240",
+              "--server-log", "/tmp/serve_bench_fleet.log"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    summary = None
+    for line in r.stdout.splitlines():
+        if line.startswith('{"serve_bench_summary"'):
+            summary = json.loads(line)["serve_bench_summary"]
+    assert summary is not None, r.stdout[-2000:]
+    assert summary["scenario"] == "fleet"
+    (m,) = summary["results"]
+    assert m["completed"] == 4
+    assert m["output_tok_s"] > 0
+    per_replica = summary["per_replica_slo"]
+    assert set(per_replica) == {"replica-0", "replica-1"}
+    assert all("slo" in v for v in per_replica.values())
+    router = summary["router"]["metrics"]
+    # Warm-up (2x4) + measured (4) requests all went through the router.
+    assert sum(router["requests_total"].values()) >= 12
+    assert sum(router["decisions"].values()) >= 12
+    assert all(v == 1.0 for v in router["replica_healthy"].values())
+
+
 def test_sp_prefill_bench_smoke():
     """sp_prefill_bench emits one JSON line per (mode, length) on the CPU
     backend (flash under interpret mode, ring on the virtual mesh)."""

@@ -109,6 +109,69 @@ def test_predictor_ordinal_task():
     assert short < long
 
 
+def test_predict_batch_matches_single():
+    """Serve path: batched predict must agree with per-item predict and
+    amortize the forward pass."""
+    prompts, lens = _make_synthetic(64)
+    cfg = PredictorConfig(vocab_size=100, embed_dim=16, hidden_dim=32,
+                          epochs=2)
+    pred = LengthPredictor(cfg)
+    pred.train(prompts, lens)
+    batch = [[7] + [50] * 5, [20] + [50] * 5, [30, 31, 32]]
+    singles = [pred.predict(None, ids) for ids in batch]
+    assert pred.predict_batch(batch) == singles
+    assert pred.predict_batch([]) == []
+
+
+def test_predict_latency_budget():
+    """The predictor sits on the request admission path; warm per-item
+    predict latency must be far below a scheduling step (budget: 50ms on
+    CPU — TPU is faster)."""
+    cfg = PredictorConfig(vocab_size=100, embed_dim=16, hidden_dim=32)
+    pred = LengthPredictor(cfg)
+    ids = list(range(10, 70))
+    pred.predict(None, ids)           # warm the jit cache
+    pred.latencies_ms.clear()
+    for _ in range(20):
+        pred.predict(None, ids)
+    stats = pred.latency_stats()
+    assert stats["p50_ms"] < 50, stats
+
+
+def test_prompt_length_heuristic():
+    from intellillm_tpu.research.predictor import PromptLengthHeuristic
+
+    h = PromptLengthHeuristic(scale=1.0, min_len=16, max_len=512)
+    # Monotone in prompt length, clipped at both ends.
+    assert h.predict(None, [1]) == 16
+    assert h.predict(None, [1] * 100) == 100
+    assert h.predict(None, [1] * 10000) == 512
+    assert h.predict("x" * 400) == 100       # ~4 chars/token
+    assert h.predict_batch([[1] * 100, "x" * 400]) == [100, 100]
+    assert h.latency_stats() == {}
+
+
+def test_load_predictor_degrades_gracefully(tmp_path):
+    """Router must work predictor-less: missing / absent / corrupt
+    checkpoints all yield the heuristic, a real checkpoint loads."""
+    from intellillm_tpu.research.predictor import (PromptLengthHeuristic,
+                                                   load_predictor)
+
+    assert isinstance(load_predictor(None), PromptLengthHeuristic)
+    assert isinstance(load_predictor(str(tmp_path / "nope")),
+                      PromptLengthHeuristic)
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "predictor_config.json").write_text("{not json")
+    assert isinstance(load_predictor(str(bad)), PromptLengthHeuristic)
+
+    good = tmp_path / "good"
+    cfg = PredictorConfig(vocab_size=100, embed_dim=16, hidden_dim=32,
+                          epochs=1)
+    LengthPredictor(cfg).save(str(good))
+    assert isinstance(load_predictor(str(good)), LengthPredictor)
+
+
 def test_predictor_classification_weighted():
     """Weighted CE handles imbalanced classes (reference weighted NLL)."""
     import numpy as np
