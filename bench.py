@@ -62,6 +62,23 @@ def _device_snapshot():
         return None
 
 
+def _flush_black_box(reason: str):
+    """Dump the in-memory observability state (live flight-recorder
+    traces, watchdog stall reports, SLO summary) to a durable JSON file
+    (obs/trace_export.py) and return its path — so a dark round
+    (BENCH_r04/r05 class: hang, watchdog kill) leaves an artifact.
+    Best-effort: the dump must never mask the original failure."""
+    if "intellillm_tpu" not in sys.modules:
+        # Nothing observability-bearing was ever imported (e.g. the
+        # probe failed before the engine); importing now can't help.
+        return None
+    try:
+        from intellillm_tpu.obs.trace_export import flush_black_box
+        return flush_black_box(reason, extra={"progress": _PROGRESS})
+    except Exception:
+        return None
+
+
 def _fail_record(reason: str, exit_code: int | None = None):
     """Print the structured failure record (one JSON line, driver-parseable).
 
@@ -84,6 +101,7 @@ def _fail_record(reason: str, exit_code: int | None = None):
     snap = _device_snapshot()
     if snap is not None:
         rec["device_telemetry"] = snap
+    rec["black_box"] = _flush_black_box(reason)
     print(json.dumps(rec), flush=True)
     if exit_code is not None:
         # os._exit: the watchdog fires when the process is wedged inside a
@@ -103,6 +121,7 @@ def _skip_record(reason: str):
         "reason": reason[:500],
         "phase": _PROGRESS["phase"],
         "probe_attempts": _PROGRESS["probe"],
+        "black_box": _flush_black_box(reason),
     }
     print(json.dumps(rec), flush=True)
 
@@ -408,6 +427,14 @@ def main():
         except Exception as e2:
             _fail_record(f"build_engine failed twice: {e2!r}")
             raise
+
+    # From here the engine (and its flight recorder) exists: a SIGTERM
+    # from the driver should flush the black box before dying.
+    try:
+        from intellillm_tpu.obs.trace_export import install_black_box_handlers
+        install_black_box_handlers((signal.SIGTERM,))
+    except Exception:
+        pass
 
     # Warmup: compile prefill+decode buckets on a short run. When the
     # measured run will chain pipelined continuations (out > K), the

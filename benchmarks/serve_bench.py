@@ -193,6 +193,45 @@ def snapshot_efficiency(base: str) -> dict:
         return {"error": f"efficiency scrape failed: {e}"}
 
 
+def snapshot_fleet_traces(router_base: str, limit: int = 3) -> dict:
+    """Sample stitched fleet traces from the router: recent trace ids
+    from /debug/trace, each fetched via /debug/trace/{id} — the per-hop
+    attribution (router_queue / routing / network / replica_queue /
+    prefill / decode) is the fleet-level answer to "where did the
+    latency go". Returns {"samples": [...], "hops_mean_ms": {...}}."""
+    out = {"samples": [], "hops_mean_ms": {}}
+    try:
+        with urllib.request.urlopen(router_base + "/debug/trace",
+                                    timeout=5) as r:
+            listing = json.loads(r.read().decode(errors="replace"))
+    except Exception as e:
+        return {"error": f"trace listing scrape failed: {e}"}
+    hop_sums, hop_counts = {}, {}
+    for trace_id in (listing.get("recent_trace_ids") or [])[:limit]:
+        try:
+            with urllib.request.urlopen(
+                    f"{router_base}/debug/trace/{trace_id}",
+                    timeout=5) as r:
+                stitched = json.loads(r.read().decode(errors="replace"))
+        except Exception:
+            continue
+        attribution = stitched.get("attribution") or {}
+        out["samples"].append({
+            "trace_id": trace_id,
+            "hops": stitched.get("hops"),
+            "e2e_s": attribution.get("e2e_s"),
+            "hops_s": attribution.get("hops_s"),
+            "num_events": len(stitched.get("timeline") or []),
+        })
+        for hop, seconds in (attribution.get("hops_s") or {}).items():
+            hop_sums[hop] = hop_sums.get(hop, 0.0) + seconds
+            hop_counts[hop] = hop_counts.get(hop, 0) + 1
+    out["hops_mean_ms"] = {
+        hop: round(hop_sums[hop] / hop_counts[hop] * 1e3, 3)
+        for hop in hop_sums}
+    return out
+
+
 def distill_device_telemetry(detail: dict) -> dict:
     """Compact memory-state record for the summary JSON: per-device
     peak/in-use bytes, the ledger, headroom, and total swap traffic."""
@@ -395,13 +434,19 @@ def run_fleet(args, model_dir: str, tokenizer) -> dict:
             "metrics": snapshot_router_metrics(router_base),
             "health_detail": snapshot_health_detail(router_base),
         }
+        # Per-hop latency splits: stitched trace samples from the
+        # router's aggregator + each replica's own hop decomposition
+        # (slo.hops_ms from its /health/detail).
+        summary["trace_attribution"] = snapshot_fleet_traces(router_base)
         per_replica = {}
         for name, base, proc, log_path in replicas:
             detail = snapshot_health_detail(base)
+            slo = detail.get("slo") or {}
             per_replica[name] = {
                 "base": base,
                 "status": detail.get("status"),
-                "slo": detail.get("slo") or {},
+                "slo": slo,
+                "hops_ms": slo.get("hops_ms"),
                 "queue_depths": detail.get("queue_depths"),
                 "kv_cache_usage": detail.get("kv_cache_usage"),
             }
@@ -409,6 +454,7 @@ def run_fleet(args, model_dir: str, tokenizer) -> dict:
         print(json.dumps({"serve_bench_fleet": {
             "per_replica_slo": per_replica,
             "router": summary["router"],
+            "trace_attribution": summary["trace_attribution"],
         }}), flush=True)
     finally:
         if router_proc is not None:

@@ -9,6 +9,11 @@ Endpoints:
     POST /generate     → {"text": [...]} or newline-delimited JSON stream
 plus the shared observability surface from entrypoints/debug_routes.py
 (/metrics, /health/detail, /debug/*).
+
+A client-supplied `X-Request-Id` header (validated: ≤128 chars from a
+safe alphabet, else replaced) becomes the request id — the distributed
+trace id the router propagates — and is echoed on every response, so
+client-side correlation with /debug/trace works end to end.
 """
 from __future__ import annotations
 
@@ -21,7 +26,7 @@ from aiohttp import web
 from intellillm_tpu.engine.arg_utils import AsyncEngineArgs
 from intellillm_tpu.engine.async_llm_engine import AsyncLLMEngine
 from intellillm_tpu.entrypoints.debug_routes import add_debug_routes
-from intellillm_tpu.obs import request_context
+from intellillm_tpu.obs import request_context, sanitize_request_id
 from intellillm_tpu.sampling_params import SamplingParams
 from intellillm_tpu.utils import random_uuid
 
@@ -43,7 +48,12 @@ async def generate(request: web.Request) -> web.StreamResponse:
     prefix_pos = request_dict.pop("prefix_pos", None)
     stream = request_dict.pop("stream", False)
     sampling_params = SamplingParams(**request_dict)
-    request_id = random_uuid()
+    # Honor a validated client X-Request-Id (this is how the router
+    # propagates the distributed trace id — every flight-recorder event
+    # then lands under the fleet-wide id); hostile or malformed values
+    # are replaced with a server-minted one. Echoed on all responses.
+    request_id = (sanitize_request_id(request.headers.get("X-Request-Id"))
+                  or random_uuid())
 
     # Bind the request id to this handler's context for the whole
     # response lifetime (not just generator creation) so log lines
@@ -56,7 +66,8 @@ async def generate(request: web.Request) -> web.StreamResponse:
 
         if stream:
             response = web.StreamResponse(
-                headers={"Content-Type": "application/x-ndjson"})
+                headers={"Content-Type": "application/x-ndjson",
+                         "X-Request-Id": request_id})
             await response.prepare(request)
             async for request_output in results_generator:
                 text_outputs = [
@@ -73,7 +84,8 @@ async def generate(request: web.Request) -> web.StreamResponse:
             if (request.transport is not None
                     and request.transport.is_closing()):
                 await engine.abort(request_id)
-                return web.Response(status=499)
+                return web.Response(status=499,
+                                    headers={"X-Request-Id": request_id})
             final_output = request_output
 
         assert final_output is not None
@@ -81,7 +93,8 @@ async def generate(request: web.Request) -> web.StreamResponse:
             final_output.prompt + output.text
             for output in final_output.outputs
         ]
-        return web.json_response({"text": text_outputs})
+        return web.json_response({"text": text_outputs},
+                                 headers={"X-Request-Id": request_id})
 
 
 def build_app(enable_profiling: bool = False) -> web.Application:

@@ -46,11 +46,13 @@ async def show_available_models(request: web.Request) -> web.Response:
     return web.json_response(models.model_dump())
 
 
-async def _streaming_response(request: web.Request,
-                              generator) -> web.StreamResponse:
-    response = web.StreamResponse(
-        headers={"Content-Type": "text/event-stream",
-                 "Cache-Control": "no-cache"})
+async def _streaming_response(request: web.Request, generator,
+                              request_id: str = None) -> web.StreamResponse:
+    headers = {"Content-Type": "text/event-stream",
+               "Cache-Control": "no-cache"}
+    if request_id is not None:
+        headers["X-Request-Id"] = request_id
+    response = web.StreamResponse(headers=headers)
     await response.prepare(request)
     async for chunk in generator:
         await response.write(chunk.encode())
@@ -58,32 +60,48 @@ async def _streaming_response(request: web.Request,
     return response
 
 
+def _request_id(request: web.Request, prefix: str) -> str:
+    """The request id (= distributed trace id): a validated client
+    X-Request-Id wins (client-side correlation, router propagation),
+    else a server-minted `{prefix}-<uuid>`. Echoed on every response."""
+    from intellillm_tpu.obs import sanitize_request_id
+    from intellillm_tpu.utils import random_uuid
+    return (sanitize_request_id(request.headers.get("X-Request-Id"))
+            or f"{prefix}-{random_uuid()}")
+
+
 async def create_chat_completion(request: web.Request) -> web.StreamResponse:
+    request_id = _request_id(request, "chatcmpl")
     try:
         body = ChatCompletionRequest(**await request.json())
     except Exception as e:
         return _error_to_response(
             openai_serving_chat.create_error_response(str(e)))
-    generator = await openai_serving_chat.create_chat_completion(body)
+    generator = await openai_serving_chat.create_chat_completion(
+        body, request_id=request_id)
     if isinstance(generator, ErrorResponse):
         return _error_to_response(generator)
     if body.stream:
-        return await _streaming_response(request, generator)
-    return web.json_response(generator.model_dump())
+        return await _streaming_response(request, generator, request_id)
+    return web.json_response(generator.model_dump(),
+                             headers={"X-Request-Id": request_id})
 
 
 async def create_completion(request: web.Request) -> web.StreamResponse:
+    request_id = _request_id(request, "cmpl")
     try:
         body = CompletionRequest(**await request.json())
     except Exception as e:
         return _error_to_response(
             openai_serving_completion.create_error_response(str(e)))
-    generator = await openai_serving_completion.create_completion(body)
+    generator = await openai_serving_completion.create_completion(
+        body, request_id=request_id)
     if isinstance(generator, ErrorResponse):
         return _error_to_response(generator)
     if body.stream and not body.use_beam_search:
-        return await _streaming_response(request, generator)
-    return web.json_response(generator.model_dump())
+        return await _streaming_response(request, generator, request_id)
+    return web.json_response(generator.model_dump(),
+                             headers={"X-Request-Id": request_id})
 
 
 @web.middleware

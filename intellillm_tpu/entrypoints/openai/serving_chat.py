@@ -63,7 +63,8 @@ class OpenAIServingChat(OpenAIServing):
         return request.messages[-1]["role"]
 
     async def create_chat_completion(
-        self, request: ChatCompletionRequest
+        self, request: ChatCompletionRequest,
+        request_id: Optional[str] = None
     ) -> Union[ErrorResponse, ChatCompletionResponse, AsyncIterator[str]]:
         error = await self._check_model(request)
         if error is not None:
@@ -78,7 +79,9 @@ class OpenAIServingChat(OpenAIServing):
             return self.create_error_response(
                 f"Error in applying chat template from request: {e}")
 
-        request_id = f"chatcmpl-{random_uuid()}"
+        # A caller-supplied id (the server handler's validated
+        # X-Request-Id — the distributed trace id) wins over a minted one.
+        request_id = request_id or f"chatcmpl-{random_uuid()}"
         try:
             token_ids = self._validate_prompt_and_tokenize(request,
                                                            prompt=prompt)

@@ -100,7 +100,8 @@ def request_to_sampling_params(request) -> SamplingParams:
 class OpenAIServingCompletion(OpenAIServing):
 
     async def create_completion(
-        self, request: CompletionRequest
+        self, request: CompletionRequest,
+        request_id: Optional[str] = None
     ) -> Union[ErrorResponse, CompletionResponse,
                AsyncIterator[str]]:
         error = await self._check_model(request)
@@ -113,7 +114,9 @@ class OpenAIServingCompletion(OpenAIServing):
             return self.create_error_response(
                 "echo is not currently supported")
 
-        request_id = f"cmpl-{random_uuid()}"
+        # A caller-supplied id (the server handler's validated
+        # X-Request-Id — the distributed trace id) wins over a minted one.
+        request_id = request_id or f"cmpl-{random_uuid()}"
         created_time = int(time.time())
         model_name = request.model
 

@@ -13,6 +13,10 @@ from intellillm_tpu.obs.flight_recorder import (EVENTS, FlightRecorder,
                                                 get_flight_recorder)
 from intellillm_tpu.obs.slo import (SLOTracker, derive_request_metrics,
                                     get_slo_tracker)
+from intellillm_tpu.obs.trace_export import (TraceSink, flush_black_box,
+                                             get_trace_sink,
+                                             install_black_box_handlers,
+                                             sanitize_request_id)
 from intellillm_tpu.obs.tracing import (PHASES, StepTracer, get_step_tracer,
                                         request_context)
 from intellillm_tpu.obs.watchdog import EngineWatchdog, get_watchdog
@@ -27,14 +31,19 @@ __all__ = [
     "PHASES",
     "SLOTracker",
     "StepTracer",
+    "TraceSink",
     "derive_request_metrics",
+    "flush_black_box",
     "get_compile_tracker",
     "get_device_telemetry",
     "get_efficiency_tracker",
     "get_flight_recorder",
     "get_slo_tracker",
     "get_step_tracer",
+    "get_trace_sink",
     "get_watchdog",
+    "install_black_box_handlers",
     "record_kernel_dispatch",
     "request_context",
+    "sanitize_request_id",
 ]

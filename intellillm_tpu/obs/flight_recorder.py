@@ -16,11 +16,25 @@ finished requests move to a separate finished ring (default 256) so
 Event names used by the engine/scheduler wiring:
 
     arrived, queued, scheduled, prefill_start, preempted, swapped_out,
-    swapped_in, first_token, finished, aborted
+    swapped_in, first_token, finished, aborted, rerouted
 
 `queued` is recorded at scheduler admission (after tokenization), so
 queue-wait derived as `scheduled - queued` (obs/slo.py) measures
 scheduler wait only, not tokenization time.
+
+`rerouted` is a terminal recorded by the router path when a replica
+dies mid-request and the request is restarted elsewhere: it seals the
+trace on the FAILED replica (moving it to the finished ring, so the
+failover can't leave an orphaned live entry) and, being recorded before
+the engine abort lands, makes the late `aborted` a sealed-trace no-op —
+the retried attempt is the one the SLO tracker counts.
+
+Every trace is tagged with this process's *hop* — which tier of the
+fleet recorded it ("engine" for replicas, "router" for the router's own
+span recorder; override with INTELLILLM_TRACE_HOP). The request id IS
+the distributed trace id: the router propagates it over X-Request-Id
+(see docs/observability.md, "Distributed tracing"), so fetching the
+same id from every hop and merging on `ts` yields the fleet timeline.
 """
 from __future__ import annotations
 
@@ -32,9 +46,14 @@ from typing import Any, Dict, List, Optional
 
 # Canonical event names (wiring sites pass these strings).
 EVENTS = ("arrived", "queued", "scheduled", "prefill_start", "preempted",
-          "swapped_out", "swapped_in", "first_token", "finished", "aborted")
+          "swapped_out", "swapped_in", "first_token", "finished", "aborted",
+          "rerouted")
 
-_TERMINAL = ("finished", "aborted")
+_TERMINAL = ("finished", "aborted", "rerouted")
+
+
+def _default_hop() -> str:
+    return os.environ.get("INTELLILLM_TRACE_HOP", "engine")
 
 
 class FlightRecorder:
@@ -42,8 +61,10 @@ class FlightRecorder:
 
     def __init__(self, enabled: bool = True, max_events_per_request: int = 64,
                  max_live_requests: int = 2048,
-                 max_finished_requests: int = 256) -> None:
+                 max_finished_requests: int = 256,
+                 hop: Optional[str] = None) -> None:
         self.enabled = enabled
+        self.hop = hop if hop is not None else _default_hop()
         self.max_events_per_request = max_events_per_request
         self.max_live_requests = max_live_requests
         self.max_finished_requests = max_finished_requests
@@ -87,7 +108,7 @@ class FlightRecorder:
             if buf is None:
                 return None
             items = list(buf)
-        return [{"ts": ts, "event": ev,
+        return [{"ts": ts, "event": ev, "hop": self.hop,
                  **({"detail": d} if d is not None else {})}
                 for ts, ev, d in items]
 
@@ -101,7 +122,8 @@ class FlightRecorder:
         for rid, events in items[:limit]:
             out.append({
                 "request_id": rid,
-                "events": [{"ts": ts, "event": ev,
+                "hop": self.hop,
+                "events": [{"ts": ts, "event": ev, "hop": self.hop,
                             **({"detail": d} if d is not None else {})}
                            for ts, ev, d in events],
             })

@@ -12,6 +12,12 @@ id here; the tracker replays the flight-recorder trace and derives:
     tpot        (terminal - first_token) / max(gen_tokens - 1, 1)
     e2e         terminal - arrived
     preemptions count per mode (recompute / swap) + finish reason
+    hops        per-hop latency attribution of e2e: this process's
+                share decomposed as replica_queue (scheduled - queued)
+                / prefill (first_token - scheduled) / decode (terminal
+                - first_token). The router adds its own hops
+                (router_queue / routing / network) when stitching a
+                fleet trace (router/trace.py).
 
 Exported (when `prometheus_client` is installed — silently skipped
 otherwise):
@@ -21,6 +27,15 @@ otherwise):
     intellillm_request_finished_total{reason}  counter
     intellillm_request_generation_tokens       histogram
     intellillm_slo_goodput_ratio               gauge
+    intellillm_trace_hop_seconds{hop}          histogram — the per-hop
+        attribution above, one observation per finished request per hop
+
+Each finished trace is also offered to the durable trace sink
+(obs/trace_export.py; INTELLILLM_TRACE_EXPORT, default off): requests
+that violated their SLO, were preempted, aborted or rerouted are always
+exported, the healthy rest is hash-sampled. A bounded ring of the
+slowest requests in the window (id + per-hop split) is served in
+`summary()["slowest"]` for /health/detail and intellillm-top.
 
 Goodput is the fraction of the rolling window (default 512 finishes)
 whose TTFT and TPOT are both within the configured SLOs (`--slo-ttft-ms`
@@ -54,6 +69,7 @@ except ImportError:  # pragma: no cover
 _DEFAULT_TTFT_MS = 1000.0
 _DEFAULT_TPOT_MS = 200.0
 _DEFAULT_WINDOW = 512
+_SLOWEST_KEEP = 8
 
 _QUEUE_TIME_BUCKETS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0,
                        2.5, 5.0, 10.0, 30.0, 60.0]
@@ -93,6 +109,12 @@ class _SLOMetrics:
             "intellillm_slo_goodput_ratio",
             "Fraction of the rolling finish window meeting both the TTFT "
             "and TPOT SLOs.")
+        self.histogram_hop_seconds = Histogram(
+            "intellillm_trace_hop_seconds",
+            "Per-hop latency attribution of request e2e (hop = "
+            "replica_queue | prefill | decode on replicas; router_queue "
+            "| routing | network on the router).", ["hop"],
+            buckets=_QUEUE_TIME_BUCKETS)
 
     @classmethod
     def reset_for_testing(cls) -> None:
@@ -136,7 +158,7 @@ def derive_request_metrics(events: List[Dict[str, Any]],
         if name == "preempted":
             mode = ev.get("detail") or "unknown"
             preemptions[mode] = preemptions.get(mode, 0) + 1
-        if name in ("finished", "aborted"):
+        if name in ("finished", "aborted", "rerouted"):
             terminal_ts = ev["ts"]
             terminal_event = name
             terminal_detail = ev.get("detail")
@@ -162,8 +184,22 @@ def derive_request_metrics(events: List[Dict[str, Any]],
     e2e = (max(terminal_ts - arrived, 0.0)
            if arrived is not None else None)
 
+    # Per-hop attribution of this process's share of e2e. Only hops the
+    # trace actually evidences are emitted, so they partition the span
+    # from `queued` to the terminal (TTFT additionally carries arrival→
+    # admission time, which no hop claims).
+    hops: Dict[str, float] = {}
+    if queued is not None and scheduled is not None:
+        hops["replica_queue"] = max(scheduled - queued, 0.0)
+    if scheduled is not None and first_token is not None:
+        hops["prefill"] = max(first_token - scheduled, 0.0)
+    if first_token is not None:
+        hops["decode"] = max(terminal_ts - first_token, 0.0)
+
     if terminal_event == "aborted":
         reason = "abort"
+    elif terminal_event == "rerouted":
+        reason = "rerouted"
     else:
         reason = terminal_detail or "unknown"
     return {
@@ -173,8 +209,21 @@ def derive_request_metrics(events: List[Dict[str, Any]],
         "e2e_s": e2e,
         "generation_tokens": max(int(num_generation_tokens), 0),
         "preemptions": preemptions,
+        "hops": hops,
         "reason": reason,
     }
+
+
+def observe_hop_seconds(hops: Dict[str, float]) -> None:
+    """Record per-hop attribution into the intellillm_trace_hop_seconds
+    family without an SLO-window record — the router's span path uses
+    this (it has hop timings but no engine-side request record)."""
+    if not _PROMETHEUS:
+        return
+    m = _SLOMetrics()
+    for hop, seconds in hops.items():
+        if seconds is not None:
+            m.histogram_hop_seconds.labels(hop).observe(seconds)
 
 
 def _percentile(sorted_vals: List[float], p: float) -> float:
@@ -208,6 +257,9 @@ class SLOTracker:
         self._eligible = 0
         self._finished_total: Dict[str, int] = {}
         self._preemptions_total: Dict[str, int] = {}
+        # Worst offenders by e2e (id + per-hop split) for the
+        # slowest-requests panel; small and rebuilt on every insert.
+        self._slowest: List[Dict[str, Any]] = []
         self._metrics = _SLOMetrics() if _PROMETHEUS else None
 
     def configure(self, slo_ttft_ms: Optional[float] = None,
@@ -229,12 +281,17 @@ class SLOTracker:
         if not self.enabled:
             return
         from intellillm_tpu.obs.flight_recorder import get_flight_recorder
-        events = get_flight_recorder().get_trace(request_id)
+        recorder = get_flight_recorder()
+        events = recorder.get_trace(request_id)
         if not events:
             return
         rec = derive_request_metrics(events, num_generation_tokens)
         if rec is not None:
+            rec["request_id"] = request_id
             self.observe(rec)
+            from intellillm_tpu.obs.trace_export import get_trace_sink
+            get_trace_sink().maybe_export(request_id, events, rec,
+                                          hop=recorder.hop)
 
     def observe(self, rec: Dict[str, Any]) -> None:
         """Record one derived request record (see derive_request_metrics
@@ -245,10 +302,15 @@ class SLOTracker:
         tpot = rec.get("tpot_s")
         # Goodput judges only requests that produced a first token; a
         # single-token request (tpot None) is judged on TTFT alone.
+        # Rerouted attempts are excluded — the retried attempt is the
+        # one whose latency the client saw end to end.
         good: Optional[bool] = None
-        if ttft is not None:
+        if ttft is not None and rec.get("reason") != "rerouted":
             good = ttft * 1e3 <= self.slo_ttft_ms and (
                 tpot is None or tpot * 1e3 <= self.slo_tpot_ms)
+        # Tail-sampling keep signal for the trace sink (and operators
+        # reading the exported record).
+        rec["slo_violated"] = good is False
         with self._lock:
             reason = rec.get("reason") or "unknown"
             self._finished_total[reason] = (
@@ -261,8 +323,25 @@ class SLOTracker:
                 "ttft_s": ttft,
                 "tpot_s": tpot,
                 "e2e_s": rec.get("e2e_s"),
+                "hops": rec.get("hops") or {},
                 "good": good,
             })
+            e2e = rec.get("e2e_s")
+            if e2e is not None:
+                self._slowest.append({
+                    "request_id": rec.get("request_id"),
+                    "e2e_ms": round(e2e * 1e3, 3),
+                    "ttft_ms": (round(ttft * 1e3, 3)
+                                if ttft is not None else None),
+                    "hops_ms": {h: round(v * 1e3, 3)
+                                for h, v in
+                                (rec.get("hops") or {}).items()},
+                    "reason": reason,
+                    "slo_violated": rec["slo_violated"],
+                })
+                self._slowest.sort(key=lambda r: r["e2e_ms"],
+                                   reverse=True)
+                del self._slowest[_SLOWEST_KEEP:]
             if good is not None:
                 self._eligible += 1
                 self._good += int(good)
@@ -282,6 +361,8 @@ class SLOTracker:
             m.counter_finished.labels(reason).inc()
             m.histogram_generation_tokens.observe(
                 rec.get("generation_tokens") or 0)
+            for hop, seconds in (rec.get("hops") or {}).items():
+                m.histogram_hop_seconds.labels(hop).observe(seconds)
             if goodput is not None:
                 m.gauge_goodput.set(goodput)
 
@@ -295,6 +376,7 @@ class SLOTracker:
                        if self._eligible else None)
             finished = dict(self._finished_total)
             preempted = dict(self._preemptions_total)
+            slowest = [dict(r) for r in self._slowest]
         out: Dict[str, Any] = {
             "window": len(window),
             "goodput_ratio": (round(goodput, 4)
@@ -315,6 +397,18 @@ class SLOTracker:
                 "p90": round(_percentile(vals, 90), 3),
                 "p99": round(_percentile(vals, 99), 3),
             } if vals else None)
+        hop_names = sorted({h for r in window for h in r.get("hops", {})})
+        hops_ms: Dict[str, Any] = {}
+        for hop in hop_names:
+            vals = sorted(r["hops"][hop] * 1e3 for r in window
+                          if hop in r.get("hops", {}))
+            hops_ms[hop] = {
+                "p50": round(_percentile(vals, 50), 3),
+                "p90": round(_percentile(vals, 90), 3),
+                "p99": round(_percentile(vals, 99), 3),
+            }
+        out["hops_ms"] = hops_ms or None
+        out["slowest"] = slowest
         return out
 
     def reset_for_testing(self) -> None:
@@ -324,6 +418,7 @@ class SLOTracker:
             self._eligible = 0
             self._finished_total = {}
             self._preemptions_total = {}
+            self._slowest = []
             self.window_size = max(
                 int(os.environ.get("INTELLILLM_SLO_WINDOW",
                                    _DEFAULT_WINDOW)), 1)

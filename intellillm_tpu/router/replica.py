@@ -53,13 +53,23 @@ class Replica:
         self.inflight = 0
 
     async def generate(self, payload: dict,
-                       predicted_len: Optional[int] = None
+                       predicted_len: Optional[int] = None,
+                       request_id: Optional[str] = None
                        ) -> AsyncIterator[dict]:
+        """Stream the request. `request_id` is the router-assigned
+        distributed trace (sub-)id; None lets the replica mint one."""
         raise NotImplementedError
 
     async def health_detail(self) -> Tuple[int, dict]:
         """(status_code, body) of the replica's /health/detail."""
         raise NotImplementedError
+
+    async def fetch_trace(self, request_id: str) -> Optional[list]:
+        """This replica's flight-recorder events for `request_id`, or
+        None when unknown/unreachable — the stitching side of
+        router/trace.py. Must not raise: a dead replica is exactly when
+        the stitched view matters most."""
+        return None
 
     async def close(self) -> None:
         pass
@@ -80,7 +90,8 @@ class InProcessReplica(Replica):
         self.healthy = False
 
     async def generate(self, payload: dict,
-                       predicted_len: Optional[int] = None
+                       predicted_len: Optional[int] = None,
+                       request_id: Optional[str] = None
                        ) -> AsyncIterator[dict]:
         if self._killed:
             raise ReplicaFailure(f"replica {self.replica_id} is down")
@@ -89,12 +100,22 @@ class InProcessReplica(Replica):
         prefix_pos = payload.pop("prefix_pos", None)
         payload.pop("stream", None)
         sampling_params = SamplingParams(**payload)
-        request_id = random_uuid()
+        request_id = request_id or random_uuid()
         gen = self.engine.generate(prompt, sampling_params, request_id,
                                    prefix_pos=prefix_pos,
                                    predicted_len=predicted_len)
         async for request_output in gen:
             if self._killed:
+                # Seal the trace as `rerouted` BEFORE the abort lands
+                # (aborts are processed at the next engine step): the
+                # request leaves no orphaned live flight-recorder entry
+                # on this dead replica, and the late `aborted` hits a
+                # sealed trace — so the SLO finish hook fires for the
+                # retried attempt only, not this one.
+                from intellillm_tpu.obs import get_flight_recorder
+                get_flight_recorder().record(
+                    request_id, "rerouted",
+                    detail=f"replica={self.replica_id} died mid-stream")
                 try:
                     await self.engine.abort(request_id)
                 finally:
@@ -129,6 +150,13 @@ class InProcessReplica(Replica):
             body["kv_cache_usage"] = None
         return 200, body
 
+    async def fetch_trace(self, request_id: str) -> Optional[list]:
+        # The process-global recorder — the engine's hop. A killed
+        # replica can still serve its sealed traces (that is the point:
+        # the `rerouted` terminal must be visible in the stitched view).
+        from intellillm_tpu.obs import get_flight_recorder
+        return get_flight_recorder().get_trace(request_id)
+
 
 class HTTPReplica(Replica):
     """Fronts an engine server over HTTP (demo api_server protocol).
@@ -154,16 +182,21 @@ class HTTPReplica(Replica):
         return self._session
 
     async def generate(self, payload: dict,
-                       predicted_len: Optional[int] = None
+                       predicted_len: Optional[int] = None,
+                       request_id: Optional[str] = None
                        ) -> AsyncIterator[dict]:
         # predicted_len stays router-side: the demo server's SamplingParams
         # parsing rejects unknown fields.
         import aiohttp
         body = dict(payload)
         body["stream"] = True
+        # Context propagation: the replica server honors X-Request-Id,
+        # so its flight-recorder events land under the router's trace id.
+        headers = {"X-Request-Id": request_id} if request_id else None
         try:
             async with self._get_session().post(
-                    f"{self.base_url}/generate", json=body) as resp:
+                    f"{self.base_url}/generate", json=body,
+                    headers=headers) as resp:
                 if resp.status != 200:
                     raise ReplicaFailure(
                         f"replica {self.replica_id}: /generate -> "
@@ -190,6 +223,22 @@ class HTTPReplica(Replica):
             raise ReplicaFailure(
                 f"replica {self.replica_id}: {type(e).__name__}: {e}"
             ) from e
+
+    async def fetch_trace(self, request_id: str) -> Optional[list]:
+        import aiohttp
+        try:
+            async with self._get_session().get(
+                    f"{self.base_url}/debug/trace",
+                    params={"request_id": request_id},
+                    timeout=aiohttp.ClientTimeout(total=5.0)) as resp:
+                if resp.status != 200:
+                    return None
+                body = await resp.json()
+                return body.get("events")
+        except Exception:
+            # Unreachable replica: the stitched trace reports the
+            # attempt with events=None instead of failing the fetch.
+            return None
 
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:

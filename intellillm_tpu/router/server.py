@@ -3,41 +3,61 @@
 Speaks the demo api_server's `/generate` protocol on the front side and
 streams through to a chosen replica on the back side:
 
-    GET  /health         200 when ≥1 replica is healthy, else 503
-    POST /generate       routed completion; same body as api_server
-    GET  /metrics        Prometheus scrape (intellillm_router_* + any
-                         in-process replica families)
-    GET  /health/detail  aggregated: router decision counters, policy
-                         state, per-replica health/load snapshots; 503
-                         when no healthy replica
+    GET  /health             200 when ≥1 replica is healthy, else 503
+    POST /generate           routed completion; same body as api_server.
+                             Honors/echoes X-Request-Id (the distributed
+                             trace id, propagated to the replica)
+    GET  /metrics            Prometheus scrape (intellillm_router_* +
+                             any in-process replica families)
+    GET  /health/detail      aggregated: router decision counters,
+                             policy state, per-replica health/load
+                             snapshots, trace/hop summary; 503 when no
+                             healthy replica
+    GET  /debug/trace        recently-completed trace ids + the
+                             router's own span traces
+    GET  /debug/trace/{id}   the STITCHED fleet trace: router spans
+                             merged with every attempted replica's
+                             flight-recorder events into one causally-
+                             ordered timeline with per-hop latency
+                             attribution (router/trace.py)
 
 Failover: a `ReplicaFailure` mid-request marks the replica unhealthy,
 drops its affinity placements, and re-routes the request once to another
 replica (excluding the failed one). Because `/generate` stream chunks
 carry CUMULATIVE text, a client that already received chunks from the
 failed replica just keeps receiving (superset) chunks from the new one.
+Attempt k runs under the sub-request id `{trace_id}#f{k}` so both
+replicas of a failover keep their own sealed trace.
 
 Run: python -m intellillm_tpu.router.server --replica-urls ... | \
          --launch-replicas N [engine args passed through to replicas]
-See docs/routing.md.
+See docs/routing.md and docs/observability.md ("Distributed tracing").
 """
 from __future__ import annotations
 
 import argparse
 import asyncio
 import json
+from collections import deque
 from typing import AsyncIterator, Dict, List, Optional
 
 from aiohttp import web
 
 from intellillm_tpu.affinity import prompt_affinity_key
 from intellillm_tpu.logger import init_logger
+from intellillm_tpu.obs.flight_recorder import FlightRecorder
+from intellillm_tpu.obs.slo import _percentile, observe_hop_seconds
+from intellillm_tpu.obs.trace_export import (get_trace_sink,
+                                             sanitize_request_id)
 from intellillm_tpu.router.metrics import DECISIONS, get_router_metrics
 from intellillm_tpu.router.policy import (NoReplicaAvailable, RouterConfig,
                                           RoutingPolicy)
 from intellillm_tpu.router.replica import (Replica, ReplicaFailure,
                                            ReplicaManager,
                                            launch_http_replica)
+from intellillm_tpu.router.trace import (TraceBook, attempt_request_id,
+                                         stitch_trace)
+from intellillm_tpu.utils import random_uuid
 
 logger = init_logger(__name__)
 
@@ -58,6 +78,14 @@ class Router:
         # Python-side decision counters so the aggregated /health/detail
         # works without prometheus_client.
         self.decisions: Dict[str, int] = {d: 0 for d in DECISIONS}
+        # The router's OWN span recorder — separate from the process-
+        # global engine recorder so an in-process replica's events for
+        # the same trace id don't collide with the router's spans.
+        self.recorder = FlightRecorder(hop="router")
+        self.tracebook = TraceBook()
+        # Rolling router-side hop timings for the /health/detail trace
+        # summary (seconds; small fixed window).
+        self._hop_window: deque = deque(maxlen=256)
 
     def add_replica(self, replica: Replica, healthy: bool = False) -> None:
         self.manager.add(replica, healthy=healthy)
@@ -89,36 +117,71 @@ class Router:
         if m is not None:
             m.counter_decisions.labels(decision=decision).inc()
 
-    async def stream_request(self, payload: dict) -> AsyncIterator[dict]:
+    async def stream_request(self, payload: dict,
+                             trace_id: Optional[str] = None
+                             ) -> AsyncIterator[dict]:
         """Route `payload` and yield its (cumulative-text) chunks,
-        failing over up to `max_retries` times."""
+        failing over up to `max_retries` times. `trace_id` is the
+        distributed trace id (client-supplied X-Request-Id or router-
+        minted); every routing span lands in the router's recorder
+        under it, and attempt k reaches its replica as the sub-request
+        id `{trace_id}#f{k}`."""
         prompt = payload.get("prompt", "")
         token_ids = self._token_ids(prompt)
         key = prompt_affinity_key(token_ids, self.config.block_size,
                                   self.config.affinity_blocks)
         predicted_len = self._predict_len(prompt, token_ids)
+        trace_id = trace_id or random_uuid()
+        self.recorder.record(trace_id, "received",
+                             detail=f"prompt_tokens={len(token_ids)}")
 
         excluded: set = set()
         attempts = self.config.max_retries + 1
         last_error: Optional[Exception] = None
+        first_chunk_seen = False
         for attempt in range(attempts):
             loads = self.manager.healthy_loads(exclude=excluded)
-            replica_id, decision = self.policy.choose(key, loads)
+            try:
+                replica_id, decision = self.policy.choose(key, loads)
+            except NoReplicaAvailable:
+                self.recorder.record(trace_id, "aborted",
+                                     detail="no_replica_available")
+                raise
             if attempt > 0:
                 decision = "failover"
             self._count_decision(decision)
+            self.recorder.record(trace_id, "route_decision",
+                                 detail=f"{decision}->{replica_id}")
+            request_id = attempt_request_id(trace_id, attempt)
             self.manager.on_route(replica_id, predicted_len)
+            self.tracebook.note_attempt(trace_id, attempt, replica_id,
+                                        request_id, decision)
+            self.recorder.record(
+                trace_id, "routed",
+                detail=f"attempt={attempt} replica={replica_id} "
+                       f"request_id={request_id}")
             replica = self.manager.get(replica_id)
             try:
                 async for chunk in replica.generate(
-                        payload, predicted_len=predicted_len):
+                        payload, predicted_len=predicted_len,
+                        request_id=request_id):
+                    if not first_chunk_seen:
+                        first_chunk_seen = True
+                        self.recorder.record(trace_id, "first_chunk",
+                                             detail=f"replica={replica_id}")
                     yield chunk
                 self.manager.on_complete(replica_id, predicted_len)
+                self.recorder.record(trace_id, "finished",
+                                     detail=f"replica={replica_id}")
+                self._finish_trace(trace_id, failed_over=attempt > 0)
                 return
             except ReplicaFailure as e:
                 last_error = e
                 logger.warning("replica %s failed serving request: %s",
                                replica_id, e)
+                self.recorder.record(
+                    trace_id, "replica_failed",
+                    detail=f"replica={replica_id}: {e}"[:200])
                 self.manager.on_complete(replica_id, predicted_len)
                 self.manager.mark_failed(replica_id)
                 # Its cached prefixes are gone with it: let its keys
@@ -128,10 +191,88 @@ class Router:
                 if m is not None:
                     m.counter_failovers.labels(replica=replica_id).inc()
                 excluded.add(replica_id)
+        self.recorder.record(trace_id, "aborted",
+                             detail="retries_exhausted")
+        self._finish_trace(trace_id, failed_over=True, failed=True)
         raise last_error if last_error is not None else NoReplicaAvailable(
             "request exhausted retries")
 
+    def _finish_trace(self, trace_id: str, failed_over: bool,
+                      failed: bool = False) -> None:
+        """Terminal bookkeeping for one routed trace: router-side hop
+        timings (router_queue / routing) into the hop histogram + the
+        rolling window, and the span trace into the durable sink
+        (failovers/failures are always kept — tail sampling)."""
+        events = self.recorder.get_trace(trace_id)
+        if not events:
+            return
+        received = decision0 = None
+        routing = 0.0
+        pending_decision = None
+        terminal = events[-1]["ts"]
+        for ev in events:
+            if ev["event"] == "received" and received is None:
+                received = ev["ts"]
+            elif ev["event"] == "route_decision":
+                pending_decision = ev["ts"]
+                if decision0 is None:
+                    decision0 = ev["ts"]
+            elif ev["event"] == "routed" and pending_decision is not None:
+                routing += max(ev["ts"] - pending_decision, 0.0)
+                pending_decision = None
+        if received is None:
+            return
+        hops = {
+            "router_queue": (max(decision0 - received, 0.0)
+                             if decision0 is not None else 0.0),
+            "routing": routing,
+        }
+        observe_hop_seconds(hops)
+        self._hop_window.append(
+            {**hops, "e2e_s": max(terminal - received, 0.0)})
+        rec = {
+            "reason": ("error" if failed
+                       else "rerouted" if failed_over else "finished"),
+            "e2e_s": max(terminal - received, 0.0),
+            "hops": hops,
+        }
+        get_trace_sink().maybe_export(trace_id, events, rec, hop="router")
+
     # --- observability ----------------------------------------------------
+
+    async def stitched_trace(self, trace_id: str) -> Optional[dict]:
+        """Fetch + stitch the fleet trace for `trace_id`: the router's
+        spans merged with each attempted replica's flight-recorder
+        events (router/trace.py). None when the router never saw it."""
+        router_events = self.recorder.get_trace(trace_id)
+        attempts = self.tracebook.attempts(trace_id) or []
+        for att in attempts:
+            replica = self.manager.replicas.get(att["replica_id"])
+            att["events"] = (await replica.fetch_trace(att["request_id"])
+                             if replica is not None else None)
+        return stitch_trace(trace_id, router_events, attempts)
+
+    def _trace_summary(self) -> dict:
+        """Router-side hop timings + trace bookkeeping for
+        /health/detail."""
+        window = list(self._hop_window)
+        out: Dict[str, object] = {
+            "window": len(window),
+            "live_traces": len(self.recorder.live_request_ids()),
+            "recent_trace_ids": self.tracebook.recent_trace_ids(limit=8),
+            "export": {
+                "enabled": get_trace_sink().enabled,
+                "path": get_trace_sink().path,
+            },
+        }
+        for hop in ("router_queue", "routing", "e2e_s"):
+            vals = sorted(r[hop] * 1e3 for r in window if hop in r)
+            key = "e2e_ms" if hop == "e2e_s" else f"{hop}_ms"
+            out[key] = ({
+                "p50": round(_percentile(vals, 50), 3),
+                "p99": round(_percentile(vals, 99), 3),
+            } if vals else None)
+        return out
 
     def snapshot(self) -> dict:
         healthy = [rid for rid, r in self.manager.replicas.items()
@@ -141,6 +282,7 @@ class Router:
             "healthy_replicas": sorted(healthy),
             "decisions": dict(self.decisions),
             "affinity_entries": len(self.policy.affinity),
+            "tracing": self._trace_summary(),
             "config": {
                 "block_size": self.config.block_size,
                 "affinity_blocks": self.config.affinity_blocks,
@@ -163,11 +305,18 @@ def build_router_app(router: Router) -> web.Application:
     async def generate(request: web.Request) -> web.StreamResponse:
         request_dict = await request.json()
         stream = bool(request_dict.pop("stream", False))
+        # The distributed trace id: honor a (validated) client
+        # X-Request-Id so client-side correlation works, else mint one;
+        # echo it either way.
+        trace_id = (sanitize_request_id(request.headers.get("X-Request-Id"))
+                    or random_uuid())
         try:
-            chunk_iter = router.stream_request(request_dict)
+            chunk_iter = router.stream_request(request_dict,
+                                               trace_id=trace_id)
             if stream:
                 response = web.StreamResponse(
-                    headers={"Content-Type": "application/x-ndjson"})
+                    headers={"Content-Type": "application/x-ndjson",
+                             "X-Request-Id": trace_id})
                 prepared = False
                 async for chunk in chunk_iter:
                     if not prepared:
@@ -183,13 +332,16 @@ def build_router_app(router: Router) -> web.Application:
             async for chunk in chunk_iter:
                 final_chunk = chunk
             assert final_chunk is not None
-            return web.json_response(final_chunk)
+            return web.json_response(final_chunk,
+                                     headers={"X-Request-Id": trace_id})
         except NoReplicaAvailable as e:
-            return web.json_response({"error": str(e)}, status=503)
+            return web.json_response({"error": str(e)}, status=503,
+                                     headers={"X-Request-Id": trace_id})
         except ReplicaFailure as e:
             # Retries exhausted. A prepared stream can't change status;
             # aiohttp just closes it, which clients see as truncation.
-            return web.json_response({"error": str(e)}, status=502)
+            return web.json_response({"error": str(e)}, status=502,
+                                     headers={"X-Request-Id": trace_id})
 
     async def health_detail(request: web.Request) -> web.Response:
         body = {"router": router.snapshot()}
@@ -197,11 +349,35 @@ def build_router_app(router: Router) -> web.Application:
         body["status"] = "ok" if ok else "no_healthy_replica"
         return web.json_response(body, status=200 if ok else 503)
 
+    async def debug_trace_list(request: web.Request) -> web.Response:
+        try:
+            limit = int(request.query.get("limit", "32"))
+        except ValueError:
+            return web.json_response({"error": "limit must be an integer"},
+                                     status=400)
+        return web.json_response({
+            "live_trace_ids": router.recorder.live_request_ids(),
+            "recent_trace_ids": router.tracebook.recent_trace_ids(limit),
+            "recent_finished": router.recorder.recent_finished(limit),
+        })
+
+    async def debug_trace_stitched(request: web.Request) -> web.Response:
+        trace_id = request.match_info["trace_id"]
+        stitched = await router.stitched_trace(trace_id)
+        if stitched is None:
+            return web.json_response(
+                {"error": f"no trace for trace_id={trace_id} "
+                 "(never routed here, or evicted from the ring)"},
+                status=404)
+        return web.json_response(stitched)
+
     app = web.Application()
     app.router.add_get("/health", health)
     app.router.add_post("/generate", generate)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/health/detail", health_detail)
+    app.router.add_get("/debug/trace", debug_trace_list)
+    app.router.add_get("/debug/trace/{trace_id}", debug_trace_stitched)
 
     async def _start(app: web.Application) -> None:
         router.manager.start_polling()

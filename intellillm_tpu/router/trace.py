@@ -1,0 +1,188 @@
+"""Fleet trace stitching: router spans + replica flight-recorder traces.
+
+One request through the router produces spans on every hop it touched:
+the router's own span recorder (a dedicated `FlightRecorder` with
+hop="router" — separate from the process-global engine recorder so an
+in-process replica's events for the same id don't collide with the
+router's) plus each attempted replica's engine flight recorder. The
+request id IS the trace id; failover attempt k runs under the
+sub-request id `{trace_id}#f{k}` so each attempt has its own sealed
+trace on its own replica (reusing the id would collide with the first
+attempt's sealed `rerouted` terminal).
+
+Router span taxonomy (recorded by router/server.py, terminal rules as
+in obs/flight_recorder.py):
+
+    received        request hit the router handler
+    route_decision  policy verdict (detail: "<decision>-><replica>")
+    routed          attempt dispatched (detail names attempt, replica
+                    and sub-request id)
+    first_chunk     first streamed chunk left the replica
+    replica_failed  a ReplicaFailure (detail: replica + error)
+    finished        request completed (terminal)
+    aborted         retries exhausted (terminal)
+
+`TraceBook` remembers which (replica, sub-request id) pairs a trace
+touched — the part the router's span recorder can't express — and
+`stitch()` merges all hops into one causally-ordered timeline with a
+per-hop latency attribution that partitions the router-observed e2e:
+
+    router_queue   received -> first route_decision
+    routing        route_decision -> routed, summed over attempts
+    replica_queue  scheduled - queued, summed over attempts
+    prefill        first_token - scheduled, summed over attempts
+    decode         terminal - first_token, summed over attempts
+    network        the residual (transport + anything replicas did not
+                   evidence), clamped at 0
+
+so sum(hops) == e2e up to clock skew between hosts.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+ROUTER_EVENTS = ("received", "route_decision", "routed", "first_chunk",
+                 "replica_failed", "finished", "aborted")
+
+ROUTER_HOPS = ("router_queue", "routing", "network")
+REPLICA_HOPS = ("replica_queue", "prefill", "decode")
+
+
+def attempt_request_id(trace_id: str, attempt: int) -> str:
+    """Sub-request id for failover attempt `attempt` (0-based)."""
+    return trace_id if attempt == 0 else f"{trace_id}#f{attempt}"
+
+
+class TraceBook:
+    """Bounded map trace_id -> the replica attempts it fanned out to
+    (insertion-ordered; oldest trace evicted past `max_traces`)."""
+
+    def __init__(self, max_traces: int = 512) -> None:
+        self.max_traces = max_traces
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, List[Dict[str, Any]]]" = (
+            OrderedDict())
+
+    def note_attempt(self, trace_id: str, attempt: int, replica_id: str,
+                     request_id: str, decision: str) -> None:
+        with self._lock:
+            attempts = self._traces.get(trace_id)
+            if attempts is None:
+                attempts = []
+                self._traces[trace_id] = attempts
+                while len(self._traces) > self.max_traces:
+                    self._traces.popitem(last=False)
+            attempts.append({
+                "attempt": attempt,
+                "replica_id": replica_id,
+                "request_id": request_id,
+                "decision": decision,
+            })
+
+    def attempts(self, trace_id: str) -> Optional[List[Dict[str, Any]]]:
+        with self._lock:
+            attempts = self._traces.get(trace_id)
+            return [dict(a) for a in attempts] if attempts else None
+
+    def recent_trace_ids(self, limit: int = 32) -> List[str]:
+        with self._lock:
+            ids = list(self._traces.keys())
+        return ids[-limit:][::-1]
+
+    def reset_for_testing(self) -> None:
+        with self._lock:
+            self._traces = OrderedDict()
+
+
+def _first_ts(events: List[Dict[str, Any]], name: str) -> Optional[float]:
+    for ev in events:
+        if ev["event"] == name:
+            return ev["ts"]
+    return None
+
+
+def attribute_hops(router_events: List[Dict[str, Any]],
+                   attempts: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-hop decomposition of the router-observed e2e (see module
+    docstring). `attempts` entries carry an optional "events" list (the
+    replica-side trace; absent when the replica was unreachable)."""
+    received = _first_ts(router_events, "received")
+    terminal = None
+    for ev in router_events:
+        if ev["event"] in ("finished", "aborted"):
+            terminal = ev["ts"]
+    if received is None or terminal is None:
+        return {"e2e_s": None, "hops_s": {}}
+    e2e = max(terminal - received, 0.0)
+
+    hops = {h: 0.0 for h in ("router_queue", "routing", "replica_queue",
+                             "prefill", "decode")}
+    decision_ts = [ev["ts"] for ev in router_events
+                   if ev["event"] == "route_decision"]
+    routed_ts = [ev["ts"] for ev in router_events
+                 if ev["event"] == "routed"]
+    if decision_ts:
+        hops["router_queue"] = max(decision_ts[0] - received, 0.0)
+    for d, r in zip(decision_ts, routed_ts):
+        hops["routing"] += max(r - d, 0.0)
+
+    for att in attempts:
+        events = att.get("events")
+        if not events:
+            continue
+        queued = _first_ts(events, "queued") or _first_ts(events, "arrived")
+        scheduled = _first_ts(events, "scheduled")
+        first_token = _first_ts(events, "first_token")
+        end = events[-1]["ts"]
+        if queued is not None and scheduled is not None:
+            hops["replica_queue"] += max(scheduled - queued, 0.0)
+        if scheduled is not None:
+            hops["prefill"] += max((first_token or end) - scheduled, 0.0)
+        if first_token is not None:
+            hops["decode"] += max(end - first_token, 0.0)
+
+    # What no hop evidenced: transport, serialization, clock gaps. The
+    # clamp keeps the decomposition a partition when replica clocks run
+    # slightly ahead of the router's.
+    hops["network"] = max(e2e - sum(hops.values()), 0.0)
+    return {
+        "e2e_s": round(e2e, 6),
+        "hops_s": {h: round(v, 6) for h, v in hops.items()},
+    }
+
+
+def stitch_trace(trace_id: str,
+                 router_events: Optional[List[Dict[str, Any]]],
+                 attempts: Optional[List[Dict[str, Any]]]
+                 ) -> Optional[Dict[str, Any]]:
+    """Merge the router's spans and every attempt's replica trace into
+    one causally-ordered timeline. Returns None when the router never
+    saw the trace. Replica events are labelled `replica:<id>`; attempts
+    whose replica trace could not be fetched (dead replica, evicted
+    ring) still appear in `attempts` with events=None."""
+    if not router_events:
+        return None
+    attempts = attempts or []
+    timeline: List[Dict[str, Any]] = [
+        {**ev, "hop": "router"} for ev in router_events]
+    for att in attempts:
+        for ev in att.get("events") or []:
+            timeline.append({**ev,
+                             "hop": f"replica:{att['replica_id']}",
+                             "request_id": att["request_id"]})
+    # Stable sort: equal timestamps keep router-before-replica insertion
+    # order, which matches causality (the router routed before the
+    # replica saw the request).
+    timeline.sort(key=lambda ev: ev["ts"])
+    return {
+        "trace_id": trace_id,
+        "hops": ["router"] + [f"replica:{a['replica_id']}"
+                              for a in attempts],
+        "attempts": [{k: v for k, v in att.items() if k != "events"}
+                     | {"has_events": bool(att.get("events"))}
+                     for att in attempts],
+        "timeline": timeline,
+        "attribution": attribute_hops(router_events, attempts),
+    }

@@ -117,6 +117,28 @@ def _device_lines(devices: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def _slowest_lines(slowest: List[Dict[str, Any]],
+                   limit: int = 4) -> List[str]:
+    """Slowest-requests panel: worst e2e in the SLO window with each
+    request's per-hop split — the tail-latency question ("why was THIS
+    request slow?") answered without leaving the terminal. Fleet ids
+    stitch further via the router's /debug/trace/{id}."""
+    lines: List[str] = []
+    if not slowest:
+        return lines
+    lines.append("")
+    lines.append("Slowest requests (window):")
+    for rec in slowest[:limit]:
+        hops = rec.get("hops_ms") or {}
+        hop_str = " ".join(f"{hop}={hops[hop]:.0f}ms"
+                           for hop in sorted(hops)) or "no hop data"
+        flag = "  ** SLO **" if rec.get("slo_violated") else ""
+        rid = str(rec.get("request_id") or "?")
+        lines.append(f"  {rid[:34]:<34} e2e={rec.get('e2e_ms', 0):>8.0f}ms"
+                     f"  {hop_str}{flag}")
+    return lines
+
+
 def render_frame(health: Optional[Dict[str, Any]],
                  metrics: Dict[str, List[Tuple[Dict[str, str], float]]],
                  base: str) -> str:
@@ -190,6 +212,13 @@ def render_frame(health: Optional[Dict[str, Any]],
             f"TTFT p50/p99 {_p(slo.get('ttft_ms'))}ms  "
             f"TPOT p50/p99 {_p(slo.get('tpot_ms'))}ms  "
             f"queue-wait p50/p99 {_p(slo.get('queue_wait_ms'))}ms")
+        hops = slo.get("hops_ms") or {}
+        if hops:
+            lines.append("Hops (p50ms): " + "  ".join(
+                f"{hop}={stats.get('p50', 'n/a')}"
+                for hop, stats in sorted(hops.items())))
+
+    lines.extend(_slowest_lines(slo.get("slowest") or []))
 
     lines.extend(_efficiency_lines(health.get("efficiency") or {}))
 

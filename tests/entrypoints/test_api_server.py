@@ -157,6 +157,48 @@ def test_top_renders_one_frame(api_server):
     assert "Queues:" in out.stdout
 
 
+def test_x_request_id_honored_and_echoed(api_server):
+    """A valid client X-Request-Id becomes the engine request id (the
+    distributed trace id): echoed on the response and queryable in the
+    flight recorder under the SAME id."""
+    rid = "trace-e2e-0042"
+    r = requests.post(BASE + "/generate",
+                      json={"prompt": "hello my name is",
+                            "max_tokens": 4, "temperature": 0.0},
+                      headers={"X-Request-Id": rid})
+    assert r.status_code == 200
+    assert r.headers["X-Request-Id"] == rid
+    tr = requests.get(BASE + "/debug/trace", params={"request_id": rid})
+    assert tr.status_code == 200
+    events = tr.json()["events"]
+    assert [e["event"] for e in events][-1] == "finished"
+    assert all(e["hop"] == "engine" for e in events)
+
+
+def test_x_request_id_echoed_on_stream(api_server):
+    rid = "trace-stream-1"
+    r = requests.post(BASE + "/generate",
+                      json={"prompt": "hello", "max_tokens": 2,
+                            "temperature": 0.0, "stream": True},
+                      headers={"X-Request-Id": rid}, stream=True)
+    assert r.status_code == 200
+    assert r.headers["X-Request-Id"] == rid
+    for _ in r.iter_lines():
+        pass
+
+
+def test_invalid_x_request_id_replaced(api_server):
+    """Hostile/invalid ids (bad charset) are rejected and replaced with
+    a minted uuid — still echoed so the client learns the real id."""
+    r = requests.post(BASE + "/generate",
+                      json={"prompt": "hello", "max_tokens": 2,
+                            "temperature": 0.0},
+                      headers={"X-Request-Id": "bad id/../{}"})
+    assert r.status_code == 200
+    echoed = r.headers["X-Request-Id"]
+    assert echoed and echoed != "bad id/../{}"
+
+
 def test_client_disconnect_aborts(api_server):
     """Closing the HTTP connection mid-stream must abort the request
     server-side (failure-detection parity: abort-on-disconnect), leaving

@@ -170,6 +170,48 @@ def test_chat_completion(openai_server):
     assert body["choices"][0]["message"]["role"] == "assistant"
 
 
+def test_x_request_id_honored_and_echoed(openai_server):
+    """A valid X-Request-Id becomes the completion id (the distributed
+    trace id), is echoed on the response, and the engine's flight
+    recorder holds the trace under the derived per-prompt id."""
+    rid = "trace-openai-7"
+
+    async def run():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(BASE + "/v1/completions", json={
+                "model": "tiny-opt", "prompt": "hello my name is",
+                "max_tokens": 4, "temperature": 0.0,
+            }, headers={"X-Request-Id": rid}) as resp:
+                assert resp.status == 200
+                assert resp.headers["X-Request-Id"] == rid
+                body = await resp.json()
+            assert body["id"] == rid
+            # Completions fan out per prompt as `<id>-<i>`.
+            async with s.get(BASE + "/debug/trace",
+                             params={"request_id": f"{rid}-0"}) as resp:
+                assert resp.status == 200
+                trace = await resp.json()
+            assert [e["event"] for e in trace["events"]][-1] == "finished"
+            # An invalid id is replaced by a minted cmpl- uuid.
+            async with s.post(BASE + "/v1/completions", json={
+                "model": "tiny-opt", "prompt": "hello",
+                "max_tokens": 2, "temperature": 0.0,
+            }, headers={"X-Request-Id": "bad id{}"}) as resp:
+                assert resp.status == 200
+                assert resp.headers["X-Request-Id"].startswith("cmpl-")
+            # Chat echoes too.
+            async with s.post(BASE + "/v1/chat/completions", json={
+                "model": "tiny-opt",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 2, "temperature": 0.0,
+            }, headers={"X-Request-Id": "chat-trace-1"}) as resp:
+                assert resp.status == 200
+                assert resp.headers["X-Request-Id"] == "chat-trace-1"
+                assert (await resp.json())["id"] == "chat-trace-1"
+
+    asyncio.run(run())
+
+
 def test_bad_request_returns_error(openai_server):
     status, body = asyncio.run(_post("/v1/completions", {
         "model": "tiny-opt",

@@ -59,6 +59,8 @@ def test_sources_define_metrics():
     assert "intellillm_slo_goodput_ratio" in names
     assert "intellillm_step_phase_seconds" in names
     assert "intellillm_router_requests_total" in names
+    assert "intellillm_trace_hop_seconds" in names
+    assert "intellillm_trace_exported_total" in names
 
 
 def test_every_source_metric_is_documented():

@@ -87,3 +87,39 @@ def test_known_post_seed_flags_still_exist():
                  "--predictor-path", "--affinity-blocks",
                  "--load-balance-slack"):
         assert flag in flags, flag
+
+
+# --- Environment-variable doc guard (obs package only: every env knob
+# of the observability subsystem is operator-facing and belongs in the
+# docs/observability.md env table; packages outside obs/ carry
+# developer escape hatches that are deliberately undocumented). ---
+
+ENV_VAR_RE = re.compile(r"\b(INTELLILLM_[A-Z0-9_]+)\b")
+OBS_DIR = REPO_ROOT / "intellillm_tpu" / "obs"
+
+
+def _obs_env_vars():
+    names = set()
+    for path in sorted(OBS_DIR.rglob("*.py")):
+        names.update(ENV_VAR_RE.findall(path.read_text(encoding="utf-8")))
+    # INTELLILLM_SLO_ appears as a doc-string prefix reference; drop
+    # the bare prefix, keep the concrete vars.
+    return {n for n in names if not n.endswith("_")}
+
+
+def test_env_scrape_sees_known_vars():
+    # Guard the guard.
+    names = _obs_env_vars()
+    assert "INTELLILLM_WATCHDOG" in names
+    assert "INTELLILLM_TRACE_EXPORT" in names
+    assert "INTELLILLM_TRACE_HOP" in names
+    assert "INTELLILLM_BLACK_BOX_DIR" in names
+    assert len(names) >= 15, sorted(names)
+
+
+def test_obs_env_vars_are_documented():
+    docs = "\n".join(p.read_text(encoding="utf-8") for p in DOC_FILES)
+    undocumented = sorted(n for n in _obs_env_vars() if n not in docs)
+    assert not undocumented, (
+        f"obs env vars missing from docs/observability.md: "
+        f"{undocumented} — add a row to the environment-variables table")

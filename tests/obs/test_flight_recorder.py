@@ -98,7 +98,55 @@ def test_disabled_recorder_records_nothing():
 def test_event_names_are_canonical():
     assert set(EVENTS) >= {"arrived", "scheduled", "prefill_start",
                            "preempted", "swapped_out", "swapped_in",
-                           "first_token", "finished", "aborted"}
+                           "first_token", "finished", "aborted",
+                           "rerouted"}
+
+
+def test_rerouted_is_terminal_and_seals():
+    """Failover path: `rerouted` seals the victim attempt's trace, so
+    the engine-side `aborted` that lands later (aborts are processed at
+    the next step) is a dropped no-op — no double-counted terminal."""
+    r = FlightRecorder(enabled=True)
+    r.record("r1", "arrived")
+    r.record("r1", "first_token")
+    assert r.record("r1", "rerouted", detail="replica=r0 died") is True
+    assert r.record("r1", "aborted") is False  # sealed
+    assert _events(r, "r1") == ["arrived", "first_token", "rerouted"]
+    assert "r1" not in r.live_request_ids()
+    assert [x["request_id"] for x in r.recent_finished()] == ["r1"]
+
+
+def test_events_carry_hop_tag(monkeypatch):
+    monkeypatch.delenv("INTELLILLM_TRACE_HOP", raising=False)
+    engine = FlightRecorder(enabled=True)           # default hop
+    router = FlightRecorder(enabled=True, hop="router")
+    engine.record("t", "arrived")
+    engine.record("t", "finished")
+    router.record("t", "received")
+    assert all(e["hop"] == "engine" for e in engine.get_trace("t"))
+    assert all(e["hop"] == "router" for e in router.get_trace("t"))
+    finished = engine.recent_finished()
+    assert finished[0]["hop"] == "engine"
+    assert all(e["hop"] == "engine" for e in finished[0]["events"])
+
+
+def test_hop_from_env(monkeypatch):
+    monkeypatch.setenv("INTELLILLM_TRACE_HOP", "edge-cache")
+    assert FlightRecorder(enabled=True).hop == "edge-cache"
+
+
+def test_separate_recorders_do_not_collide():
+    """The router keeps its own recorder so an in-process replica's
+    events for the SAME trace id stay on the engine recorder."""
+    engine = FlightRecorder(enabled=True)
+    router = FlightRecorder(enabled=True, hop="router")
+    router.record("t", "received")
+    engine.record("t", "arrived")
+    engine.record("t", "finished")
+    # The engine terminal must not seal the router's live span.
+    assert router.record("t", "finished") is True
+    assert _events(engine, "t") == ["arrived", "finished"]
+    assert _events(router, "t") == ["received", "finished"]
 
 
 def test_global_recorder_reset():

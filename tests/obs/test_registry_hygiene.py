@@ -37,6 +37,9 @@ def test_constructors_are_found():
     assert "intellillm_router_requests_total" in names
     assert "intellillm_router_routing_decisions_total" in names
     assert "intellillm_router_predicted_load_tokens" in names
+    # Distributed-tracing families (PR 7).
+    assert "intellillm_trace_exported_total" in names
+    assert "intellillm_trace_hop_seconds" in names
 
 
 def test_every_metric_name_is_prefixed():

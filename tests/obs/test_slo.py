@@ -75,6 +75,37 @@ class TestDerive:
         ], num_generation_tokens=1)
         assert rec["tpot_s"] == pytest.approx(0.0)
 
+    def test_hops_partition_queued_to_terminal(self):
+        rec = derive_request_metrics([
+            _ev(10.0, "arrived"),
+            _ev(10.2, "queued"),
+            _ev(11.2, "scheduled"),
+            _ev(11.5, "first_token"),
+            _ev(13.5, "finished", "stop"),
+        ], num_generation_tokens=5)
+        hops = rec["hops"]
+        assert hops["replica_queue"] == pytest.approx(1.0)
+        assert hops["prefill"] == pytest.approx(0.3)
+        assert hops["decode"] == pytest.approx(2.0)
+        # The hops partition the span from `queued` to the terminal.
+        assert sum(hops.values()) == pytest.approx(13.5 - 10.2)
+
+    def test_hops_only_evidenced_spans(self):
+        rec = derive_request_metrics([
+            _ev(0.0, "queued"), _ev(5.0, "aborted"),
+        ], num_generation_tokens=0)
+        assert rec["hops"] == {}  # never scheduled: nothing to attribute
+
+    def test_rerouted_is_terminal_with_reason(self):
+        rec = derive_request_metrics([
+            _ev(0.0, "arrived"), _ev(0.1, "queued"),
+            _ev(0.2, "scheduled"), _ev(0.5, "first_token"),
+            _ev(1.0, "rerouted", "replica=r0 died mid-stream"),
+        ], num_generation_tokens=3)
+        assert rec is not None
+        assert rec["reason"] == "rerouted"
+        assert rec["e2e_s"] == pytest.approx(1.0)
+
 
 def _record(ttft_s, tpot_s, reason="stop", **kwargs):
     return {"queue_wait_s": kwargs.get("queue_wait_s", 0.01),
@@ -160,6 +191,52 @@ class TestSummary:
                           preemptions={"swap": 1, "recompute": 1}))
         assert t.summary()["preemptions_total"] == {"swap": 3,
                                                     "recompute": 1}
+
+    def test_hops_ms_percentiles(self):
+        t = SLOTracker()
+        for i in range(1, 11):
+            rec = _record(ttft_s=0.01, tpot_s=0.001)
+            rec["hops"] = {"prefill": i / 100.0, "decode": i / 10.0}
+            t.observe(rec)
+        s = t.summary()
+        assert s["hops_ms"]["prefill"]["p50"] == pytest.approx(50.0)
+        assert s["hops_ms"]["decode"]["p99"] == pytest.approx(1000.0)
+        t2 = SLOTracker()
+        assert t2.summary()["hops_ms"] is None
+
+    def test_slowest_panel_bounded_and_sorted(self):
+        t = SLOTracker(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        for i in range(1, 21):
+            rec = _record(ttft_s=0.01, tpot_s=0.001, e2e_s=float(i))
+            rec["request_id"] = f"req-{i}"
+            rec["hops"] = {"decode": float(i) - 0.5}
+            t.observe(rec)
+        slowest = t.summary()["slowest"]
+        assert len(slowest) == 8  # bounded keep
+        assert [r["request_id"] for r in slowest] == [
+            f"req-{i}" for i in range(20, 12, -1)]  # worst first
+        assert slowest[0]["e2e_ms"] == pytest.approx(20000.0)
+        assert slowest[0]["hops_ms"]["decode"] == pytest.approx(19500.0)
+        assert slowest[0]["slo_violated"] is False
+
+    def test_slo_violation_flagged_in_record(self):
+        t = SLOTracker(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        bad = _record(ttft_s=5.0, tpot_s=1.0)
+        bad["request_id"] = "slow-1"
+        t.observe(bad)
+        assert bad["slo_violated"] is True  # the trace sink's keep signal
+        assert t.summary()["slowest"][0]["slo_violated"] is True
+
+    def test_rerouted_excluded_from_goodput(self):
+        t = SLOTracker(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        # A rerouted victim attempt that would FAIL SLO must not drag
+        # goodput down — the retried attempt is the client-visible one.
+        t.observe(_record(ttft_s=9.0, tpot_s=9.0, reason="rerouted"))
+        assert t.summary()["goodput_ratio"] is None
+        t.observe(_record(ttft_s=0.01, tpot_s=0.001))
+        s = t.summary()
+        assert s["goodput_ratio"] == pytest.approx(1.0)
+        assert s["finished_total"] == {"rerouted": 1, "stop": 1}
 
 
 class TestRecordFinish:

@@ -1,0 +1,200 @@
+"""Trace sink + black box unit tests: request-id sanitization, the
+tail-sampling keep/drop policy (SLO violators always kept, healthy rest
+hash-sampled deterministically), JSONL parseability, bounded rotation,
+the disabled short-circuit, and black-box dump integrity."""
+import json
+import os
+import signal
+
+import pytest
+
+from intellillm_tpu.obs import get_flight_recorder
+from intellillm_tpu.obs.trace_export import (MAX_REQUEST_ID_LEN, TraceSink,
+                                             _keep_hash, flush_black_box,
+                                             get_trace_sink,
+                                             install_black_box_handlers,
+                                             reset_trace_sink_for_testing,
+                                             sanitize_request_id)
+
+EVENTS = [{"ts": 1.0, "event": "arrived", "hop": "engine"},
+          {"ts": 2.0, "event": "finished", "hop": "engine"}]
+
+
+class TestSanitizeRequestId:
+
+    def test_valid_ids_pass_through(self):
+        for rid in ("abc", "req-1", "trace_2.b", "t:1", "trace#f1",
+                    "A" * MAX_REQUEST_ID_LEN):
+            assert sanitize_request_id(rid) == rid
+
+    def test_surrounding_whitespace_stripped(self):
+        assert sanitize_request_id("  req-1 ") == "req-1"
+
+    def test_rejected_ids(self):
+        for rid in (None, "", "   ", "a b", "a\nb", "a\tb", "id/../x",
+                    "ïd", "a;b", 'x"y'):
+            assert sanitize_request_id(rid) is None
+
+    def test_overlong_id_truncated(self):
+        assert sanitize_request_id("a" * 500) == "a" * MAX_REQUEST_ID_LEN
+
+    def test_bad_char_past_truncation_is_fine(self):
+        # The hostile tail is cut off before validation.
+        assert (sanitize_request_id("a" * MAX_REQUEST_ID_LEN + "\n")
+                == "a" * MAX_REQUEST_ID_LEN)
+
+
+class TestTailSampling:
+
+    def _sink(self, tmp_path, sample):
+        return TraceSink(enabled=True, trace_dir=str(tmp_path),
+                         sample=sample, max_bytes=1 << 20, max_files=4)
+
+    def test_healthy_trace_dropped_at_sample_zero(self, tmp_path):
+        sink = self._sink(tmp_path, sample=0.0)
+        assert sink.maybe_export("t1", EVENTS, {"reason": "stop"}) is None
+        assert not os.path.exists(sink.path)
+
+    def test_healthy_trace_kept_at_sample_one(self, tmp_path):
+        sink = self._sink(tmp_path, sample=1.0)
+        assert sink.maybe_export(
+            "t1", EVENTS, {"reason": "stop"}) == "kept_sampled"
+        assert os.path.exists(sink.path)
+
+    @pytest.mark.parametrize("rec", [
+        {"reason": "stop", "slo_violated": True},
+        {"reason": "stop", "preemptions": {"swap": 1}},
+        {"reason": "abort"},
+        {"reason": "rerouted"},
+        {"reason": "error"},
+    ])
+    def test_interesting_traces_always_kept(self, tmp_path, rec):
+        sink = self._sink(tmp_path, sample=0.0)
+        assert sink.maybe_export("t1", EVENTS, rec) == "kept_slo"
+
+    def test_sampling_is_deterministic_across_sinks(self, tmp_path):
+        # Same hash coordinate everywhere: the router and every replica
+        # keep the SAME sampled requests, so kept traces are complete.
+        ids = [f"trace-{i}" for i in range(200)]
+        a = self._sink(tmp_path / "a", sample=0.5)
+        b = self._sink(tmp_path / "b", sample=0.5)
+        kept_a = {i for i in ids
+                  if a.maybe_export(i, EVENTS, {"reason": "stop"})}
+        kept_b = {i for i in ids
+                  if b.maybe_export(i, EVENTS, {"reason": "stop"})}
+        assert kept_a == kept_b
+        assert 0 < len(kept_a) < len(ids)  # actually sampling
+        for i in ids:
+            assert 0.0 <= _keep_hash(i) < 1.0
+
+    def test_exported_jsonl_parses(self, tmp_path):
+        sink = self._sink(tmp_path, sample=1.0)
+        sink.maybe_export("t1", EVENTS, {"reason": "stop", "e2e_s": 1.0},
+                          hop="engine")
+        sink.maybe_export("t2", EVENTS, {"reason": "abort"}, hop="router")
+        with open(sink.path, encoding="utf-8") as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        assert [r["trace_id"] for r in rows] == ["t1", "t2"]
+        assert rows[0]["hop"] == "engine"
+        assert rows[0]["events"] == EVENTS
+        assert rows[0]["slo"]["e2e_s"] == 1.0
+        assert rows[1]["decision"] == "kept_slo"
+
+    def test_disabled_sink_short_circuits(self, tmp_path):
+        sink = TraceSink(enabled=False, trace_dir=str(tmp_path))
+        # Events must not even be read when disabled (decode hot path).
+        assert sink.maybe_export("t1", None, None) is None
+        assert os.listdir(tmp_path) == []
+
+    def test_env_default_is_off(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("INTELLILLM_TRACE_EXPORT", raising=False)
+        reset_trace_sink_for_testing()
+        try:
+            assert get_trace_sink().enabled is False
+            monkeypatch.setenv("INTELLILLM_TRACE_EXPORT", "1")
+            monkeypatch.setenv("INTELLILLM_TRACE_DIR", str(tmp_path))
+            reset_trace_sink_for_testing()
+            sink = get_trace_sink()
+            assert sink.enabled is True
+            assert sink.trace_dir == str(tmp_path)
+        finally:
+            reset_trace_sink_for_testing()
+
+
+class TestRotation:
+
+    def test_rotation_respects_byte_and_file_bounds(self, tmp_path):
+        max_bytes = 4096
+        sink = TraceSink(enabled=True, trace_dir=str(tmp_path),
+                         sample=1.0, max_bytes=max_bytes, max_files=3)
+        for i in range(300):
+            assert sink.maybe_export(f"trace-{i}", EVENTS,
+                                     {"reason": "stop"}) is not None
+        names = sorted(os.listdir(tmp_path))
+        assert len(names) <= 3
+        assert "traces.jsonl" in names
+        for name in names:
+            assert os.path.getsize(tmp_path / name) <= max_bytes + 512
+        # Every surviving line is still valid JSON.
+        for path in sink.files():
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    assert json.loads(line)["trace_id"].startswith("trace-")
+
+    def test_single_file_bound(self, tmp_path):
+        sink = TraceSink(enabled=True, trace_dir=str(tmp_path),
+                         sample=1.0, max_bytes=2048, max_files=1)
+        for i in range(100):
+            sink.maybe_export(f"t{i}", EVENTS, {"reason": "stop"})
+        assert os.listdir(tmp_path) == ["traces.jsonl"]
+        assert os.path.getsize(sink.path) <= 2048 + 512
+
+
+class TestBlackBox:
+
+    def test_flush_writes_parseable_dump(self, tmp_path):
+        recorder = get_flight_recorder()
+        recorder.reset_for_testing()
+        try:
+            recorder.record("live-1", "arrived")
+            recorder.record("done-1", "arrived")
+            recorder.record("done-1", "finished", "stop")
+            path = flush_black_box("test_reason",
+                                   extra={"round": 3},
+                                   black_box_dir=str(tmp_path))
+            assert path is not None and os.path.exists(path)
+            with open(path, encoding="utf-8") as f:
+                dump = json.load(f)
+            assert dump["reason"] == "test_reason"
+            assert dump["pid"] == os.getpid()
+            assert dump["extra"] == {"round": 3}
+            assert "live-1" in dump["live_traces"]
+            assert [t["request_id"] for t in dump["recent_finished"]] == [
+                "done-1"]
+            # No stray .tmp left behind (atomic rename).
+            assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+        finally:
+            recorder.reset_for_testing()
+
+    def test_flush_never_raises(self, tmp_path):
+        # An unwritable dir must not take the dying process down harder.
+        bad = tmp_path / "file-not-dir"
+        bad.write_text("x")
+        assert flush_black_box("x", black_box_dir=str(bad / "sub")) is None
+
+    def test_signal_handler_chains_previous(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("INTELLILLM_BLACK_BOX_DIR", str(tmp_path))
+        seen = []
+        previous = signal.signal(signal.SIGUSR1,
+                                 lambda num, frame: seen.append(num))
+        try:
+            install_black_box_handlers(signals=(signal.SIGUSR1,))
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert seen == [signal.SIGUSR1]  # previous handler still ran
+            dumps = [n for n in os.listdir(tmp_path)
+                     if n.startswith("blackbox-") and n.endswith(".json")]
+            assert len(dumps) == 1
+            with open(tmp_path / dumps[0], encoding="utf-8") as f:
+                assert json.load(f)["reason"] == f"signal {signal.SIGUSR1}"
+        finally:
+            signal.signal(signal.SIGUSR1, previous)

@@ -1,0 +1,160 @@
+"""Unit tests for the fleet trace stitcher (router/trace.py):
+sub-request ids, the TraceBook bound, per-hop attribution as an exact
+partition of e2e, and stitched-timeline ordering."""
+import pytest
+
+from intellillm_tpu.router.trace import (TraceBook, attempt_request_id,
+                                         attribute_hops, stitch_trace)
+
+
+def _ev(ts, event, detail=None):
+    out = {"ts": ts, "event": event, "hop": "router"}
+    if detail is not None:
+        out["detail"] = detail
+    return out
+
+
+def _replica_events(t0):
+    return [
+        {"ts": t0, "event": "arrived", "hop": "engine"},
+        {"ts": t0 + 0.01, "event": "queued", "hop": "engine"},
+        {"ts": t0 + 0.05, "event": "scheduled", "hop": "engine"},
+        {"ts": t0 + 0.15, "event": "first_token", "hop": "engine"},
+        {"ts": t0 + 0.55, "event": "finished", "hop": "engine"},
+    ]
+
+
+def test_attempt_request_id():
+    assert attempt_request_id("t", 0) == "t"
+    assert attempt_request_id("t", 1) == "t#f1"
+    assert attempt_request_id("t", 2) == "t#f2"
+
+
+class TestTraceBook:
+
+    def test_attempts_recorded_in_order(self):
+        book = TraceBook()
+        book.note_attempt("t", 0, "r0", "t", "affinity_new")
+        book.note_attempt("t", 1, "r1", "t#f1", "failover")
+        attempts = book.attempts("t")
+        assert [a["replica_id"] for a in attempts] == ["r0", "r1"]
+        assert attempts[1]["request_id"] == "t#f1"
+        assert book.attempts("unknown") is None
+
+    def test_bounded_eviction(self):
+        book = TraceBook(max_traces=2)
+        for i in range(4):
+            book.note_attempt(f"t{i}", 0, "r0", f"t{i}", "load_balanced")
+        assert book.attempts("t0") is None
+        assert book.attempts("t3") is not None
+        assert book.recent_trace_ids() == ["t3", "t2"]  # newest first
+
+    def test_returns_copies(self):
+        book = TraceBook()
+        book.note_attempt("t", 0, "r0", "t", "affinity_new")
+        book.attempts("t")[0]["replica_id"] = "mutated"
+        assert book.attempts("t")[0]["replica_id"] == "r0"
+
+
+class TestAttribution:
+
+    def test_partition_sums_to_e2e(self):
+        router_events = [
+            _ev(100.0, "received"),
+            _ev(100.1, "route_decision"),
+            _ev(100.12, "routed"),
+            _ev(100.3, "first_chunk"),
+            _ev(100.8, "finished"),
+        ]
+        attempts = [{"replica_id": "r0", "request_id": "t",
+                     "events": _replica_events(100.15)}]
+        out = attribute_hops(router_events, attempts)
+        assert out["e2e_s"] == pytest.approx(0.8)
+        hops = out["hops_s"]
+        assert hops["router_queue"] == pytest.approx(0.1)
+        assert hops["routing"] == pytest.approx(0.02)
+        assert hops["replica_queue"] == pytest.approx(0.04)
+        assert hops["prefill"] == pytest.approx(0.10)
+        assert hops["decode"] == pytest.approx(0.40)
+        # network is the residual — the partition is exact by construction.
+        assert sum(hops.values()) == pytest.approx(out["e2e_s"])
+        assert hops["network"] >= 0.0
+
+    def test_failover_sums_both_attempts(self):
+        router_events = [
+            _ev(0.0, "received"),
+            _ev(0.1, "route_decision"), _ev(0.12, "routed"),
+            _ev(0.5, "replica_failed"),
+            _ev(0.5, "route_decision"), _ev(0.51, "routed"),
+            _ev(1.5, "finished"),
+        ]
+        attempts = [
+            {"replica_id": "r0", "request_id": "t", "events": [
+                {"ts": 0.13, "event": "queued"},
+                {"ts": 0.15, "event": "scheduled"},
+                {"ts": 0.2, "event": "first_token"},
+                {"ts": 0.5, "event": "rerouted"},
+            ]},
+            {"replica_id": "r1", "request_id": "t#f1",
+             "events": _replica_events(0.55)},
+        ]
+        out = attribute_hops(router_events, attempts)
+        hops = out["hops_s"]
+        assert hops["routing"] == pytest.approx(0.03)       # both attempts
+        assert hops["replica_queue"] == pytest.approx(0.02 + 0.04)
+        assert sum(hops.values()) == pytest.approx(out["e2e_s"])
+
+    def test_network_clamped_nonnegative(self):
+        # Replica clock runs AHEAD of the router's: evidence exceeds
+        # e2e; the clamp keeps the partition sane.
+        router_events = [_ev(0.0, "received"), _ev(0.0, "route_decision"),
+                         _ev(0.0, "routed"), _ev(0.1, "finished")]
+        attempts = [{"replica_id": "r0", "request_id": "t", "events": [
+            {"ts": 0.0, "event": "queued"},
+            {"ts": 0.3, "event": "scheduled"},
+            {"ts": 0.4, "event": "first_token"},
+            {"ts": 0.5, "event": "finished"},
+        ]}]
+        out = attribute_hops(router_events, attempts)
+        assert out["hops_s"]["network"] == 0.0
+
+    def test_unterminated_trace(self):
+        out = attribute_hops([_ev(0.0, "received")], [])
+        assert out["e2e_s"] is None
+        assert out["hops_s"] == {}
+
+
+class TestStitch:
+
+    def test_none_without_router_events(self):
+        assert stitch_trace("t", None, []) is None
+        assert stitch_trace("t", [], None) is None
+
+    def test_timeline_ordered_across_hops(self):
+        router_events = [_ev(0.0, "received"), _ev(0.1, "route_decision"),
+                         _ev(0.12, "routed"), _ev(0.9, "finished")]
+        attempts = [{"replica_id": "r0", "request_id": "t", "attempt": 0,
+                     "decision": "affinity_new",
+                     "events": _replica_events(0.2)}]
+        st = stitch_trace("t", router_events, attempts)
+        assert st["trace_id"] == "t"
+        assert st["hops"] == ["router", "replica:r0"]
+        ts = [ev["ts"] for ev in st["timeline"]]
+        assert ts == sorted(ts)
+        hops_seen = {ev["hop"] for ev in st["timeline"]}
+        assert hops_seen == {"router", "replica:r0"}
+        # Replica events carry the sub-request id; attempts drop the raw
+        # event list but say whether one was fetched.
+        replica_evs = [e for e in st["timeline"] if e["hop"] != "router"]
+        assert all(e["request_id"] == "t" for e in replica_evs)
+        assert st["attempts"][0]["has_events"] is True
+        assert "events" not in st["attempts"][0]
+        assert st["attribution"]["e2e_s"] == pytest.approx(0.9)
+
+    def test_unfetchable_replica_still_listed(self):
+        router_events = [_ev(0.0, "received"), _ev(0.5, "aborted")]
+        attempts = [{"replica_id": "r0", "request_id": "t", "attempt": 0,
+                     "decision": "load_balanced", "events": None}]
+        st = stitch_trace("t", router_events, attempts)
+        assert st["attempts"][0]["has_events"] is False
+        assert all(ev["hop"] == "router" for ev in st["timeline"])

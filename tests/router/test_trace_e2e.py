@@ -1,0 +1,176 @@
+"""E2E acceptance for distributed tracing (tiny OPT, CPU): a client
+X-Request-Id rides through the router into TWO in-process replicas
+across a forced mid-stream failover, and GET /debug/trace/{id} returns
+ONE stitched trace — router spans + both replicas' flight-recorder
+events, causally ordered, with a per-hop attribution that sums to e2e.
+Also covers the durable sink seeing every hop of the same trace."""
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from intellillm_tpu.engine.arg_utils import AsyncEngineArgs
+from intellillm_tpu.engine.async_llm_engine import AsyncLLMEngine
+from intellillm_tpu.obs import get_flight_recorder
+from intellillm_tpu.obs.trace_export import (get_trace_sink,
+                                             reset_trace_sink_for_testing)
+from intellillm_tpu.research.predictor import PromptLengthHeuristic
+from intellillm_tpu.router.metrics import _RouterMetrics
+from intellillm_tpu.router.policy import RouterConfig
+from intellillm_tpu.router.replica import InProcessReplica, ReplicaManager
+from intellillm_tpu.router.server import Router, build_router_app
+
+PROMPT = "the president of the united states is"
+TRACE_ID = "fleet-trace-0001"
+
+
+def _build_engine(tiny_opt_dir):
+    args = AsyncEngineArgs(model=tiny_opt_dir, dtype="float32",
+                           max_model_len=128,
+                           num_device_blocks_override=128,
+                           max_num_seqs=4, max_paddings=512,
+                           swap_space=0.01, disable_log_stats=True,
+                           disable_log_requests=True)
+    return AsyncLLMEngine.from_engine_args(args)
+
+
+def test_stitched_trace_across_failover(tiny_opt_dir, monkeypatch,
+                                        tmp_path):
+    _RouterMetrics.reset_for_testing()
+    get_flight_recorder().reset_for_testing()
+    # Sink on, sample=1.0: every hop must export the same trace id.
+    monkeypatch.setenv("INTELLILLM_TRACE_EXPORT", "1")
+    monkeypatch.setenv("INTELLILLM_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("INTELLILLM_TRACE_SAMPLE", "1.0")
+    reset_trace_sink_for_testing()
+
+    async def run():
+        config = RouterConfig(block_size=8, affinity_blocks=2,
+                              load_balance_slack=0.0, max_retries=1,
+                              health_interval_s=0.2)
+        router = Router(config, ReplicaManager(health_interval_s=0.2),
+                        predictor=PromptLengthHeuristic(scale=4.0),
+                        tokenizer=None)
+        r0 = InProcessReplica("r0", _build_engine(tiny_opt_dir))
+        r1 = InProcessReplica("r1", _build_engine(tiny_opt_dir))
+        router.add_replica(r0, healthy=True)
+        router.add_replica(r1, healthy=True)
+
+        client = TestClient(TestServer(build_router_app(router)))
+        await client.start_server()
+        try:
+            # --- drive one request, killing the serving replica after
+            # the first streamed chunk -------------------------------
+            resp = await client.post(
+                "/generate",
+                json={"prompt": PROMPT, "max_tokens": 16,
+                      "temperature": 0.0, "ignore_eos": True,
+                      "stream": True},
+                headers={"X-Request-Id": TRACE_ID})
+            assert resp.status == 200
+            assert resp.headers["X-Request-Id"] == TRACE_ID
+            victim = None
+            chunks = []
+            async for line in resp.content:
+                line = line.strip()
+                if not line:
+                    continue
+                chunks.append(json.loads(line))
+                if victim is None:
+                    busy = [r for r in router.manager.replicas.values()
+                            if r.inflight > 0]
+                    assert len(busy) == 1
+                    victim = busy[0]
+                    victim.kill()
+            survivor = r1 if victim is r0 else r0
+            assert chunks[-1]["text"][0].startswith(PROMPT)
+            assert router.decisions["failover"] == 1
+
+            # --- ONE stitched trace: router + BOTH replicas ----------
+            resp = await client.get(f"/debug/trace/{TRACE_ID}")
+            assert resp.status == 200
+            st = await resp.json()
+            assert st["trace_id"] == TRACE_ID
+            assert st["hops"] == ["router",
+                                  f"replica:{victim.replica_id}",
+                                  f"replica:{survivor.replica_id}"]
+            assert [a["request_id"] for a in st["attempts"]] == [
+                TRACE_ID, f"{TRACE_ID}#f1"]
+            assert st["attempts"][1]["decision"] == "failover"
+            assert all(a["has_events"] for a in st["attempts"])
+
+            timeline = st["timeline"]
+            ts = [ev["ts"] for ev in timeline]
+            assert ts == sorted(ts)  # causally ordered
+            assert timeline[0]["hop"] == "router"
+            assert timeline[0]["event"] == "received"
+            router_evs = [ev["event"] for ev in timeline
+                          if ev["hop"] == "router"]
+            assert router_evs[-1] == "finished"
+            assert "replica_failed" in router_evs
+            assert router_evs.count("route_decision") == 2
+
+            # Victim attempt is sealed with the `rerouted` terminal;
+            # the retried attempt finished on the survivor — and the
+            # failover happened BEFORE the survivor saw the request.
+            victim_evs = [ev["event"] for ev in timeline
+                          if ev.get("request_id") == TRACE_ID]
+            assert victim_evs[-1] == "rerouted"
+            retry_evs = [ev["event"] for ev in timeline
+                         if ev.get("request_id") == f"{TRACE_ID}#f1"]
+            assert retry_evs[-1] == "finished"
+            assert (ts[next(i for i, ev in enumerate(timeline)
+                            if ev["event"] == "rerouted")]
+                    <= ts[next(i for i, ev in enumerate(timeline)
+                               if ev.get("request_id") ==
+                               f"{TRACE_ID}#f1")])
+
+            # --- per-hop attribution partitions e2e ------------------
+            attribution = st["attribution"]
+            hops_s = attribution["hops_s"]
+            assert set(hops_s) == {"router_queue", "routing",
+                                   "replica_queue", "prefill", "decode",
+                                   "network"}
+            assert all(v >= 0.0 for v in hops_s.values())
+            assert hops_s["decode"] > 0.0
+            assert sum(hops_s.values()) == pytest.approx(
+                attribution["e2e_s"], abs=1e-4)
+
+            # --- trace listing + 404 ---------------------------------
+            resp = await client.get("/debug/trace")
+            listing = await resp.json()
+            assert TRACE_ID in listing["recent_trace_ids"]
+            resp = await client.get("/debug/trace/never-routed")
+            assert resp.status == 404
+
+            # --- router /health/detail carries the hop summary -------
+            resp = await client.get("/health/detail")
+            detail = await resp.json()
+            tracing = detail["router"]["tracing"]
+            assert tracing["window"] == 1
+            assert tracing["export"]["enabled"] is True
+            assert tracing["router_queue_ms"]["p50"] >= 0.0
+            assert tracing["e2e_ms"]["p99"] > 0.0
+
+            # --- every hop exported the SAME trace id ----------------
+            sink = get_trace_sink()
+            with open(sink.path, encoding="utf-8") as f:
+                rows = [json.loads(line) for line in f if line.strip()]
+            by_hop = {(r["hop"], r["trace_id"]) for r in rows}
+            assert ("router", TRACE_ID) in by_hop
+            assert ("engine", f"{TRACE_ID}#f1") in by_hop
+            router_row = next(r for r in rows
+                              if r["hop"] == "router"
+                              and r["trace_id"] == TRACE_ID)
+            assert router_row["decision"] == "kept_slo"  # failed over
+            assert router_row["slo"]["reason"] == "rerouted"
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(run())
+    finally:
+        reset_trace_sink_for_testing()
+        get_flight_recorder().reset_for_testing()
+        _RouterMetrics.reset_for_testing()
