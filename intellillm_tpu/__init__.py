@@ -4,23 +4,39 @@ Continuous batching + paged KV cache + mesh tensor parallelism +
 OpenAI-compatible serving + predicted-length (SJF) scheduling — built on
 JAX/XLA/Pallas. Capability parity target: James-QiuHaoran/IntelliLLM
 (a vLLM 0.3.0 fork); see SURVEY.md for the component map.
+
+The top-level re-exports resolve lazily (PEP 562): stdlib-only tooling
+(`python -m intellillm_tpu.tools.lint` runs in a bare CI venv with no
+jax/transformers installed) must be able to import the package without
+pulling the serving stack.
 """
+import importlib
 
 __version__ = "0.1.0"
 
-from intellillm_tpu.engine.arg_utils import AsyncEngineArgs, EngineArgs
-from intellillm_tpu.engine.llm_engine import LLMEngine
-from intellillm_tpu.entrypoints.llm import LLM
-from intellillm_tpu.outputs import CompletionOutput, RequestOutput
-from intellillm_tpu.sampling_params import SamplingParams
+_EXPORTS = {
+    "LLM": "intellillm_tpu.entrypoints.llm",
+    "LLMEngine": "intellillm_tpu.engine.llm_engine",
+    "EngineArgs": "intellillm_tpu.engine.arg_utils",
+    "AsyncEngineArgs": "intellillm_tpu.engine.arg_utils",
+    "SamplingParams": "intellillm_tpu.sampling_params",
+    "RequestOutput": "intellillm_tpu.outputs",
+    "CompletionOutput": "intellillm_tpu.outputs",
+}
 
-__all__ = [
-    "LLM",
-    "LLMEngine",
-    "EngineArgs",
-    "AsyncEngineArgs",
-    "SamplingParams",
-    "RequestOutput",
-    "CompletionOutput",
-    "__version__",
-]
+__all__ = [*_EXPORTS, "__version__"]
+
+
+def __getattr__(name):
+    try:
+        module_name = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    value = getattr(importlib.import_module(module_name), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,44 @@
+"""intellillm-lint: TPU-serving static analysis.
+
+The hot path is a single mixed token-budget dispatch fronted by an
+asyncio router and instrumented by threaded observability pollers —
+which makes the classic TPU-serving failure modes *silent*: a stray
+host sync inside the step loop is a tail-latency bug, a recompile
+hazard in a jitted function is a 60-second stall, a blocking call in an
+`async def` freezes every stream on the loop, and an unlocked write
+from a daemon thread is a heisenbug. No test shape catches these; an
+AST walk does.
+
+This package is the rule engine behind `python -m
+intellillm_tpu.tools.lint` and `tests/analysis/test_tree_clean.py`:
+
+- `core`     Violation record, pragma parsing, module/project model
+- `engine`   file discovery, rule driving, baseline application
+- `baseline` grandfather-file IO (shrink-only: stale entries fail CI)
+- `rules/`   the rule plug-ins (one module per rule family)
+
+Suppression is explicit and audited: an inline
+`# lint: allow(<rule>) reason=...` pragma (the reason is mandatory)
+or an entry in `analysis/baseline.json` (which CI only allows to
+shrink). See docs/static_analysis.md for the catalogue and policy.
+"""
+from intellillm_tpu.analysis.core import (ModuleSource, Project, Rule,
+                                          Settings, Violation,
+                                          available_rules, build_rules,
+                                          register_rule)
+from intellillm_tpu.analysis.engine import (AnalysisResult, load_project,
+                                            run_analysis)
+
+__all__ = [
+    "AnalysisResult",
+    "ModuleSource",
+    "Project",
+    "Rule",
+    "Settings",
+    "Violation",
+    "available_rules",
+    "build_rules",
+    "load_project",
+    "register_rule",
+    "run_analysis",
+]

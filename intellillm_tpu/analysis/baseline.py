@@ -1,0 +1,78 @@
+"""Grandfather baseline: pre-existing violations CI tolerates.
+
+`analysis/baseline.json` holds fingerprints of violations that predate
+the lint gate. The policy is **shrink-only**:
+
+- a violation matching a baseline entry is reported as "baselined", not
+  a failure;
+- a baseline entry matching NO current violation is *stale* and fails
+  the gate (delete the entry — the debt was paid, the file may only
+  shrink);
+- new violations never get baselined silently: `--write-baseline` is a
+  deliberate, reviewed act.
+
+Fingerprints are `(rule, path, stripped source line)` — stable across
+unrelated edits (line numbers drift; the offending text does not).
+This PR ships the baseline EMPTY: the tree is lint-clean from day one.
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Dict, List, Tuple
+
+from intellillm_tpu.analysis.core import Violation
+
+BASELINE_VERSION = 1
+
+
+def default_baseline_path(repo_root: pathlib.Path) -> pathlib.Path:
+    return repo_root / "intellillm_tpu" / "analysis" / "baseline.json"
+
+
+def load_baseline(path: pathlib.Path) -> List[Dict[str, str]]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text(encoding="utf-8"))
+    entries = data.get("entries", [])
+    for entry in entries:
+        if not {"rule", "path", "context"} <= set(entry):
+            raise ValueError(f"malformed baseline entry: {entry}")
+    return entries
+
+
+def save_baseline(path: pathlib.Path,
+                  violations: List[Violation]) -> None:
+    entries = sorted(
+        {v.fingerprint() for v in violations})
+    payload = {
+        "version": BASELINE_VERSION,
+        "policy": "shrink-only: entries may be removed, never added, "
+                  "outside an explicitly reviewed --write-baseline",
+        "entries": [
+            {"rule": rule, "path": rel, "context": context}
+            for rule, rel, context in entries
+        ],
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+def split_baselined(
+    violations: List[Violation],
+    entries: List[Dict[str, str]],
+) -> Tuple[List[Violation], List[Violation], List[Dict[str, str]]]:
+    """(active, baselined, stale_entries). An entry matches any number
+    of violations with the same fingerprint."""
+    index = {(e["rule"], e["path"], e["context"]) for e in entries}
+    active, baselined = [], []
+    matched = set()
+    for violation in violations:
+        fp = violation.fingerprint()
+        if fp in index:
+            baselined.append(violation)
+            matched.add(fp)
+        else:
+            active.append(violation)
+    stale = [e for e in entries
+             if (e["rule"], e["path"], e["context"]) not in matched]
+    return active, baselined, stale

@@ -1,0 +1,227 @@
+"""Analysis driver: discover files, run rules, apply pragmas+baseline.
+
+The pipeline (`run_analysis`):
+
+1. discover `.py` files under the target paths (defaults to the lint
+   surface: `intellillm_tpu/`, `benchmarks/`, `bench.py`); with
+   `changed_only`, restrict to files git reports as changed,
+2. parse each file once (`ModuleSource`) — a syntax error is itself a
+   `parse-error` violation, not a crash,
+3. run every per-file rule, then every cross-file `finalize`,
+4. validate pragmas (`bad-pragma` for missing reasons / unknown rule
+   ids) and drop violations suppressed by a valid pragma on the same
+   or preceding line,
+5. split the remainder against the grandfather baseline (shrink-only:
+   stale entries are failures too).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import pathlib
+import subprocess
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from intellillm_tpu.analysis import baseline as baseline_mod
+from intellillm_tpu.analysis.core import (ModuleSource, Project, Settings,
+                                          Violation, build_rules,
+                                          known_rule_ids)
+
+DEFAULT_TARGETS = ("intellillm_tpu", "benchmarks", "bench.py")
+
+
+def repo_root_from_here() -> pathlib.Path:
+    # .../intellillm_tpu/analysis/engine.py -> repo root.
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def discover_files(repo_root: pathlib.Path,
+                   targets: Sequence[str]) -> List[pathlib.Path]:
+    files: List[pathlib.Path] = []
+    for target in targets:
+        path = (repo_root / target).resolve()
+        if path.is_dir():
+            files.extend(p for p in sorted(path.rglob("*.py"))
+                         if "__pycache__" not in p.parts)
+        elif path.suffix == ".py" and path.exists():
+            files.append(path)
+    # De-dup while preserving order (overlapping targets).
+    seen: Set[pathlib.Path] = set()
+    out = []
+    for path in files:
+        if path not in seen:
+            seen.add(path)
+            out.append(path)
+    return out
+
+
+def git_changed_files(repo_root: pathlib.Path,
+                      diff_base: Optional[str] = None) -> Set[str]:
+    """Repo-relative paths git considers changed: worktree + index vs
+    `diff_base` (default HEAD), plus untracked files."""
+    changed: Set[str] = set()
+    base = diff_base or "HEAD"
+    commands = (
+        ["git", "diff", "--name-only", base],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    )
+    for cmd in commands:
+        proc = subprocess.run(cmd, cwd=repo_root, capture_output=True,
+                              text=True, check=False)
+        if proc.returncode == 0:
+            changed.update(line.strip() for line in
+                           proc.stdout.splitlines() if line.strip())
+    return changed
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    violations: List[Violation]          # active: fail the gate
+    suppressed: List[Violation]          # pragma-allowed (with reasons)
+    baselined: List[Violation]           # grandfathered
+    stale_baseline: List[Dict[str, str]]  # baseline entries to delete
+    files_scanned: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.stale_baseline
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "violations": [v.to_dict() for v in self.violations],
+            "suppressed": [v.to_dict() for v in self.suppressed],
+            "baselined": [v.to_dict() for v in self.baselined],
+            "stale_baseline": list(self.stale_baseline),
+        }
+
+
+def _pragma_violations(mod: ModuleSource,
+                       valid_ids: frozenset) -> List[Violation]:
+    out = []
+    for pragma in mod.pragmas.values():
+        unknown = [r for r in pragma.rules if r not in valid_ids]
+        if unknown:
+            out.append(Violation(
+                rule="bad-pragma", path=mod.rel, line=pragma.line,
+                message=f"pragma allows unknown rule(s) {unknown}",
+                hint=f"known rules: {', '.join(sorted(valid_ids))}",
+                context=mod.line_text(pragma.line)))
+        if not pragma.reason:
+            out.append(Violation(
+                rule="bad-pragma", path=mod.rel, line=pragma.line,
+                message="pragma has no reason= — every suppression "
+                        "must say why the pattern is safe here",
+                hint="write `# lint: allow(<rule>) reason=<why>`",
+                context=mod.line_text(pragma.line)))
+    return out
+
+
+def _is_suppressed(violation: Violation,
+                   modules: Dict[str, ModuleSource]) -> bool:
+    mod = modules.get(violation.path)
+    if mod is None:
+        return False
+    for line in (violation.line, violation.line - 1):
+        pragma = mod.pragmas.get(line)
+        if (pragma is not None and pragma.valid
+                and violation.rule in pragma.rules):
+            return True
+    return False
+
+
+def run_analysis(
+    repo_root: Optional[pathlib.Path] = None,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    rule_ids: Optional[Iterable[str]] = None,
+    settings: Optional[Settings] = None,
+    baseline_path: Optional[pathlib.Path] = None,
+    use_baseline: bool = True,
+    changed_only: bool = False,
+    diff_base: Optional[str] = None,
+) -> AnalysisResult:
+    repo_root = (repo_root or
+                 (settings.repo_root if settings else None) or
+                 repo_root_from_here())
+    settings = settings or Settings(repo_root=repo_root)
+    files = discover_files(repo_root, targets)
+    changed: Optional[Set[str]] = None
+    if changed_only:
+        changed = git_changed_files(repo_root, diff_base)
+        files = [f for f in files
+                 if f.relative_to(repo_root).as_posix() in changed]
+
+    modules = [ModuleSource(f, f.relative_to(repo_root).as_posix())
+               for f in files]
+    project = Project(settings, modules)
+    rules = build_rules(settings, rule_ids)
+
+    violations: List[Violation] = []
+    for mod in modules:
+        if mod.parse_error is not None:
+            violations.append(Violation(
+                rule="parse-error", path=mod.rel,
+                line=mod.parse_error.lineno or 1,
+                message=f"syntax error: {mod.parse_error.msg}",
+                context=mod.line_text(mod.parse_error.lineno or 1)))
+            continue
+        for rule in rules:
+            violations.extend(rule.check(mod))
+    for rule in rules:
+        violations.extend(rule.finalize(project))
+
+    valid_ids = known_rule_ids()
+    by_rel = {m.rel: m for m in modules}
+    for mod in modules:
+        violations.extend(_pragma_violations(mod, valid_ids))
+
+    if changed is not None:
+        # Cross-file rules re-scan the whole tree (correctness of the
+        # doc guards); scope the *report* to what this diff touches.
+        violations = [v for v in violations if v.path in changed]
+
+    active: List[Violation] = []
+    suppressed: List[Violation] = []
+    for violation in violations:
+        if violation.rule != "bad-pragma" and _is_suppressed(violation,
+                                                             by_rel):
+            suppressed.append(violation)
+        else:
+            active.append(violation)
+
+    stale: List[Dict[str, str]] = []
+    baselined: List[Violation] = []
+    if use_baseline:
+        path = baseline_path or baseline_mod.default_baseline_path(
+            repo_root)
+        entries = baseline_mod.load_baseline(path)
+        active, baselined, stale = baseline_mod.split_baselined(
+            active, entries)
+        if changed is not None:
+            # A partial scan cannot judge entries for unscanned files.
+            scanned = {m.rel for m in modules}
+            stale = [e for e in stale if e["path"] in scanned]
+
+    def order(v: Violation):
+        return (v.path, v.line, v.rule)
+
+    return AnalysisResult(
+        violations=sorted(active, key=order),
+        suppressed=sorted(suppressed, key=order),
+        baselined=sorted(baselined, key=order),
+        stale_baseline=stale,
+        files_scanned=len(modules),
+    )
+
+
+@functools.lru_cache(maxsize=1)
+def load_project() -> Project:
+    """Parsed project over the default lint surface with default
+    settings — shared by the pytest guard wrappers (parse once)."""
+    repo_root = repo_root_from_here()
+    settings = Settings(repo_root=repo_root)
+    files = discover_files(repo_root, DEFAULT_TARGETS)
+    modules = [ModuleSource(f, f.relative_to(repo_root).as_posix())
+               for f in files]
+    return Project(settings, modules)

@@ -1,0 +1,7 @@
+"""Rule plug-ins. Importing this package registers every rule with the
+core registry (`@register_rule`); add a new rule by dropping a module
+here and importing it below."""
+from intellillm_tpu.analysis.rules import (async_blocking,  # noqa: F401
+                                           doc_guards, host_sync,
+                                           metric_hygiene,
+                                           recompile_hazard, shared_state)

@@ -1,0 +1,106 @@
+"""Shared AST helpers for the rule plug-ins (stdlib-only)."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return None
+    return ".".join(reversed(parts))
+
+
+def attach_parents(tree: ast.AST) -> None:
+    """Annotate every node with `.lint_parent` (idempotent)."""
+    if getattr(tree, "_lint_parents_done", False):
+        return
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.lint_parent = node  # type: ignore[attr-defined]
+    tree._lint_parents_done = True  # type: ignore[attr-defined]
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "lint_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "lint_parent", None)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def is_awaited(node: ast.Call) -> bool:
+    parent = getattr(node, "lint_parent", None)
+    return isinstance(parent, ast.Await)
+
+
+def qualified_functions(
+        tree: ast.AST) -> List[Tuple[str, str, ast.AST]]:
+    """(bare_name, qualified_name, def_node) for every function in the
+    module. Methods are qualified `Class.method`; nested defs are
+    qualified `outer.<locals>.inner` but matched by bare name too."""
+    out: List[Tuple[str, str, ast.AST]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                out.append((child.name, qual, child))
+                visit(child, f"{qual}.<locals>.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def walk_body(fn: ast.AST, *, into_nested: bool = True) -> Iterator[ast.AST]:
+    """Walk a function body. With into_nested=False, nested function
+    definitions are skipped entirely."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if (not into_nested
+                and isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda))):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def str_arg0(call: ast.Call) -> Optional[str]:
+    """First positional argument if it is a string literal."""
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def import_aliases(tree: ast.AST, module: str) -> Dict[str, str]:
+    """local-name -> imported-name for `from <module> import ...`, plus
+    module aliases for `import <module> [as alias]`."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == module:
+            for alias in node.names:
+                aliases[alias.asname or alias.name] = alias.name
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == module:
+                    aliases[alias.asname or alias.name] = module
+    return aliases

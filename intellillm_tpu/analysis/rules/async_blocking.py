@@ -1,0 +1,100 @@
+"""async-blocking: synchronous blocking calls inside `async def`.
+
+The router front end, the AsyncLLMEngine loop, and both API servers
+share one asyncio event loop; a single `time.sleep`, synchronous HTTP
+round trip, blocking file read, or `subprocess.run` inside a coroutine
+freezes EVERY in-flight stream for its duration — the whole-fleet
+tail-latency bug the PR 2 watchdog can only report after the fact.
+
+Flagged inside any `async def` (including sync closures defined there,
+which run on the loop when called):
+
+- `time.sleep(...)` — use `await asyncio.sleep(...)`,
+- sync HTTP/socket clients (`requests.*`, `urllib.request.urlopen`,
+  `socket.create_connection`, `http.client.*`) — use aiohttp,
+- `subprocess.run/call/check_*` and `os.system` — use
+  `asyncio.create_subprocess_*` or push to a thread,
+- builtin `open(...)` — blocking file IO; wrap in
+  `asyncio.to_thread` / `run_in_executor`,
+- a non-awaited `.wait(...)` call (subprocess/threading wait) — block
+  the loop up to its full timeout; `asyncio.to_thread` it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from intellillm_tpu.analysis.core import (ModuleSource, Rule, Violation,
+                                          register_rule)
+from intellillm_tpu.analysis.rules._ast_util import (attach_parents,
+                                                     dotted_name, is_awaited,
+                                                     walk_body)
+
+BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "urllib.request.urlopen",
+    "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.getoutput",
+    "os.system",
+})
+BLOCKING_PREFIXES = ("requests.", "http.client.")
+
+
+def _blocking_label(node: ast.Call) -> str:
+    """Non-empty description when the call blocks the event loop."""
+    name = dotted_name(node.func) or ""
+    if name in BLOCKING_CALLS or name.startswith(BLOCKING_PREFIXES):
+        return name
+    if isinstance(node.func, ast.Name) and node.func.id == "open":
+        return "open"
+    if (isinstance(node.func, ast.Attribute) and node.func.attr == "wait"
+            and not is_awaited(node)):
+        # Un-awaited `.wait()`: subprocess.Popen.wait, threading.Event
+        # .wait, Condition.wait — all block the loop. Awaited variants
+        # (asyncio.Event.wait etc.) are fine and excluded above.
+        return f"{dotted_name(node.func) or '<expr>.wait'}"
+    return ""
+
+
+@register_rule
+class AsyncBlockingRule(Rule):
+
+    id = "async-blocking"
+    summary = ("synchronous blocking call (sleep / sync HTTP / file IO / "
+               "subprocess / bare .wait) inside an async def")
+    hint = ("one blocked coroutine stalls every stream on the loop: use "
+            "the asyncio equivalent (asyncio.sleep, aiohttp, "
+            "create_subprocess_exec) or push the call off-loop via "
+            "asyncio.to_thread / run_in_executor")
+
+    def check(self, mod: ModuleSource) -> Iterator[Violation]:
+        if mod.tree is None:
+            return
+        attach_parents(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for sub in walk_body(node, into_nested=True):
+                # Nested async defs are visited by the outer ast.walk;
+                # skip them here so each call is reported once.
+                if isinstance(sub, ast.AsyncFunctionDef):
+                    continue
+                if isinstance(sub, ast.Call) and not self._in_nested_async(
+                        sub, node):
+                    label = _blocking_label(sub)
+                    if label:
+                        yield self.violation(
+                            mod, mod.rel, sub.lineno,
+                            f"blocking call `{label}` inside "
+                            f"`async def {node.name}`")
+
+    @staticmethod
+    def _in_nested_async(call: ast.Call, outer: ast.AsyncFunctionDef) -> bool:
+        from intellillm_tpu.analysis.rules._ast_util import ancestors
+        for anc in ancestors(call):
+            if anc is outer:
+                return False
+            if isinstance(anc, ast.AsyncFunctionDef):
+                return True
+        return False

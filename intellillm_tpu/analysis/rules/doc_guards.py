@@ -1,0 +1,203 @@
+"""flag-docs + docs-metrics: operator-doc drift guards as lint rules.
+
+These started life as three ad-hoc pytest guards
+(tests/obs/test_flag_docs.py and test_docs_metrics.py, which are now
+thin wrappers over this module). As lint rules they gain `file:line`
+anchoring, pragma/baseline handling, and a place in the same CI gate
+as the serving-correctness rules.
+
+Both are cross-file (`finalize`) rules and deliberately re-scan the
+tree from Settings.repo_root rather than trusting the (possibly
+`--changed-only`-restricted) scanned file set: doc drift is a property
+of the whole repo, and a partial scan must not fabricate "stale doc"
+findings.
+
+- `flag-docs`: every post-seed argparse flag on an operator-facing
+  surface (Settings.flag_sources) must appear in one of the operator
+  docs (Settings.doc_files); every `INTELLILLM_*` env var referenced
+  under Settings.env_var_dirs must appear there too.
+- `docs-metrics`: every `intellillm_*` metric literal in the package
+  must be documented in Settings.metrics_doc, and every metric the doc
+  mentions must still exist in the source (renames can't rot the
+  reference).
+"""
+from __future__ import annotations
+
+import pathlib
+import re
+from typing import Dict, Iterator, List, Set, Tuple
+
+from intellillm_tpu.analysis.core import (Project, Rule, Settings, Violation,
+                                          register_rule)
+
+FLAG_RE = re.compile(r"add_argument\(\s*[\"'](--[a-z0-9-]+)[\"']")
+ENV_VAR_RE = re.compile(r"\b(INTELLILLM_[A-Z0-9_]+)\b")
+SOURCE_METRIC_RE = re.compile(r"[\"'](intellillm_[a-z0-9_]+)[\"']")
+DOC_METRIC_RE = re.compile(r"\b(intellillm_[a-z0-9_]+)\b")
+# Prometheus expands histograms/counters with these suffixes; the doc
+# may quote an expanded series name.
+SERIES_SUFFIXES = ("_sum", "_count", "_bucket")
+
+
+def _read(settings: Settings, rel: str) -> str:
+    try:
+        return (settings.repo_root / rel).read_text(encoding="utf-8")
+    except OSError:
+        return ""
+
+
+def _package_files(settings: Settings) -> List[Tuple[str, str]]:
+    """(rel, text) for every package source file, pycache excluded."""
+    root = settings.repo_root / "intellillm_tpu"
+    out = []
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(settings.repo_root).as_posix()
+        out.append((rel, path.read_text(encoding="utf-8")))
+    return out
+
+
+def _first_lines(text: str, regex: re.Pattern) -> Dict[str, int]:
+    """match -> first 1-based line it appears on."""
+    out: Dict[str, int] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        for match in regex.finditer(line):
+            out.setdefault(match.group(1), i)
+    return out
+
+
+def declared_flags(settings: Settings) -> Dict[str, Tuple[str, int]]:
+    """flag -> (rel, line) over the operator-facing argparse surfaces."""
+    flags: Dict[str, Tuple[str, int]] = {}
+    for rel in settings.flag_sources:
+        for flag, line in _first_lines(_read(settings, rel),
+                                       FLAG_RE).items():
+            flags.setdefault(flag, (rel, line))
+    return flags
+
+
+def obs_env_vars(settings: Settings) -> Dict[str, Tuple[str, int]]:
+    """env var -> (rel, line) under the obs package. Bare `INTELLILLM_`
+    prefix references (trailing underscore) are not vars."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for dir_rel in settings.env_var_dirs:
+        root = settings.repo_root / dir_rel
+        for path in sorted(root.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(settings.repo_root).as_posix()
+            text = path.read_text(encoding="utf-8")
+            for name, line in _first_lines(text, ENV_VAR_RE).items():
+                if not name.endswith("_"):
+                    out.setdefault(name, (rel, line))
+    return out
+
+
+def doc_text(settings: Settings) -> str:
+    return "\n".join(_read(settings, rel) for rel in settings.doc_files)
+
+
+def source_metric_names(settings: Settings) -> Dict[str, Tuple[str, int]]:
+    """metric -> (rel, line of first definition/use) over the package."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for rel, text in _package_files(settings):
+        for name, line in _first_lines(text, SOURCE_METRIC_RE).items():
+            if (name.startswith("intellillm_tpu")
+                    or name in settings.non_metrics):
+                continue
+            out.setdefault(name, (rel, line))
+    return out
+
+
+def _strip_suffix(name: str) -> str:
+    for suffix in SERIES_SUFFIXES:
+        if name.endswith(suffix):
+            return name[:-len(suffix)]
+    return name
+
+
+def doc_metric_names(settings: Settings) -> Dict[str, int]:
+    """metric -> first line in the metrics reference doc."""
+    out: Dict[str, int] = {}
+    text = _read(settings, settings.metrics_doc)
+    for i, line in enumerate(text.splitlines(), start=1):
+        for match in DOC_METRIC_RE.finditer(line):
+            name = _strip_suffix(match.group(1))
+            if (name.startswith("intellillm_tpu")
+                    or name in settings.non_metrics):
+                continue
+            out.setdefault(name, i)
+    return out
+
+
+@register_rule
+class FlagDocsRule(Rule):
+
+    id = "flag-docs"
+    summary = ("post-seed CLI flag or obs env var missing from the "
+               "operator docs")
+    hint = ("document the flag/env var (semantics + default) in "
+            "docs/observability.md or docs/routing.md")
+
+    def finalize(self, project: Project) -> Iterator[Violation]:
+        settings = self.settings
+        docs = doc_text(settings)
+        for flag, (rel, line) in sorted(declared_flags(settings).items()):
+            if flag in settings.seed_flags or flag in docs:
+                continue
+            yield self.violation(
+                project.by_rel.get(rel), rel, line,
+                f"flag `{flag}` was added after the seed but is not "
+                "documented in the operator docs",
+                context=_context(project, settings, rel, line))
+        for name, (rel, line) in sorted(obs_env_vars(settings).items()):
+            if name in docs:
+                continue
+            yield self.violation(
+                project.by_rel.get(rel), rel, line,
+                f"obs env var `{name}` is not documented in the "
+                "operator docs",
+                context=_context(project, settings, rel, line))
+
+
+@register_rule
+class DocsMetricsRule(Rule):
+
+    id = "docs-metrics"
+    summary = ("metric defined in source but absent from the metrics "
+               "reference, or documented but gone from the source")
+
+    def finalize(self, project: Project) -> Iterator[Violation]:
+        settings = self.settings
+        source = source_metric_names(settings)
+        documented = doc_metric_names(settings)
+        for name, (rel, line) in sorted(source.items()):
+            if name not in documented:
+                yield self.violation(
+                    project.by_rel.get(rel), rel, line,
+                    f"metric `{name}` is not documented in "
+                    f"{settings.metrics_doc}",
+                    hint="add it to the metrics reference table",
+                    context=_context(project, settings, rel, line))
+        for name, line in sorted(documented.items()):
+            if name not in source:
+                yield self.violation(
+                    None, settings.metrics_doc, line,
+                    f"metric `{name}` is documented but absent from the "
+                    "source",
+                    hint="remove or rename it in the metrics reference",
+                    context=_context(project, settings,
+                                     settings.metrics_doc, line))
+
+
+def _context(project: Project, settings: Settings, rel: str,
+             line: int) -> str:
+    mod = project.by_rel.get(rel)
+    if mod is not None:
+        return mod.line_text(line)
+    text = _read(settings, rel)
+    lines = text.splitlines()
+    if 1 <= line <= len(lines):
+        return lines[line - 1].strip()
+    return ""

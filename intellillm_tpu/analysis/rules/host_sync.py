@@ -1,0 +1,87 @@
+"""host-sync: device->host synchronization inside hot-path functions.
+
+On TPU the step loop stays fast only while the host keeps dispatching
+ahead of the device. `jax.block_until_ready`, `jax.device_get`,
+`.item()`, and `np.asarray`/`np.array` on a device array all force the
+host to wait for the device — inside the designated step-loop functions
+(Settings.hot_paths) that is a tail-latency bug unless it is the ONE
+intentional fetch point, which must carry a
+`# lint: allow(host-sync) reason=...` pragma explaining why.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Iterator, List, Tuple
+
+from intellillm_tpu.analysis.core import (ModuleSource, Rule, Violation,
+                                          register_rule)
+from intellillm_tpu.analysis.rules._ast_util import (dotted_name,
+                                                     qualified_functions,
+                                                     walk_body)
+
+# Dotted call targets that synchronize host and device.
+SYNC_CALLS = frozenset({
+    "jax.block_until_ready",
+    "jax.device_get",
+    "np.asarray", "np.array",
+    "numpy.asarray", "numpy.array",
+})
+# Attribute calls that synchronize regardless of receiver spelling.
+SYNC_METHODS = frozenset({"item", "block_until_ready"})
+
+
+def _sync_label(node: ast.Call) -> str:
+    """Non-empty when the call is a host sync, else ''."""
+    name = dotted_name(node.func)
+    if name in SYNC_CALLS:
+        return name
+    if isinstance(node.func, ast.Attribute):
+        method = node.func.attr
+        if method == "item" and not node.args and not node.keywords:
+            return ".item()"
+        if method == "block_until_ready":
+            return f".{method}()"
+    return ""
+
+
+@register_rule
+class HostSyncRule(Rule):
+
+    id = "host-sync"
+    summary = ("device->host sync (block_until_ready / device_get / "
+               ".item() / np.asarray) inside a designated hot-path "
+               "function")
+    hint = ("keep the step loop async: move the sync off the hot path, "
+            "fetch via the packed 1-fetch D2H, or — if this IS the "
+            "intentional fetch — add `# lint: allow(host-sync) "
+            "reason=...`")
+
+    def check(self, mod: ModuleSource) -> Iterator[Violation]:
+        patterns = self.settings.hot_paths.get(mod.rel)
+        if not patterns or mod.tree is None:
+            return
+        matched: List[Tuple[str, ast.AST]] = [
+            (qual, fn) for bare, qual, fn in qualified_functions(mod.tree)
+            if any(fnmatch.fnmatch(qual, p) or fnmatch.fnmatch(bare, p)
+                   for p in patterns)
+        ]
+        # A designated function walks its whole subtree (closures
+        # included); drop matched defs nested inside another match so a
+        # sync is reported once.
+        nested = set()
+        for _, fn in matched:
+            for node in walk_body(fn):
+                if id(node) != id(fn):
+                    nested.add(id(node))
+        for qual, fn in matched:
+            if id(fn) in nested:
+                continue
+            for node in walk_body(fn, into_nested=True):
+                if isinstance(node, ast.Call):
+                    label = _sync_label(node)
+                    if label:
+                        yield self.violation(
+                            mod, mod.rel, node.lineno,
+                            f"host sync `{label}` inside hot-path "
+                            f"function `{qual}`")

@@ -1,0 +1,166 @@
+"""metric-hygiene + unbounded-growth: metric and collection discipline.
+
+`metric-hygiene` absorbs the old tests/obs/test_registry_hygiene.py
+guard (that file is now a thin wrapper) and adds a placement check:
+
+- every Prometheus collector constructed in-package carries the
+  `intellillm_` prefix (one grafana namespace, no collisions with other
+  exporters),
+- every module that registers collectors exposes a `reset_for_testing`
+  hook (tests rebuild engines; duplicate registration raises),
+- collectors are constructed ONLY in the designated metrics modules
+  (Settings.metrics_modules) — ad-hoc families elsewhere dodge the
+  registry/docs guards and leak into the shared REGISTRY.
+
+Import-aware: only `Counter`/`Gauge`/`Histogram`/`Summary` names
+actually imported from prometheus_client count (the engine's
+`utils.Counter` sequence counter does not).
+
+`unbounded-growth` flags writes/appends to *module-level* dicts and
+lists from function bodies in the per-request server paths
+(Settings.request_path_globs): one entry per request with no eviction
+is an OOM with extra steps. Bounded structures (`deque(maxlen=...)`)
+are exempt.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from intellillm_tpu.analysis.core import (ModuleSource, Rule, Violation,
+                                          register_rule)
+from intellillm_tpu.analysis.rules._ast_util import (dotted_name,
+                                                     import_aliases,
+                                                     str_arg0, walk_body)
+
+COLLECTOR_NAMES = frozenset({"Counter", "Gauge", "Histogram", "Summary"})
+GROW_METHODS = frozenset({"append", "add", "setdefault", "update",
+                          "extend", "insert"})
+
+
+def prometheus_collector_calls(mod: ModuleSource):
+    """(call, metric_name) for every prometheus_client collector
+    constructed in the module (import-aware)."""
+    if mod.tree is None:
+        return
+    aliases = import_aliases(mod.tree, "prometheus_client")
+    local_collectors = {local for local, orig in aliases.items()
+                        if orig in COLLECTOR_NAMES}
+    module_aliases = {local for local, orig in aliases.items()
+                      if orig == "prometheus_client"}
+    if not local_collectors and not module_aliases:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        is_collector = (isinstance(func, ast.Name)
+                        and func.id in local_collectors)
+        if not is_collector and isinstance(func, ast.Attribute):
+            is_collector = (func.attr in COLLECTOR_NAMES
+                            and isinstance(func.value, ast.Name)
+                            and func.value.id in module_aliases)
+        if is_collector:
+            yield node, str_arg0(node)
+
+
+@register_rule
+class MetricHygieneRule(Rule):
+
+    id = "metric-hygiene"
+    summary = ("Prometheus collector without the intellillm_ prefix, "
+               "outside a designated metrics module, or in a module "
+               "lacking a reset_for_testing hook")
+    hint = ("keep all collector families in obs/, engine/metrics.py, or "
+            "router/metrics.py with intellillm_-prefixed names and a "
+            "reset_for_testing hook")
+
+    def check(self, mod: ModuleSource) -> Iterator[Violation]:
+        in_metrics_module = mod.matches(self.settings.metrics_modules)
+        prefix = self.settings.metric_prefix()
+        saw_collector = False
+        for call, name in prometheus_collector_calls(mod):
+            saw_collector = True
+            shown = name if name is not None else "<dynamic>"
+            if not in_metrics_module:
+                yield self.violation(
+                    mod, mod.rel, call.lineno,
+                    f"Prometheus collector `{shown}` constructed outside "
+                    "the designated metrics modules")
+            if name is not None and not name.startswith(prefix):
+                yield self.violation(
+                    mod, mod.rel, call.lineno,
+                    f"metric `{name}` lacks the `{prefix}` prefix — all "
+                    "exported series share one namespace")
+        if saw_collector and "reset_for_testing" not in mod.text:
+            yield self.violation(
+                mod, mod.rel, 1,
+                "module registers Prometheus collectors but has no "
+                "reset_for_testing hook — tests cannot unregister "
+                "between engine rebuilds",
+                context=f"<module {mod.rel}>")
+
+
+@register_rule
+class UnboundedGrowthRule(Rule):
+
+    id = "unbounded-growth"
+    summary = ("module-level dict/list grown from a function in a "
+               "per-request server path with no eviction")
+    hint = ("bound it: deque(maxlen=...), an LRU, a TTL sweep — or move "
+            "the state onto an object with a reset/eviction policy")
+
+    def check(self, mod: ModuleSource) -> Iterator[Violation]:
+        if mod.tree is None or not mod.matches(
+                self.settings.request_path_globs):
+            return
+        growable = self._module_level_containers(mod.tree)
+        if not growable:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for sub in walk_body(node, into_nested=False):
+                name = self._grown_global(sub, growable)
+                if name is not None:
+                    yield self.violation(
+                        mod, mod.rel, sub.lineno,
+                        f"module-level container `{name}` grows inside "
+                        f"`{node.name}` with no visible bound — one "
+                        "entry per request is unbounded memory")
+
+    @staticmethod
+    def _module_level_containers(tree: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in tree.body:  # module top level only
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            unbounded = (isinstance(value, (ast.Dict, ast.List))
+                         or (isinstance(value, ast.Call)
+                             and dotted_name(value.func) in (
+                                 "dict", "list", "collections.defaultdict",
+                                 "defaultdict")))
+            if unbounded:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        out.add(target.id)
+        return out
+
+    @staticmethod
+    def _grown_global(node: ast.AST, growable: Set[str]):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (isinstance(target, ast.Subscript)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id in growable):
+                    return target.value.id
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in GROW_METHODS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in growable):
+                return func.value.id
+        return None

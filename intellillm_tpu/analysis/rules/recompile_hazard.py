@@ -1,0 +1,203 @@
+"""recompile-hazard: trace-unsafe Python inside jit-compiled functions.
+
+A `jax.jit`/`pjit` body executes as *Python* exactly once per dispatch
+bucket (the compile-tracker's (program, key) space from PR 1); after
+that the compiled executable replays. Host-side nondeterminism inside
+a traced body therefore does not do what it reads like:
+
+- `time.time()` / `random.*` freeze at trace time (the compiled program
+  bakes the first value in forever),
+- `print` / `logger.*` fire only at trace time — or worse, formatting a
+  tracer in an f-string forces a concretization error,
+- a shape-bearing Python argument (num_steps, widths, k) that is NOT in
+  `static_argnames` retraces on every new value — a silent
+  compile-per-request stall the compile tracker shows as an exploding
+  bucket count.
+
+Detection: a function is "traced" if it is decorated with
+jit/pjit (directly or via functools.partial), is wrapped by a
+`jax.jit(fn, ...)` call anywhere in the module (the model_runner
+pattern: `self._jit_x = jax.jit(self._x_fn, ...)`), or is listed in
+Settings.extra_traced (helpers like layers/sampler.sample that run
+under an enclosing trace).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from intellillm_tpu.analysis.core import (ModuleSource, Rule, Violation,
+                                          register_rule)
+from intellillm_tpu.analysis.rules._ast_util import (attach_parents,
+                                                     ancestors, dotted_name,
+                                                     qualified_functions,
+                                                     walk_body)
+
+JIT_NAMES = frozenset({"jax.jit", "jit", "pjit", "jax.pjit"})
+
+# Host clocks and Python/NumPy RNG: values freeze at trace time.
+NONDETERMINISTIC_CALLS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns", "time.perf_counter_ns", "datetime.datetime.now",
+    "datetime.now",
+})
+NONDETERMINISTIC_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+# Parameter names that carry shapes/loop bounds: if traced as dynamic
+# values they either fail tracing or retrace per value.
+SHAPE_ARG_RE = re.compile(
+    r"^(num_.+|.+_(steps|len|size|width)|top_k|logprob_k|"
+    r"prompt_logprob_k)$")
+# Array-typed first params of the runner's calling convention are never
+# shape-bearing even when their names look like it.
+IGNORED_PARAMS = frozenset({"self", "params", "kv_caches"})
+
+
+def _jit_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if name in JIT_NAMES:
+        return True
+    # functools.partial(jax.jit, ...) used as a decorator.
+    if name in ("functools.partial", "partial") and node.args:
+        return dotted_name(node.args[0]) in JIT_NAMES
+    return False
+
+
+def _static_names(call: ast.Call) -> Optional[Set[str]]:
+    """Literal static_argnames of a jit call; None when the kwarg is
+    absent or not a literal (then the shape-arg check is skipped —
+    better silent than wrong)."""
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names: Set[str] = set()
+            value = kw.value
+            if isinstance(value, ast.Constant) and isinstance(
+                    value.value, str):
+                return {value.value}
+            if isinstance(value, (ast.Tuple, ast.List)):
+                for elt in value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str):
+                        names.add(elt.value)
+                    else:
+                        return None
+                return names
+            return None
+        if kw.arg == "static_argnums":
+            # Positional statics: resolved against the signature by the
+            # caller (we only handle literal tuples of ints).
+            return None
+    return set()
+
+
+@register_rule
+class RecompileHazardRule(Rule):
+
+    id = "recompile-hazard"
+    summary = ("trace-unsafe Python (host clock/RNG/logging/f-string) or "
+               "a non-static shape-bearing argument inside a "
+               "jit-compiled function")
+    hint = ("traced bodies run once per compile bucket: thread "
+            "jax.random keys for randomness, log outside the traced "
+            "function (or via jax.debug), and declare shape/loop-bound "
+            "args in static_argnames")
+
+    def check(self, mod: ModuleSource) -> Iterator[Violation]:
+        if mod.tree is None:
+            return
+        attach_parents(mod.tree)
+        funcs = qualified_functions(mod.tree)
+        by_bare: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        for bare, qual, fn in funcs:
+            by_bare.setdefault(bare, []).append((qual, fn))
+
+        # (fn node, qual, statics) for every traced function.
+        traced: Dict[int, Tuple[ast.AST, str, Optional[Set[str]]]] = {}
+
+        def mark(fn: ast.AST, qual: str,
+                 statics: Optional[Set[str]]) -> None:
+            traced.setdefault(id(fn), (fn, qual, statics))
+
+        # 1. Decorated defs: @jax.jit / @partial(jax.jit, ...).
+        for bare, qual, fn in funcs:
+            for deco in fn.decorator_list:
+                if (isinstance(deco, ast.Call) and _jit_call(deco)):
+                    mark(fn, qual, _static_names(deco))
+                elif dotted_name(deco) in JIT_NAMES:
+                    mark(fn, qual, set())
+
+        # 2. Wrap sites: jax.jit(<fn-or-self.method>, ...) anywhere.
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and _jit_call(node)
+                    and node.args):
+                continue
+            target = node.args[0]
+            name = None
+            if isinstance(target, ast.Attribute):
+                name = target.attr  # self._decode_fn
+            elif isinstance(target, ast.Name):
+                name = target.id
+            if name is None:
+                continue
+            for qual, fn in by_bare.get(name, ()):
+                mark(fn, qual, _static_names(node))
+
+        # 3. Settings-designated traced helpers.
+        for pattern in self.settings.extra_traced.get(mod.rel, ()):
+            for qual, fn in by_bare.get(pattern, ()):
+                mark(fn, qual, None)
+
+        for _, (fn, qual, statics) in sorted(traced.items(),
+                                             key=lambda kv: kv[1][0].lineno):
+            yield from self._check_traced_body(mod, fn, qual)
+            if statics is not None:
+                yield from self._check_shape_args(mod, fn, qual, statics)
+
+    def _check_traced_body(self, mod: ModuleSource, fn: ast.AST,
+                           qual: str) -> Iterator[Violation]:
+        for node in walk_body(fn, into_nested=True):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                if (name in NONDETERMINISTIC_CALLS
+                        or name.startswith(NONDETERMINISTIC_PREFIXES)):
+                    yield self.violation(
+                        mod, mod.rel, node.lineno,
+                        f"nondeterministic host call `{name}` in traced "
+                        f"function `{qual}`: the value freezes at trace "
+                        "time and never changes in the compiled program")
+                elif name == "print" or name.split(".")[0] in ("logger",
+                                                               "logging"):
+                    yield self.violation(
+                        mod, mod.rel, node.lineno,
+                        f"`{name}` in traced function `{qual}`: runs at "
+                        "trace time only (never per step), and "
+                        "formatting a tracer concretizes it")
+            elif isinstance(node, ast.JoinedStr):
+                # f-strings: formatting a traced value concretizes it.
+                # Error paths (raise/assert) execute at trace time on
+                # static data, which is the legitimate use.
+                if any(isinstance(a, (ast.Raise, ast.Assert))
+                       for a in ancestors(node)):
+                    continue
+                yield self.violation(
+                    mod, mod.rel, node.lineno,
+                    f"f-string in traced function `{qual}`: formatting "
+                    "a tracer forces host concretization (or freezes at "
+                    "trace time)")
+
+    def _check_shape_args(self, mod: ModuleSource, fn: ast.AST, qual: str,
+                          statics: Set[str]) -> Iterator[Violation]:
+        args = fn.args
+        names = [a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)]
+        for name in names:
+            if name in IGNORED_PARAMS or name in statics:
+                continue
+            if SHAPE_ARG_RE.match(name):
+                yield self.violation(
+                    mod, mod.rel, fn.lineno,
+                    f"shape-bearing argument `{name}` of jitted "
+                    f"`{qual}` is not in static_argnames: every new "
+                    "value retraces (a new compile-tracker bucket per "
+                    "request)")

@@ -1,0 +1,197 @@
+"""unlocked-shared-state: unguarded writes from daemon-thread methods.
+
+The obs stack runs daemon threads (watchdog monitor, device-telemetry
+poller) that share instance state with the serving thread; the pattern
+the codebase standardized on is a `self._lock = threading.Lock()` per
+class with every cross-thread write inside `with self._lock:`. This
+rule mechanizes that contract:
+
+1. find classes that start a `threading.Thread(target=self.<m>)`,
+2. compute the closure of methods reachable from those targets via
+   `self.<m>()` calls,
+3. flag any write to `self.<attr>` (assign / augassign / subscript /
+   mutating container method) inside that closure that is NOT under a
+   `with self.<lock>:` block, when the same attribute is also touched
+   by methods outside the closure (i.e. genuinely shared).
+
+`__init__` is exempt as the "other side" (construction precedes the
+thread), and attributes that hold the locks/events themselves are
+never flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from intellillm_tpu.analysis.core import (ModuleSource, Rule, Violation,
+                                          register_rule)
+from intellillm_tpu.analysis.rules._ast_util import (attach_parents,
+                                                     ancestors, dotted_name,
+                                                     walk_body)
+
+LOCK_CONSTRUCTORS = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+})
+# Synchronization primitives: writes to these are their own protocol.
+SYNC_CONSTRUCTORS = LOCK_CONSTRUCTORS | frozenset({
+    "threading.Event", "Event", "threading.Semaphore", "Semaphore",
+})
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "add", "insert", "update",
+    "setdefault", "pop", "popleft", "remove", "discard", "clear",
+})
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+
+    def __init__(self, cls: ast.ClassDef) -> None:
+        self.cls = cls
+        self.methods: Dict[str, ast.AST] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs: Set[str] = set()
+        self.sync_attrs: Set[str] = set()
+        self.thread_targets: Set[str] = set()
+        for method in self.methods.values():
+            for node in walk_body(method):
+                if isinstance(node, ast.Assign):
+                    value = node.value
+                    if isinstance(value, ast.Call):
+                        ctor = dotted_name(value.func)
+                        for target in node.targets:
+                            attr = _self_attr(target)
+                            if attr is None:
+                                continue
+                            if ctor in LOCK_CONSTRUCTORS:
+                                self.lock_attrs.add(attr)
+                            if ctor in SYNC_CONSTRUCTORS:
+                                self.sync_attrs.add(attr)
+                if (isinstance(node, ast.Call)
+                        and dotted_name(node.func) in ("threading.Thread",
+                                                       "Thread")):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            attr = _self_attr(kw.value)
+                            if attr is not None:
+                                self.thread_targets.add(attr)
+
+    def target_closure(self) -> Set[str]:
+        """Thread-target methods plus everything reachable from them
+        via self.<m>() calls."""
+        seen: Set[str] = set()
+        frontier: List[str] = [t for t in self.thread_targets
+                               if t in self.methods]
+        while frontier:
+            name = frontier.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for node in walk_body(self.methods[name]):
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                    if callee in self.methods and callee not in seen:
+                        frontier.append(callee)
+        return seen
+
+    def attrs_touched(self, method: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in walk_body(method):
+            attr = _self_attr(node)
+            if attr is not None:
+                out.add(attr)
+        return out
+
+
+def _under_lock(node: ast.AST, lock_attrs: Set[str]) -> bool:
+    for anc in ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if _self_attr(item.context_expr) in lock_attrs:
+                    return True
+    return False
+
+
+@register_rule
+class UnlockedSharedStateRule(Rule):
+
+    id = "unlocked-shared-state"
+    summary = ("instance attribute written from a threading.Thread target "
+               "without the class's lock while other methods touch it")
+    hint = ("wrap the write in `with self._lock:` (the pattern "
+            "obs/watchdog.py and obs/device_telemetry.py use), or make "
+            "the attribute thread-private")
+
+    def check(self, mod: ModuleSource) -> Iterator[Violation]:
+        if mod.tree is None:
+            return
+        attach_parents(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(mod, _ClassInfo(node))
+
+    def _check_class(self, mod: ModuleSource,
+                     info: _ClassInfo) -> Iterator[Violation]:
+        if not info.thread_targets:
+            return
+        closure = info.target_closure()
+        if not closure:
+            return
+        # Attributes the non-thread side touches (construction exempt).
+        outside: Dict[str, str] = {}
+        for name, method in info.methods.items():
+            if name in closure or name == "__init__":
+                continue
+            for attr in info.attrs_touched(method):
+                outside.setdefault(attr, name)
+        exempt = info.lock_attrs | info.sync_attrs
+        for name in sorted(closure):
+            method = info.methods[name]
+            for node in walk_body(method):
+                attr, verb = self._write_target(node)
+                if attr is None or attr in exempt or attr not in outside:
+                    continue
+                if _under_lock(node, info.lock_attrs):
+                    continue
+                yield self.violation(
+                    mod, mod.rel, node.lineno,
+                    f"`self.{attr}` {verb} in thread-side "
+                    f"`{info.cls.name}.{name}` without holding the "
+                    f"class lock, but `{info.cls.name}."
+                    f"{outside[attr]}` also touches it")
+
+    @staticmethod
+    def _write_target(node: ast.AST):
+        """(attr, verb) when the node writes self.<attr>, else (None, '')."""
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr is not None:
+                    return attr, "assigned"
+                if isinstance(target, (ast.Subscript, ast.Attribute)):
+                    attr = _self_attr(getattr(target, "value", None))
+                    if attr is not None:
+                        return attr, "mutated (subscript write)"
+        elif isinstance(node, ast.AugAssign):
+            attr = _self_attr(node.target)
+            if attr is not None:
+                return attr, "aug-assigned"
+            attr = _self_attr(getattr(node.target, "value", None))
+            if attr is not None:
+                return attr, "mutated (aug subscript)"
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in MUTATORS:
+                attr = _self_attr(func.value)
+                if attr is not None:
+                    return attr, f"mutated (.{func.attr}())"
+        return None, ""

@@ -246,7 +246,10 @@ class HTTPReplica(Replica):
         if self.proc is not None and self.proc.poll() is None:
             self.proc.terminate()
             try:
-                self.proc.wait(timeout=10)
+                # Off-loop: Popen.wait blocks up to its full timeout,
+                # which would freeze every other stream on the router's
+                # event loop for the duration of a slow shutdown.
+                await asyncio.to_thread(self.proc.wait, 10)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
 

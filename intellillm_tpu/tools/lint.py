@@ -1,0 +1,133 @@
+"""intellillm-lint CLI: the TPU-serving static-analysis gate.
+
+    python -m intellillm_tpu.tools.lint [paths...]
+        [--changed-only [--diff-base REF]]
+        [--rules host-sync,async-blocking,...] [--list-rules]
+        [--format human|json] [--baseline PATH | --no-baseline]
+        [--write-baseline] [--show-suppressed]
+
+Exit status: 0 when the tree is clean (no active violations AND no
+stale baseline entries), 1 otherwise, 2 on usage errors.
+
+Default paths are the lint surface CI gates on: `intellillm_tpu/`,
+`benchmarks/`, and `bench.py`. `--changed-only` restricts to files git
+sees as changed vs `--diff-base` (default HEAD) — the pre-commit mode.
+
+Suppression is explicit: an inline `# lint: allow(<rule>) reason=...`
+pragma, or a grandfathered entry in `analysis/baseline.json` (shrink-
+only; `--write-baseline` regenerates it and is a reviewed act — this
+repo ships it empty). See docs/static_analysis.md for the catalogue.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from intellillm_tpu.analysis import available_rules, run_analysis
+from intellillm_tpu.analysis.baseline import (default_baseline_path,
+                                              save_baseline)
+from intellillm_tpu.analysis.engine import (DEFAULT_TARGETS,
+                                            repo_root_from_here)
+
+
+def _parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m intellillm_tpu.tools.lint",
+        description="TPU-serving static analysis "
+                    "(docs/static_analysis.md)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/dirs to scan (default: "
+                             f"{', '.join(DEFAULT_TARGETS)})")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="only scan files git reports as changed "
+                             "(pre-commit mode)")
+    parser.add_argument("--diff-base", default=None,
+                        help="git ref for --changed-only (default HEAD)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids (default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    parser.add_argument("--format", choices=("human", "json"),
+                        default="human")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: "
+                             "intellillm_tpu/analysis/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (report everything)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate the baseline from current "
+                             "violations (reviewed act; keep it "
+                             "shrinking)")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also list pragma-suppressed findings")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    repo_root = repo_root_from_here()
+
+    if args.list_rules:
+        for rule_id, cls in sorted(available_rules().items()):
+            print(f"{rule_id:24s} {cls.summary}")
+        print(f"{'bad-pragma':24s} lint pragma without a reason= or "
+              "with an unknown rule id")
+        print(f"{'parse-error':24s} file does not parse")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+
+    import pathlib
+    baseline_path = (pathlib.Path(args.baseline) if args.baseline
+                     else default_baseline_path(repo_root))
+
+    try:
+        result = run_analysis(
+            repo_root=repo_root,
+            targets=tuple(args.paths) if args.paths else DEFAULT_TARGETS,
+            rule_ids=rule_ids,
+            baseline_path=baseline_path,
+            use_baseline=not args.no_baseline and not args.write_baseline,
+            changed_only=args.changed_only,
+            diff_base=args.diff_base,
+        )
+    except ValueError as e:  # unknown rule id, malformed baseline
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        save_baseline(baseline_path, result.violations)
+        print(f"wrote {len(result.violations)} entr"
+              f"{'y' if len(result.violations) == 1 else 'ies'} to "
+              f"{baseline_path}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=2))
+        return 0 if result.ok else 1
+
+    for violation in result.violations:
+        print(violation.format())
+    for entry in result.stale_baseline:
+        print(f"{entry['path']}: [stale-baseline] baseline entry for "
+              f"[{entry['rule']}] no longer matches any violation — "
+              "delete it (the baseline only shrinks)")
+    if args.show_suppressed:
+        for violation in result.suppressed:
+            print(f"(suppressed) {violation.format(show_hint=False)}")
+    if result.ok:
+        print(f"clean: {result.files_scanned} files, "
+              f"{len(result.suppressed)} pragma-suppressed, "
+              f"{len(result.baselined)} baselined")
+        return 0
+    print(f"\n{len(result.violations)} violation(s), "
+          f"{len(result.stale_baseline)} stale baseline entr(y/ies) "
+          f"across {result.files_scanned} files")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,8 +116,10 @@ class InflightStep:
         r = self.runner
         if self.plp is not None:
             plp_dev, plp_k, plp_params = self.plp
+            # lint: allow(host-sync) reason=the designed single D2H point: prompt logprobs must reach the host to be attached to request output
             r._attach_prompt_logprobs(np.asarray(plp_dev), plp_k,
                                       self.metas, self.rows, plp_params)
+        # lint: allow(host-sync) reason=the one intentional fetch per step: sampled ids must cross to the host here so the engine can emit tokens; everything upstream stays async
         packed = np.array(self.packed) if self.proc else np.asarray(
             self.packed)
         sampled, sampled_lp, topk_ids, topk_lp = r._unpack(
@@ -125,6 +127,7 @@ class InflightStep:
         if self.proc:
             proc_rows, fetched, row_params, row_tokens, row_seeds = self.proc
             r._resample_processor_rows(
+                # lint: allow(host-sync) reason=processor rows resample on the host by design; fetched was produced by the same dispatch the packed fetch above already waited on
                 proc_rows, np.asarray(fetched), row_params, row_tokens,
                 row_seeds, sampled, sampled_lp, topk_ids, topk_lp, self.t1)
         return r._process_sampling(self.metas, self.rows, sampled,
@@ -1178,6 +1181,7 @@ class ModelRunner:
 
         with self._tracer.span("sample"):
             sampled, sampled_lp, topk_ids, topk_lp = self._unpack(
+                # lint: allow(host-sync) reason=the mixed step's single designed D2H: sampled ids must reach the host to emit tokens this step
                 np.asarray(packed), 1, 1, st.logprob_k)
             output: SamplerOutput = []
             for mi, meta in enumerate(seq_group_metadata_list):

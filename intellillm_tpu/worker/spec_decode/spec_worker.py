@@ -167,6 +167,7 @@ class SpecDecodeWorker(Worker):
                 do_minp=False, do_penalties=False, do_random=False)
             self.cache_engine.device_cache = caches
             import jax
+            # lint: allow(host-sync) reason=teacher warm-up runs before serving; block so the teacher executable is compiled and resident before the first speculative step
             jax.block_until_ready(packed)
             return 1
         except Exception as e:  # best-effort, same contract as warm-up

@@ -391,6 +391,7 @@ class Worker:
                                     num_steps=k, **flags)
                                 self.cache_engine.device_cache = caches
                                 n += 1
+                        # lint: allow(host-sync) reason=warm-up runs before serving; blocking here ensures executables are resident and the logged compile wall-time is honest
                         jax.block_until_ready(packed)
             logger.info("Warm-up: compiled %d decode executables "
                         "(bs=%s) in %.1fs", n,

@@ -1,0 +1,48 @@
+"""Shared fixtures: a Settings pointing at the miniature fixture repo.
+
+`fixtures/mini_repo/` is a self-contained tree with at least one true
+positive and one pragma-suppressed case per rule; every repo-specific
+knob in `Settings` is overridden to match its layout, which is exactly
+how the rules stay testable without scanning the real package.
+"""
+import pathlib
+
+import pytest
+
+from intellillm_tpu.analysis import Settings, run_analysis
+
+MINI_ROOT = pathlib.Path(__file__).parent / "fixtures" / "mini_repo"
+MINI_TARGETS = ("pkg", "intellillm_tpu")
+
+
+def make_mini_settings() -> Settings:
+    return Settings(
+        repo_root=MINI_ROOT,
+        hot_paths={"pkg/runner.py": ("Runner.execute_model",
+                                     "Runner._finalize")},
+        extra_traced={},
+        metrics_modules=("pkg/metrics/*.py", ),
+        request_path_globs=("pkg/server.py", ),
+        flag_sources=("pkg/flags.py", ),
+        seed_flags=frozenset({"--model"}),
+        doc_files=("docs/ops.md", ),
+        metrics_doc="docs/ops.md",
+        env_var_dirs=("pkg/obs", ),
+        non_metrics=frozenset(),
+    )
+
+
+@pytest.fixture
+def mini_settings() -> Settings:
+    return make_mini_settings()
+
+
+@pytest.fixture
+def run_mini(mini_settings):
+    def _run(rule_ids=None, targets=MINI_TARGETS, **kwargs):
+        kwargs.setdefault("use_baseline", False)
+        return run_analysis(repo_root=MINI_ROOT, targets=targets,
+                            rule_ids=rule_ids, settings=mini_settings,
+                            **kwargs)
+
+    return _run

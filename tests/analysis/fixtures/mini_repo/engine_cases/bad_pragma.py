@@ -1,0 +1,4 @@
+"""Engine fixture: invalid pragmas are themselves violations."""
+
+VALUE = 1  # lint: allow(host-sync)
+OTHER = 2  # lint: allow(not-a-rule) reason=typo in the rule id

@@ -1,0 +1,10 @@
+"""docs-metrics fixture: documented, undocumented, and waived metrics.
+
+The docs-metrics rule scans `<repo_root>/intellillm_tpu` for metric
+literals, so the mini repo mirrors that layout.
+"""
+
+STEP_SECONDS = "intellillm_fixture_step_seconds"
+ORPHAN = "intellillm_fixture_orphan_total"
+# lint: allow(docs-metrics) reason=fixture: internal series, deliberately undocumented
+HIDDEN = "intellillm_fixture_hidden_total"

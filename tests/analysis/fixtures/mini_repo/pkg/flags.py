@@ -1,0 +1,12 @@
+"""flag-docs fixture: seed, documented, undocumented, and waived flags."""
+import argparse
+
+
+def build_parser():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model")
+    parser.add_argument("--fixture-documented")
+    parser.add_argument("--fixture-undocumented")
+    # lint: allow(flag-docs) reason=fixture: internal debug flag, deliberately undocumented
+    parser.add_argument("--fixture-internal")
+    return parser

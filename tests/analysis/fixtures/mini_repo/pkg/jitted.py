@@ -1,0 +1,31 @@
+"""recompile-hazard fixture: trace-unsafe bodies and a non-static shape arg."""
+import functools
+import time
+
+import jax
+
+
+@jax.jit
+def decode_step(tokens, num_steps):
+    t = time.time()
+    print("trace-time only", t)
+    return tokens
+
+
+@functools.partial(jax.jit, static_argnames=("num_steps",))
+def decode_step_ok(tokens, num_steps):
+    return tokens
+
+
+@jax.jit
+def seeded(tokens):
+    # lint: allow(recompile-hazard) reason=fixture: trace-time constant is intended here
+    t0 = time.monotonic()
+    return tokens, t0
+
+
+def _inner_fn(x, top_k):
+    return x
+
+
+_jit_inner = jax.jit(_inner_fn)

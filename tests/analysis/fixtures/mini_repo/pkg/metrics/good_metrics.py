@@ -1,0 +1,9 @@
+"""Designated metrics module: prefixed family + reset hook = clean."""
+from prometheus_client import REGISTRY, Counter
+
+FIXTURE_REQS = Counter("intellillm_fixture_requests_total",
+                       "fixture requests")
+
+
+def reset_for_testing():
+    REGISTRY.unregister(FIXTURE_REQS)

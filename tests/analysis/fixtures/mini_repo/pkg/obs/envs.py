@@ -1,0 +1,5 @@
+"""flag-docs env-var fixture: one documented, one not."""
+import os
+
+POLL = os.environ.get("INTELLILLM_FIXTURE_POLL_SEC", "5")
+DEBUG = os.environ.get("INTELLILLM_FIXTURE_HIDDEN", "0")

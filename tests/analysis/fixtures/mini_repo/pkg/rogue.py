@@ -1,0 +1,6 @@
+"""metric-hygiene fixture: collectors outside the designated modules."""
+from prometheus_client import Counter, Gauge
+
+ROGUE = Counter("rogue_total", "unprefixed, wrong module")
+# lint: allow(metric-hygiene) reason=fixture: scratch gauge for a local experiment
+SCRATCH = Gauge("intellillm_fixture_scratch", "suppressed placement")

@@ -1,0 +1,23 @@
+"""host-sync fixture: a step loop with stray syncs and one blessed fetch."""
+import jax
+import numpy as np
+
+
+class Runner:
+
+    def execute_model(self, batch):
+        out = self._dispatch(batch)
+        jax.block_until_ready(out)
+        flag = out.done.item()
+        return out, flag
+
+    def _finalize(self, packed):
+        # lint: allow(host-sync) reason=fixture: the designed single fetch point
+        host = np.asarray(packed)
+        return host
+
+    def _dispatch(self, batch):
+        return batch
+
+    def cold_path(self, batch):
+        return np.asarray(batch)

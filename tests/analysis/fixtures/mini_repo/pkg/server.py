@@ -1,0 +1,27 @@
+"""async-blocking + unbounded-growth fixture: a per-request server path."""
+import time
+
+REQUEST_LOG = []
+_CACHE = {}
+RECENT = None  # not a container: never flagged
+
+
+async def handle(request):
+    time.sleep(0.1)
+    REQUEST_LOG.append(request)
+    _CACHE[request.id] = request
+    return request
+
+
+async def shutdown(proc):
+    # lint: allow(async-blocking) reason=fixture: shutdown path, loop is draining anyway
+    proc.wait(timeout=5)
+
+
+async def audit(request):
+    # lint: allow(unbounded-growth) reason=fixture: flushed by the harness every batch
+    REQUEST_LOG.append(request)
+
+
+def sync_helper(request):
+    REQUEST_LOG.append(request)

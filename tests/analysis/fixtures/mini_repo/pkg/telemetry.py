@@ -1,0 +1,30 @@
+"""unlocked-shared-state fixture: a poller thread sharing state."""
+import threading
+
+
+class Poller:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = []
+        self._last = None
+        self._errors = 0
+
+    def start(self):
+        thread = threading.Thread(target=self._loop, daemon=True)
+        thread.start()
+
+    def _loop(self):
+        while True:
+            self._last = self._read()
+            with self._lock:
+                self._samples.append(self._last)
+            # lint: allow(unlocked-shared-state) reason=fixture: int bump tolerates torn reads
+            self._errors += 1
+
+    def _read(self):
+        return 1
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._samples), self._last, self._errors

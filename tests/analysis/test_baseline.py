@@ -1,0 +1,68 @@
+"""Baseline round-trip and the shrink-only gate semantics."""
+import json
+
+import pytest
+
+from intellillm_tpu.analysis.baseline import (load_baseline, save_baseline,
+                                              split_baselined)
+
+
+def test_round_trip_grandfathers_violations(tmp_path, run_mini):
+    found = run_mini(rule_ids=["host-sync"])
+    assert len(found.violations) == 2
+
+    baseline = tmp_path / "baseline.json"
+    save_baseline(baseline, found.violations)
+
+    gated = run_mini(rule_ids=["host-sync"], baseline_path=baseline,
+                     use_baseline=True)
+    assert gated.ok
+    assert gated.violations == []
+    assert len(gated.baselined) == 2
+    assert gated.stale_baseline == []
+
+
+def test_stale_entry_fails_the_gate(tmp_path, run_mini):
+    found = run_mini(rule_ids=["host-sync"])
+    baseline = tmp_path / "baseline.json"
+    save_baseline(baseline, found.violations)
+
+    # Simulate paying off one debt: its entry is now stale.
+    data = json.loads(baseline.read_text())
+    paid, data["entries"] = data["entries"][0], data["entries"][1:]
+    extinct = dict(paid)
+    extinct["context"] = "this_line_no_longer_exists()"
+    data["entries"].append(extinct)
+    baseline.write_text(json.dumps(data))
+
+    gated = run_mini(rule_ids=["host-sync"], baseline_path=baseline,
+                     use_baseline=True)
+    assert not gated.ok
+    # The un-baselined violation resurfaces AND the stale entry fails.
+    assert len(gated.violations) == 1
+    assert gated.stale_baseline == [extinct]
+
+
+def test_fingerprint_survives_line_drift(run_mini):
+    """Fingerprints key on the offending text, not the line number."""
+    found = run_mini(rule_ids=["host-sync"])
+    entries = [{"rule": v.rule, "path": v.path, "context": v.context}
+               for v in found.violations]
+    shifted = [v for v in found.violations]
+    for violation in shifted:
+        violation.line += 40  # unrelated edits moved the file around
+    active, baselined, stale = split_baselined(shifted, entries)
+    assert active == []
+    assert len(baselined) == 2
+    assert stale == []
+
+
+def test_missing_baseline_file_is_empty(tmp_path):
+    assert load_baseline(tmp_path / "nope.json") == []
+
+
+def test_malformed_entry_rejected(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"entries": [{"rule": "host-sync"}]}))
+    with pytest.raises(ValueError, match="malformed"):
+        load_baseline(path)

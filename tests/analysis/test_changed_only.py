@@ -1,0 +1,58 @@
+"""--changed-only semantics: scope the report, never fabricate findings."""
+import intellillm_tpu.analysis.engine as engine_mod
+from intellillm_tpu.analysis.engine import git_changed_files
+
+
+def test_report_scoped_to_changed_files(run_mini, monkeypatch):
+    monkeypatch.setattr(engine_mod, "git_changed_files",
+                        lambda root, base=None: {"pkg/server.py"})
+    result = run_mini(changed_only=True)
+    assert result.files_scanned == 1
+    assert {v.path for v in result.violations} == {"pkg/server.py"}
+    # async-blocking + the 2 handle growths + sync_helper growth.
+    assert len(result.violations) == 4
+    # The cross-file doc rules still ran over the whole tree, but their
+    # findings for unchanged files are scoped out of this report.
+    assert not any(v.rule in ("flag-docs", "docs-metrics")
+                   for v in result.violations)
+
+
+def test_no_changes_means_clean(run_mini, monkeypatch):
+    monkeypatch.setattr(engine_mod, "git_changed_files",
+                        lambda root, base=None: set())
+    result = run_mini(changed_only=True)
+    assert result.ok
+    assert result.files_scanned == 0
+
+
+def test_stale_entries_only_judged_for_scanned_files(run_mini, monkeypatch,
+                                                     tmp_path):
+    """A partial scan must not flag baseline entries for files it never
+    looked at."""
+    import json
+
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"entries": [
+        {"rule": "host-sync", "path": "pkg/runner.py",
+         "context": "jax.block_until_ready(out)"},
+    ]}))
+    monkeypatch.setattr(engine_mod, "git_changed_files",
+                        lambda root, base=None: {"pkg/server.py"})
+    result = run_mini(changed_only=True, baseline_path=baseline,
+                      use_baseline=True)
+    assert result.stale_baseline == []
+
+    # A full scan with the same baseline does see the entry matched.
+    full = run_mini(baseline_path=baseline, use_baseline=True)
+    assert full.stale_baseline == []
+    assert len(full.baselined) == 1
+
+
+def test_git_changed_files_returns_relative_paths():
+    """Smoke against the real repo: paths are repo-relative posix."""
+    from intellillm_tpu.analysis.engine import repo_root_from_here
+
+    changed = git_changed_files(repo_root_from_here())
+    assert isinstance(changed, set)
+    for path in changed:
+        assert not path.startswith("/")

@@ -1,0 +1,38 @@
+"""CLI surface: python -m intellillm_tpu.tools.lint."""
+import json
+
+from intellillm_tpu.tools.lint import main
+
+
+def test_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("host-sync", "recompile-hazard", "async-blocking",
+                    "unlocked-shared-state", "metric-hygiene",
+                    "unbounded-growth", "flag-docs", "docs-metrics",
+                    "bad-pragma", "parse-error"):
+        assert rule_id in out, rule_id
+
+
+def test_tree_exits_zero_human(capsys):
+    assert main([]) == 0
+    assert "clean:" in capsys.readouterr().out
+
+
+def test_tree_exits_zero_json(capsys):
+    assert main(["--format", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
+    assert payload["violations"] == []
+    assert payload["stale_baseline"] == []
+    assert payload["files_scanned"] > 100
+
+
+def test_unknown_rule_id_is_a_usage_error(capsys):
+    assert main(["--rules", "not-a-rule"]) == 2
+    assert "not-a-rule" in capsys.readouterr().err
+
+
+def test_rule_subset_runs(capsys):
+    assert main(["--rules", "host-sync", "intellillm_tpu/worker"]) == 0
+    assert "clean:" in capsys.readouterr().out

@@ -1,0 +1,67 @@
+"""Pragma parsing and suppression semantics."""
+import textwrap
+
+from intellillm_tpu.analysis.core import parse_pragmas
+
+
+def test_trailing_pragma_parsed():
+    pragmas = parse_pragmas(
+        "x = 1  # lint: allow(host-sync) reason=intentional fetch\n")
+    assert list(pragmas) == [1]
+    pragma = pragmas[1]
+    assert pragma.rules == ("host-sync", )
+    assert pragma.reason == "intentional fetch"
+    assert pragma.valid
+
+
+def test_multi_rule_pragma():
+    pragmas = parse_pragmas(
+        "# lint: allow(host-sync, async-blocking) reason=both waived\n"
+        "x = 1\n")
+    assert pragmas[1].rules == ("host-sync", "async-blocking")
+
+
+def test_missing_reason_is_invalid():
+    pragmas = parse_pragmas("x = 1  # lint: allow(host-sync)\n")
+    assert not pragmas[1].valid
+
+
+def test_docstring_mention_is_not_a_pragma():
+    text = textwrap.dedent('''
+        def helper():
+            """Write `# lint: allow(host-sync) reason=...` to waive."""
+            return 1
+    ''')
+    assert parse_pragmas(text) == {}
+
+
+def test_fallback_scan_for_unparseable_files():
+    text = "def broken(:\n    x = 1  # lint: allow(host-sync) reason=still seen\n"
+    pragmas = parse_pragmas(text)
+    assert list(pragmas) == [2]
+    assert pragmas[2].valid
+
+
+def test_same_line_and_preceding_line_suppress(tmp_path, mini_settings):
+    from intellillm_tpu.analysis import run_analysis
+
+    target = tmp_path / "pkg"
+    target.mkdir()
+    (target / "runner.py").write_text(
+        "import jax\n"
+        "\n"
+        "\n"
+        "class Runner:\n"
+        "\n"
+        "    def execute_model(self, out):\n"
+        "        jax.block_until_ready(out)  # lint: allow(host-sync) reason=same line\n"
+        "        # lint: allow(host-sync) reason=preceding line\n"
+        "        jax.block_until_ready(out)\n"
+        "        jax.block_until_ready(out)\n",
+        encoding="utf-8")
+    mini_settings.repo_root = tmp_path
+    result = run_analysis(repo_root=tmp_path, targets=("pkg", ),
+                          rule_ids=["host-sync"], settings=mini_settings,
+                          use_baseline=False)
+    assert [v.line for v in result.suppressed] == [7, 9]
+    assert [v.line for v in result.violations] == [10]

@@ -1,0 +1,197 @@
+"""Per-rule fixture tests: every rule has at least one true positive
+and one pragma-suppressed case in the miniature repo."""
+
+
+def _by_rule(violations, rule):
+    return [v for v in violations if v.rule == rule]
+
+
+def _lines(violations):
+    return sorted((v.path, v.line) for v in violations)
+
+
+class TestHostSync:
+
+    def test_true_positives(self, run_mini):
+        result = run_mini(rule_ids=["host-sync"])
+        assert _lines(result.violations) == [
+            ("pkg/runner.py", 10),  # jax.block_until_ready
+            ("pkg/runner.py", 11),  # .item()
+        ]
+        assert "block_until_ready" in result.violations[0].message
+        assert "Runner.execute_model" in result.violations[0].message
+
+    def test_pragma_suppressed_fetch(self, run_mini):
+        result = run_mini(rule_ids=["host-sync"])
+        assert _lines(result.suppressed) == [("pkg/runner.py", 16)]
+
+    def test_non_hot_path_is_clean(self, run_mini):
+        result = run_mini(rule_ids=["host-sync"])
+        assert not any("cold_path" in v.message for v in result.violations)
+
+
+class TestRecompileHazard:
+
+    def test_true_positives(self, run_mini):
+        result = run_mini(rule_ids=["recompile-hazard"])
+        lines = _lines(result.violations)
+        # decode_step: non-static num_steps (def line), time.time, print.
+        assert ("pkg/jitted.py", 9) in lines
+        assert ("pkg/jitted.py", 10) in lines
+        assert ("pkg/jitted.py", 11) in lines
+        # _inner_fn jitted at a wrap site: top_k not static.
+        assert ("pkg/jitted.py", 27) in lines
+        assert len(lines) == 4
+
+    def test_static_argnames_accepted(self, run_mini):
+        result = run_mini(rule_ids=["recompile-hazard"])
+        assert not any("decode_step_ok" in v.message
+                       for v in result.violations + result.suppressed)
+
+    def test_pragma_suppressed(self, run_mini):
+        result = run_mini(rule_ids=["recompile-hazard"])
+        assert _lines(result.suppressed) == [("pkg/jitted.py", 23)]
+        assert "time.monotonic" in result.suppressed[0].message
+
+
+class TestAsyncBlocking:
+
+    def test_true_positive(self, run_mini):
+        result = run_mini(rule_ids=["async-blocking"])
+        assert _lines(result.violations) == [("pkg/server.py", 10)]
+        assert "time.sleep" in result.violations[0].message
+        assert "async def handle" in result.violations[0].message
+
+    def test_pragma_suppressed_wait(self, run_mini):
+        result = run_mini(rule_ids=["async-blocking"])
+        assert _lines(result.suppressed) == [("pkg/server.py", 18)]
+        assert ".wait" in result.suppressed[0].message
+
+
+class TestUnlockedSharedState:
+
+    def test_true_positive(self, run_mini):
+        result = run_mini(rule_ids=["unlocked-shared-state"])
+        assert _lines(result.violations) == [("pkg/telemetry.py", 19)]
+        violation = result.violations[0]
+        assert "_last" in violation.message
+        assert "Poller._loop" in violation.message
+        assert "Poller.snapshot" in violation.message
+
+    def test_locked_write_is_clean(self, run_mini):
+        result = run_mini(rule_ids=["unlocked-shared-state"])
+        assert not any("_samples" in v.message for v in result.violations)
+
+    def test_pragma_suppressed(self, run_mini):
+        result = run_mini(rule_ids=["unlocked-shared-state"])
+        assert _lines(result.suppressed) == [("pkg/telemetry.py", 23)]
+
+
+class TestMetricHygiene:
+
+    def test_true_positives(self, run_mini):
+        result = run_mini(rule_ids=["metric-hygiene"])
+        lines = _lines(result.violations)
+        # rogue.py line 4: placement + prefix; line 1: no reset hook.
+        assert lines == [("pkg/rogue.py", 1), ("pkg/rogue.py", 4),
+                         ("pkg/rogue.py", 4)]
+        messages = " | ".join(v.message for v in result.violations)
+        assert "reset_for_testing" in messages
+        assert "intellillm_" in messages
+        assert "outside" in messages
+
+    def test_designated_module_is_clean(self, run_mini):
+        result = run_mini(rule_ids=["metric-hygiene"])
+        assert not any(v.path.startswith("pkg/metrics/")
+                       for v in result.violations + result.suppressed)
+
+    def test_pragma_suppressed_placement(self, run_mini):
+        result = run_mini(rule_ids=["metric-hygiene"])
+        assert _lines(result.suppressed) == [("pkg/rogue.py", 6)]
+
+
+class TestUnboundedGrowth:
+
+    def test_true_positives(self, run_mini):
+        result = run_mini(rule_ids=["unbounded-growth"])
+        assert _lines(result.violations) == [
+            ("pkg/server.py", 11),  # REQUEST_LOG.append in handle
+            ("pkg/server.py", 12),  # _CACHE[...] = in handle
+            ("pkg/server.py", 27),  # REQUEST_LOG.append in sync_helper
+        ]
+
+    def test_pragma_suppressed(self, run_mini):
+        result = run_mini(rule_ids=["unbounded-growth"])
+        assert _lines(result.suppressed) == [("pkg/server.py", 23)]
+
+
+class TestFlagDocs:
+
+    def test_true_positives(self, run_mini):
+        result = run_mini(rule_ids=["flag-docs"])
+        lines = _lines(result.violations)
+        assert ("pkg/flags.py", 9) in lines        # --fixture-undocumented
+        assert ("pkg/obs/envs.py", 5) in lines     # INTELLILLM_FIXTURE_HIDDEN
+        assert len(lines) == 2
+
+    def test_seed_and_documented_flags_skipped(self, run_mini):
+        result = run_mini(rule_ids=["flag-docs"])
+        everything = result.violations + result.suppressed
+        assert not any("--model" in v.message for v in everything)
+        assert not any("--fixture-documented" in v.message
+                       for v in everything)
+
+    def test_pragma_suppressed(self, run_mini):
+        result = run_mini(rule_ids=["flag-docs"])
+        assert _lines(result.suppressed) == [("pkg/flags.py", 11)]
+        assert "--fixture-internal" in result.suppressed[0].message
+
+
+class TestDocsMetrics:
+
+    def test_true_positives(self, run_mini):
+        result = run_mini(rule_ids=["docs-metrics"])
+        by_path = {v.path: v for v in result.violations}
+        orphan = by_path["intellillm_tpu/metrics_src.py"]
+        assert orphan.line == 8
+        assert "intellillm_fixture_orphan_total" in orphan.message
+        ghost = by_path["docs/ops.md"]
+        assert "intellillm_fixture_ghost_total" in ghost.message
+        assert len(result.violations) == 2
+
+    def test_pragma_suppressed(self, run_mini):
+        result = run_mini(rule_ids=["docs-metrics"])
+        assert _lines(result.suppressed) == [
+            ("intellillm_tpu/metrics_src.py", 10)]
+
+
+class TestEngineChecks:
+
+    def test_bad_pragmas_and_parse_errors(self, run_mini):
+        result = run_mini(targets=("engine_cases", ))
+        bad = _by_rule(result.violations, "bad-pragma")
+        assert _lines(bad) == [("engine_cases/bad_pragma.py", 3),
+                               ("engine_cases/bad_pragma.py", 4)]
+        assert "no reason=" in bad[0].message
+        assert "not-a-rule" in bad[1].message
+        parse = _by_rule(result.violations, "parse-error")
+        assert _lines(parse) == [("engine_cases/broken.py", 1)]
+
+    def test_full_mini_repo_totals(self, run_mini):
+        """Whole-tree aggregate: the per-rule counts add up, nothing
+        double-reports, and every suppression carries a reason."""
+        result = run_mini()
+        per_rule = {}
+        for violation in result.violations:
+            per_rule[violation.rule] = per_rule.get(violation.rule, 0) + 1
+        assert per_rule == {
+            "host-sync": 2,
+            "recompile-hazard": 4,
+            "async-blocking": 1,
+            "unlocked-shared-state": 1,
+            "metric-hygiene": 3,
+            "unbounded-growth": 3,
+            "flag-docs": 2,
+            "docs-metrics": 2,
+        }
+        assert len(result.suppressed) == 8

@@ -1,60 +1,25 @@
-"""Doc-drift guard: every `intellillm_*` metric name defined in the
-source must be documented in docs/observability.md's metrics reference,
-and every metric the doc mentions must still exist in the source — so
-the reference can't rot as metrics are added or renamed."""
-import pathlib
-import re
-
-REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
-PACKAGE_DIR = REPO_ROOT / "intellillm_tpu"
-DOC_PATH = REPO_ROOT / "docs" / "observability.md"
-
-# Metric names appear in source as quoted string literals passed to the
-# prometheus_client constructors.
-SOURCE_METRIC_RE = re.compile(r"[\"'](intellillm_[a-z0-9_]+)[\"']")
-DOC_METRIC_RE = re.compile(r"\b(intellillm_[a-z0-9_]+)\b")
-# Prometheus expands histograms/counters with these suffixes; the doc
-# may quote an expanded series name.
-SERIES_SUFFIXES = ("_sum", "_count", "_bucket")
-# Quoted intellillm_ literals that are not metric names (the package
-# prefix itself, the request-id contextvar in logger.py).
-NON_METRICS = {"intellillm_request_id"}
+"""Metrics-reference doc-drift guard, now a thin wrapper over the
+`docs-metrics` lint rule (intellillm_tpu/analysis/rules/doc_guards.py):
+every `intellillm_*` metric name defined in the source must be
+documented in docs/observability.md's metrics reference, and every
+metric the doc mentions must still exist in the source. This wrapper
+keeps the original guard-the-guard assertions so the scrape itself
+can't rot."""
+from intellillm_tpu.analysis.engine import load_project
+from intellillm_tpu.analysis.rules.doc_guards import (DocsMetricsRule,
+                                                      doc_metric_names,
+                                                      source_metric_names)
 
 
-def _strip_suffix(name: str) -> str:
-    for suffix in SERIES_SUFFIXES:
-        if name.endswith(suffix):
-            return name[:-len(suffix)]
-    return name
-
-
-def source_metric_names() -> set:
-    names = set()
-    for path in sorted(PACKAGE_DIR.rglob("*.py")):
-        for match in SOURCE_METRIC_RE.finditer(
-                path.read_text(encoding="utf-8")):
-            name = match.group(1)
-            if name.startswith("intellillm_tpu") or name in NON_METRICS:
-                continue
-            names.add(name)
-    return names
-
-
-def doc_metric_names() -> set:
-    names = set()
-    for match in DOC_METRIC_RE.finditer(
-            DOC_PATH.read_text(encoding="utf-8")):
-        name = _strip_suffix(match.group(1))
-        if name.startswith("intellillm_tpu") or name in NON_METRICS:
-            continue
-        names.add(name)
-    return names
+def _docs_metrics_violations():
+    project = load_project()
+    return list(DocsMetricsRule(project.settings).finalize(project))
 
 
 def test_sources_define_metrics():
     # Guard the guard: if the regex scrape breaks, this fails before the
     # cross-check tests vacuously pass.
-    names = source_metric_names()
+    names = set(source_metric_names(load_project().settings))
     assert len(names) >= 20, names
     assert "intellillm_slo_goodput_ratio" in names
     assert "intellillm_step_phase_seconds" in names
@@ -64,16 +29,23 @@ def test_sources_define_metrics():
 
 
 def test_every_source_metric_is_documented():
-    undocumented = source_metric_names() - doc_metric_names()
+    undocumented = [v.format() for v in _docs_metrics_violations()
+                    if "not documented" in v.message]
     assert not undocumented, (
-        f"metrics defined in source but missing from {DOC_PATH}: "
-        f"{sorted(undocumented)} — add them to the metrics reference "
-        "in docs/observability.md")
+        f"metrics defined in source but missing from the metrics "
+        f"reference: {undocumented} — add them to docs/observability.md")
 
 
 def test_every_documented_metric_exists_in_source():
-    stale = doc_metric_names() - source_metric_names()
+    stale = [v.format() for v in _docs_metrics_violations()
+             if "absent from the source" in v.message]
     assert not stale, (
-        f"metrics documented in {DOC_PATH} but absent from the source: "
-        f"{sorted(stale)} — remove or rename them in "
-        "docs/observability.md")
+        f"metrics documented but absent from the source: {stale} — "
+        "remove or rename them in docs/observability.md")
+
+
+def test_doc_scrape_sees_documented_metrics():
+    # Guard the guard on the doc side too.
+    documented = set(doc_metric_names(load_project().settings))
+    assert len(documented) >= 20, sorted(documented)
+    assert "intellillm_step_phase_seconds" in documented

@@ -1,65 +1,27 @@
-"""Static doc-drift guard for observability CLI flags: every EngineArgs
-/ server flag added after the growth seed must be documented in
-docs/observability.md or docs/routing.md (companion to
-test_registry_hygiene.py, which guards metric names, and
-test_docs_metrics.py, which guards the metrics reference table)."""
-import pathlib
-import re
-
-REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
-# A post-seed flag may be documented in either operator doc (router
-# flags live in docs/routing.md).
-DOC_FILES = (
-    REPO_ROOT / "docs" / "observability.md",
-    REPO_ROOT / "docs" / "routing.md",
-)
-
-# Files whose argparse surface is operator-facing engine/server config
-# (tools/top.py is a client, not a server — its flags live in its own
-# --help and module docstring).
-FLAG_SOURCES = (
-    "intellillm_tpu/engine/arg_utils.py",
-    "intellillm_tpu/entrypoints/api_server.py",
-    "intellillm_tpu/entrypoints/openai/api_server.py",
-    "intellillm_tpu/router/server.py",
-)
-
-FLAG_RE = re.compile(r"add_argument\(\s*[\"'](--[a-z0-9-]+)[\"']")
-
-# The EngineArgs/server flags present in the growth seed (commit
-# 47dbfda). Anything NOT in this set was added by an observability PR
-# and must be documented. Frozen on purpose: extend it only if a seed
-# flag was genuinely missed, never to dodge documenting a new flag.
-SEED_FLAGS = frozenset({
-    "--block-size", "--chat-template", "--data-parallel-size",
-    "--disable-log-requests", "--disable-log-stats", "--dtype",
-    "--enable-lora", "--enforce-eager", "--gpu-memory-utilization",
-    "--hbm-utilization", "--host", "--kv-cache-dtype", "--load-format",
-    "--lora-dtype", "--lora-extra-vocab-size", "--max-cpu-loras",
-    "--max-log-len", "--max-lora-rank", "--max-loras", "--max-model-len",
-    "--max-num-batched-tokens", "--max-num-seqs", "--max-paddings",
-    "--model", "--num-decode-steps", "--num-device-blocks-override",
-    "--num-speculative-tokens", "--pipeline-parallel-size", "--port",
-    "--quantization", "--response-role", "--revision",
-    "--scheduling-policy", "--seed", "--served-model-name",
-    "--sp-prefill-threshold", "--speculative-model", "--swap-space",
-    "--tensor-parallel-size", "--tokenizer", "--tokenizer-mode",
-    "--trust-remote-code", "--api-key",
-})
+"""Flag/env-var doc-drift guard, now a thin wrapper over the
+`flag-docs` lint rule (intellillm_tpu/analysis/rules/doc_guards.py):
+every EngineArgs/server flag added after the growth seed, and every
+`INTELLILLM_*` env var of the obs subsystem, must be documented in
+docs/observability.md or docs/routing.md. The flag sources, seed-flag
+freeze, and doc list moved verbatim into
+intellillm_tpu/analysis/core.py (DEFAULT_FLAG_SOURCES /
+DEFAULT_SEED_FLAGS / DEFAULT_DOC_FILES); this wrapper keeps the
+original guard-the-guard assertions so the scrape itself can't rot."""
+from intellillm_tpu.analysis.engine import load_project
+from intellillm_tpu.analysis.rules.doc_guards import (FlagDocsRule,
+                                                      declared_flags,
+                                                      obs_env_vars)
 
 
-def _declared_flags():
-    flags = set()
-    for rel in FLAG_SOURCES:
-        text = (REPO_ROOT / rel).read_text(encoding="utf-8")
-        flags.update(FLAG_RE.findall(text))
-    return flags
+def _flag_docs_violations():
+    project = load_project()
+    return list(FlagDocsRule(project.settings).finalize(project))
 
 
 def test_scrape_sees_known_flags():
     # Guard the guard: if the regex or file list rots, the doc check
     # below passes vacuously.
-    flags = _declared_flags()
+    flags = set(declared_flags(load_project().settings))
     assert "--max-num-seqs" in flags
     assert "--slo-ttft-ms" in flags
     assert "--enable-profiling" in flags
@@ -68,10 +30,8 @@ def test_scrape_sees_known_flags():
 
 
 def test_post_seed_flags_are_documented():
-    docs = "\n".join(p.read_text(encoding="utf-8") for p in DOC_FILES)
-    undocumented = sorted(
-        flag for flag in _declared_flags() - SEED_FLAGS
-        if flag not in docs)
+    undocumented = [v.format() for v in _flag_docs_violations()
+                    if "flag `" in v.message]
     assert not undocumented, (
         f"flags added after the seed but missing from "
         f"docs/observability.md and docs/routing.md: {undocumented} — "
@@ -81,7 +41,7 @@ def test_post_seed_flags_are_documented():
 def test_known_post_seed_flags_still_exist():
     # The flags this guard was written for must stay scrapeable; if one
     # is renamed, update the docs and this list together.
-    flags = _declared_flags()
+    flags = set(declared_flags(load_project().settings))
     for flag in ("--slo-ttft-ms", "--slo-tpot-ms", "--hbm-headroom-warn",
                  "--enable-profiling", "--peak-flops", "--replica-urls",
                  "--predictor-path", "--affinity-blocks",
@@ -89,27 +49,9 @@ def test_known_post_seed_flags_still_exist():
         assert flag in flags, flag
 
 
-# --- Environment-variable doc guard (obs package only: every env knob
-# of the observability subsystem is operator-facing and belongs in the
-# docs/observability.md env table; packages outside obs/ carry
-# developer escape hatches that are deliberately undocumented). ---
-
-ENV_VAR_RE = re.compile(r"\b(INTELLILLM_[A-Z0-9_]+)\b")
-OBS_DIR = REPO_ROOT / "intellillm_tpu" / "obs"
-
-
-def _obs_env_vars():
-    names = set()
-    for path in sorted(OBS_DIR.rglob("*.py")):
-        names.update(ENV_VAR_RE.findall(path.read_text(encoding="utf-8")))
-    # INTELLILLM_SLO_ appears as a doc-string prefix reference; drop
-    # the bare prefix, keep the concrete vars.
-    return {n for n in names if not n.endswith("_")}
-
-
 def test_env_scrape_sees_known_vars():
     # Guard the guard.
-    names = _obs_env_vars()
+    names = set(obs_env_vars(load_project().settings))
     assert "INTELLILLM_WATCHDOG" in names
     assert "INTELLILLM_TRACE_EXPORT" in names
     assert "INTELLILLM_TRACE_HOP" in names
@@ -118,8 +60,8 @@ def test_env_scrape_sees_known_vars():
 
 
 def test_obs_env_vars_are_documented():
-    docs = "\n".join(p.read_text(encoding="utf-8") for p in DOC_FILES)
-    undocumented = sorted(n for n in _obs_env_vars() if n not in docs)
+    undocumented = [v.format() for v in _flag_docs_violations()
+                    if "env var" in v.message]
     assert not undocumented, (
         f"obs env vars missing from docs/observability.md: "
         f"{undocumented} — add a row to the environment-variables table")

@@ -1,28 +1,32 @@
-"""Static registry-hygiene guard over every Prometheus metric
-constructor in the package: names must carry the `intellillm_` prefix
-(one grafana namespace, no collisions with other exporters), and any
-module that registers collectors must expose a `reset_for_testing` hook
-so tests can rebuild engines without duplicate-registration errors."""
-import pathlib
-import re
-
-REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
-PACKAGE_DIR = REPO_ROOT / "intellillm_tpu"
-
-# A prometheus_client collector construction: the metric name is the
-# first (string literal) argument.
-CONSTRUCTOR_RE = re.compile(
-    r"\b(?:Counter|Gauge|Histogram|Summary)\(\s*[\"']([^\"']+)[\"']")
+"""Registry-hygiene guard, now a thin wrapper over the `metric-hygiene`
+lint rule (intellillm_tpu/analysis/rules/metric_hygiene.py): names must
+carry the `intellillm_` prefix (one grafana namespace, no collisions
+with other exporters), every module that registers collectors must
+expose a `reset_for_testing` hook, and collectors live only in the
+designated metrics modules. The rule also runs in the lint CI gate
+(tests/analysis/test_tree_clean.py); this wrapper keeps the original
+guard-the-guard assertions so the scrape itself can't rot."""
+from intellillm_tpu.analysis.engine import load_project
+from intellillm_tpu.analysis.rules.metric_hygiene import (
+    MetricHygieneRule, prometheus_collector_calls)
 
 
 def _metric_constructors():
-    """(path, metric_name) for every collector constructed in-package."""
+    """(module, metric_name) for every collector constructed in-package."""
     found = []
-    for path in sorted(PACKAGE_DIR.rglob("*.py")):
-        text = path.read_text(encoding="utf-8")
-        for match in CONSTRUCTOR_RE.finditer(text):
-            found.append((path, match.group(1)))
+    for mod in load_project().modules:
+        for _, name in prometheus_collector_calls(mod):
+            found.append((mod, name))
     return found
+
+
+def _hygiene_violations():
+    project = load_project()
+    rule = MetricHygieneRule(project.settings)
+    out = []
+    for mod in project.modules:
+        out.extend(rule.check(mod))
+    return out
 
 
 def test_constructors_are_found():
@@ -43,19 +47,25 @@ def test_constructors_are_found():
 
 
 def test_every_metric_name_is_prefixed():
-    bad = [(str(p.relative_to(REPO_ROOT)), name)
-           for p, name in _metric_constructors()
-           if not name.startswith("intellillm_")]
+    bad = [v.format() for v in _hygiene_violations()
+           if "prefix" in v.message]
     assert not bad, (
         f"metrics without the intellillm_ prefix: {bad} — all exported "
         "series share one namespace")
 
 
 def test_every_metrics_module_has_reset_hook():
-    modules = {p for p, _ in _metric_constructors()}
-    missing = [str(p.relative_to(REPO_ROOT)) for p in sorted(modules)
-               if "reset_for_testing" not in p.read_text(encoding="utf-8")]
+    missing = [v.format() for v in _hygiene_violations()
+               if "reset_for_testing" in v.message]
     assert not missing, (
         f"modules registering Prometheus collectors without a "
         f"reset_for_testing hook: {missing} — tests cannot unregister "
         "their collectors between engine rebuilds")
+
+
+def test_collectors_only_in_designated_modules():
+    # New with the lint suite: ad-hoc families outside obs/,
+    # engine/metrics.py, router/metrics.py dodge the guards above.
+    strays = [v.format() for v in _hygiene_violations()
+              if "outside" in v.message]
+    assert not strays, strays
