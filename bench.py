@@ -22,6 +22,7 @@ Env knobs: INTELLILLM_BENCH_SIZE=7b|1b|tiny (default 7b),
 """
 from __future__ import annotations
 
+import glob
 import json
 import os
 import signal
@@ -463,7 +464,7 @@ def main():
     _PROGRESS["phase"] = "done"
     tok_s = out_tokens / elapsed
     family = "mixtral" if size == "moe" else "llama2"
-    print(json.dumps({
+    rec = {
         "metric": f"{family}-{size}-dummy offline output tok/s/chip "
                   f"(bs={batch_size}, in={input_len}, out={output_len}, "
                   f"mml={max_model_len}, greedy, "
@@ -471,7 +472,43 @@ def main():
         "value": round(tok_s, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),
-    }))
+    }
+    rec["regression"] = _regression_vs_prior(tok_s)
+    print(json.dumps(rec))
+
+
+def _regression_vs_prior(tok_s: float):
+    """Self-reporting trajectory: compare against the best successful
+    prior round's BENCH_r0*.json record (written by the driver next to
+    this script) and flag a > 5% drop. None when no prior round parsed
+    a positive tok/s (e.g. r04/r05 died before measuring)."""
+    best_value, best_round = 0.0, None
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        for path in sorted(glob.glob(os.path.join(here, "BENCH_r0*.json"))):
+            try:
+                with open(path) as f:
+                    prior = json.load(f)
+            except Exception:
+                continue
+            parsed = prior.get("parsed") or {}
+            value = parsed.get("value")
+            if (parsed.get("unit") == "tok/s/chip"
+                    and isinstance(value, (int, float)) and value > 0
+                    and value > best_value):
+                best_value = value
+                best_round = prior.get("n")
+    except Exception:
+        return None
+    if best_round is None:
+        return None
+    delta_pct = (tok_s - best_value) / best_value * 100.0
+    return {
+        "baseline_round": best_round,
+        "baseline_tok_s": best_value,
+        "delta_pct": round(delta_pct, 1),
+        "regressed": delta_pct < -5.0,
+    }
 
 
 if __name__ == "__main__":

@@ -193,6 +193,38 @@ def snapshot_efficiency(base: str) -> dict:
         return {"error": f"efficiency scrape failed: {e}"}
 
 
+def snapshot_alerts(base: str) -> dict:
+    """Scrape /debug/alerts. On a router this includes the fleet block
+    (every replica's alert summary aggregated), so a fleet run can
+    assert "no alerts fired" from one endpoint."""
+    try:
+        with urllib.request.urlopen(base + "/debug/alerts", timeout=5) as r:
+            return json.loads(r.read().decode(errors="replace"))
+    except Exception as e:
+        return {"error": f"alerts scrape failed: {e}"}
+
+
+def distill_alerts(alerts: dict) -> dict:
+    """Compact alert verdict for the summary line: which rules are
+    firing/pending and whether the run finished clean."""
+    if not alerts or "error" in alerts:
+        return {"error": (alerts or {}).get("error", "no alert data"),
+                "clean": None}
+    fleet = alerts.get("fleet")
+    firing = sorted((fleet.get("rules_firing") or []) if fleet
+                    else (alerts.get("firing") or []))
+    pending = sorted((fleet.get("rules_pending") or []) if fleet
+                     else (alerts.get("pending") or []))
+    return {
+        "firing": firing,
+        "pending": pending,
+        "page_firing": (fleet.get("page_firing") if fleet
+                        else alerts.get("page_firing", False)),
+        "clean": not firing and not pending,
+        "fleet_aggregated": fleet is not None,
+    }
+
+
 def snapshot_fleet_traces(router_base: str, limit: int = 3) -> dict:
     """Sample stitched fleet traces from the router: recent trace ids
     from /debug/trace, each fetched via /debug/trace/{id} — the per-hop
@@ -434,6 +466,9 @@ def run_fleet(args, model_dir: str, tokenizer) -> dict:
             "metrics": snapshot_router_metrics(router_base),
             "health_detail": snapshot_health_detail(router_base),
         }
+        # Fleet-aggregated alert state from the router: the bench's
+        # "no alerts fired" assertion (or the list of what did).
+        summary["alerts"] = distill_alerts(snapshot_alerts(router_base))
         # Per-hop latency splits: stitched trace samples from the
         # router's aggregator + each replica's own hop decomposition
         # (slo.hops_ms from its /health/detail).
@@ -455,6 +490,7 @@ def run_fleet(args, model_dir: str, tokenizer) -> dict:
             "per_replica_slo": per_replica,
             "router": summary["router"],
             "trace_attribution": summary["trace_attribution"],
+            "alerts": summary["alerts"],
         }}), flush=True)
     finally:
         if router_proc is not None:
@@ -546,6 +582,7 @@ def main(args) -> dict:
         summary["slo"] = detail.get("slo") or {}
         summary["device_telemetry"] = distill_device_telemetry(detail)
         summary["efficiency"] = snapshot_efficiency(base)
+        summary["alerts"] = distill_alerts(snapshot_alerts(base))
     finally:
         proc.send_signal(signal.SIGKILL)
         proc.wait()

@@ -22,11 +22,12 @@ from intellillm_tpu.core.scheduler import Scheduler, SchedulerOutputs
 from intellillm_tpu.engine.arg_utils import EngineArgs
 from intellillm_tpu.engine.metrics import StatLogger, Stats
 from intellillm_tpu.logger import init_logger
-from intellillm_tpu.obs import (get_device_telemetry,
+from intellillm_tpu.obs import (get_alert_manager, get_boot_timeline,
+                                get_device_telemetry,
                                 get_efficiency_tracker,
-                                get_flight_recorder, get_slo_tracker,
-                                get_step_tracer, get_watchdog,
-                                request_context)
+                                get_flight_recorder, get_metrics_history,
+                                get_slo_tracker, get_step_tracer,
+                                get_watchdog, request_context)
 from intellillm_tpu.outputs import RequestOutput
 from intellillm_tpu.sampling_params import SamplingParams
 from intellillm_tpu.sequence import (SamplerOutput, Sequence, SequenceGroup,
@@ -95,8 +96,13 @@ class LLMEngine:
             self.worker = Worker(model_config, parallel_config,
                                  scheduler_config, cache_config,
                                  lora_config)
-        self.worker.init_model()
-        self.worker.load_model()
+        # Boot timeline (obs/boot.py): phase durations surface in
+        # /health/detail — a persistent compile cache should show up as
+        # a collapsed warm-up phase.
+        self._boot = get_boot_timeline()
+        with self._boot.phase("weights_load"):
+            self.worker.init_model()
+            self.worker.load_model()
 
         # Fused multi-step decode is incompatible with ALiBi (bias needs
         # the true query position per substep) and sliding window (exact
@@ -213,6 +219,17 @@ class LLMEngine:
             },
             kv_usage=self.kv_cache_usage)
 
+        # Metrics history + alert rules (obs/history.py, obs/alerts.py):
+        # the sampler snapshots every intellillm_* gauge/counter on an
+        # interval; the alert manager re-evaluates its rule set after
+        # each tick. Attached last so the first sample sees a fully
+        # initialized engine; boot is marked complete here.
+        self._history = get_metrics_history()
+        self._alerts = get_alert_manager()
+        self._alerts.attach(self._history)
+        self._history.attach()
+        self._boot.mark_complete()
+
     def kv_cache_usage(self) -> dict:
         """KV-cache fill fractions (device HBM + CPU swap), 0..1."""
         num_total = self.cache_config.num_device_blocks
@@ -238,6 +255,12 @@ class LLMEngine:
 
     def _init_cache(self) -> None:
         """Profile → block counts → allocate pool (reference :283-342)."""
+        with self._boot.phase("cache_init"):
+            self._init_cache_pool()
+        with self._boot.phase("warmup_compile"):
+            self.worker.warm_up_model()
+
+    def _init_cache_pool(self) -> None:
         cc = self.cache_config
         if cc.num_device_blocks_override is not None:
             num_device = cc.num_device_blocks_override
@@ -278,7 +301,6 @@ class LLMEngine:
             self.parallel_config)
         self._cpu_block_bytes = CacheEngine.get_logical_cache_block_size(
             cc.block_size, cc.cache_dtype, self.model_config)
-        self.worker.warm_up_model()
 
     @classmethod
     def from_engine_args(cls, engine_args: EngineArgs,

@@ -16,12 +16,23 @@
                                         MFU, and the per-bucket pad-
                                         FLOPs waste attribution
                                         (?top=N trims the waste list)
+    GET  /debug/history?metric=&window= in-process metrics history:
+                                        [[t, v], ...] points for one
+                                        series (window like "5m"/"1h"
+                                        or seconds), or the store
+                                        snapshot + series list when no
+                                        metric is given
+    GET  /debug/alerts                  alert rule table with pending/
+                                        firing/resolved states
     GET  /health/detail                 structured liveness: last-step
                                         age, watchdog state, queue
-                                        depths, KV usage, SLO summary;
-                                        503 while the watchdog has a
-                                        stall declared (and before the
-                                        engine is up)
+                                        depths, KV usage, SLO summary,
+                                        boot-phase timings, alert
+                                        summary; 503 while the watchdog
+                                        has a stall declared (and
+                                        before the engine is up);
+                                        "degraded" (still 200) while a
+                                        page-severity alert is firing
     POST /debug/profiler/start?dir=...  begin a jax.profiler device trace
     POST /debug/profiler/stop           end it (writes the trace to disk)
 
@@ -40,10 +51,54 @@ from typing import Callable, Optional
 
 from aiohttp import web
 
-from intellillm_tpu.obs import (get_compile_tracker, get_device_telemetry,
+from intellillm_tpu.obs import (get_alert_manager, get_boot_timeline,
+                                get_compile_tracker, get_device_telemetry,
                                 get_efficiency_tracker,
-                                get_flight_recorder, get_slo_tracker,
-                                get_watchdog)
+                                get_flight_recorder, get_metrics_history,
+                                get_slo_tracker, get_watchdog)
+
+
+def _parse_window(raw: Optional[str], default: float = 600.0) -> float:
+    """Accept "300", "5m", "1h" (and "30s"); raise ValueError otherwise."""
+    if not raw:
+        return default
+    raw = raw.strip().lower()
+    scale = 1.0
+    if raw.endswith(("s", "m", "h")):
+        scale = {"s": 1.0, "m": 60.0, "h": 3600.0}[raw[-1]]
+        raw = raw[:-1]
+    value = float(raw) * scale
+    if value <= 0:
+        raise ValueError("window must be positive")
+    return value
+
+
+async def debug_history(request: web.Request) -> web.Response:
+    """Shared by both API servers and the router (module-level like
+    `metrics`, since the handler has no engine dependency)."""
+    history = get_metrics_history()
+    metric = request.query.get("metric")
+    try:
+        window_s = _parse_window(request.query.get("window"))
+    except (ValueError, KeyError):
+        return web.json_response(
+            {"error": "window must look like 300, 5m, or 1h"}, status=400)
+    if not metric:
+        body = history.snapshot()
+        body["series"] = history.series_names()
+        return web.json_response(body)
+    if metric not in history.series_names():
+        return web.json_response(
+            {"error": f"unknown series {metric!r} "
+             "(see /debug/history for the list)"}, status=404)
+    tier = request.query.get("tier")
+    points = history.query(metric, window_s, tier=tier)
+    return web.json_response({"metric": metric, "window_s": window_s,
+                              "points": points})
+
+
+async def debug_alerts(request: web.Request) -> web.Response:
+    return web.json_response(get_alert_manager().snapshot())
 
 
 async def metrics(request: web.Request) -> web.Response:
@@ -106,8 +161,17 @@ def add_debug_routes(app: web.Application,
     async def health_detail(request: web.Request) -> web.Response:
         """Deep liveness, as opposed to the LB-cheap bare-200 /health:
         503 while the watchdog has declared a stall (or before engine
-        startup), 200 with the same body otherwise."""
+        startup), 200 with the same body otherwise. A firing
+        page-severity alert reports "degraded" but stays 200 — alerts
+        flag trends, not hard process death, and a 503 here would have
+        the LB amplify a goodput dip into an outage."""
         watchdog = get_watchdog()
+        alerts = get_alert_manager()
+        # Re-evaluate the rule set on deep-health reads: a stall that
+        # cleared between sampler ticks must not linger as "degraded"
+        # for up to one history interval (rules are plain dict math over
+        # pre-aggregated windows — cheap enough for LB-cadence polling).
+        alerts.evaluate_now()
         body = {
             "watchdog": watchdog.snapshot(),
             "slo": get_slo_tracker().summary(),
@@ -118,6 +182,8 @@ def add_debug_routes(app: web.Application,
             "efficiency": get_efficiency_tracker().snapshot(
                 top_n=4, include_buckets=False),
             "live_requests": len(get_flight_recorder().live_request_ids()),
+            "alerts": alerts.summary(),
+            "boot": get_boot_timeline().snapshot(),
         }
         engine = get_engine()
         if engine is None:
@@ -134,7 +200,12 @@ def add_debug_routes(app: web.Application,
         except Exception:
             body["kv_cache_usage"] = None
         stalled = watchdog.state == "stalled"
-        body["status"] = "stalled" if stalled else "ok"
+        if stalled:
+            body["status"] = "stalled"
+        elif alerts.page_firing():
+            body["status"] = "degraded"
+        else:
+            body["status"] = "ok"
         return web.json_response(body, status=503 if stalled else 200)
 
     async def profiler_start(request: web.Request) -> web.Response:
@@ -164,6 +235,8 @@ def add_debug_routes(app: web.Application,
     app.router.add_get("/debug/trace", debug_trace)
     app.router.add_get("/debug/stall", debug_stall)
     app.router.add_get("/debug/efficiency", debug_efficiency)
+    app.router.add_get("/debug/history", debug_history)
+    app.router.add_get("/debug/alerts", debug_alerts)
     app.router.add_get("/health/detail", health_detail)
     if enable_profiling:
         app.router.add_post("/debug/profiler/start", profiler_start)

@@ -1,7 +1,11 @@
 """Observability subsystem: step-phase tracing, XLA compile tracking,
 the per-request flight recorder, request SLO telemetry, the engine
-stall watchdog, device/HBM telemetry, and the compute-efficiency
-ledger. See docs/observability.md."""
+stall watchdog, device/HBM telemetry, the compute-efficiency ledger,
+the in-process metrics history, and the alert rule engine. See
+docs/observability.md."""
+from intellillm_tpu.obs.alerts import (AlertManager, AlertRule,
+                                       built_in_rules, get_alert_manager)
+from intellillm_tpu.obs.boot import BootTimeline, get_boot_timeline
 from intellillm_tpu.obs.compile_tracker import (CompileTracker,
                                                 get_compile_tracker,
                                                 record_kernel_dispatch)
@@ -11,6 +15,7 @@ from intellillm_tpu.obs.efficiency import (EfficiencyTracker,
                                            get_efficiency_tracker)
 from intellillm_tpu.obs.flight_recorder import (EVENTS, FlightRecorder,
                                                 get_flight_recorder)
+from intellillm_tpu.obs.history import MetricsHistory, get_metrics_history
 from intellillm_tpu.obs.slo import (SLOTracker, derive_request_metrics,
                                     get_slo_tracker)
 from intellillm_tpu.obs.trace_export import (TraceSink, flush_black_box,
@@ -22,22 +27,30 @@ from intellillm_tpu.obs.tracing import (PHASES, StepTracer, get_step_tracer,
 from intellillm_tpu.obs.watchdog import EngineWatchdog, get_watchdog
 
 __all__ = [
+    "AlertManager",
+    "AlertRule",
+    "BootTimeline",
     "CompileTracker",
     "DeviceTelemetry",
     "EVENTS",
     "EfficiencyTracker",
     "EngineWatchdog",
     "FlightRecorder",
+    "MetricsHistory",
     "PHASES",
     "SLOTracker",
     "StepTracer",
     "TraceSink",
+    "built_in_rules",
     "derive_request_metrics",
     "flush_black_box",
+    "get_alert_manager",
+    "get_boot_timeline",
     "get_compile_tracker",
     "get_device_telemetry",
     "get_efficiency_tracker",
     "get_flight_recorder",
+    "get_metrics_history",
     "get_slo_tracker",
     "get_step_tracer",
     "get_trace_sink",

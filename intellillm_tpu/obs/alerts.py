@@ -1,0 +1,597 @@
+"""Declarative alert rules evaluated over the in-process metrics
+history (obs/history.py) — the framework noticing its own degradation
+instead of waiting for an external Prometheus + Alertmanager pair.
+
+Built-in rules (each a small `AlertRule` with pending/firing/resolved
+states, Google SRE Workbook style for the burn rate):
+
+    slo_burn_rate    page  error budget (1 - goodput target,
+                           `INTELLILLM_SLO_GOODPUT_TARGET`) burning
+                           faster than `INTELLILLM_BURN_THRESHOLD`× in
+                           BOTH the fast (`INTELLILLM_BURN_FAST_S`, 5 m)
+                           and slow (`INTELLILLM_BURN_SLOW_S`, 1 h)
+                           windows of the goodput series
+    watchdog_stall   page  the engine stall watchdog has a stall
+                           declared (escalation of /debug/stall)
+    hbm_headroom     page  mean HBM headroom over the fast window below
+                           the device-telemetry warn threshold
+    mfu_collapse     warn  fast-window MFU fell below half the
+                           slow-window MFU (throughput regression with
+                           no config change)
+    compile_storm    warn  XLA compiles climbing after warm-up
+                           (recompile churn burns steps)
+    router_failover  warn  replica failovers observed in the fast
+                           window (router process only — the series is
+                           absent on replicas, so the rule stays
+                           inactive there)
+
+State machine per rule: inactive -> pending (condition held, waiting
+out `for_s`) -> firing -> resolved (condition cleared; kept visible for
+a grace period, then inactive). Exported as the
+`intellillm_alerts{rule,state}` gauge family (1 for the current state)
+plus `intellillm_alert_transitions_total{rule,state}`; served at
+`GET /debug/alerts`; summarized in `/health/detail` where a firing
+page-severity alert flips deep health to "degraded" (HTTP 200 — 503
+stays reserved for watchdog stalls/initialization). An optional
+`INTELLILLM_ALERT_WEBHOOK` URL receives a JSON POST per
+firing/resolved transition with bounded retry/backoff on a daemon
+worker. INTELLILLM_ALERTS=0 disables evaluation entirely.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter, Gauge
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+STATES = ("inactive", "pending", "firing", "resolved")
+_DEFAULT_GOODPUT_TARGET = 0.99
+_DEFAULT_BURN_FAST_S = 300.0
+_DEFAULT_BURN_SLOW_S = 3600.0
+# The SRE Workbook's fast-burn threshold: 14.4x burns a 30-day budget
+# in ~2 days; any sustained burn above it deserves a page.
+_DEFAULT_BURN_THRESHOLD = 14.4
+_RESOLVED_KEEP_S = 600.0
+_WEBHOOK_RETRIES = 3
+_WEBHOOK_BACKOFF_S = 0.5
+_WEBHOOK_QUEUE = 64
+
+
+class _AlertMetrics:
+    """Prometheus collectors for alert state (process-global, built
+    once — same singleton pattern as device telemetry)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.gauge_alerts = Gauge(
+            "intellillm_alerts",
+            "Alert rule state (1 on the current state's child; "
+            "inactive | pending | firing | resolved).",
+            ["rule", "state"])
+        self.counter_transitions = Counter(
+            "intellillm_alert_transitions_total",
+            "Alert state transitions by rule and entered state.",
+            ["rule", "state"])
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+def _env_f(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("Ignoring invalid %s=%r (want a float).", name, raw)
+        return default
+
+
+def _enabled_from_env() -> bool:
+    from intellillm_tpu.utils import parse_env_flag
+    flag = parse_env_flag(os.environ.get("INTELLILLM_ALERTS"))
+    return True if flag is None else flag
+
+
+class AlertRule:
+    """One declarative rule. Subclasses (or instances with an
+    `evaluate_fn`) return (active, value, detail): active None means
+    "no data" — the rule cannot progress toward firing but a firing
+    alert is not resolved by a data gap either."""
+
+    def __init__(self, name: str, severity: str = "warn",
+                 for_s: float = 0.0, description: str = "",
+                 evaluate_fn: Optional[Callable] = None) -> None:
+        assert severity in ("page", "warn"), severity
+        self.name = name
+        self.severity = severity
+        self.for_s = for_s
+        self.description = description
+        self._evaluate_fn = evaluate_fn
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        if self._evaluate_fn is not None:
+            return self._evaluate_fn(history, now)
+        raise NotImplementedError
+
+
+class SLOBurnRateRule(AlertRule):
+    """Multi-window goodput burn rate against the PR 2 SLO objectives.
+
+    error rate = 1 - goodput; budget = 1 - goodput target. The alert
+    requires the burn in BOTH windows to exceed the threshold: the fast
+    window makes it responsive (fires within one evaluation interval of
+    a hard violation), the slow window keeps a brief blip from paging.
+    """
+
+    def __init__(self, goodput_target: Optional[float] = None,
+                 fast_s: Optional[float] = None,
+                 slow_s: Optional[float] = None,
+                 threshold: Optional[float] = None) -> None:
+        self.goodput_target = (
+            goodput_target if goodput_target is not None
+            else min(max(_env_f("INTELLILLM_SLO_GOODPUT_TARGET",
+                                _DEFAULT_GOODPUT_TARGET), 0.0), 0.9999))
+        self.fast_s = (fast_s if fast_s is not None
+                       else _env_f("INTELLILLM_BURN_FAST_S",
+                                   _DEFAULT_BURN_FAST_S))
+        self.slow_s = (slow_s if slow_s is not None
+                       else _env_f("INTELLILLM_BURN_SLOW_S",
+                                   _DEFAULT_BURN_SLOW_S))
+        self.threshold = (threshold if threshold is not None
+                          else _env_f("INTELLILLM_BURN_THRESHOLD",
+                                      _DEFAULT_BURN_THRESHOLD))
+        super().__init__(
+            "slo_burn_rate", severity="page",
+            description=f"SLO goodput error budget (target "
+            f"{self.goodput_target:g}) burning > {self.threshold:g}x in "
+            f"both the {self.fast_s:g}s and {self.slow_s:g}s windows")
+
+    def _burn(self, history, window_s: float,
+              now: float) -> Optional[float]:
+        goodput = history.avg("intellillm_slo_goodput_ratio", window_s,
+                              now=now)
+        if goodput is None:
+            return None
+        budget = max(1.0 - self.goodput_target, 1e-6)
+        return (1.0 - goodput) / budget
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        fast = self._burn(history, self.fast_s, now)
+        slow = self._burn(history, self.slow_s, now)
+        if fast is None or slow is None:
+            return None, None, "no goodput samples yet"
+        active = fast > self.threshold and slow > self.threshold
+        return active, round(fast, 3), (
+            f"burn fast={fast:.1f}x slow={slow:.1f}x "
+            f"(threshold {self.threshold:g}x)")
+
+
+class WatchdogStallRule(AlertRule):
+
+    def __init__(self) -> None:
+        super().__init__(
+            "watchdog_stall", severity="page",
+            description="engine stall watchdog has a stall declared")
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        from intellillm_tpu.obs.watchdog import get_watchdog
+        wd = get_watchdog().snapshot()
+        if not wd.get("enabled"):
+            return None, None, "watchdog disabled"
+        stalled = wd.get("state") == "stalled"
+        return stalled, float(wd.get("stalls_fired") or 0), (
+            f"state={wd.get('state')} "
+            f"last_step_age_s={wd.get('last_step_age_s')}")
+
+
+class HBMHeadroomRule(AlertRule):
+
+    def __init__(self, window_s: Optional[float] = None) -> None:
+        self.window_s = (window_s if window_s is not None
+                         else _env_f("INTELLILLM_BURN_FAST_S",
+                                     _DEFAULT_BURN_FAST_S))
+        super().__init__(
+            "hbm_headroom", severity="page",
+            description="mean HBM headroom below the device-telemetry "
+            "warn threshold (allocator OOM risk)")
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        from intellillm_tpu.obs.device_telemetry import get_device_telemetry
+        headroom = history.avg("intellillm_hbm_headroom_ratio",
+                               self.window_s, now=now)
+        if headroom is None:
+            return None, None, "no HBM samples (CPU backend?)"
+        warn = get_device_telemetry().headroom_warn or 0.0
+        return headroom < warn, round(headroom, 4), (
+            f"headroom {headroom * 100:.1f}% (warn < {warn * 100:.1f}%)")
+
+
+class MFUCollapseRule(AlertRule):
+
+    def __init__(self, fast_s: Optional[float] = None,
+                 slow_s: Optional[float] = None) -> None:
+        self.fast_s = (fast_s if fast_s is not None
+                       else _env_f("INTELLILLM_BURN_FAST_S",
+                                   _DEFAULT_BURN_FAST_S))
+        self.slow_s = (slow_s if slow_s is not None
+                       else _env_f("INTELLILLM_BURN_SLOW_S",
+                                   _DEFAULT_BURN_SLOW_S))
+        super().__init__(
+            "mfu_collapse", severity="warn",
+            description="fast-window MFU fell below half the slow-window "
+            "MFU (hardware-utilization regression)")
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        fast = history.avg("intellillm_mfu", self.fast_s, now=now)
+        slow = history.avg("intellillm_mfu", self.slow_s, now=now)
+        if fast is None or slow is None or slow <= 0.01:
+            return None, None, "no meaningful MFU baseline yet"
+        return fast < 0.5 * slow, round(fast, 4), (
+            f"MFU fast={fast:.3f} vs slow={slow:.3f}")
+
+
+class CompileStormRule(AlertRule):
+
+    def __init__(self, window_s: Optional[float] = None,
+                 max_compiles: float = 8.0) -> None:
+        self.window_s = (window_s if window_s is not None
+                         else _env_f("INTELLILLM_BURN_FAST_S",
+                                     _DEFAULT_BURN_FAST_S))
+        self.max_compiles = max_compiles
+        super().__init__(
+            "compile_storm", severity="warn",
+            description="XLA compiles climbing after warm-up (bucket "
+            "churn is recompiling instead of reusing executables)")
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        delta = history.delta("intellillm_xla_compiles_total",
+                              self.window_s, now=now)
+        if delta is None:
+            return None, None, "not enough compile samples yet"
+        return delta > self.max_compiles, delta, (
+            f"{delta:g} compiles in the last {self.window_s:g}s "
+            f"(threshold > {self.max_compiles:g})")
+
+
+class RouterFailoverRule(AlertRule):
+
+    def __init__(self, window_s: Optional[float] = None) -> None:
+        self.window_s = (window_s if window_s is not None
+                         else _env_f("INTELLILLM_BURN_FAST_S",
+                                     _DEFAULT_BURN_FAST_S))
+        super().__init__(
+            "router_failover", severity="warn",
+            description="replica failovers observed in the fast window "
+            "(router process only)")
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        delta = history.delta("intellillm_router_failovers_total",
+                              self.window_s, now=now)
+        if delta is None:
+            return None, None, "no failover series (not a router?)"
+        return delta > 0, delta, (
+            f"{delta:g} failovers in the last {self.window_s:g}s")
+
+
+def built_in_rules() -> List[AlertRule]:
+    return [SLOBurnRateRule(), WatchdogStallRule(), HBMHeadroomRule(),
+            MFUCollapseRule(), CompileStormRule(), RouterFailoverRule()]
+
+
+class _RuleState:
+    __slots__ = ("state", "since", "value", "detail", "transitions")
+
+    def __init__(self) -> None:
+        self.state = "inactive"
+        self.since: Optional[float] = None
+        self.value: Optional[float] = None
+        self.detail = ""
+        self.transitions = 0
+
+
+class AlertManager:
+    """Evaluates the rule set after every history sample tick and keeps
+    the pending/firing/resolved state machine per rule."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 rules: Optional[List[AlertRule]] = None,
+                 webhook_url: Optional[str] = None,
+                 now_fn: Callable[[], float] = time.monotonic) -> None:
+        self.enabled = (_enabled_from_env() if enabled is None else enabled)
+        self.webhook_url = (webhook_url if webhook_url is not None
+                            else os.environ.get("INTELLILLM_ALERT_WEBHOOK"))
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self.rules: List[AlertRule] = (list(rules) if rules is not None
+                                       else built_in_rules())
+        self._states: Dict[str, _RuleState] = {
+            r.name: _RuleState() for r in self.rules}
+        self._history = None
+        self._webhook_queue: deque = deque(maxlen=_WEBHOOK_QUEUE)
+        self._webhook_worker: Optional[threading.Thread] = None
+        self._webhook_wake = threading.Event()
+        self._webhook_stop = threading.Event()
+        self._webhook_sent = 0
+        self._webhook_failed = 0
+        self._metrics = _AlertMetrics() if _PROMETHEUS else None
+
+    def add_rule(self, rule: AlertRule) -> None:
+        with self._lock:
+            self.rules.append(rule)
+            self._states[rule.name] = _RuleState()
+
+    # --- evaluation -------------------------------------------------------
+
+    def attach(self, history=None) -> None:
+        """Register on the history sampler: rules re-evaluate after
+        every sample tick, so a violation shows up within one
+        evaluation interval."""
+        if not self.enabled:
+            return
+        if history is None:
+            from intellillm_tpu.obs.history import get_metrics_history
+            history = get_metrics_history()
+        self._history = history
+        history.register_listener(self.evaluate_now)
+
+    def evaluate_now(self, now: Optional[float] = None) -> None:
+        if not self.enabled:
+            return
+        history = self._history
+        if history is None:
+            from intellillm_tpu.obs.history import get_metrics_history
+            history = self._history = get_metrics_history()
+        t = self._now() if now is None else now
+        with self._lock:
+            rules = list(self.rules)
+        for rule in rules:
+            try:
+                active, value, detail = rule.evaluate(history, t)
+            except Exception:
+                logger.exception("Alert rule %s failed to evaluate.",
+                                 rule.name)
+                continue
+            self._advance(rule, active, value, detail, t)
+
+    def _advance(self, rule: AlertRule, active: Optional[bool],
+                 value: Optional[float], detail: str, now: float) -> None:
+        events: List[Dict[str, Any]] = []
+        with self._lock:
+            st = self._states[rule.name]
+            st.value = value
+            st.detail = detail
+            since = st.since if st.since is not None else now
+            # Resolved visibility is purely time-based: retire it even
+            # when the rule currently has no data (e.g. the bad samples
+            # aged out of every window).
+            if st.state == "resolved" and not active \
+                    and now - since >= _RESOLVED_KEEP_S:
+                self._transition(rule, st, "inactive", now, events)
+            old = st.state
+            if active:
+                if old in ("inactive", "resolved"):
+                    if rule.for_s > 0:
+                        self._transition(rule, st, "pending", now, events)
+                    else:
+                        self._transition(rule, st, "firing", now, events)
+                elif old == "pending" and now - since >= rule.for_s:
+                    self._transition(rule, st, "firing", now, events)
+            elif active is False:
+                if old == "firing":
+                    self._transition(rule, st, "resolved", now, events)
+                elif old == "pending":
+                    self._transition(rule, st, "inactive", now, events)
+            # active None (no data): hold the current state — a data gap
+            # neither fires nor resolves anything (resolved ages out
+            # above regardless).
+        for event in events:
+            self._notify(event)
+
+    def _transition(self, rule: AlertRule, st: _RuleState, new: str,
+                    now: float, events: List[Dict[str, Any]]) -> None:
+        old = st.state
+        st.state = new
+        st.since = now
+        st.transitions += 1
+        if new in ("firing", "resolved"):
+            log = (logger.warning if new == "firing" else logger.info)
+            log("ALERT %s: %s -> %s (%s) — %s", rule.name, old, new,
+                rule.severity, st.detail)
+            events.append({
+                "rule": rule.name,
+                "severity": rule.severity,
+                "state": new,
+                "previous_state": old,
+                "value": st.value,
+                "detail": st.detail,
+                "description": rule.description,
+                "ts": time.time(),
+            })
+        if self._metrics is not None:
+            for state in STATES:
+                self._metrics.gauge_alerts.labels(rule.name, state).set(
+                    1.0 if state == new else 0.0)
+            self._metrics.counter_transitions.labels(rule.name, new).inc()
+
+    # --- webhook ----------------------------------------------------------
+
+    def _notify(self, event: Dict[str, Any]) -> None:
+        if not self.webhook_url:
+            return
+        with self._lock:
+            self._webhook_queue.append(event)
+        self._start_webhook_worker()
+        self._webhook_wake.set()
+
+    def _start_webhook_worker(self) -> None:
+        with self._lock:
+            if (self._webhook_worker is not None
+                    and self._webhook_worker.is_alive()):
+                return
+            self._webhook_stop.clear()
+            self._webhook_worker = threading.Thread(
+                target=self._webhook_loop,
+                name="intellillm-alert-webhook", daemon=True)
+            self._webhook_worker.start()
+
+    def _webhook_loop(self) -> None:
+        while not self._webhook_stop.is_set():
+            self._webhook_wake.wait(1.0)
+            self._webhook_wake.clear()
+            while True:
+                with self._lock:
+                    if not self._webhook_queue:
+                        break
+                    event = self._webhook_queue.popleft()
+                # Delivery (network + backoff sleeps) stays outside the
+                # lock so it can't stall rule evaluation.
+                delivered = self._deliver(event)
+                with self._lock:
+                    if delivered:
+                        self._webhook_sent += 1
+                    else:
+                        self._webhook_failed += 1
+
+    def _deliver(self, event: Dict[str, Any]) -> bool:
+        """POST one transition, with bounded retry/backoff. Never
+        raises."""
+        payload = json.dumps(event).encode()
+        for attempt in range(_WEBHOOK_RETRIES):
+            try:
+                req = urllib.request.Request(
+                    self.webhook_url, data=payload,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5.0):
+                    return True
+            except Exception as e:
+                if attempt == _WEBHOOK_RETRIES - 1:
+                    logger.warning(
+                        "Alert webhook delivery failed after %d "
+                        "attempts: %s", _WEBHOOK_RETRIES, e)
+                else:
+                    time.sleep(_WEBHOOK_BACKOFF_S * (2 ** attempt))
+        return False
+
+    # --- read side --------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Full rule table for /debug/alerts."""
+        now = self._now()
+        with self._lock:
+            rules: Dict[str, Any] = {}
+            for rule in self.rules:
+                st = self._states[rule.name]
+                rules[rule.name] = {
+                    "state": st.state,
+                    "severity": rule.severity,
+                    "for_s": rule.for_s,
+                    "since_age_s": (round(now - st.since, 3)
+                                    if st.since is not None else None),
+                    "value": st.value,
+                    "detail": st.detail,
+                    "description": rule.description,
+                    "transitions": st.transitions,
+                }
+            firing = sorted(n for n, r in rules.items()
+                            if r["state"] == "firing")
+            pending = sorted(n for n, r in rules.items()
+                             if r["state"] == "pending")
+            counts: Dict[str, int] = {s: 0 for s in STATES}
+            for r in rules.values():
+                counts[r["state"]] += 1
+            webhook_sent = self._webhook_sent
+            webhook_failed = self._webhook_failed
+        return {
+            "enabled": self.enabled,
+            "rules": rules,
+            "firing": firing,
+            "pending": pending,
+            "counts": counts,
+            "page_firing": any(
+                r["state"] == "firing" and r["severity"] == "page"
+                for r in rules.values()),
+            "webhook": {
+                "configured": bool(self.webhook_url),
+                "sent": webhook_sent,
+                "failed": webhook_failed,
+            },
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact block for /health/detail and the router fleet
+        aggregation."""
+        snap = self.snapshot()
+        return {
+            "enabled": snap["enabled"],
+            "firing": snap["firing"],
+            "pending": snap["pending"],
+            "page_firing": snap["page_firing"],
+            "counts": snap["counts"],
+        }
+
+    def page_firing(self) -> bool:
+        with self._lock:
+            for rule in self.rules:
+                if (rule.severity == "page"
+                        and self._states[rule.name].state == "firing"):
+                    return True
+        return False
+
+    def reset_for_testing(self) -> None:
+        self._webhook_stop.set()
+        self._webhook_wake.set()
+        worker = self._webhook_worker
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=2.0)
+        self.__init__()
+
+
+_MANAGER: Optional[AlertManager] = None
+_MANAGER_LOCK = threading.Lock()
+
+
+def get_alert_manager() -> AlertManager:
+    global _MANAGER
+    if _MANAGER is None:
+        with _MANAGER_LOCK:
+            if _MANAGER is None:
+                _MANAGER = AlertManager()
+    return _MANAGER

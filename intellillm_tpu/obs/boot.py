@@ -1,0 +1,69 @@
+"""Boot-phase timing: how long the engine spent loading weights,
+initializing the KV cache, and warming up compilation.
+
+Exposed under the "boot" key of `/health/detail` — groundwork for the
+persistent-compile-cache roadmap item (a warm cache should show up as a
+collapsed warm-up phase). Pure bookkeeping: no collectors, no threads,
+no env vars.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+
+class BootTimeline:
+    """Wall-clock durations of named boot phases for one process."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._phases_s: Dict[str, float] = {}
+        self._started = time.monotonic()
+        self._completed_at: Optional[float] = None
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.record(name, time.monotonic() - t0)
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._phases_s[name] = (
+                self._phases_s.get(name, 0.0) + max(seconds, 0.0))
+
+    def mark_complete(self) -> None:
+        with self._lock:
+            if self._completed_at is None:
+                self._completed_at = time.monotonic()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            total = (self._completed_at - self._started
+                     if self._completed_at is not None else None)
+            return {
+                "phases_s": {k: round(v, 3)
+                             for k, v in self._phases_s.items()},
+                "total_s": round(total, 3) if total is not None else None,
+                "complete": self._completed_at is not None,
+            }
+
+    def reset_for_testing(self) -> None:
+        self.__init__()
+
+
+_TIMELINE: Optional[BootTimeline] = None
+_TIMELINE_LOCK = threading.Lock()
+
+
+def get_boot_timeline() -> BootTimeline:
+    global _TIMELINE
+    if _TIMELINE is None:
+        with _TIMELINE_LOCK:
+            if _TIMELINE is None:
+                _TIMELINE = BootTimeline()
+    return _TIMELINE

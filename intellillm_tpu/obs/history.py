@@ -1,0 +1,491 @@
+"""In-process metrics history: bounded time-series ring buffers.
+
+The obs stack (tracing, SLO, device telemetry, efficiency) exports rich
+point-in-time metrics, but nothing in the process can answer "is this
+replica getting *worse*?" — trend questions previously required an
+external Prometheus scraping /metrics. This module closes that gap
+with a sampler thread that snapshots every registered `intellillm_*`
+gauge/counter (plus python-side fallback collectors, so it degrades to
+CPU-null / no-prometheus environments exactly like device telemetry)
+on an interval (`INTELLILLM_HISTORY_INTERVAL_S`, default 10 s) into
+fixed-size ring buffers with three downsampled tiers:
+
+    raw   one point per sample tick        (default keep 360)
+    1m    60 s bucket averages             (default keep 360 ≈ 6 h)
+    10m   600 s bucket averages            (default keep 288 ≈ 48 h)
+
+Memory is hard-capped: ring sizes are fixed, the series count is capped
+at `INTELLILLM_HISTORY_MAX_SERIES` (default 256; series beyond the cap
+are dropped and counted, never stored), and the estimated footprint is
+exported as `intellillm_history_memory_bytes` next to
+`intellillm_history_series`. Served as JSON at
+`GET /debug/history?metric=...&window=...` on both API servers and the
+router; the alert rule engine (obs/alerts.py) evaluates over it via
+listeners that run after every sample tick.
+
+INTELLILLM_HISTORY=0 disables everything (no sampler thread; record
+hooks become no-ops and /debug/history serves an empty store).
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Gauge
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+_DEFAULT_INTERVAL_S = 10.0
+_DEFAULT_MAX_SERIES = 256
+_RAW_KEEP = 360
+_TIERS: Tuple[Tuple[str, float, int], ...] = (
+    ("1m", 60.0, 360),
+    ("10m", 600.0, 288),
+)
+# Conservative per-point footprint estimate (a (float, float) tuple plus
+# deque slot overhead) used for the exported memory figure and the
+# hard-cap derivation.
+_POINT_BYTES = 120
+_MAX_POINTS_PER_SERIES = _RAW_KEEP + sum(keep for _, _, keep in _TIERS)
+# Minimum finishes in the SLO rolling window before the goodput series
+# is recorded at all (see _builtin_sample).
+_GOODPUT_MIN_WINDOW = 3
+# Series the built-in collector gates (e.g. on minimum traffic): the
+# raw registry scrape must not resurrect them from the exported gauge
+# when the collector deliberately withheld them.
+_COLLECTOR_OWNED = frozenset({"intellillm_slo_goodput_ratio"})
+
+
+class _HistoryMetrics:
+    """Prometheus collectors for the history store itself (process-
+    global, built once — same singleton pattern as device telemetry)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.gauge_series = Gauge(
+            "intellillm_history_series",
+            "Live time-series tracked by the in-process metrics history.")
+        self.gauge_memory = Gauge(
+            "intellillm_history_memory_bytes",
+            "Estimated memory footprint of the in-process metrics "
+            "history ring buffers.")
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+def _env_f(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("Ignoring invalid %s=%r (want a float).", name, raw)
+        return default
+
+
+def _enabled_from_env() -> bool:
+    from intellillm_tpu.utils import parse_env_flag
+    flag = parse_env_flag(os.environ.get("INTELLILLM_HISTORY"))
+    return True if flag is None else flag
+
+
+class _Downsampler:
+    """Fixed-width bucket averager feeding one bounded ring."""
+
+    def __init__(self, bucket_s: float, keep: int) -> None:
+        self.bucket_s = bucket_s
+        self.points: deque = deque(maxlen=keep)
+        self._bucket: Optional[float] = None  # bucket start time
+        self._sum = 0.0
+        self._n = 0
+
+    def add(self, t: float, value: float) -> None:
+        bucket = math.floor(t / self.bucket_s) * self.bucket_s
+        if self._bucket is None:
+            self._bucket = bucket
+        elif bucket != self._bucket:
+            self._flush()
+            self._bucket = bucket
+        self._sum += value
+        self._n += 1
+
+    def _flush(self) -> None:
+        if self._bucket is not None and self._n:
+            self.points.append((self._bucket, self._sum / self._n))
+        self._sum = 0.0
+        self._n = 0
+
+
+class _Series:
+    """One metric's raw ring plus its downsampled tiers."""
+
+    def __init__(self) -> None:
+        self.raw: deque = deque(maxlen=_RAW_KEEP)
+        self.tiers: Dict[str, _Downsampler] = {
+            name: _Downsampler(bucket_s, keep)
+            for name, bucket_s, keep in _TIERS}
+
+    def add(self, t: float, value: float) -> None:
+        self.raw.append((t, value))
+        for tier in self.tiers.values():
+            tier.add(t, value)
+
+    def num_points(self) -> int:
+        return len(self.raw) + sum(len(t.points)
+                                   for t in self.tiers.values())
+
+
+class MetricsHistory:
+    """Process-global bounded time-series store (one per process)."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 interval_s: Optional[float] = None,
+                 max_series: Optional[int] = None,
+                 now_fn: Callable[[], float] = time.monotonic) -> None:
+        self.enabled = (_enabled_from_env() if enabled is None else enabled)
+        self.interval_s = (interval_s if interval_s is not None
+                           else _env_f("INTELLILLM_HISTORY_INTERVAL_S",
+                                       _DEFAULT_INTERVAL_S))
+        self.max_series = (max_series if max_series is not None
+                           else max(int(_env_f(
+                               "INTELLILLM_HISTORY_MAX_SERIES",
+                               _DEFAULT_MAX_SERIES)), 1))
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self._series: Dict[str, _Series] = {}
+        self._dropped_series = 0
+        self._samples_taken = 0
+        self._last_sample: Optional[float] = None
+        self._collectors: List[Callable[[], Dict[str, float]]] = []
+        self._listeners: List[Callable[[float], None]] = []
+        self._sampler: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._metrics = _HistoryMetrics() if _PROMETHEUS else None
+
+    # --- sources ----------------------------------------------------------
+
+    def register_collector(self,
+                           fn: Callable[[], Dict[str, float]]) -> None:
+        """Add a python-side sample source: fn() -> {series_name: value}.
+        Collectors keep history (and alerting) working when
+        prometheus_client is absent or a backend reports nothing."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def register_listener(self, fn: Callable[[float], None]) -> None:
+        """Called with the sample timestamp after every tick (the alert
+        manager evaluates its rules here)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def _scrape_registry(self) -> Dict[str, float]:
+        """Flatten every registered intellillm_ gauge/counter sample into
+        `name{label=value,...}` series keys."""
+        if not _PROMETHEUS:
+            return {}
+        from prometheus_client import REGISTRY
+        out: Dict[str, float] = {}
+        try:
+            families = list(REGISTRY.collect())
+        except Exception:
+            logger.exception("History registry scrape failed.")
+            return out
+        for family in families:
+            if not family.name.startswith("intellillm_"):
+                continue
+            if family.type not in ("gauge", "counter"):
+                continue
+            for sample in family.samples:
+                if sample.name.endswith("_created"):
+                    continue
+                try:
+                    value = float(sample.value)
+                except (TypeError, ValueError):
+                    continue
+                if not math.isfinite(value):
+                    continue
+                key = sample.name
+                if sample.labels:
+                    key += "{" + ",".join(
+                        f"{k}={v}" for k, v in
+                        sorted(sample.labels.items())) + "}"
+                if key in _COLLECTOR_OWNED:
+                    continue
+                out[key] = value
+        return out
+
+    def sample_once(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Take one sample tick: registry scrape + python collectors
+        (collectors win on key collisions, so the aggregate series the
+        alert rules read are backend-independent), then notify
+        listeners. Never raises."""
+        if not self.enabled:
+            return {}
+        t = self._now() if now is None else now
+        values = self._scrape_registry()
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                sampled = fn() or {}
+            except Exception:
+                logger.exception("History collector %r failed.", fn)
+                continue
+            for name, value in sampled.items():
+                try:
+                    value = float(value)
+                except (TypeError, ValueError):
+                    continue
+                if math.isfinite(value):
+                    values[name] = value
+        with self._lock:
+            for name, value in values.items():
+                series = self._series.get(name)
+                if series is None:
+                    if len(self._series) >= self.max_series:
+                        self._dropped_series += 1
+                        continue
+                    series = self._series[name] = _Series()
+                series.add(t, value)
+            self._samples_taken += 1
+            self._last_sample = t
+            num_series = len(self._series)
+            mem = self._memory_bytes_locked()
+            listeners = list(self._listeners)
+        if self._metrics is not None:
+            self._metrics.gauge_series.set(num_series)
+            self._metrics.gauge_memory.set(mem)
+        for fn in listeners:
+            try:
+                fn(t)
+            except Exception:
+                logger.exception("History listener %r failed.", fn)
+        return values
+
+    # --- read side --------------------------------------------------------
+
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def query(self, name: str, window_s: Optional[float] = None,
+              tier: Optional[str] = None,
+              now: Optional[float] = None) -> List[List[float]]:
+        """Points for one series as [[t, value], ...]. The tier is picked
+        by the window (raw while it still covers the window, else the
+        coarsest tier that does), or forced via `tier`."""
+        t = self._now() if now is None else now
+        with self._lock:
+            series = self._series.get(name)
+            if series is None:
+                return []
+            points = self._pick_points_locked(series, window_s, tier)
+            if window_s is not None:
+                cutoff = t - window_s
+                points = [p for p in points if p[0] >= cutoff]
+            return [[round(p[0], 3), p[1]] for p in points]
+
+    def _pick_points_locked(self, series: _Series,
+                            window_s: Optional[float],
+                            tier: Optional[str]) -> List[Tuple[float,
+                                                               float]]:
+        if tier is not None:
+            if tier == "raw":
+                return list(series.raw)
+            ds = series.tiers.get(tier)
+            return list(ds.points) if ds is not None else []
+        if window_s is None or window_s <= _RAW_KEEP * self.interval_s:
+            return list(series.raw)
+        for name, bucket_s, keep in _TIERS:
+            if window_s <= bucket_s * keep:
+                return list(series.tiers[name].points)
+        return list(series.tiers[_TIERS[-1][0]].points)
+
+    def latest(self, name: str) -> Optional[float]:
+        with self._lock:
+            series = self._series.get(name)
+            if series is None or not series.raw:
+                return None
+            return series.raw[-1][1]
+
+    def avg(self, name: str, window_s: float,
+            now: Optional[float] = None) -> Optional[float]:
+        """Mean over the window, or None with no points in it."""
+        points = self.query(name, window_s=window_s, now=now)
+        if not points:
+            return None
+        return sum(p[1] for p in points) / len(points)
+
+    def delta(self, name: str, window_s: float,
+              now: Optional[float] = None) -> Optional[float]:
+        """Increase over the window (for cumulative counters): last
+        value minus first value, clamped at 0 for resets."""
+        points = self.query(name, window_s=window_s, now=now)
+        if len(points) < 2:
+            return None
+        return max(points[-1][1] - points[0][1], 0.0)
+
+    def _memory_bytes_locked(self) -> int:
+        return sum(s.num_points() for s in self._series.values()) \
+            * _POINT_BYTES
+
+    def memory_bytes(self) -> int:
+        with self._lock:
+            return self._memory_bytes_locked()
+
+    def memory_cap_bytes(self) -> int:
+        return self.max_series * _MAX_POINTS_PER_SERIES * _POINT_BYTES
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Cheap status dict for /debug/history and /health/detail."""
+        now = self._now()
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "interval_s": self.interval_s,
+                "series": len(self._series),
+                "max_series": self.max_series,
+                "dropped_series": self._dropped_series,
+                "samples_taken": self._samples_taken,
+                "last_sample_age_s": (round(now - self._last_sample, 3)
+                                      if self._last_sample is not None
+                                      else None),
+                "memory_bytes": self._memory_bytes_locked(),
+                "memory_cap_bytes": self.max_series
+                * _MAX_POINTS_PER_SERIES * _POINT_BYTES,
+                "tiers": {"raw": {"interval_s": self.interval_s,
+                                  "keep": _RAW_KEEP},
+                          **{name: {"bucket_s": bucket_s, "keep": keep}
+                             for name, bucket_s, keep in _TIERS}},
+            }
+
+    # --- sampler lifecycle ------------------------------------------------
+
+    def attach(self, start_sampler: bool = True) -> None:
+        """Engine/router registers itself at init: install the built-in
+        fallback collectors, take an immediate sample, start the daemon
+        sampler."""
+        if not self.enabled:
+            return
+        self.register_collector(_builtin_sample)
+        self.sample_once()
+        if start_sampler:
+            self._start_sampler()
+
+    def configure(self, interval_s: Optional[float] = None,
+                  max_series: Optional[int] = None) -> None:
+        if interval_s is not None:
+            self.interval_s = float(interval_s)
+        if max_series is not None:
+            self.max_series = max(int(max_series), 1)
+        self._wake.set()  # re-sample promptly with the new settings
+
+    def _start_sampler(self) -> None:
+        with self._lock:
+            if self._sampler is not None and self._sampler.is_alive():
+                return
+            self._stop.clear()
+            self._sampler = threading.Thread(
+                target=self._sample_loop,
+                name="intellillm-metrics-history", daemon=True)
+            self._sampler.start()
+
+    def _sample_loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(max(self.interval_s, 0.05))
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.sample_once()
+            except Exception:
+                logger.exception("Metrics history sample failed.")
+
+    def reset_for_testing(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        sampler = self._sampler
+        if sampler is not None and sampler.is_alive():
+            sampler.join(timeout=2.0)
+        self.__init__()
+
+
+def _builtin_sample() -> Dict[str, float]:
+    """Python-side fallback sources: the aggregate series the built-in
+    alert rules read, available with or without prometheus_client (the
+    same CPU-null degradation contract as device telemetry). Names
+    mirror the exported metric families so /debug/history keys are
+    stable across backends."""
+    out: Dict[str, float] = {}
+    from intellillm_tpu.obs.compile_tracker import get_compile_tracker
+    from intellillm_tpu.obs.device_telemetry import get_device_telemetry
+    from intellillm_tpu.obs.efficiency import get_efficiency_tracker
+    from intellillm_tpu.obs.slo import get_slo_tracker
+    from intellillm_tpu.obs.watchdog import get_watchdog
+
+    slo = get_slo_tracker().summary()
+    # Goodput from a near-empty rolling window is statistically nothing:
+    # one slow warm-up request would read as a 100x burn and page. Keep
+    # the series dark until there's a minimum of traffic to judge.
+    if slo.get("goodput_ratio") is not None \
+            and slo.get("window", 0) >= _GOODPUT_MIN_WINDOW:
+        out["intellillm_slo_goodput_ratio"] = slo["goodput_ratio"]
+    headroom = get_device_telemetry().headroom_ratio()
+    if headroom is not None:
+        out["intellillm_hbm_headroom_ratio"] = headroom
+    eff = get_efficiency_tracker().snapshot(top_n=0, include_buckets=False)
+    if eff.get("mfu") is not None:
+        out["intellillm_mfu"] = eff["mfu"]
+    compiles = get_compile_tracker().snapshot()
+    out["intellillm_xla_compiles_total"] = float(
+        sum((compiles.get("compiles") or {}).values()))
+    wd = get_watchdog().snapshot()
+    out["intellillm_engine_stalls_total"] = float(
+        wd.get("stalls_fired") or 0)
+    return out
+
+
+# Built lazily (not at import) so the no-prometheus reload tests can
+# rebuild the module without re-registering collectors.
+_HISTORY: Optional[MetricsHistory] = None
+_HISTORY_LOCK = threading.Lock()
+
+
+def get_metrics_history() -> MetricsHistory:
+    global _HISTORY
+    if _HISTORY is None:
+        with _HISTORY_LOCK:
+            if _HISTORY is None:
+                _HISTORY = MetricsHistory()
+    return _HISTORY

@@ -11,8 +11,13 @@ streams through to a chosen replica on the back side:
                              any in-process replica families)
     GET  /health/detail      aggregated: router decision counters,
                              policy state, per-replica health/load
-                             snapshots, trace/hop summary; 503 when no
-                             healthy replica
+                             snapshots, trace/hop summary, fleet alert
+                             state; 503 when no healthy replica
+    GET  /debug/alerts       the router's own alert rules PLUS a fleet
+                             block aggregating each replica's alert
+                             summary (from its polled /health/detail)
+    GET  /debug/history      router-process metrics history (same
+                             handler as the API servers)
     GET  /debug/trace        recently-completed trace ids + the
                              router's own span traces
     GET  /debug/trace/{id}   the STITCHED fleet trace: router spans
@@ -274,6 +279,40 @@ class Router:
             } if vals else None)
         return out
 
+    def fleet_alerts(self) -> dict:
+        """Fleet-wide alert state: the router process's own rules plus
+        each replica's alert summary as captured by the health poller
+        (replica /health/detail bodies carry an "alerts" block). This is
+        what lets serve_bench --scenario fleet assert "no alerts fired"
+        without scraping every replica itself."""
+        from intellillm_tpu.obs import get_alert_manager
+        own = get_alert_manager().summary()
+        per_replica: Dict[str, Optional[dict]] = {}
+        firing: set = set()
+        pending: set = set()
+        page_firing = bool(own.get("page_firing"))
+        firing.update(own.get("firing") or [])
+        pending.update(own.get("pending") or [])
+        for rid, replica in self.manager.replicas.items():
+            summary = (replica.last_health or {}).get("alerts")
+            per_replica[rid] = summary
+            if not summary:
+                continue
+            firing.update(summary.get("firing") or [])
+            pending.update(summary.get("pending") or [])
+            page_firing = page_firing or bool(summary.get("page_firing"))
+        return {
+            "router": own,
+            "replicas": per_replica,
+            "fleet": {
+                "rules_firing": sorted(firing),
+                "rules_pending": sorted(pending),
+                "firing_total": len(firing),
+                "page_firing": page_firing,
+                "clean": not firing and not pending,
+            },
+        }
+
     def snapshot(self) -> dict:
         healthy = [rid for rid, r in self.manager.replicas.items()
                    if r.healthy]
@@ -283,6 +322,7 @@ class Router:
             "decisions": dict(self.decisions),
             "affinity_entries": len(self.policy.affinity),
             "tracing": self._trace_summary(),
+            "alerts": self.fleet_alerts(),
             "config": {
                 "block_size": self.config.block_size,
                 "affinity_blocks": self.config.affinity_blocks,
@@ -296,7 +336,8 @@ class Router:
 
 
 def build_router_app(router: Router) -> web.Application:
-    from intellillm_tpu.entrypoints.debug_routes import metrics
+    from intellillm_tpu.entrypoints.debug_routes import (debug_history,
+                                                         metrics)
 
     async def health(request: web.Request) -> web.Response:
         ok = any(r.healthy for r in router.manager.replicas.values())
@@ -361,6 +402,15 @@ def build_router_app(router: Router) -> web.Application:
             "recent_finished": router.recorder.recent_finished(limit),
         })
 
+    async def debug_alerts(request: web.Request) -> web.Response:
+        """The engine handler's body plus the fleet aggregation."""
+        from intellillm_tpu.obs import get_alert_manager
+        body = get_alert_manager().snapshot()
+        fleet = router.fleet_alerts()
+        body["fleet"] = fleet["fleet"]
+        body["replicas"] = fleet["replicas"]
+        return web.json_response(body)
+
     async def debug_trace_stitched(request: web.Request) -> web.Response:
         trace_id = request.match_info["trace_id"]
         stitched = await router.stitched_trace(trace_id)
@@ -378,9 +428,21 @@ def build_router_app(router: Router) -> web.Application:
     app.router.add_get("/health/detail", health_detail)
     app.router.add_get("/debug/trace", debug_trace_list)
     app.router.add_get("/debug/trace/{trace_id}", debug_trace_stitched)
+    app.router.add_get("/debug/history", debug_history)
+    app.router.add_get("/debug/alerts", debug_alerts)
 
     async def _start(app: web.Application) -> None:
         router.manager.start_polling()
+        # Metrics history + alerts in the ROUTER process too: the
+        # failover counter feeds the router_failover rule; attach order
+        # (listener first) means rules evaluate on the first sample.
+        from intellillm_tpu.obs import get_alert_manager, get_metrics_history
+        history = get_metrics_history()
+        history.register_collector(lambda: {
+            "intellillm_router_failovers_total":
+                float(router.decisions.get("failover", 0))})
+        get_alert_manager().attach(history)
+        history.attach()
 
     async def _cleanup(app: web.Application) -> None:
         await router.stop()

@@ -4,10 +4,13 @@
                                        [--interval 2.0] [--once]
                                        [--api-key KEY]
 
-Polls `GET /health/detail` and `GET /metrics` and renders per-device HBM
-bars, the memory ledger, swap traffic, queue depths, KV-cache usage,
-goodput/SLO percentiles, and the compute-efficiency panel (MFU, pad%,
-per-axis bucket fill, top-waste bucket). Curses-free: each frame clears the screen with
+Polls `GET /health/detail`, `GET /metrics`, `GET /debug/alerts`, and
+`GET /debug/history` and renders per-device HBM bars, the memory
+ledger, swap traffic, queue depths, KV-cache usage, goodput/SLO
+percentiles with a goodput history sparkline, the ALERTS panel
+(pending/firing rules, fleet aggregation when pointed at a router), and
+the compute-efficiency panel (MFU, pad%, per-axis bucket fill,
+top-waste bucket). Curses-free: each frame clears the screen with
 ANSI escapes, so it works over any dumb tty / kubectl exec. `--once`
 prints a single frame and exits (scriptable health check).
 
@@ -139,9 +142,58 @@ def _slowest_lines(slowest: List[Dict[str, Any]],
     return lines
 
 
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(points: List[List[float]], width: int = 40) -> str:
+    """Unicode sparkline over [[t, v], ...] points, newest right."""
+    values = [p[1] for p in points if isinstance(p[1], (int, float))]
+    if not values:
+        return ""
+    values = values[-width:]
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    out = []
+    for v in values:
+        idx = (int((v - lo) / span * (len(_SPARK_CHARS) - 1))
+               if span > 0 else 0)
+        out.append(_SPARK_CHARS[idx])
+    return "".join(out)
+
+
+def _alerts_lines(alerts: Optional[Dict[str, Any]]) -> List[str]:
+    """ALERTS panel from /debug/alerts. Works for both the engine shape
+    (rules table) and the router shape (rules table + "fleet" block)."""
+    if not alerts:
+        return []
+    lines = ["", "Alerts:"]
+    rules = alerts.get("rules") or {}
+    active = {name: r for name, r in sorted(rules.items())
+              if (r or {}).get("state") not in (None, "inactive")}
+    if not active:
+        lines.append("  all clear"
+                     if alerts.get("enabled", True) else "  disabled")
+    for name, rule in active.items():
+        state = rule.get("state", "?").upper()
+        flag = " **" if (rule.get("state") == "firing"
+                         and rule.get("severity") == "page") else ""
+        lines.append(f"  {state:<8} {name:<18} [{rule.get('severity')}] "
+                     f"{rule.get('detail') or ''}{flag}")
+    fleet = alerts.get("fleet")
+    if fleet:
+        firing = fleet.get("rules_firing") or []
+        lines.append(
+            f"  fleet: {'CLEAN' if fleet.get('clean') else 'ACTIVE'}  "
+            f"firing={','.join(firing) if firing else 'none'}  "
+            f"page={'yes' if fleet.get('page_firing') else 'no'}")
+    return lines
+
+
 def render_frame(health: Optional[Dict[str, Any]],
                  metrics: Dict[str, List[Tuple[Dict[str, str], float]]],
-                 base: str) -> str:
+                 base: str,
+                 alerts: Optional[Dict[str, Any]] = None,
+                 history: Optional[Dict[str, Any]] = None) -> str:
     lines: List[str] = []
     now = time.strftime("%H:%M:%S")
     if health is None:
@@ -218,6 +270,12 @@ def render_frame(health: Optional[Dict[str, Any]],
                 f"{hop}={stats.get('p50', 'n/a')}"
                 for hop, stats in sorted(hops.items())))
 
+    spark = _sparkline((history or {}).get("points") or [])
+    if spark:
+        lines.append(f"Goodput history: {spark}")
+
+    lines.extend(_alerts_lines(alerts))
+
     lines.extend(_slowest_lines(slo.get("slowest") or []))
 
     lines.extend(_efficiency_lines(health.get("efficiency") or {}))
@@ -285,7 +343,15 @@ def run_once(base: str, api_key: Optional[str] = None,
              timeout: float = 5.0) -> str:
     health = fetch_json(f"{base}/health/detail", timeout, api_key)
     metrics = fetch_metrics(f"{base}/metrics", timeout, api_key)
-    return render_frame(health, metrics, base)
+    alerts = fetch_json(f"{base}/debug/alerts", timeout, api_key)
+    history = fetch_json(
+        f"{base}/debug/history"
+        "?metric=intellillm_slo_goodput_ratio&window=1h",
+        timeout, api_key)
+    # A 404 body (no goodput samples yet) has no "points" — treated as
+    # an empty sparkline by render_frame.
+    return render_frame(health, metrics, base, alerts=alerts,
+                        history=history)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

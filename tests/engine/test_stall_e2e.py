@@ -14,7 +14,8 @@ from aiohttp.test_utils import TestClient, TestServer
 
 from intellillm_tpu import LLM, SamplingParams
 from intellillm_tpu.entrypoints.debug_routes import add_debug_routes
-from intellillm_tpu.obs import (get_compile_tracker, get_flight_recorder,
+from intellillm_tpu.obs import (get_alert_manager, get_compile_tracker,
+                                get_flight_recorder, get_metrics_history,
                                 get_slo_tracker, get_watchdog)
 
 
@@ -38,6 +39,11 @@ def _get(app, *paths):
 def test_wedged_dispatch_fires_watchdog_and_health_detail(tiny_opt_dir):
     get_flight_recorder().reset_for_testing()
     get_slo_tracker().reset_for_testing()
+    # /health/detail now consults the alert manager over the history
+    # store: stale goodput points from earlier engine tests would read
+    # as an SLO burn and report "degraded" where this test needs "ok".
+    get_metrics_history().reset_for_testing()
+    get_alert_manager().reset_for_testing()
     wd = get_watchdog()
     # Fresh watchdog BEFORE the engine builds: warm-up compiles run under
     # the default 300s dispatch threshold and must not trip anything.

@@ -1,0 +1,129 @@
+"""CPU end-to-end alert flow: a forced SLO violation flows through the
+real SLO tracker into the singleton history store, the burn-rate rule
+fires on the next sample tick, and the firing page is visible on every
+surface — /debug/alerts, /health/detail (reports "degraded" but stays
+HTTP 200), and the intellillm_alerts metric — then recovery flips it to
+resolved and health back to "ok"."""
+import asyncio
+import time
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from intellillm_tpu.entrypoints import api_server as demo_server
+from intellillm_tpu.obs import (get_alert_manager, get_metrics_history,
+                                get_slo_tracker)
+
+
+def _run(app, scenario):
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await scenario(client)
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+class _FakeScheduler:
+    waiting = ()
+    running = ()
+    swapped = ()
+
+
+class _FakeSyncEngine:
+    scheduler = _FakeScheduler()
+
+    def kv_cache_usage(self):
+        return {"device": 0.0}
+
+
+class _FakeAsyncEngine:
+    engine = _FakeSyncEngine()
+
+
+def _observe(tracker, ttft_s, n=1):
+    for _ in range(n):
+        tracker.observe({"queue_wait_s": 0.01, "ttft_s": ttft_s,
+                         "tpot_s": 0.005, "e2e_s": 0.5,
+                         "generation_tokens": 8, "preemptions": {},
+                         "reason": "stop"})
+
+
+def test_slo_violation_fires_page_alert_end_to_end(monkeypatch):
+    # Sub-second burn windows so recovery can age the bad sample out of
+    # the fast window inside the test instead of waiting five minutes.
+    monkeypatch.setenv("INTELLILLM_BURN_FAST_S", "0.2")
+    monkeypatch.setenv("INTELLILLM_BURN_SLOW_S", "0.4")
+    tracker = get_slo_tracker()
+    history = get_metrics_history()
+    manager = get_alert_manager()
+    tracker.reset_for_testing()
+    history.reset_for_testing()
+    manager.reset_for_testing()  # re-reads the burn-window env knobs
+    # Only the built-in collectors feed the store: gauges left in the
+    # live prometheus registry by other tests must not leak in.
+    monkeypatch.setattr(history, "_scrape_registry", lambda: {})
+    monkeypatch.setattr(demo_server, "engine", _FakeAsyncEngine())
+    try:
+        # Every finish blows a 100ms TTFT SLO: goodput 0.0 against the
+        # 0.99 target is a 100x burn in both windows.
+        tracker.configure(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        _observe(tracker, ttft_s=0.5, n=4)
+        # Engine wiring order: listener first, so the rule set is
+        # evaluated on attach()'s immediate first sample — the page must
+        # fire within ONE evaluation interval of the violation.
+        manager.attach(history)
+        history.attach(start_sampler=False)
+
+        async def firing(client):
+            resp = await client.get("/debug/alerts")
+            assert resp.status == 200
+            data = await resp.json()
+            assert "slo_burn_rate" in data["firing"]
+            assert data["page_firing"] is True
+            rule = data["rules"]["slo_burn_rate"]
+            assert rule["state"] == "firing"
+            assert "burn fast=" in rule["detail"]
+
+            resp = await client.get("/health/detail")
+            assert resp.status == 200  # degraded, NOT an outage: a 503
+            data = await resp.json()   # would have the LB amplify it
+            assert data["status"] == "degraded"
+            assert data["alerts"]["page_firing"] is True
+            assert "slo_burn_rate" in data["alerts"]["firing"]
+
+            resp = await client.get("/metrics")
+            if resp.status == 200:     # 501 without prometheus_client
+                body = await resp.text()
+                assert ('intellillm_alerts{rule="slo_burn_rate",'
+                        'state="firing"} 1.0') in body
+
+        _run(demo_server.build_app(), firing)
+
+        # Recovery: healthy finishes only, and the violating sample ages
+        # out of both burn windows before the next tick.
+        tracker.reset_for_testing()
+        tracker.configure(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+        _observe(tracker, ttft_s=0.05, n=4)
+        time.sleep(0.5)
+        history.sample_once()  # listener re-evaluates the rules
+
+        async def resolved(client):
+            resp = await client.get("/debug/alerts")
+            data = await resp.json()
+            assert data["rules"]["slo_burn_rate"]["state"] == "resolved"
+            assert data["firing"] == []
+            assert data["page_firing"] is False
+
+            resp = await client.get("/health/detail")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["status"] == "ok"
+            assert data["alerts"]["firing"] == []
+
+        _run(demo_server.build_app(), resolved)
+    finally:
+        tracker.reset_for_testing()
+        history.reset_for_testing()
+        manager.reset_for_testing()

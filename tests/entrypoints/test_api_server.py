@@ -144,6 +144,9 @@ def test_top_renders_one_frame(api_server):
     assert "Memory ledger" in frame
     assert "params" in frame and "kv_pool" in frame
     assert "UNREACHABLE" not in frame
+    # The ALERTS panel renders from /debug/alerts ("all clear" when no
+    # rule is pending/firing; the rule table when one is).
+    assert "Alerts:" in frame
 
     # The module entry point end-to-end (imports the heavy package, so
     # give it a generous timeout on cold CPU).

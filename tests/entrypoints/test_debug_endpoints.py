@@ -9,7 +9,8 @@ from aiohttp.test_utils import TestClient, TestServer
 from intellillm_tpu.engine.metrics import _Metrics, _PROMETHEUS
 from intellillm_tpu.entrypoints import api_server as demo_server
 from intellillm_tpu.entrypoints.openai import api_server as openai_server
-from intellillm_tpu.obs import (get_flight_recorder, get_slo_tracker,
+from intellillm_tpu.obs import (get_alert_manager, get_flight_recorder,
+                                get_metrics_history, get_slo_tracker,
                                 get_watchdog)
 
 
@@ -199,6 +200,77 @@ def test_both_servers_serve_metrics_from_shared_handler():
 
     _run(demo_server.build_app(), scenario)
     _run(openai_server.build_app(), scenario)
+
+
+def test_history_and_alerts_endpoints_on_both_servers(monkeypatch):
+    """/debug/history serves the store snapshot, per-series points with
+    window parsing (and 404/400 on bad input); /debug/alerts serves the
+    rule table; /health/detail carries the alert summary + boot block.
+    Both servers share the handlers via debug_routes."""
+    history = get_metrics_history()
+    manager = get_alert_manager()
+    history.reset_for_testing()
+    manager.reset_for_testing()
+    # Isolate from gauges other tests left in the live prometheus
+    # registry (a stale goodput value would trip the burn-rate rule).
+    monkeypatch.setattr(history, "_scrape_registry", lambda: {})
+    history.register_collector(
+        lambda: {"intellillm_test_endpoint_gauge": 0.25})
+    history.sample_once()
+    manager.attach(history)
+    manager.evaluate_now()
+    try:
+        async def scenario(client):
+            resp = await client.get("/debug/history")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["enabled"] is True
+            assert "intellillm_test_endpoint_gauge" in data["series"]
+            assert data["memory_bytes"] <= data["memory_cap_bytes"]
+
+            resp = await client.get(
+                "/debug/history",
+                params={"metric": "intellillm_test_endpoint_gauge",
+                        "window": "5m"})
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["window_s"] == 300.0
+            assert [p[1] for p in data["points"]] == [0.25]
+
+            resp = await client.get(
+                "/debug/history", params={"metric": "intellillm_nope"})
+            assert resp.status == 404
+
+            resp = await client.get(
+                "/debug/history",
+                params={"metric": "intellillm_test_endpoint_gauge",
+                        "window": "soon"})
+            assert resp.status == 400
+
+            resp = await client.get("/debug/alerts")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["enabled"] is True
+            assert "slo_burn_rate" in data["rules"]
+            assert data["rules"]["slo_burn_rate"]["state"] in (
+                "inactive", "pending", "firing", "resolved")
+            assert data["firing"] == []
+            assert data["page_firing"] is False
+
+            # No engine behind the test app: 503 "initializing", but the
+            # alert summary and boot timeline ride along already.
+            resp = await client.get("/health/detail")
+            assert resp.status == 503
+            data = await resp.json()
+            assert data["alerts"]["page_firing"] is False
+            assert "firing" in data["alerts"]
+            assert "phases_s" in data["boot"]
+
+        _run(demo_server.build_app(), scenario)
+        _run(openai_server.build_app(), scenario)
+    finally:
+        history.reset_for_testing()
+        manager.reset_for_testing()
 
 
 def test_demo_server_has_debug_routes():

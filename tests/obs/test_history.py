@@ -1,0 +1,183 @@
+"""Unit tests for obs/history.py: fake-clock sampling into the
+raw/1m/10m tiers, window queries and tier auto-selection, the
+series-count cap, the memory cap under a 10k-sample soak, listener
+dispatch, and the disabled store's no-op contract."""
+import threading
+
+import pytest
+
+from intellillm_tpu.obs.history import (_MAX_POINTS_PER_SERIES,
+                                        _POINT_BYTES, _RAW_KEEP,
+                                        MetricsHistory)
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _store(clock, **kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("interval_s", 10.0)
+    return MetricsHistory(now_fn=clock, **kw)
+
+
+def test_sample_collectors_feed_raw_and_tiers():
+    clock = _Clock()
+    h = _store(clock)
+    vals = {"g": 1.0}
+    h.register_collector(lambda: {"intellillm_test_gauge": vals["g"]})
+    for i in range(12):  # two minutes at 10s
+        clock.t = i * 10.0
+        vals["g"] = float(i)
+        h.sample_once()
+    assert "intellillm_test_gauge" in h.series_names()
+    raw = h.query("intellillm_test_gauge", tier="raw")
+    assert len(raw) == 12
+    assert raw[-1] == [110.0, 11.0]
+    # 1m tier: bucket [0, 60) flushed once bucket [60, 120) opened —
+    # its mean is avg(0..5) = 2.5.
+    one_m = h.query("intellillm_test_gauge", tier="1m")
+    assert one_m == [[0.0, 2.5]]
+    assert h.latest("intellillm_test_gauge") == 11.0
+
+
+def test_window_query_avg_delta():
+    clock = _Clock()
+    h = _store(clock)
+    series = {}
+    h.register_collector(lambda: dict(series))
+    for i in range(10):
+        clock.t = i * 10.0
+        series["intellillm_test_counter"] = float(i * 5)
+        h.sample_once()
+    # Window of 30s from t=90 keeps t in [60, 90].
+    pts = h.query("intellillm_test_counter", window_s=30.0)
+    assert [p[0] for p in pts] == [60.0, 70.0, 80.0, 90.0]
+    assert h.avg("intellillm_test_counter", 30.0) == pytest.approx(37.5)
+    assert h.delta("intellillm_test_counter", 30.0) == pytest.approx(15.0)
+    # Unknown series: empty result, None aggregates.
+    assert h.query("intellillm_nope", window_s=30.0) == []
+    assert h.avg("intellillm_nope", 30.0) is None
+    assert h.delta("intellillm_nope", 30.0) is None
+
+
+def test_counter_reset_clamps_delta_at_zero():
+    clock = _Clock()
+    h = _store(clock)
+    series = {"intellillm_test_counter": 100.0}
+    h.register_collector(lambda: dict(series))
+    h.sample_once()
+    clock.t = 10.0
+    series["intellillm_test_counter"] = 3.0  # process restart
+    h.sample_once()
+    assert h.delta("intellillm_test_counter", 60.0) == 0.0
+
+
+def test_tier_autoselection_by_window():
+    clock = _Clock()
+    h = _store(clock)
+    h.register_collector(lambda: {"intellillm_test_gauge": 1.0})
+    for i in range(50):
+        clock.t = i * 10.0
+        h.sample_once()
+    # Raw covers 360 * 10s = 1h: a 10-minute window stays raw.
+    assert len(h.query("intellillm_test_gauge", window_s=600.0)) == 50
+    # A 2h window exceeds raw coverage -> 1m tier (fewer, bucketed
+    # points, each on a 60s boundary).
+    coarse = h.query("intellillm_test_gauge", window_s=7200.0)
+    assert coarse
+    assert all(p[0] % 60.0 == 0.0 for p in coarse)
+    assert len(coarse) < 50
+
+
+def test_max_series_cap_drops_and_counts(monkeypatch):
+    clock = _Clock()
+    h = _store(clock, max_series=3)
+    # Isolate from whatever intellillm_ collectors other tests left in
+    # the live prometheus registry — counts must be deterministic.
+    monkeypatch.setattr(h, "_scrape_registry", lambda: {})
+    h.register_collector(lambda: {
+        f"intellillm_test_{i}": float(i) for i in range(8)})
+    h.sample_once()
+    assert len(h.series_names()) == 3
+    snap = h.snapshot()
+    assert snap["series"] == 3
+    assert snap["dropped_series"] == 5
+
+
+def test_soak_10k_samples_stays_under_memory_cap(monkeypatch):
+    clock = _Clock()
+    h = _store(clock, max_series=8)
+    monkeypatch.setattr(h, "_scrape_registry", lambda: {})
+    h.register_collector(lambda: {
+        f"intellillm_test_{i}": clock.t * (i + 1) for i in range(8)})
+    for i in range(10_000):
+        clock.t = i * 10.0
+        h.sample_once()
+    assert h.memory_bytes() <= h.memory_cap_bytes()
+    assert h.memory_cap_bytes() == 8 * _MAX_POINTS_PER_SERIES * _POINT_BYTES
+    for name in h.series_names():
+        assert len(h.query(name, tier="raw")) == _RAW_KEEP
+    snap = h.snapshot()
+    assert snap["samples_taken"] == 10_000
+    assert snap["memory_bytes"] <= snap["memory_cap_bytes"]
+
+
+def test_listeners_get_timestamp_and_errors_are_contained():
+    clock = _Clock(5.0)
+    h = _store(clock)
+    seen = []
+
+    def boom(t):
+        raise RuntimeError("listener bug")
+
+    h.register_listener(boom)
+    h.register_listener(seen.append)
+    h.register_collector(lambda: {"intellillm_test_gauge": 1.0})
+    h.sample_once()
+    assert seen == [5.0]
+
+
+def test_collector_failure_does_not_kill_the_tick():
+    clock = _Clock()
+    h = _store(clock)
+
+    def bad():
+        raise RuntimeError("collector bug")
+
+    h.register_collector(bad)
+    h.register_collector(lambda: {"intellillm_test_gauge": 2.0,
+                                  "intellillm_test_nan": float("nan")})
+    h.sample_once()
+    assert h.latest("intellillm_test_gauge") == 2.0
+    # Non-finite values are skipped, not stored.
+    assert "intellillm_test_nan" not in h.series_names()
+
+
+def test_disabled_store_is_a_noop():
+    clock = _Clock()
+    h = _store(clock, enabled=False)
+    h.register_collector(lambda: {"intellillm_test_gauge": 1.0})
+    assert h.sample_once() == {}
+    assert h.series_names() == []
+    snap = h.snapshot()
+    assert snap["enabled"] is False
+    assert snap["samples_taken"] == 0
+    h.attach()  # must not start a sampler thread
+    assert h._sampler is None
+
+
+def test_sampler_thread_lifecycle():
+    h = MetricsHistory(enabled=True, interval_s=0.01)
+    h.register_collector(lambda: {"intellillm_test_gauge": 1.0})
+    h.attach(start_sampler=True)
+    evt = threading.Event()
+    h.register_listener(lambda t: evt.set())
+    assert evt.wait(timeout=5.0)
+    assert h._sampler is not None and h._sampler.is_alive()
+    h.reset_for_testing()
+    assert h._sampler is None

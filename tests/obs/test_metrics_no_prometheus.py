@@ -8,8 +8,10 @@ import sys
 import pytest
 
 import intellillm_tpu.engine.metrics as metrics_mod
+import intellillm_tpu.obs.alerts as alerts_mod
 import intellillm_tpu.obs.device_telemetry as devtel_mod
 import intellillm_tpu.obs.efficiency as eff_mod
+import intellillm_tpu.obs.history as history_mod
 import intellillm_tpu.obs.slo as slo_mod
 import intellillm_tpu.obs.watchdog as watchdog_mod
 
@@ -310,6 +312,75 @@ def test_efficiency_without_prometheus(monkeypatch):
         restored = importlib.reload(eff_mod)
         assert restored._PROMETHEUS is True
         restored._EfficiencyMetrics.reset_for_testing()
+
+
+def test_history_without_prometheus(monkeypatch):
+    """The history store must sample, tier, and answer window queries
+    with prometheus_client absent (the registry scrape just yields
+    nothing; collectors still feed the rings that back /debug/history)."""
+    history_mod._HistoryMetrics.reset_for_testing()
+    monkeypatch.setitem(sys.modules, "prometheus_client", None)
+    try:
+        reloaded = importlib.reload(history_mod)
+        assert reloaded._PROMETHEUS is False
+
+        clock = {"t": 0.0}
+        h = reloaded.MetricsHistory(enabled=True, interval_s=10.0,
+                                    now_fn=lambda: clock["t"])
+        assert h._metrics is None
+        series = {}
+        h.register_collector(lambda: dict(series))
+        for i in range(12):
+            clock["t"] = i * 10.0
+            series["intellillm_test_gauge"] = float(i)
+            h.sample_once()
+        assert h.latest("intellillm_test_gauge") == 11.0
+        assert len(h.query("intellillm_test_gauge", tier="raw")) == 12
+        assert h.query("intellillm_test_gauge", tier="1m")
+        assert h.avg("intellillm_test_gauge", 30.0) == pytest.approx(9.5)
+        snap = h.snapshot()
+        assert snap["series"] == 1
+        assert snap["memory_bytes"] <= snap["memory_cap_bytes"]
+    finally:
+        monkeypatch.undo()
+        restored = importlib.reload(history_mod)
+        assert restored._PROMETHEUS is True
+        restored._HistoryMetrics.reset_for_testing()
+
+
+def test_alerts_without_prometheus(monkeypatch):
+    """The full pending/firing/resolved cycle must run — snapshot,
+    summary, page flag — without the intellillm_alerts gauge."""
+    alerts_mod._AlertMetrics.reset_for_testing()
+    monkeypatch.setitem(sys.modules, "prometheus_client", None)
+    try:
+        reloaded = importlib.reload(alerts_mod)
+        assert reloaded._PROMETHEUS is False
+
+        clock = {"t": 0.0}
+        flag = {"active": True}
+        rule = reloaded.AlertRule(
+            "test_rule", severity="page",
+            evaluate_fn=lambda h, now: (flag["active"], 1.0, "d"))
+        manager = reloaded.AlertManager(enabled=True, rules=[rule],
+                                        webhook_url="",
+                                        now_fn=lambda: clock["t"])
+        assert manager._metrics is None
+        manager.evaluate_now()
+        snap = manager.snapshot()
+        assert snap["rules"]["test_rule"]["state"] == "firing"
+        assert manager.page_firing() is True
+        flag["active"] = False
+        clock["t"] = 10.0
+        manager.evaluate_now()
+        assert manager.snapshot()["rules"]["test_rule"]["state"] \
+            == "resolved"
+        assert manager.summary()["page_firing"] is False
+    finally:
+        monkeypatch.undo()
+        restored = importlib.reload(alerts_mod)
+        assert restored._PROMETHEUS is True
+        restored._AlertMetrics.reset_for_testing()
 
 
 def test_spec_acceptance_rate_optional():

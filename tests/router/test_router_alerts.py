@@ -1,0 +1,104 @@
+"""Fleet alert aggregation: the router unions its own alert summary
+with whatever each replica's health poller captured (replica
+/health/detail bodies carry an "alerts" block), and serves the result
+on /debug/alerts and inside its snapshot — no engines, no real HTTP
+polling."""
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from intellillm_tpu.obs import get_alert_manager
+from intellillm_tpu.router.policy import RouterConfig
+from intellillm_tpu.router.replica import Replica, ReplicaManager
+from intellillm_tpu.router.server import Router, build_router_app
+
+
+@pytest.fixture(autouse=True)
+def _quiet_router_manager(monkeypatch):
+    """Pin the router-process singleton to disabled for these tests:
+    engine tests earlier in the run may have left the shared history
+    sampler feeding it, and a rule re-firing mid-test would pollute the
+    fleet union (which is what's under test here)."""
+    monkeypatch.setenv("INTELLILLM_ALERTS", "0")
+    manager = get_alert_manager()
+    manager.reset_for_testing()
+    yield
+    monkeypatch.undo()
+    manager.reset_for_testing()
+
+
+def _router():
+    mgr = ReplicaManager()
+    mgr.add(Replica("r0"), healthy=True)
+    mgr.add(Replica("r1"), healthy=True)
+    return Router(RouterConfig(), mgr)
+
+
+def _run(app, scenario):
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await scenario(client)
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+def test_fleet_alerts_clean_when_nothing_reported():
+    router = _router()
+    fa = router.fleet_alerts()
+    assert fa["fleet"]["clean"] is True
+    assert fa["fleet"]["rules_firing"] == []
+    assert fa["fleet"]["page_firing"] is False
+    # Replicas never polled yet: summary slot exists but is empty.
+    assert set(fa["replicas"]) == {"r0", "r1"}
+    assert fa["replicas"]["r0"] is None
+
+
+def test_fleet_alerts_union_replica_summaries():
+    router = _router()
+    router.manager.replicas["r0"].last_health = {"alerts": {
+        "enabled": True, "firing": ["slo_burn_rate"], "pending": [],
+        "page_firing": True, "counts": {"firing": 1}}}
+    router.manager.replicas["r1"].last_health = {"alerts": {
+        "enabled": True, "firing": [], "pending": ["mfu_collapse"],
+        "page_firing": False, "counts": {"pending": 1}}}
+    fa = router.fleet_alerts()
+    assert fa["fleet"]["rules_firing"] == ["slo_burn_rate"]
+    assert fa["fleet"]["rules_pending"] == ["mfu_collapse"]
+    assert fa["fleet"]["firing_total"] == 1
+    assert fa["fleet"]["page_firing"] is True
+    assert fa["fleet"]["clean"] is False
+    assert fa["replicas"]["r0"]["firing"] == ["slo_burn_rate"]
+    # The aggregate also rides inside the router snapshot that backs
+    # the router's /health/detail.
+    snap = router.snapshot()
+    assert snap["alerts"]["fleet"]["rules_firing"] == ["slo_burn_rate"]
+
+
+def test_router_debug_alerts_endpoint_serves_fleet_view():
+    router = _router()
+    router.manager.replicas["r1"].last_health = {"alerts": {
+        "enabled": True, "firing": ["hbm_headroom"], "pending": [],
+        "page_firing": True, "counts": {"firing": 1}}}
+
+    async def scenario(client):
+        resp = await client.get("/debug/alerts")
+        assert resp.status == 200
+        data = await resp.json()
+        # Router-process rule table plus the fleet aggregate.
+        assert "rules" in data
+        assert data["fleet"]["rules_firing"] == ["hbm_headroom"]
+        assert data["fleet"]["page_firing"] is True
+        assert data["replicas"]["r1"]["firing"] == ["hbm_headroom"]
+        assert data["replicas"]["r0"] is None
+
+        resp = await client.get("/health/detail")
+        assert resp.status == 200
+        data = await resp.json()
+        fleet = data["router"]["alerts"]["fleet"]
+        assert fleet["rules_firing"] == ["hbm_headroom"]
+
+    _run(build_router_app(router), scenario)

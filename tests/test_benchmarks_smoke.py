@@ -100,6 +100,11 @@ def test_serve_bench_fleet_smoke():
     assert sum(router["requests_total"].values()) >= 12
     assert sum(router["decisions"].values()) >= 12
     assert all(v == 1.0 for v in router["replica_healthy"].values())
+    # Fleet-aggregated alert state from the router's /debug/alerts: the
+    # bench asserts "no page fired" the same way an operator would.
+    alerts = summary["alerts"]
+    assert alerts["fleet_aggregated"] is True
+    assert alerts["page_firing"] is False
 
 
 def test_sp_prefill_bench_smoke():
