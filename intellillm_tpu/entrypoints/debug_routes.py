@@ -47,6 +47,7 @@ behind the same --api-key auth as every non-health route.
 from __future__ import annotations
 
 import asyncio
+import math
 from typing import Callable, Optional
 
 from aiohttp import web
@@ -68,8 +69,10 @@ def _parse_window(raw: Optional[str], default: float = 600.0) -> float:
         scale = {"s": 1.0, "m": 60.0, "h": 3600.0}[raw[-1]]
         raw = raw[:-1]
     value = float(raw) * scale
-    if value <= 0:
-        raise ValueError("window must be positive")
+    # NaN slips past a bare `<= 0` and an infinite cutoff silently
+    # empties every query — both are caller errors, not windows.
+    if not math.isfinite(value) or value <= 0:
+        raise ValueError("window must be positive and finite")
     return value
 
 

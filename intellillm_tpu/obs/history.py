@@ -60,10 +60,14 @@ _MAX_POINTS_PER_SERIES = _RAW_KEEP + sum(keep for _, _, keep in _TIERS)
 # Minimum finishes in the SLO rolling window before the goodput series
 # is recorded at all (see _builtin_sample).
 _GOODPUT_MIN_WINDOW = 3
-# Series the built-in collector gates (e.g. on minimum traffic): the
-# raw registry scrape must not resurrect them from the exported gauge
-# when the collector deliberately withheld them.
-_COLLECTOR_OWNED = frozenset({"intellillm_slo_goodput_ratio"})
+# Series the built-in collector gates (e.g. on minimum traffic, or on
+# having real device data): the raw registry scrape must not resurrect
+# them from the exported gauge when the collector deliberately withheld
+# them. The headroom gauge matters: it registers at prometheus's
+# default 0.0 in processes that never poll telemetry (the router), and
+# a scraped 0.0 reads as "out of HBM" and fires the page rule.
+_COLLECTOR_OWNED = frozenset({"intellillm_slo_goodput_ratio",
+                              "intellillm_hbm_headroom_ratio"})
 
 
 class _HistoryMetrics:
@@ -142,6 +146,16 @@ class _Downsampler:
             self.points.append((self._bucket, self._sum / self._n))
         self._sum = 0.0
         self._n = 0
+
+    def peek(self) -> List[Tuple[float, float]]:
+        """Flushed points PLUS the in-progress bucket's running average.
+        Buckets only flush when the next one opens, so without the peek
+        a tier read would lag by up to one full bucket (10 minutes for
+        the 10m tier), skewing avg/delta toward stale data."""
+        out = list(self.points)
+        if self._bucket is not None and self._n:
+            out.append((self._bucket, self._sum / self._n))
+        return out
 
 
 class _Series:
@@ -324,13 +338,13 @@ class MetricsHistory:
             if tier == "raw":
                 return list(series.raw)
             ds = series.tiers.get(tier)
-            return list(ds.points) if ds is not None else []
+            return ds.peek() if ds is not None else []
         if window_s is None or window_s <= _RAW_KEEP * self.interval_s:
             return list(series.raw)
         for name, bucket_s, keep in _TIERS:
             if window_s <= bucket_s * keep:
-                return list(series.tiers[name].points)
-        return list(series.tiers[_TIERS[-1][0]].points)
+                return series.tiers[name].peek()
+        return series.tiers[_TIERS[-1][0]].peek()
 
     def latest(self, name: str) -> Optional[float]:
         with self._lock:

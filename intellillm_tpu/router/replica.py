@@ -350,7 +350,13 @@ class ReplicaManager:
             r.last_health_ts = time.monotonic()
             # A 503 "initializing" body is a live-but-not-ready replica;
             # "stalled" (watchdog) is unhealthy like a probe failure.
-            ok = status == 200 and body.get("status") == "ok"
+            # "degraded" (page-severity alert firing) stays HEALTHY:
+            # /health/detail keeps it at 200 precisely so load balancers
+            # don't eject a still-serving replica, and this poller must
+            # honor the same contract — a fleet-wide alert (e.g.
+            # slo_burn_rate) would otherwise degrade every replica and
+            # turn a goodput dip into a router-wide 503 outage.
+            ok = status == 200 and body.get("status") in ("ok", "degraded")
             if ok:
                 if not r.healthy:
                     logger.info("replica %s healthy", r.replica_id)

@@ -43,6 +43,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import time
 from collections import deque
 from typing import AsyncIterator, Dict, List, Optional
 
@@ -293,8 +294,21 @@ class Router:
         page_firing = bool(own.get("page_firing"))
         firing.update(own.get("firing") or [])
         pending.update(own.get("pending") or [])
+        # A replica's captured summary is only fleet state while the
+        # replica is reachable: an unhealthy replica (or one whose poll
+        # timestamp has gone stale) would otherwise pin its LAST
+        # summary — firing or clean — into the fleet view forever.
+        stale_after_s = 3.0 * self.manager.health_interval_s
+        now = time.monotonic()
         for rid, replica in self.manager.replicas.items():
             summary = (replica.last_health or {}).get("alerts")
+            stale = (not replica.healthy
+                     or (replica.last_health_ts is not None
+                         and now - replica.last_health_ts > stale_after_s))
+            if stale:
+                per_replica[rid] = ({**summary, "stale": True}
+                                    if summary else None)
+                continue
             per_replica[rid] = summary
             if not summary:
                 continue

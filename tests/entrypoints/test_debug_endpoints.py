@@ -247,6 +247,16 @@ def test_history_and_alerts_endpoints_on_both_servers(monkeypatch):
                         "window": "soon"})
             assert resp.status == 400
 
+            # Non-finite floats parse but are not windows: "nan" slips
+            # past a bare <= 0 check and an "inf" cutoff silently
+            # empties the series — both must 400, not 200-with-[].
+            for bogus in ("nan", "inf", "-inf"):
+                resp = await client.get(
+                    "/debug/history",
+                    params={"metric": "intellillm_test_endpoint_gauge",
+                            "window": bogus})
+                assert resp.status == 400, bogus
+
             resp = await client.get("/debug/alerts")
             assert resp.status == 200
             data = await resp.json()

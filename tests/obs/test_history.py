@@ -38,10 +38,12 @@ def test_sample_collectors_feed_raw_and_tiers():
     raw = h.query("intellillm_test_gauge", tier="raw")
     assert len(raw) == 12
     assert raw[-1] == [110.0, 11.0]
-    # 1m tier: bucket [0, 60) flushed once bucket [60, 120) opened —
-    # its mean is avg(0..5) = 2.5.
+    # 1m tier: bucket [0, 60) flushed once bucket [60, 120) opened
+    # (mean avg(0..5) = 2.5), and the IN-PROGRESS bucket [60, 120) is
+    # visible too with its running mean avg(6..11) = 8.5 — tier reads
+    # must not lag a full bucket behind the data.
     one_m = h.query("intellillm_test_gauge", tier="1m")
-    assert one_m == [[0.0, 2.5]]
+    assert one_m == [[0.0, 2.5], [60.0, 8.5]]
     assert h.latest("intellillm_test_gauge") == 11.0
 
 
@@ -125,6 +127,24 @@ def test_soak_10k_samples_stays_under_memory_cap(monkeypatch):
     snap = h.snapshot()
     assert snap["samples_taken"] == 10_000
     assert snap["memory_bytes"] <= snap["memory_cap_bytes"]
+
+
+def test_registry_scrape_does_not_resurrect_collector_owned_series():
+    """The router process registers the device-telemetry gauges (via
+    get_device_telemetry) without ever polling them, leaving the
+    unlabeled headroom gauge at prometheus's default 0.0 — the registry
+    scrape must not record that as "0% headroom" (it would fire the
+    page-severity hbm_headroom rule on every CPU router). Same contract
+    as the traffic-gated goodput series: collector-owned keys come only
+    from the built-in collector."""
+    pytest.importorskip("prometheus_client")
+    from intellillm_tpu.obs.device_telemetry import get_device_telemetry
+    get_device_telemetry()  # registers intellillm_hbm_headroom_ratio
+    clock = _Clock()
+    h = _store(clock)
+    h.sample_once()  # real registry scrape, no collectors attached
+    assert "intellillm_hbm_headroom_ratio" not in h.series_names()
+    assert "intellillm_slo_goodput_ratio" not in h.series_names()
 
 
 def test_listeners_get_timestamp_and_errors_are_contained():

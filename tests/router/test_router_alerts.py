@@ -78,6 +78,72 @@ def test_fleet_alerts_union_replica_summaries():
     assert snap["alerts"]["fleet"]["rules_firing"] == ["slo_burn_rate"]
 
 
+def test_fleet_alerts_skip_dead_and_stale_replicas():
+    """A replica that went unhealthy (or whose health poll timestamp is
+    stale) must not pin its last captured alert summary into the fleet
+    aggregate forever — it is flagged stale and excluded."""
+    import time
+
+    router = _router()
+    firing = {"alerts": {
+        "enabled": True, "firing": ["slo_burn_rate"], "pending": [],
+        "page_firing": True, "counts": {"firing": 1}}}
+    # r0 died after its last (firing) summary was captured.
+    router.manager.replicas["r0"].last_health = dict(firing)
+    router.manager.replicas["r0"].healthy = False
+    fa = router.fleet_alerts()
+    assert fa["fleet"]["clean"] is True
+    assert fa["fleet"]["rules_firing"] == []
+    assert fa["fleet"]["page_firing"] is False
+    assert fa["replicas"]["r0"]["stale"] is True
+    assert fa["replicas"]["r0"]["firing"] == ["slo_burn_rate"]
+
+    # r1 is still marked healthy but its poll timestamp has gone stale
+    # (poller wedged / replica unreachable before unhealthy_after).
+    router.manager.replicas["r1"].last_health = dict(firing)
+    router.manager.replicas["r1"].last_health_ts = (
+        time.monotonic() - 100 * router.manager.health_interval_s)
+    fa = router.fleet_alerts()
+    assert fa["fleet"]["rules_firing"] == []
+    assert fa["replicas"]["r1"]["stale"] is True
+
+    # A fresh poll brings r1 back into the aggregate.
+    router.manager.replicas["r1"].last_health_ts = time.monotonic()
+    fa = router.fleet_alerts()
+    assert fa["fleet"]["rules_firing"] == ["slo_burn_rate"]
+    assert fa["fleet"]["page_firing"] is True
+
+
+def test_poller_keeps_degraded_replica_healthy():
+    """/health/detail reports "degraded" (still 200) while a page
+    alert fires, explicitly so LBs keep routing to the replica — the
+    router's own poller must honor that too, else a fleet-wide alert
+    (e.g. slo_burn_rate) ejects EVERY replica and 503s all traffic."""
+
+    class _DegradedReplica(Replica):
+        def __init__(self, replica_id, status):
+            super().__init__(replica_id)
+            self.status = status
+
+        async def health_detail(self):
+            return 200, {"status": self.status}
+
+    mgr = ReplicaManager(unhealthy_after=1)
+    mgr.add(_DegradedReplica("deg", "degraded"), healthy=True)
+    mgr.add(_DegradedReplica("stalled", "stalled"), healthy=True)
+    asyncio.run(mgr.poll_once())
+    assert mgr.replicas["deg"].healthy is True
+    assert mgr.replicas["deg"].consecutive_failures == 0
+    # "stalled" (watchdog) is still ejected like a probe failure.
+    assert mgr.replicas["stalled"].healthy is False
+
+    # A degraded poll also RECOVERS an unhealthy replica.
+    mgr.replicas["deg"].healthy = False
+    mgr.replicas["deg"].consecutive_failures = 3
+    asyncio.run(mgr.poll_once())
+    assert mgr.replicas["deg"].healthy is True
+
+
 def test_router_debug_alerts_endpoint_serves_fleet_view():
     router = _router()
     router.manager.replicas["r1"].last_health = {"alerts": {
