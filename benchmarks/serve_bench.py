@@ -32,7 +32,8 @@ from benchmarks.benchmark_serving import (build_requests,  # noqa: E402
 from benchmarks.common import save_dummy_checkpoint  # noqa: E402
 
 
-def launch_server(model_dir: str, args) -> subprocess.Popen:
+def launch_server(model_dir: str, args,
+                  scheduling_policy: str = None) -> subprocess.Popen:
     cmd = [
         sys.executable, "-m",
         "intellillm_tpu.entrypoints.openai.api_server",
@@ -58,6 +59,12 @@ def launch_server(model_dir: str, args) -> subprocess.Popen:
     if args.max_num_batched_tokens:
         cmd += ["--max-num-batched-tokens",
                 str(args.max_num_batched_tokens)]
+    if scheduling_policy:
+        cmd += ["--scheduling-policy", scheduling_policy]
+    if getattr(args, "sjf_starvation_s", None) is not None:
+        cmd += ["--sjf-starvation-s", str(args.sjf_starvation_s)]
+    if getattr(args, "predictor_path", None):
+        cmd += ["--predictor-path", args.predictor_path]
     env = dict(os.environ)
     env.setdefault("HF_HUB_OFFLINE", "1")
     # Server logs go to a file, not an undrained pipe (a full pipe buffer
@@ -504,6 +511,47 @@ def run_fleet(args, model_dir: str, tokenizer) -> dict:
     return summary
 
 
+def _compare_policies(args, model_dir, tokenizer, policies) -> dict:
+    """Run the ttft-under-load scenario once per scheduling policy (one
+    server lifecycle each) and print an SLO comparison block — the
+    FCFS-vs-SJF view docs/scheduling.md describes."""
+    rows = {}
+    summaries = {}
+    for policy in policies:
+        s = run_single(args, model_dir, tokenizer, scheduling_policy=policy)
+        summaries[policy] = s
+        result = s["results"][0]
+        slo = s.get("slo") or {}
+        rows[policy] = {
+            "probe_ttft_ms": result["probe_ttft_ms"],
+            "background_ttft_p99_ms": result["background_ttft_p99_ms"],
+            "background_tpot_p99_ms": result["background_tpot_p99_ms"],
+            "queue_wait_p99_ms": (slo.get("queue_wait_ms") or {}).get("p99"),
+            "goodput_ratio": slo.get("goodput_ratio"),
+        }
+    block = {"scenario": args.scenario, "policies": rows,
+             "sjf_starvation_s": args.sjf_starvation_s}
+    base_row = rows.get("fcfs")
+    if base_row is not None:
+        for policy, row in rows.items():
+            if policy == "fcfs":
+                continue
+            for key in ("probe_ttft_ms", "background_ttft_p99_ms",
+                        "background_tpot_p99_ms"):
+                if (row.get(key) is not None
+                        and base_row.get(key) is not None):
+                    row[f"{key}_delta_vs_fcfs"] = round(
+                        row[key] - base_row[key], 1)
+    if args.sjf_starvation_s is not None:
+        deadline_ms = args.sjf_starvation_s * 1e3
+        for row in rows.values():
+            qw = row.get("queue_wait_p99_ms")
+            row["queue_wait_under_deadline"] = (
+                qw is not None and qw < deadline_ms)
+    print(json.dumps({"serve_bench_policy_comparison": block}), flush=True)
+    return {"policy_comparison": block, "summaries": summaries}
+
+
 def main(args) -> dict:
     from transformers import AutoTokenizer
 
@@ -517,7 +565,21 @@ def main(args) -> dict:
     if args.scenario == "fleet":
         return run_fleet(args, model_dir, tokenizer)
 
-    proc = launch_server(model_dir, args)
+    policies = [p.strip() for p in (args.scheduling_policy or "").split(",")
+                if p.strip()]
+    if len(policies) > 1:
+        if args.scenario != "ttft-under-load":
+            raise SystemExit(
+                "--scheduling-policy accepts a comma-separated comparison "
+                "axis only with --scenario ttft-under-load")
+        return _compare_policies(args, model_dir, tokenizer, policies)
+    return run_single(args, model_dir, tokenizer,
+                      scheduling_policy=policies[0] if policies else None)
+
+
+def run_single(args, model_dir, tokenizer, scheduling_policy=None) -> dict:
+    proc = launch_server(model_dir, args,
+                         scheduling_policy=scheduling_policy)
     base = f"http://127.0.0.1:{args.port}"
     api_url = base + "/v1/completions"
     model_name = f"dummy-{args.size}"
@@ -527,6 +589,8 @@ def main(args) -> dict:
                "max_num_seqs": args.max_num_seqs,
                "num_decode_steps": args.num_decode_steps,
                "quantization": args.quantization,
+               "scheduling_policy": scheduling_policy or "fcfs",
+               "sjf_starvation_s": args.sjf_starvation_s,
                "kv_cache_dtype": args.kv_cache_dtype, "results": []}
     try:
         wait_healthy(proc, base, args.init_timeout, args.server_log)
@@ -580,6 +644,7 @@ def main(args) -> dict:
         summary["observability"] = snapshot_observability(base)
         detail = snapshot_health_detail(base)
         summary["slo"] = detail.get("slo") or {}
+        summary["predictor"] = detail.get("predictor")
         summary["device_telemetry"] = distill_device_telemetry(detail)
         summary["efficiency"] = snapshot_efficiency(base)
         summary["alerts"] = distill_alerts(snapshot_alerts(base))
@@ -640,6 +705,18 @@ def make_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--probe-delay", type=float, default=2.0,
                    help="seconds after the background burst before the "
                         "probe is sent")
+    p.add_argument("--scheduling-policy", type=str, default=None,
+                   help="pass --scheduling-policy to the server (fcfs | "
+                        "sjf | sjf_remaining). With --scenario "
+                        "ttft-under-load a comma-separated list (e.g. "
+                        "'fcfs,sjf_remaining') runs the scenario once per "
+                        "policy and prints an SLO comparison block")
+    p.add_argument("--sjf-starvation-s", type=float, default=None,
+                   help="pass --sjf-starvation-s to the server (SJF "
+                        "aging deadline, seconds)")
+    p.add_argument("--predictor-path", type=str, default=None,
+                   help="pass --predictor-path to the server "
+                        "(length-predictor checkpoint)")
     p.add_argument("--enable-chunked-prefill", action="store_true",
                    help="pass --enable-chunked-prefill to the server")
     p.add_argument("--max-num-batched-tokens", type=int, default=None,

@@ -151,6 +151,7 @@ DEFAULT_METRICS_MODULES: Tuple[str, ...] = (
     "intellillm_tpu/obs/*.py",
     "intellillm_tpu/engine/metrics.py",
     "intellillm_tpu/router/metrics.py",
+    "intellillm_tpu/prediction/metrics.py",
 )
 
 # Per-request server paths where an append to a module-level container
@@ -196,6 +197,7 @@ DEFAULT_SEED_FLAGS = frozenset({
 DEFAULT_DOC_FILES: Tuple[str, ...] = (
     "docs/observability.md",
     "docs/routing.md",
+    "docs/scheduling.md",
 )
 DEFAULT_METRICS_DOC = "docs/observability.md"
 

@@ -286,6 +286,8 @@ class SchedulerConfig:
         policy: str = "fcfs",
         num_decode_steps: int = 8,
         enable_chunked_prefill: bool = False,
+        sjf_starvation_s: Optional[float] = None,
+        predictor_path: Optional[str] = None,
     ) -> None:
         self.enable_chunked_prefill = enable_chunked_prefill
         if max_num_batched_tokens is not None:
@@ -303,6 +305,14 @@ class SchedulerConfig:
         self.max_model_len = max_model_len
         self.max_paddings = max_paddings
         self.policy = policy
+        # Aging deadline for the SJF policies: a waiting group older than
+        # this is promoted to FCFS priority above every un-promoted group
+        # (None / 0 disables aging; ignored by fcfs).
+        self.sjf_starvation_s = sjf_starvation_s
+        # Length-predictor checkpoint the engine loads at boot when a
+        # non-FCFS policy needs predictions and no predictor was injected
+        # (None -> PromptLengthHeuristic fallback).
+        self.predictor_path = predictor_path
         # Decode iterations fused into one jitted device call (multi-step
         # decode). The host sees one dispatch + one result fetch per K
         # tokens instead of per token — the TPU-side answer to the
@@ -324,6 +334,8 @@ class SchedulerConfig:
                 "max_num_batched_tokens must be >= max_num_seqs")
         if self.num_decode_steps < 1:
             raise ValueError("num_decode_steps must be >= 1")
+        if self.sjf_starvation_s is not None and self.sjf_starvation_s < 0:
+            raise ValueError("sjf_starvation_s must be >= 0 (0 disables)")
 
 
 @dataclass

@@ -13,16 +13,40 @@ SURVEY §2.10). Here SJF variants are first-class:
              minus tokens already generated), which avoids starving
              long-running jobs near completion.
 
-Unknown lengths sort last; ties break FCFS by arrival time.
+Unknown lengths sort last; ties break FCFS by arrival time. SJF
+variants accept a starvation deadline (`starvation_s`): a group that
+has waited at least that long is *promoted* above every non-promoted
+group and ordered FCFS among the promoted, bounding max queue-wait
+under a stream of short jobs (FastServe-style aging).
 """
 from __future__ import annotations
 
-from typing import Deque, List
+from typing import Deque, List, Optional
 
 from intellillm_tpu.sequence import SequenceGroup
 
 
 class Policy:
+
+    def __init__(self, starvation_s: Optional[float] = None) -> None:
+        # None / <= 0 disables aging promotion (FCFS ignores it anyway).
+        self.starvation_s = (float(starvation_s)
+                             if starvation_s and starvation_s > 0 else None)
+
+    # Beats every SJF priority (those are <= 0 plus a tiny age term)
+    # while staying well below FCFS's own scale-free age values.
+    _PROMOTED = float(10**7)
+
+    def _promoted_priority(self, now: float,
+                           seq_group: SequenceGroup) -> Optional[float]:
+        """FCFS-ordered priority above all SJF values once a group has
+        waited past the starvation deadline, else None."""
+        if self.starvation_s is None:
+            return None
+        age = now - seq_group.arrival_time
+        if age < self.starvation_s:
+            return None
+        return self._PROMOTED + age
 
     def get_priority(self, now: float, seq_group: SequenceGroup) -> float:
         """Higher = scheduled first."""
@@ -53,6 +77,9 @@ class SJF(Policy):
     _UNKNOWN = 10**9
 
     def get_priority(self, now: float, seq_group: SequenceGroup) -> float:
+        promoted = self._promoted_priority(now, seq_group)
+        if promoted is not None:
+            return promoted
         plen = seq_group.predicted_len
         if plen is None:
             plen = self._UNKNOWN
@@ -67,13 +94,19 @@ class SJFRemaining(Policy):
     _UNKNOWN = 10**9
 
     def get_priority(self, now: float, seq_group: SequenceGroup) -> float:
+        promoted = self._promoted_priority(now, seq_group)
+        if promoted is not None:
+            return promoted
+        age = min(now - seq_group.arrival_time, 10**6)
         plen = seq_group.predicted_len
         if plen is None:
-            return -float(self._UNKNOWN)
+            # Unknown lengths sort last but still break ties FCFS among
+            # themselves — without the age term their sort order is
+            # whatever the deque happened to hold.
+            return -float(self._UNKNOWN) + age * 1e-9
         generated = max(
             (s.get_output_len() for s in seq_group.get_seqs()), default=0)
         remaining = max(plen - generated, 0)
-        age = min(now - seq_group.arrival_time, 10**6)
         return -float(remaining) + age * 1e-9
 
 

@@ -34,6 +34,7 @@ from intellillm_tpu.core.policy import Policy, PolicyFactory
 from intellillm_tpu.logger import init_logger
 from intellillm_tpu.obs import (get_flight_recorder, get_slo_tracker,
                                 get_step_tracer)
+from intellillm_tpu.prediction import get_prediction_service
 from intellillm_tpu.prefix import PrefixPool
 from intellillm_tpu.sequence import (Sequence, SequenceData, SequenceGroup,
                                      SequenceGroupMetadata, SequenceStatus)
@@ -132,7 +133,9 @@ class Scheduler:
         self._len_buckets = default_len_buckets(
             scheduler_config.max_model_len)
 
-        self.policy: Policy = PolicyFactory.get_policy(scheduler_config.policy)
+        self.policy: Policy = PolicyFactory.get_policy(
+            scheduler_config.policy,
+            starvation_s=getattr(scheduler_config, "sjf_starvation_s", None))
         self.block_manager = BlockSpaceManager(
             block_size=cache_config.block_size,
             num_device_blocks=cache_config.num_device_blocks,
@@ -202,6 +205,9 @@ class Scheduler:
                         seq_group.request_id,
                         sum(s.get_output_len()
                             for s in seq_group.get_seqs()))
+                    # Aborted decodes must not calibrate the length
+                    # predictor (their actual length is censored).
+                    get_prediction_service().discard(seq_group.request_id)
                 for seq in seq_group.get_seqs():
                     if seq.is_finished():
                         continue
@@ -213,6 +219,37 @@ class Scheduler:
 
     def get_num_unfinished_seq_groups(self) -> int:
         return len(self.waiting) + len(self.running) + len(self.swapped)
+
+    def iter_seq_groups(self) -> Iterable[SequenceGroup]:
+        """Every in-flight group across the three state queues (the
+        calibrator restamps their predictions through this)."""
+        yield from self.waiting
+        yield from self.running
+        yield from self.swapped
+
+    def _pop_preemption_victim(self) -> SequenceGroup:
+        """Remove and return the running group with the most predicted
+        remaining work (p90 when available — evicting the priciest tail
+        frees the most future block demand per preemption). Groups
+        without any prediction fall back to the priority-order tail."""
+        best_i = -1
+        best_remaining = -1.0
+        for i, sg in enumerate(self.running):
+            plen = getattr(sg, "predicted_len_p90", None)
+            if plen is None:
+                plen = sg.predicted_len
+            if plen is None:
+                continue
+            generated = max(
+                (s.get_output_len() for s in sg.get_seqs()), default=0)
+            remaining = max(float(plen) - generated, 0.0)
+            if remaining > best_remaining:
+                best_i, best_remaining = i, remaining
+        if best_i < 0:
+            return self.running.pop()  # lowest priority
+        victim = self.running[best_i]
+        del self.running[best_i]
+        return victim
 
     # --- the scheduling pass --------------------------------------------
 
@@ -390,7 +427,7 @@ class Scheduler:
             while not self.block_manager.can_append_slots(
                     seq_group, self._clamped_steps(seq_group, num_steps)):
                 if self.running:
-                    victim = self.running.pop()  # lowest priority
+                    victim = self._pop_preemption_victim()
                     self._preempt(victim, blocks_to_swap_out)
                     preempted.append(victim)
                 else:
@@ -552,7 +589,7 @@ class Scheduler:
                 continue
             while not self.block_manager.can_append_slots(seq_group, 1):
                 if self.running:
-                    victim = self.running.pop()  # lowest priority
+                    victim = self._pop_preemption_victim()
                     self._preempt(victim, blocks_to_swap_out)
                     preempted.append(victim)
                 else:

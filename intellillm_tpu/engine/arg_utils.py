@@ -38,6 +38,8 @@ class EngineArgs:
     max_num_seqs: int = 256
     max_paddings: int = 256
     scheduling_policy: str = "fcfs"
+    sjf_starvation_s: Optional[float] = None
+    predictor_path: Optional[str] = None
     num_decode_steps: int = 8
     enable_chunked_prefill: bool = False
     # Model
@@ -110,6 +112,18 @@ class EngineArgs:
         parser.add_argument("--max-paddings", type=int, default=256)
         parser.add_argument("--scheduling-policy", type=str, default="fcfs",
                             help="fcfs | sjf | sjf_remaining")
+        parser.add_argument("--sjf-starvation-s", type=float, default=None,
+                            help="aging deadline for the SJF policies: a "
+                            "waiting request older than this many seconds "
+                            "is promoted to FCFS priority above every "
+                            "un-promoted request, bounding max queue-wait "
+                            "(default: disabled; ignored by fcfs; see "
+                            "docs/scheduling.md)")
+        parser.add_argument("--predictor-path", type=str, default=None,
+                            help="response-length predictor checkpoint "
+                            "loaded at engine boot when a non-FCFS policy "
+                            "is selected (default: prompt-length "
+                            "heuristic; see docs/scheduling.md)")
         parser.add_argument("--num-decode-steps", type=int, default=8,
                             help="decode iterations fused per device call")
         parser.add_argument("--enable-chunked-prefill", action="store_true",
@@ -211,6 +225,8 @@ class EngineArgs:
             policy=self.scheduling_policy,
             num_decode_steps=self.num_decode_steps,
             enable_chunked_prefill=self.enable_chunked_prefill,
+            sjf_starvation_s=self.sjf_starvation_s,
+            predictor_path=self.predictor_path,
         )
         lora_config = None
         if self.enable_lora:

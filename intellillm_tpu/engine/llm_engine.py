@@ -29,6 +29,7 @@ from intellillm_tpu.obs import (get_alert_manager, get_boot_timeline,
                                 get_slo_tracker, get_step_tracer,
                                 get_watchdog, request_context)
 from intellillm_tpu.outputs import RequestOutput
+from intellillm_tpu.prediction import get_prediction_service
 from intellillm_tpu.sampling_params import SamplingParams
 from intellillm_tpu.sequence import (SamplerOutput, Sequence, SequenceGroup,
                                      SequenceGroupOutput, SequenceStatus)
@@ -79,6 +80,21 @@ class LLMEngine:
             self.tokenizer = None
         else:
             self._init_tokenizer()
+
+        # A non-FCFS policy without an injected predictor auto-loads one
+        # (checkpoint from --predictor-path, else the prompt-length
+        # heuristic) so SJF never runs open-loop on absent predictions.
+        if (self.length_predictor is None
+                and scheduler_config.policy != "fcfs"):
+            from intellillm_tpu.research.predictor import load_predictor
+            self.length_predictor = load_predictor(
+                scheduler_config.predictor_path,
+                self.tokenizer.tokenizer if self.tokenizer else None)
+        # Calibrated quantile predictions (prediction/): p50 orders the
+        # SJF queue, p90 prices preemption victims; the finish hook below
+        # feeds actual lengths back into the online calibrator.
+        self._prediction = get_prediction_service().configure(
+            self.length_predictor)
 
         self.speculative_config = speculative_config
         if speculative_config is not None:
@@ -363,16 +379,22 @@ class LLMEngine:
                 prompt_token_ids[:prefix_pos],
                 lora_request.lora_int_id if lora_request else 0)
 
-        if predicted_len is None and self.length_predictor is not None:
-            try:
-                predicted_len = int(
-                    self.length_predictor.predict(prompt, prompt_token_ids))
-            except Exception as e:
-                logger.warning("Length predictor failed: %s", e)
+        # Oracle-supplied predicted_len wins (and is never calibrated);
+        # otherwise the service returns calibrated quantiles and handles
+        # predictor failures (log once per episode + failure counter).
+        prediction = None
+        if predicted_len is None and self._prediction.enabled:
+            prediction = self._prediction.predict(request_id, prompt,
+                                                  prompt_token_ids)
+            if prediction is not None:
+                predicted_len = prediction.p50
 
         seq_group = SequenceGroup(request_id, [seq], sampling_params,
                                   arrival_time, lora_request, prefix,
                                   predicted_len)
+        if prediction is not None:
+            seq_group.predicted_len_p90 = prediction.p90
+            seq_group.predicted_len_raw = prediction.raw
         self._flight.record(request_id, "arrived",
                             detail=f"prompt_tokens={len(prompt_token_ids)}")
         self.scheduler.add_seq_group(seq_group)
@@ -756,10 +778,15 @@ class LLMEngine:
                 # fires exactly once per request.
                 if self._flight.record(seq_group.request_id, "finished",
                                        detail=",".join(reasons) or None):
-                    self._slo.record_finish(
-                        seq_group.request_id,
-                        sum(s.get_output_len()
-                            for s in seq_group.get_seqs()))
+                    actual_len = sum(s.get_output_len()
+                                     for s in seq_group.get_seqs())
+                    self._slo.record_finish(seq_group.request_id,
+                                            actual_len)
+                    # Same exactly-once seal feeds the online length
+                    # calibrator; it may restamp in-flight predictions.
+                    self._prediction.observe_finish(
+                        seq_group.request_id, actual_len,
+                        scheduler=self.scheduler)
             request_outputs.append(RequestOutput.from_seq_group(seq_group))
 
         # Flip freshly computed prefixes (reference llm_engine.py:727-731).

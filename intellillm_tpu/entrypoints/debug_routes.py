@@ -24,6 +24,10 @@
                                         metric is given
     GET  /debug/alerts                  alert rule table with pending/
                                         firing/resolved states
+    GET  /debug/predictor               length-predictor calibration
+                                        table (per-bucket factors) +
+                                        recent predicted-vs-actual
+                                        samples (docs/scheduling.md)
     GET  /health/detail                 structured liveness: last-step
                                         age, watchdog state, queue
                                         depths, KV usage, SLO summary,
@@ -57,6 +61,7 @@ from intellillm_tpu.obs import (get_alert_manager, get_boot_timeline,
                                 get_efficiency_tracker,
                                 get_flight_recorder, get_metrics_history,
                                 get_slo_tracker, get_watchdog)
+from intellillm_tpu.prediction import get_prediction_service
 
 
 def _parse_window(raw: Optional[str], default: float = 600.0) -> float:
@@ -102,6 +107,13 @@ async def debug_history(request: web.Request) -> web.Response:
 
 async def debug_alerts(request: web.Request) -> web.Response:
     return web.json_response(get_alert_manager().snapshot())
+
+
+async def debug_predictor(request: web.Request) -> web.Response:
+    """Calibration table + recent predicted-vs-actual samples. Module
+    level like `metrics`: the prediction service is process-global, so
+    the handler has no engine dependency."""
+    return web.json_response(get_prediction_service().snapshot())
 
 
 async def metrics(request: web.Request) -> web.Response:
@@ -187,6 +199,10 @@ def add_debug_routes(app: web.Application,
             "live_requests": len(get_flight_recorder().live_request_ids()),
             "alerts": alerts.summary(),
             "boot": get_boot_timeline().snapshot(),
+            # Compact: the per-bucket table lives at /debug/predictor.
+            # The router's load estimator consumes calibration_factor
+            # from here to correct its own predicted lengths.
+            "predictor": get_prediction_service().health_block(),
         }
         engine = get_engine()
         if engine is None:
@@ -240,6 +256,7 @@ def add_debug_routes(app: web.Application,
     app.router.add_get("/debug/efficiency", debug_efficiency)
     app.router.add_get("/debug/history", debug_history)
     app.router.add_get("/debug/alerts", debug_alerts)
+    app.router.add_get("/debug/predictor", debug_predictor)
     app.router.add_get("/health/detail", health_detail)
     if enable_profiling:
         app.router.add_post("/debug/profiler/start", profiler_start)

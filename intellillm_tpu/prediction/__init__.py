@@ -1,0 +1,15 @@
+"""Online length-prediction subsystem: calibrated quantile predictions
+driving SJF scheduling (see docs/scheduling.md)."""
+from intellillm_tpu.prediction.calibration import OnlineCalibrator, bucket_of
+from intellillm_tpu.prediction.service import (
+    Prediction, PredictionService, get_prediction_service,
+    reset_prediction_service_for_testing)
+
+__all__ = [
+    "OnlineCalibrator",
+    "bucket_of",
+    "Prediction",
+    "PredictionService",
+    "get_prediction_service",
+    "reset_prediction_service_for_testing",
+]

@@ -1,0 +1,285 @@
+"""Online calibration of response-length predictions.
+
+The predictor (`research/predictor.py`) is trained offline; serving
+traffic drifts. The engine's flight recorder already observes the
+*actual* decode length of every finished request, so this module closes
+the loop: per prompt-length bucket it maintains an EWMA of the
+actual/predicted ratio plus p50/p90 correction factors taken from a
+rolling window of recent ratios. Admission-time predictions are scaled
+by the bucket's factors (p50 orders the SJF queue, p90 prices
+preemption victims), and in-flight `SequenceGroup` predictions are
+restamped when a bucket's factor moves materially.
+
+Pure stdlib, no jax / no model imports — safe to import from core/.
+Thread-safe: the engine step loop, the asyncio HTTP handlers, and
+in-process router replicas all touch the same instance.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from intellillm_tpu.prediction.metrics import get_predictor_metrics
+
+_EWMA_ALPHA = 0.2
+_RATIO_WINDOW = 128
+_MAX_PENDING = 4096
+_RECENT_KEEP = 64
+# Relative factor move below which in-flight predictions are NOT
+# restamped (refresh is cheap but not free; 5% never reorders a queue
+# whose predictions differ by whole buckets).
+_DIRTY_THRESHOLD = 0.05
+# Largest power-of-two bucket edge; longer prompts share one bucket.
+_MAX_BUCKET_EDGE = 2048
+_MIN_BUCKET_EDGE = 32
+
+
+def bucket_of(prompt_len: int) -> str:
+    """Power-of-two prompt-length bucket label, e.g. "32-63", "2048+"."""
+    if prompt_len >= _MAX_BUCKET_EDGE:
+        return f"{_MAX_BUCKET_EDGE}+"
+    lo = _MIN_BUCKET_EDGE
+    if prompt_len < lo:
+        return f"0-{lo - 1}"
+    while lo * 2 <= prompt_len:
+        lo *= 2
+    return f"{lo}-{lo * 2 - 1}"
+
+
+class _BucketStats:
+    """Per-bucket calibration state (guarded by the calibrator's lock)."""
+
+    __slots__ = ("samples", "ewma_ratio", "ratios", "factor_p50",
+                 "factor_p90")
+
+    def __init__(self) -> None:
+        self.samples = 0
+        self.ewma_ratio = 1.0
+        self.ratios: deque = deque(maxlen=_RATIO_WINDOW)
+        self.factor_p50 = 1.0
+        self.factor_p90 = 1.0
+
+    def update(self, ratio: float) -> None:
+        self.samples += 1
+        if self.samples == 1:
+            self.ewma_ratio = ratio
+        else:
+            self.ewma_ratio += _EWMA_ALPHA * (ratio - self.ewma_ratio)
+        self.ratios.append(ratio)
+        ordered = sorted(self.ratios)
+        self.factor_p50 = _quantile(ordered, 0.5)
+        self.factor_p90 = _quantile(ordered, 0.9)
+
+
+def _quantile(ordered: List[float], q: float) -> float:
+    if not ordered:
+        return 1.0
+    idx = min(int(q * len(ordered)), len(ordered) - 1)
+    return ordered[idx]
+
+
+class OnlineCalibrator:
+    """Learns per-bucket correction factors from finished requests.
+
+    Admissions register via `note_admission`; the engine's exactly-once
+    finish hook feeds `observe`; schedulers read corrected predictions
+    via `correct`. Aborted requests need no explicit hook — the pending
+    map is LRU-bounded, so their entries age out.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, _BucketStats] = {}
+        # request_id -> (prompt_len, raw_prediction)
+        self._pending: "OrderedDict[str, Tuple[int, int]]" = OrderedDict()
+        self._recent: deque = deque(maxlen=_RECENT_KEEP)
+        self._dirty: set = set()
+        # Factor each bucket's in-flight predictions were last stamped
+        # with; drift beyond _DIRTY_THRESHOLD triggers a restamp.
+        self._stamped: Dict[str, float] = {}
+        self._samples_total = 0
+        self._abs_error_ewma: Optional[float] = None
+        self._abs_error_cal_ewma: Optional[float] = None
+        self._over_rate = 0.0
+        self._under_rate = 0.0
+
+    # ------------------------------------------------------------------
+    # Admission / finish path
+    # ------------------------------------------------------------------
+
+    def note_admission(self, request_id: str, prompt_len: int,
+                       raw_prediction: int) -> None:
+        with self._lock:
+            self._pending[request_id] = (int(prompt_len),
+                                         int(raw_prediction))
+            self._pending.move_to_end(request_id)
+            while len(self._pending) > _MAX_PENDING:
+                self._pending.popitem(last=False)
+
+    def discard(self, request_id: str) -> None:
+        """Drop a pending admission (aborted before finishing)."""
+        with self._lock:
+            self._pending.pop(request_id, None)
+
+    def observe(self, request_id: str,
+                actual_len: int) -> Optional[Dict[str, object]]:
+        """Fold one finished request into the calibration state.
+
+        Returns the recorded sample, or None when the request never
+        registered an admission (no prediction was made for it).
+        """
+        with self._lock:
+            entry = self._pending.pop(request_id, None)
+            if entry is None:
+                return None
+            prompt_len, raw = entry
+            actual = max(int(actual_len), 0)
+            label = bucket_of(prompt_len)
+            stats = self._buckets.setdefault(label, _BucketStats())
+
+            # Error of the *calibrated* prediction, with the factors as
+            # they stood before this sample — this is the series that
+            # must shrink for calibration to be working.
+            calibrated = max(int(round(raw * stats.factor_p50)), 1)
+            err_raw = abs(raw - actual)
+            err_cal = abs(calibrated - actual)
+
+            stats.update(actual / max(raw, 1))
+            self._samples_total += 1
+            if self._abs_error_ewma is None:
+                self._abs_error_ewma = float(err_raw)
+                self._abs_error_cal_ewma = float(err_cal)
+            else:
+                self._abs_error_ewma += _EWMA_ALPHA * (
+                    err_raw - self._abs_error_ewma)
+                self._abs_error_cal_ewma += _EWMA_ALPHA * (
+                    err_cal - self._abs_error_cal_ewma)
+            self._over_rate += _EWMA_ALPHA * (
+                (1.0 if raw > actual else 0.0) - self._over_rate)
+            self._under_rate += _EWMA_ALPHA * (
+                (1.0 if raw < actual else 0.0) - self._under_rate)
+
+            # A material factor move marks the bucket dirty so in-flight
+            # predictions from it get restamped.
+            if abs(stats.factor_p50 - self._stamped_factor(label)) > (
+                    _DIRTY_THRESHOLD * max(self._stamped_factor(label),
+                                           1e-9)):
+                self._dirty.add(label)
+
+            sample = {
+                "request_id": request_id,
+                "prompt_len": prompt_len,
+                "bucket": label,
+                "predicted_raw": raw,
+                "predicted_calibrated": calibrated,
+                "actual": actual,
+            }
+            self._recent.append(sample)
+            self._export_locked(label, stats)
+            return sample
+
+    def _stamped_factor(self, label: str) -> float:
+        return self._stamped.get(label, 1.0)
+
+    # ------------------------------------------------------------------
+    # Read path
+    # ------------------------------------------------------------------
+
+    def correct(self, prompt_len: int, raw: int) -> Tuple[int, int]:
+        """(p50, p90) calibrated predictions for a raw point estimate."""
+        with self._lock:
+            stats = self._buckets.get(bucket_of(prompt_len))
+            if stats is None or stats.samples == 0:
+                return max(int(raw), 1), max(int(raw), 1)
+            p50 = max(int(round(raw * stats.factor_p50)), 1)
+            p90 = max(int(round(raw * stats.factor_p90)), p50)
+            return p50, p90
+
+    def factor(self, prompt_len: Optional[int] = None) -> float:
+        """Bucket p50 factor, or the samples-weighted global factor."""
+        with self._lock:
+            if prompt_len is not None:
+                stats = self._buckets.get(bucket_of(prompt_len))
+                return stats.factor_p50 if stats else 1.0
+            total = sum(b.samples for b in self._buckets.values())
+            if total == 0:
+                return 1.0
+            return sum(b.factor_p50 * b.samples
+                       for b in self._buckets.values()) / total
+
+    def refresh_predictions(self, seq_groups: Iterable) -> int:
+        """Restamp in-flight predictions from dirty buckets.
+
+        Only groups carrying `predicted_len_raw` (i.e. stamped by the
+        prediction service, not an oracle-supplied length) are touched.
+        Returns the number of groups restamped and clears the dirty set.
+        """
+        with self._lock:
+            dirty = self._dirty
+            if not dirty:
+                return 0
+            self._dirty = set()
+            for label in dirty:
+                stats = self._buckets.get(label)
+                if stats is not None:
+                    self._stamped[label] = stats.factor_p50
+            snapshot = {label: self._buckets[label] for label in dirty
+                        if label in self._buckets}
+        refreshed = 0
+        for sg in seq_groups:
+            raw = getattr(sg, "predicted_len_raw", None)
+            if raw is None:
+                continue
+            label = bucket_of(len(sg.prompt_token_ids))
+            stats = snapshot.get(label)
+            if stats is None:
+                continue
+            p50 = max(int(round(raw * stats.factor_p50)), 1)
+            sg.predicted_len = p50
+            sg.predicted_len_p90 = max(
+                int(round(raw * stats.factor_p90)), p50)
+            refreshed += 1
+        if refreshed:
+            metrics = get_predictor_metrics()
+            if metrics is not None:
+                metrics.counter_refreshes.inc(refreshed)
+        return refreshed
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "samples_total": self._samples_total,
+                "pending": len(self._pending),
+                "abs_error_ewma": self._abs_error_ewma,
+                "abs_error_calibrated_ewma": self._abs_error_cal_ewma,
+                "overprediction_rate": round(self._over_rate, 4),
+                "underprediction_rate": round(self._under_rate, 4),
+                "buckets": {
+                    label: {
+                        "samples": b.samples,
+                        "ewma_ratio": round(b.ewma_ratio, 4),
+                        "factor_p50": round(b.factor_p50, 4),
+                        "factor_p90": round(b.factor_p90, 4),
+                    }
+                    for label, b in sorted(self._buckets.items())
+                },
+                "recent": list(self._recent),
+            }
+
+    def _export_locked(self, label: str, stats: _BucketStats) -> None:
+        metrics = get_predictor_metrics()
+        if metrics is None:
+            return
+        metrics.gauge_abs_error.set(self._abs_error_ewma or 0.0)
+        metrics.gauge_abs_error_calibrated.set(
+            self._abs_error_cal_ewma or 0.0)
+        metrics.gauge_overprediction_rate.set(self._over_rate)
+        metrics.gauge_underprediction_rate.set(self._under_rate)
+        metrics.gauge_calibration_factor.labels(bucket=label).set(
+            stats.factor_p50)
+        metrics.counter_samples.inc()

@@ -1,0 +1,81 @@
+"""Prometheus collectors for the length-prediction subsystem.
+
+Process-global singleton, same pattern as `obs/slo.py`'s `_SLOMetrics`:
+built once, unregistered via `reset_for_testing` so tests can rebuild
+the registry. All gauges here are scraped by the in-process
+`MetricsHistory` store (it walks every `intellillm_*` gauge/counter
+family), so the predictor series get history + alerting for free.
+"""
+from __future__ import annotations
+
+try:
+    from prometheus_client import Counter, Gauge
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+
+class _PredictorMetrics:
+    """Collectors for predicted-vs-actual length error and calibration."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.gauge_abs_error = Gauge(
+            "intellillm_predictor_abs_error",
+            "EWMA of |predicted - actual| response length in tokens, over "
+            "finished requests (raw prediction, before calibration).")
+        self.gauge_abs_error_calibrated = Gauge(
+            "intellillm_predictor_abs_error_calibrated",
+            "EWMA of |calibrated prediction - actual| response length in "
+            "tokens — should trend below the raw abs error as the online "
+            "calibrator converges.")
+        self.gauge_overprediction_rate = Gauge(
+            "intellillm_predictor_overprediction_rate",
+            "EWMA fraction of finished requests whose raw prediction "
+            "exceeded the actual response length.")
+        self.gauge_underprediction_rate = Gauge(
+            "intellillm_predictor_underprediction_rate",
+            "EWMA fraction of finished requests whose raw prediction fell "
+            "short of the actual response length.")
+        self.gauge_calibration_factor = Gauge(
+            "intellillm_predictor_calibration_factor",
+            "Median actual/predicted length ratio per prompt-length bucket "
+            "(power-of-two buckets; 1.0 = perfectly calibrated).",
+            ["bucket"])
+        self.counter_samples = Counter(
+            "intellillm_predictor_samples_total",
+            "Finished requests folded into the online calibrator.")
+        self.counter_failures = Counter(
+            "intellillm_predictor_failures_total",
+            "Length-predictor exceptions on the admission path (request "
+            "proceeds without a prediction).")
+        self.counter_refreshes = Counter(
+            "intellillm_predictor_inflight_refreshes_total",
+            "In-flight SequenceGroup predictions restamped after a "
+            "material calibration shift.")
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+def get_predictor_metrics():
+    """The collector singleton, or None without prometheus_client."""
+    if not _PROMETHEUS:
+        return None
+    return _PredictorMetrics()

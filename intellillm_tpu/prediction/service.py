@@ -1,0 +1,162 @@
+"""PredictionService: one quantile-prediction interface for scheduling.
+
+Wraps whatever predictor the deployment configured — the BERT-style
+`research/predictor.py:LengthPredictor`, the `PromptLengthHeuristic`
+fallback, or nothing at all — and runs every point estimate through the
+`OnlineCalibrator`, returning (p50, p90) quantile predictions: p50
+orders the SJF queue, p90 prices preemption victims.
+
+Process-global singleton (like the SLO tracker): the engine, the debug
+endpoints, and in-process router replicas all read the same calibration
+state. Importing this module pulls in no jax/model code — the heavy
+predictor is injected by the engine at boot.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, NamedTuple, Optional
+
+from intellillm_tpu.logger import init_logger
+from intellillm_tpu.prediction.calibration import OnlineCalibrator, bucket_of
+from intellillm_tpu.prediction.metrics import get_predictor_metrics
+
+logger = init_logger(__name__)
+
+
+class Prediction(NamedTuple):
+    """Quantile response-length prediction for one request."""
+    p50: int        # calibrated median — SJF ordering
+    p90: int        # calibrated tail — preemption-victim cost
+    raw: int        # the predictor's uncorrected point estimate
+    bucket: str     # prompt-length bucket the correction came from
+
+
+class PredictionService:
+    """Calibrated quantile predictions + failure containment.
+
+    Predictor exceptions never reach the admission path: they are
+    counted (`intellillm_predictor_failures_total`), logged once per
+    failure episode (a success resets the episode), and surface as a
+    `None` prediction so the request proceeds unpredicted.
+    """
+
+    def __init__(self, predictor=None) -> None:
+        self._predictor = predictor
+        self.calibrator = OnlineCalibrator()
+        self._failure_episode = False
+        self._failures = 0
+
+    # ------------------------------------------------------------------
+    # Configuration
+    # ------------------------------------------------------------------
+
+    def configure(self, predictor) -> "PredictionService":
+        self._predictor = predictor
+        return self
+
+    @property
+    def enabled(self) -> bool:
+        return self._predictor is not None
+
+    # ------------------------------------------------------------------
+    # Admission path
+    # ------------------------------------------------------------------
+
+    def predict(self, request_id: str, prompt: Optional[str],
+                prompt_token_ids: Optional[List[int]]
+                ) -> Optional[Prediction]:
+        if self._predictor is None:
+            return None
+        try:
+            raw = int(self._predictor.predict(prompt, prompt_token_ids))
+        except Exception as e:
+            self._failures += 1
+            metrics = get_predictor_metrics()
+            if metrics is not None:
+                metrics.counter_failures.inc()
+            if not self._failure_episode:
+                self._failure_episode = True
+                logger.warning(
+                    "Length predictor failed (%s: %s); requests proceed "
+                    "unpredicted until it recovers. Counted in "
+                    "intellillm_predictor_failures_total; further "
+                    "failures in this episode are not logged.",
+                    type(e).__name__, e)
+            return None
+        if self._failure_episode:
+            self._failure_episode = False
+            logger.info("Length predictor recovered after %d failure(s).",
+                        self._failures)
+        prompt_len = (len(prompt_token_ids) if prompt_token_ids
+                      else len(prompt or ""))
+        p50, p90 = self.calibrator.correct(prompt_len, raw)
+        self.calibrator.note_admission(request_id, prompt_len, raw)
+        return Prediction(p50=p50, p90=p90, raw=raw,
+                          bucket=bucket_of(prompt_len))
+
+    # ------------------------------------------------------------------
+    # Finish path (exactly-once, gated by the flight recorder seal)
+    # ------------------------------------------------------------------
+
+    def observe_finish(self, request_id: str, actual_len: int,
+                       scheduler=None) -> None:
+        sample = self.calibrator.observe(request_id, actual_len)
+        if sample is None or scheduler is None:
+            return
+        # Restamp in-flight predictions when this sample moved a bucket
+        # factor materially (no-op otherwise; the dirty set gates it).
+        self.calibrator.refresh_predictions(scheduler.iter_seq_groups())
+
+    def discard(self, request_id: str) -> None:
+        self.calibrator.discard(request_id)
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    def health_block(self) -> dict:
+        """Compact block for /health/detail (router polls this)."""
+        snap = self.calibrator.snapshot()
+        return {
+            "enabled": self.enabled,
+            "calibration_factor": round(self.calibrator.factor(), 4),
+            "abs_error_ewma": snap["abs_error_ewma"],
+            "samples": snap["samples_total"],
+            "failures": self._failures,
+        }
+
+    def snapshot(self) -> dict:
+        """Full table for GET /debug/predictor."""
+        body = self.calibrator.snapshot()
+        body["enabled"] = self.enabled
+        body["failures"] = self._failures
+        body["global_calibration_factor"] = round(
+            self.calibrator.factor(), 4)
+        if self._predictor is not None:
+            body["predictor"] = type(self._predictor).__name__
+            stats = getattr(self._predictor, "latency_stats", None)
+            if callable(stats):
+                try:
+                    body["predictor_latency_ms"] = stats()
+                except Exception:
+                    pass
+        return body
+
+
+_service: Optional[PredictionService] = None
+_service_lock = threading.Lock()
+
+
+def get_prediction_service() -> PredictionService:
+    global _service
+    if _service is None:
+        with _service_lock:
+            if _service is None:
+                _service = PredictionService()
+    return _service
+
+
+def reset_prediction_service_for_testing() -> None:
+    global _service
+    with _service_lock:
+        _service = None

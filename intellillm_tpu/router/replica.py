@@ -52,6 +52,19 @@ class Replica:
         self.predicted_load = 0.0
         self.inflight = 0
 
+    @property
+    def calibration_factor(self) -> float:
+        """This replica's predictor calibration factor (global p50
+        actual/predicted ratio) from its last /health/detail poll; 1.0
+        until the replica reports one. The router scales its predicted
+        lengths by this so fleet load estimates use corrected lengths."""
+        predictor = (self.last_health or {}).get("predictor") or {}
+        try:
+            factor = float(predictor.get("calibration_factor", 1.0))
+        except (TypeError, ValueError):
+            return 1.0
+        return factor if factor > 0 else 1.0
+
     async def generate(self, payload: dict,
                        predicted_len: Optional[int] = None,
                        request_id: Optional[str] = None
@@ -148,6 +161,10 @@ class InProcessReplica(Replica):
             body["kv_cache_usage"] = llm_engine.kv_cache_usage()
         except Exception:
             body["kv_cache_usage"] = None
+        # Same block the HTTP replicas expose via debug_routes'
+        # /health/detail — the router reads calibration_factor from it.
+        from intellillm_tpu.prediction import get_prediction_service
+        body["predictor"] = get_prediction_service().health_block()
         return 200, body
 
     async def fetch_trace(self, request_id: str) -> Optional[list]:

@@ -159,24 +159,31 @@ class Router:
             self.recorder.record(trace_id, "route_decision",
                                  detail=f"{decision}->{replica_id}")
             request_id = attempt_request_id(trace_id, attempt)
-            self.manager.on_route(replica_id, predicted_len)
+            replica = self.manager.get(replica_id)
+            # Scale by the replica's reported calibration factor (from
+            # its /health/detail predictor block) so the fleet load model
+            # charges corrected lengths. The SAME scaled value must flow
+            # through on_route / generate / on_complete — the accounting
+            # is symmetric, and the factor may move between calls.
+            scaled_len = max(
+                int(round(predicted_len * replica.calibration_factor)), 1)
+            self.manager.on_route(replica_id, scaled_len)
             self.tracebook.note_attempt(trace_id, attempt, replica_id,
                                         request_id, decision)
             self.recorder.record(
                 trace_id, "routed",
                 detail=f"attempt={attempt} replica={replica_id} "
                        f"request_id={request_id}")
-            replica = self.manager.get(replica_id)
             try:
                 async for chunk in replica.generate(
-                        payload, predicted_len=predicted_len,
+                        payload, predicted_len=scaled_len,
                         request_id=request_id):
                     if not first_chunk_seen:
                         first_chunk_seen = True
                         self.recorder.record(trace_id, "first_chunk",
                                              detail=f"replica={replica_id}")
                     yield chunk
-                self.manager.on_complete(replica_id, predicted_len)
+                self.manager.on_complete(replica_id, scaled_len)
                 self.recorder.record(trace_id, "finished",
                                      detail=f"replica={replica_id}")
                 self._finish_trace(trace_id, failed_over=attempt > 0)
@@ -188,7 +195,7 @@ class Router:
                 self.recorder.record(
                     trace_id, "replica_failed",
                     detail=f"replica={replica_id}: {e}"[:200])
-                self.manager.on_complete(replica_id, predicted_len)
+                self.manager.on_complete(replica_id, scaled_len)
                 self.manager.mark_failed(replica_id)
                 # Its cached prefixes are gone with it: let its keys
                 # re-seed instead of pinning to a corpse.

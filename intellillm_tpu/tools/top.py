@@ -274,6 +274,8 @@ def render_frame(health: Optional[Dict[str, Any]],
     if spark:
         lines.append(f"Goodput history: {spark}")
 
+    lines.extend(_predictor_lines(health.get("predictor")))
+
     lines.extend(_alerts_lines(alerts))
 
     lines.extend(_slowest_lines(slo.get("slowest") or []))
@@ -288,6 +290,25 @@ def render_frame(health: Optional[Dict[str, Any]],
     if tok_parts:
         lines.append("Tokens (cumulative): " + "  ".join(tok_parts))
     return "\n".join(lines)
+
+
+def _predictor_lines(pred: Optional[Dict[str, Any]]) -> List[str]:
+    """PREDICTOR panel from /health/detail's predictor block (the full
+    calibration table lives at /debug/predictor)."""
+    if not pred or not pred.get("enabled"):
+        return []
+    abs_err = pred.get("abs_error_ewma")
+    err_s = (f"{abs_err:.1f} tok" if isinstance(abs_err, (int, float))
+             else "n/a")
+    parts = [
+        f"cal x{pred.get('calibration_factor', 1.0)}",
+        f"abs-err {err_s}",
+        f"samples {pred.get('samples', 0)}",
+    ]
+    failures = pred.get("failures") or 0
+    if failures:
+        parts.append(f"failures {failures} **")
+    return ["", "Predictor: " + "  ".join(parts)]
 
 
 def _efficiency_lines(eff: Dict[str, Any]) -> List[str]:

@@ -9,7 +9,8 @@ from intellillm_tpu.sequence import Sequence, SequenceGroup, SequenceStatus
 
 
 def make_scheduler(num_blocks=16, block_size=4, max_num_seqs=8,
-                   policy="fcfs", num_decode_steps=1, max_model_len=64):
+                   policy="fcfs", num_decode_steps=1, max_model_len=64,
+                   **config_kwargs):
     cache_config = CacheConfig(block_size=block_size, swap_space_gib=0.001)
     cache_config.num_device_blocks = num_blocks
     cache_config.num_cpu_blocks = 8
@@ -19,7 +20,8 @@ def make_scheduler(num_blocks=16, block_size=4, max_num_seqs=8,
         max_model_len=max_model_len,
         max_paddings=256,
         policy=policy,
-        num_decode_steps=num_decode_steps)
+        num_decode_steps=num_decode_steps,
+        **config_kwargs)
     return Scheduler(scheduler_config, cache_config)
 
 
@@ -137,3 +139,116 @@ def test_abort():
     assert not s.has_unfinished_seqs()
     assert seq.status == SequenceStatus.FINISHED_ABORTED
     assert s.block_manager.get_num_free_device_blocks() == 16
+
+
+# --- length-predicted scheduling: calibration, aging, victim choice ----
+
+
+def _group(rid, arrival, predicted_len):
+    return SequenceGroup(rid, [Sequence(hash(rid) % 1000, "x", [1], 4)],
+                         SamplingParams(), arrival_time=arrival,
+                         predicted_len=predicted_len)
+
+
+def test_sjf_remaining_unknown_lengths_sort_last_fcfs():
+    """Unknown-length groups sort behind any predicted job and FCFS
+    among themselves — the age term is a tiebreak, never dominant."""
+    policy = PolicyFactory.get_policy("sjf_remaining")
+    known = _group("k", arrival=90.0, predicted_len=10**6)
+    unk_old = _group("a", arrival=0.0, predicted_len=None)
+    unk_new = _group("b", arrival=80.0, predicted_len=None)
+    order = policy.sort_by_priority(100.0, [unk_new, known, unk_old])
+    assert [g.request_id for g in order] == ["k", "a", "b"]
+
+
+def test_starvation_promotion_is_fcfs_above_sjf():
+    policy = PolicyFactory.get_policy("sjf", starvation_s=5.0)
+    long_oldest = _group("a", arrival=0.0, predicted_len=1000)
+    long_older = _group("b", arrival=50.0, predicted_len=1000)
+    short_fresh = _group("c", arrival=98.0, predicted_len=1)
+    order = policy.sort_by_priority(
+        100.0, [short_fresh, long_older, long_oldest])
+    # Both long jobs waited past the deadline: promoted above the fresh
+    # short job, ordered FCFS between themselves.
+    assert [g.request_id for g in order] == ["a", "b", "c"]
+    # Disabled (unset or 0) never promotes.
+    for off in (PolicyFactory.get_policy("sjf"),
+                PolicyFactory.get_policy("sjf", starvation_s=0)):
+        assert off.starvation_s is None
+        order = off.sort_by_priority(100.0, [long_oldest, short_fresh])
+        assert [g.request_id for g in order] == ["c", "a"]
+
+
+def test_starvation_deadline_bounds_queue_wait_in_scheduler():
+    """An old long job must be admitted ahead of a stream of fresh
+    short jobs once its wait exceeds --sjf-starvation-s."""
+    import time
+    s = make_scheduler(policy="sjf", max_num_seqs=1, num_blocks=64,
+                       sjf_starvation_s=5.0)
+    now = time.monotonic()
+    g_long, _ = add_request(s, "0", 4, predicted_len=1000)
+    g_long.arrival_time = now - 10.0  # waited past the deadline
+    for rid in ("1", "2"):
+        g, _ = add_request(s, rid, 4, predicted_len=1)
+        g.arrival_time = now
+    metas, _ = s.schedule()
+    assert [m.request_id for m in metas] == ["0"], (
+        "aged-out long job must be promoted over fresh short jobs")
+
+
+def test_calibration_refresh_reorders_sjf_queue():
+    """Golden ordering: a calibration update restamps a service-stamped
+    in-flight prediction and flips the SJF admission order."""
+    from intellillm_tpu.prediction import OnlineCalibrator
+
+    s = make_scheduler(policy="sjf", max_num_seqs=1, num_blocks=64)
+    g_stamped, _ = add_request(s, "0", 40, predicted_len=100)
+    g_stamped.predicted_len_raw = 100         # stamped by the service
+    g_oracle, _ = add_request(s, "1", 8, predicted_len=50)  # oracle len
+
+    cal = OnlineCalibrator()
+    cal.note_admission("warm", 40, 100)
+    cal.observe("warm", 10)  # bucket 32-63 factor → 0.1, marked dirty
+    assert cal.refresh_predictions(s.iter_seq_groups()) == 1
+    assert g_stamped.predicted_len == 10
+    assert g_oracle.predicted_len == 50  # oracle-supplied: never touched
+
+    metas, _ = s.schedule()
+    assert [m.request_id for m in metas] == ["0"], (
+        "restamped prediction (10 < 50) must now win SJF admission")
+
+
+def test_preemption_victim_is_most_predicted_remaining():
+    """Under memory pressure the victim is the running group with the
+    most predicted remaining work, not the priority-order tail."""
+    # 7 blocks: three 8-token prompts use 6, and the conservative
+    # can_append_slots check (2 free per appending seq) forces exactly
+    # one preemption on the first decode step.
+    s = make_scheduler(num_blocks=7, block_size=4)
+    g1, _ = add_request(s, "0", 8, predicted_len=10)
+    g2, _ = add_request(s, "1", 8, predicted_len=500)
+    g3, _ = add_request(s, "2", 8, predicted_len=10)
+    metas, _ = s.schedule()
+    assert len(metas) == 3
+    for g in (g1, g2, g3):
+        append_token(g)
+    metas, out = s.schedule()
+    assert not out.prompt_run
+    # Old behavior evicted the tail (g3); now the 500-token prediction
+    # is evicted, freeing the most future block demand.
+    assert [m.request_id for m in metas] == ["0", "2"]
+    assert g2.get_seqs()[0].status == SequenceStatus.WAITING
+
+
+def test_preemption_victim_prices_with_p90_when_available():
+    s = make_scheduler(num_blocks=7, block_size=4)
+    g1, _ = add_request(s, "0", 8, predicted_len=10)
+    g2, _ = add_request(s, "1", 8, predicted_len=500)
+    g3, _ = add_request(s, "2", 8, predicted_len=10)
+    g3.predicted_len_p90 = 800  # calibrated tail dwarfs g2's p50
+    s.schedule()
+    for g in (g1, g2, g3):
+        append_token(g)
+    metas, _ = s.schedule()
+    assert [m.request_id for m in metas] == ["0", "1"]
+    assert g3.get_seqs()[0].status == SequenceStatus.WAITING

@@ -283,6 +283,51 @@ def test_history_and_alerts_endpoints_on_both_servers(monkeypatch):
         manager.reset_for_testing()
 
 
+def test_predictor_endpoint_and_health_block_on_both_servers():
+    """/debug/predictor serves the calibration table; /health/detail
+    carries the compact predictor block (the router polls it for the
+    calibration factor) — even while the server is still initializing."""
+    from intellillm_tpu.prediction import (
+        get_prediction_service, reset_prediction_service_for_testing)
+
+    class _Stub:
+        def predict(self, prompt, prompt_token_ids):
+            return 100
+
+    reset_prediction_service_for_testing()
+    svc = get_prediction_service().configure(_Stub())
+    assert svc.predict("dbg-1", None, list(range(40))) is not None
+    svc.observe_finish("dbg-1", 20)
+    try:
+        async def scenario(client):
+            resp = await client.get("/debug/predictor")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["enabled"] is True
+            assert data["samples_total"] == 1
+            assert data["predictor"] == "_Stub"
+            assert data["global_calibration_factor"] == pytest.approx(0.2)
+            assert data["buckets"]["32-63"]["factor_p50"] == pytest.approx(
+                0.2)
+            assert data["recent"][0]["request_id"] == "dbg-1"
+            assert data["recent"][0]["actual"] == 20
+
+            # No engine behind the test app: 503 "initializing", but the
+            # predictor block rides along for the router's poller.
+            resp = await client.get("/health/detail")
+            assert resp.status == 503
+            data = await resp.json()
+            assert data["predictor"]["enabled"] is True
+            assert data["predictor"]["samples"] == 1
+            assert data["predictor"]["calibration_factor"] == (
+                pytest.approx(0.2))
+
+        _run(demo_server.build_app(), scenario)
+        _run(openai_server.build_app(), scenario)
+    finally:
+        reset_prediction_service_for_testing()
+
+
 def test_demo_server_has_debug_routes():
     _seed_recorder()
     try:

@@ -1,0 +1,171 @@
+"""OnlineCalibrator unit tests: bucket mapping, EWMA/quantile factors,
+pending-admission lifecycle, dirty-bucket in-flight restamping, and the
+exported error series shrinking under a forced misprediction."""
+from types import SimpleNamespace
+
+import pytest
+
+from intellillm_tpu.prediction import calibration
+from intellillm_tpu.prediction.calibration import OnlineCalibrator, bucket_of
+from intellillm_tpu.prediction.metrics import _PROMETHEUS
+from intellillm_tpu.prediction.service import PredictionService
+
+
+def test_bucket_of_power_of_two_labels():
+    assert bucket_of(0) == "0-31"
+    assert bucket_of(31) == "0-31"
+    assert bucket_of(32) == "32-63"
+    assert bucket_of(63) == "32-63"
+    assert bucket_of(100) == "64-127"
+    assert bucket_of(2047) == "1024-2047"
+    assert bucket_of(2048) == "2048+"
+    assert bucket_of(100_000) == "2048+"
+
+
+def test_correct_is_identity_without_samples():
+    cal = OnlineCalibrator()
+    assert cal.correct(40, 100) == (100, 100)
+    assert cal.factor() == 1.0
+    assert cal.factor(40) == 1.0
+
+
+def test_observe_updates_bucket_factor_and_correct():
+    cal = OnlineCalibrator()
+    cal.note_admission("r1", 40, 100)
+    sample = cal.observe("r1", 20)
+    assert sample["bucket"] == "32-63"
+    assert sample["predicted_raw"] == 100
+    assert sample["actual"] == 20
+    # Single-sample quantiles: p50 == p90 == the one ratio (0.2).
+    assert cal.correct(40, 100) == (20, 20)
+    assert cal.factor(40) == pytest.approx(0.2)
+    assert cal.factor() == pytest.approx(0.2)
+    # Other buckets stay uncalibrated.
+    assert cal.correct(500, 100) == (100, 100)
+
+
+def test_observe_unknown_request_returns_none():
+    cal = OnlineCalibrator()
+    assert cal.observe("never-admitted", 10) is None
+    assert cal.snapshot()["samples_total"] == 0
+
+
+def test_discard_drops_pending_admission():
+    cal = OnlineCalibrator()
+    cal.note_admission("r1", 40, 100)
+    cal.discard("r1")
+    assert cal.observe("r1", 20) is None
+
+
+def test_pending_map_is_lru_bounded(monkeypatch):
+    monkeypatch.setattr(calibration, "_MAX_PENDING", 3)
+    cal = OnlineCalibrator()
+    for i in range(5):
+        cal.note_admission(f"r{i}", 40, 100)
+    # r0 and r1 aged out; r4 is still pending.
+    assert cal.observe("r0", 20) is None
+    assert cal.observe("r1", 20) is None
+    assert cal.observe("r4", 20) is not None
+
+
+def test_quantile_factors_over_rolling_window():
+    cal = OnlineCalibrator()
+    # Ratios 0.1, 0.2, ..., 1.0 → p50 at index 5 (0.6), p90 at index 9.
+    for i, actual in enumerate(range(10, 101, 10)):
+        cal.note_admission(f"r{i}", 40, 100)
+        cal.observe(f"r{i}", actual)
+    p50, p90 = cal.correct(40, 100)
+    assert p50 == 60
+    assert p90 == 100
+    snap = cal.snapshot()["buckets"]["32-63"]
+    assert snap["samples"] == 10
+    assert snap["factor_p50"] == pytest.approx(0.6)
+    assert snap["factor_p90"] == pytest.approx(1.0)
+
+
+def test_correct_clamps_p90_at_least_p50_and_floor_one():
+    cal = OnlineCalibrator()
+    cal.note_admission("r1", 40, 100)
+    cal.observe("r1", 0)  # ratio 0 → factor 0 → predictions floor at 1
+    assert cal.correct(40, 100) == (1, 1)
+
+
+def test_refresh_restamps_only_raw_groups_in_dirty_buckets():
+    cal = OnlineCalibrator()
+    cal.note_admission("warm", 40, 100)
+    cal.observe("warm", 10)  # bucket 32-63 factor 0.1 → dirty
+
+    stamped = SimpleNamespace(prompt_token_ids=list(range(40)),
+                              predicted_len_raw=100, predicted_len=100,
+                              predicted_len_p90=100)
+    oracle = SimpleNamespace(prompt_token_ids=list(range(40)),
+                             predicted_len_raw=None, predicted_len=50,
+                             predicted_len_p90=None)
+    other_bucket = SimpleNamespace(prompt_token_ids=list(range(500)),
+                                   predicted_len_raw=100, predicted_len=100,
+                                   predicted_len_p90=100)
+    refreshed = cal.refresh_predictions([stamped, oracle, other_bucket])
+    assert refreshed == 1
+    assert stamped.predicted_len == 10
+    assert stamped.predicted_len_p90 == 10
+    assert oracle.predicted_len == 50          # oracle-supplied: untouched
+    assert other_bucket.predicted_len == 100   # clean bucket: untouched
+
+
+def test_refresh_is_noop_when_factors_are_stable():
+    cal = OnlineCalibrator()
+    cal.note_admission("warm", 40, 100)
+    cal.observe("warm", 10)
+    assert cal.refresh_predictions([]) == 0  # dirty cleared, none matched
+    # Same ratio again: factor unchanged → bucket stays clean.
+    cal.note_admission("warm2", 40, 100)
+    cal.observe("warm2", 10)
+    sg = SimpleNamespace(prompt_token_ids=list(range(40)),
+                         predicted_len_raw=100, predicted_len=10,
+                         predicted_len_p90=10)
+    assert cal.refresh_predictions([sg]) == 0
+    assert sg.predicted_len == 10
+
+
+def test_snapshot_shape():
+    cal = OnlineCalibrator()
+    cal.note_admission("r1", 40, 100)
+    cal.observe("r1", 80)
+    snap = cal.snapshot()
+    assert snap["samples_total"] == 1
+    assert snap["pending"] == 0
+    assert snap["abs_error_ewma"] == 20.0
+    assert 0.0 <= snap["overprediction_rate"] <= 1.0
+    assert snap["recent"][0]["request_id"] == "r1"
+    assert set(snap["buckets"]["32-63"]) == {
+        "samples", "ewma_ratio", "factor_p50", "factor_p90"}
+
+
+@pytest.mark.skipif(not _PROMETHEUS, reason="needs prometheus_client")
+def test_forced_misprediction_error_series_decreases():
+    """Acceptance e2e: a predictor that always guesses 200 against a
+    workload that always produces 25 tokens. The exported calibrated
+    abs-error series must shrink across calibration updates while the
+    raw series stays at the (constant) misprediction."""
+    from prometheus_client import REGISTRY
+
+    svc = PredictionService(
+        predictor=SimpleNamespace(predict=lambda prompt, ids: 200))
+    errors = []
+    for i in range(6):
+        rid = f"force-{i}"
+        assert svc.predict(rid, None, list(range(40))) is not None
+        svc.observe_finish(rid, 25)
+        errors.append(REGISTRY.get_sample_value(
+            "intellillm_predictor_abs_error_calibrated"))
+    # First sample is priced with factor 1.0 (error 175); every later
+    # one uses the learned 0.125 factor (error 0), so the EWMA decays.
+    assert errors[0] == pytest.approx(175.0)
+    assert all(b < a for a, b in zip(errors, errors[1:]))
+    assert errors[-1] < errors[0] / 2
+    # The raw series records the uncalibrated miss, flat at 175.
+    assert REGISTRY.get_sample_value(
+        "intellillm_predictor_abs_error") == pytest.approx(175.0)
+    assert REGISTRY.get_sample_value(
+        "intellillm_predictor_calibration_factor",
+        {"bucket": "32-63"}) == pytest.approx(0.125)

@@ -1,0 +1,138 @@
+"""PredictionService unit tests: quantile stamping, failure containment
+(log once per episode, count every failure), and the finish-path hook
+that restamps in-flight scheduler groups."""
+import logging
+from types import SimpleNamespace
+
+import pytest
+
+from intellillm_tpu.prediction.service import (
+    PredictionService, get_prediction_service,
+    reset_prediction_service_for_testing)
+
+
+class _FlakyPredictor:
+    """Predicts a constant, or raises while `fail` is set."""
+
+    def __init__(self, value=100):
+        self.value = value
+        self.fail = False
+
+    def predict(self, prompt, prompt_token_ids):
+        if self.fail:
+            raise RuntimeError("checkpoint went away")
+        return self.value
+
+
+def test_disabled_service_predicts_none():
+    svc = PredictionService()
+    assert not svc.enabled
+    assert svc.predict("r1", "hello", None) is None
+    block = svc.health_block()
+    assert block["enabled"] is False
+    assert block["calibration_factor"] == 1.0
+
+
+def test_predict_stamps_quantiles_and_learns():
+    svc = PredictionService(predictor=_FlakyPredictor(value=100))
+    p = svc.predict("r1", None, list(range(40)))
+    assert (p.p50, p.p90, p.raw, p.bucket) == (100, 100, 100, "32-63")
+    svc.observe_finish("r1", 20)
+    # The finished sample recalibrates the bucket: next prediction from
+    # the same bucket comes back corrected.
+    p2 = svc.predict("r2", None, list(range(40)))
+    assert p2.raw == 100
+    assert p2.p50 == 20
+    block = svc.health_block()
+    assert block["samples"] == 1
+    assert block["calibration_factor"] == pytest.approx(0.2)
+
+
+def test_prompt_len_falls_back_to_text_length():
+    svc = PredictionService(predictor=_FlakyPredictor())
+    p = svc.predict("r1", "x" * 40, None)
+    assert p.bucket == "32-63"
+
+
+def test_failures_logged_once_per_episode(caplog, monkeypatch):
+    # The package logger does not propagate (it has its own stdout
+    # handler); re-enable propagation so caplog sees the records.
+    monkeypatch.setattr(
+        logging.getLogger("intellillm_tpu"), "propagate", True)
+    svc = PredictionService(predictor=_FlakyPredictor())
+    svc._predictor.fail = True
+    with caplog.at_level(logging.INFO,
+                         logger="intellillm_tpu.prediction.service"):
+        assert svc.predict("r1", "x", None) is None
+        assert svc.predict("r2", "x", None) is None
+        warnings = [r for r in caplog.records
+                    if r.levelno == logging.WARNING]
+        assert len(warnings) == 1, "one warning per failure episode"
+
+        # Recovery closes the episode (logged at INFO)...
+        svc._predictor.fail = False
+        assert svc.predict("r3", "x", None) is not None
+        assert any("recovered" in r.message for r in caplog.records)
+
+        # ...so the next failure opens a new episode with a new warning.
+        svc._predictor.fail = True
+        assert svc.predict("r4", "x", None) is None
+        warnings = [r for r in caplog.records
+                    if r.levelno == logging.WARNING]
+        assert len(warnings) == 2
+    # Every failure is counted, logged or not.
+    assert svc._failures == 3
+    assert svc.health_block()["failures"] == 3
+
+
+def test_observe_finish_refreshes_inflight_groups():
+    svc = PredictionService(predictor=_FlakyPredictor(value=100))
+    svc.predict("r1", None, list(range(40)))
+    inflight = SimpleNamespace(prompt_token_ids=list(range(40)),
+                               predicted_len_raw=100, predicted_len=100,
+                               predicted_len_p90=100)
+    scheduler = SimpleNamespace(iter_seq_groups=lambda: iter([inflight]))
+    svc.observe_finish("r1", 10, scheduler=scheduler)
+    assert inflight.predicted_len == 10
+    assert inflight.predicted_len_p90 == 10
+
+
+def test_observe_finish_without_sample_skips_refresh():
+    svc = PredictionService(predictor=_FlakyPredictor())
+
+    def boom():
+        raise AssertionError("refresh must not run for unmatched finishes")
+
+    svc.observe_finish("never-admitted", 10,
+                       scheduler=SimpleNamespace(iter_seq_groups=boom))
+
+
+def test_discard_censors_aborted_requests():
+    svc = PredictionService(predictor=_FlakyPredictor(value=100))
+    svc.predict("r1", None, list(range(40)))
+    svc.discard("r1")
+    svc.observe_finish("r1", 20)
+    assert svc.health_block()["samples"] == 0
+
+
+def test_snapshot_names_the_predictor():
+    svc = PredictionService(predictor=_FlakyPredictor())
+    svc.predict("r1", None, list(range(40)))
+    svc.observe_finish("r1", 50)
+    snap = svc.snapshot()
+    assert snap["enabled"] is True
+    assert snap["predictor"] == "_FlakyPredictor"
+    assert snap["global_calibration_factor"] == pytest.approx(0.5)
+    assert snap["failures"] == 0
+
+
+def test_global_service_singleton_reset():
+    reset_prediction_service_for_testing()
+    try:
+        a = get_prediction_service()
+        assert a is get_prediction_service()
+        assert not a.enabled  # fresh instance, no predictor injected
+        reset_prediction_service_for_testing()
+        assert get_prediction_service() is not a
+    finally:
+        reset_prediction_service_for_testing()
