@@ -429,6 +429,13 @@ def main():
             _fail_record(f"build_engine failed twice: {e2!r}")
             raise
 
+    # Structured warm-up outcome (compiled-executable count + wall
+    # seconds) straight off the worker: the "<30s warm-up, mixed program
+    # family only" boot criterion is checked from BENCH_r*.json fields,
+    # not from log grep.
+    _PROGRESS["engine_warmup"] = getattr(engine.worker, "warmup_stats",
+                                         None)
+
     # From here the engine (and its flight recorder) exists: a SIGTERM
     # from the driver should flush the black box before dying.
     try:
@@ -474,6 +481,12 @@ def main():
         "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),
     }
     rec["regression"] = _regression_vs_prior(tok_s)
+    warmup = _PROGRESS.get("engine_warmup")
+    if warmup is not None:
+        rec["warmup_compile"] = {
+            **warmup,
+            "under_30s": warmup.get("seconds", 1e9) < 30.0,
+        }
     print(json.dumps(rec))
 
 

@@ -54,8 +54,8 @@ def launch_server(model_dir: str, args,
         cmd += ["--quantization", args.quantization]
     if args.num_device_blocks:
         cmd += ["--num-device-blocks-override", str(args.num_device_blocks)]
-    if args.enable_chunked_prefill:
-        cmd += ["--enable-chunked-prefill"]
+    if args.disable_chunked_prefill:
+        cmd += ["--disable-chunked-prefill"]
     if args.max_num_batched_tokens:
         cmd += ["--max-num-batched-tokens",
                 str(args.max_num_batched_tokens)]
@@ -363,7 +363,7 @@ def run_ttft_under_load(args, api_url: str, model_name: str, tokenizer,
     (pr,) = probe_results
     return {
         "scenario": "ttft-under-load",
-        "chunked_prefill": bool(args.enable_chunked_prefill),
+        "chunked_prefill": not args.disable_chunked_prefill,
         "max_num_batched_tokens": args.max_num_batched_tokens,
         "probe_input_len": probe[1],
         "probe_output_len": probe[2],
@@ -643,6 +643,15 @@ def run_single(args, model_dir, tokenizer, scheduling_policy=None) -> dict:
                       flush=True)
         summary["observability"] = snapshot_observability(base)
         detail = snapshot_health_detail(base)
+        # Structured warm-up outcome from the boot timeline: compiled
+        # executable count + wall seconds, plus the machine-checked
+        # "<30s warm-up" boot criterion.
+        boot = detail.get("boot") or {}
+        warmup = boot.get("warmup")
+        summary["boot"] = boot
+        summary["warmup_compile"] = (
+            {**warmup, "under_30s": warmup.get("seconds", 1e9) < 30.0}
+            if warmup else None)
         summary["slo"] = detail.get("slo") or {}
         summary["predictor"] = detail.get("predictor")
         summary["device_telemetry"] = distill_device_telemetry(detail)
@@ -718,7 +727,12 @@ def make_arg_parser() -> argparse.ArgumentParser:
                    help="pass --predictor-path to the server "
                         "(length-predictor checkpoint)")
     p.add_argument("--enable-chunked-prefill", action="store_true",
-                   help="pass --enable-chunked-prefill to the server")
+                   default=True,
+                   help="chunked prefill is the server default; flag "
+                        "kept for script compatibility (no-op)")
+    p.add_argument("--disable-chunked-prefill", action="store_true",
+                   help="pass --disable-chunked-prefill to the server "
+                        "(whole-prompt single-chunk admission)")
     p.add_argument("--max-num-batched-tokens", type=int, default=None,
                    help="pass --max-num-batched-tokens to the server "
                         "(per-step token budget; with chunked prefill "

@@ -144,6 +144,17 @@ DEFAULT_EXTRA_TRACED: Mapping[str, Tuple[str, ...]] = {
     ),
 }
 
+# Jit dispatch-bucket axes a module may define (attributes/globals named
+# `*_buckets`): each axis multiplies the executable count (one XLA
+# compile per bucket combination). model_runner.py collapsed to the
+# single mixed `(token_budget,)` family in PR 12 — the recompile-hazard
+# rule fails any NEW `*_buckets` definition there so the bucket zoo
+# (batch x length x block-width, 5-executable warm-up) cannot quietly
+# come back.
+DEFAULT_BUCKET_AXES: Mapping[str, Tuple[str, ...]] = {
+    "intellillm_tpu/worker/model_runner.py": ("mixed_token_buckets", ),
+}
+
 # Modules allowed to construct Prometheus collectors. Everything else
 # reporting a metric goes through these (one registry, one reset hook,
 # one docs table) — ad-hoc families elsewhere dodge the hygiene guards.
@@ -221,6 +232,8 @@ class Settings:
         default_factory=lambda: dict(DEFAULT_HOT_PATHS))
     extra_traced: Mapping[str, Tuple[str, ...]] = dataclasses.field(
         default_factory=lambda: dict(DEFAULT_EXTRA_TRACED))
+    bucket_axes: Mapping[str, Tuple[str, ...]] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_BUCKET_AXES))
     metrics_modules: Tuple[str, ...] = DEFAULT_METRICS_MODULES
     request_path_globs: Tuple[str, ...] = DEFAULT_REQUEST_PATH_GLOBS
     flag_sources: Tuple[str, ...] = DEFAULT_FLAG_SOURCES

@@ -107,6 +107,9 @@ class RecompileHazardRule(Rule):
         if mod.tree is None:
             return
         attach_parents(mod.tree)
+        allowed_axes = self.settings.bucket_axes.get(mod.rel)
+        if allowed_axes is not None:
+            yield from self._check_bucket_axes(mod, set(allowed_axes))
         funcs = qualified_functions(mod.tree)
         by_bare: Dict[str, List[Tuple[str, ast.AST]]] = {}
         for bare, qual, fn in funcs:
@@ -153,6 +156,45 @@ class RecompileHazardRule(Rule):
             yield from self._check_traced_body(mod, fn, qual)
             if statics is not None:
                 yield from self._check_shape_args(mod, fn, qual, statics)
+
+    def _check_bucket_axes(self, mod: ModuleSource,
+                           allowed: Set[str]) -> Iterator[Violation]:
+        """Settings.bucket_axes pins the dispatch-bucket axes a module
+        may define. Every `*_buckets` attribute/global is a jit dispatch
+        axis — one executable per bucket value, multiplied across axes.
+        model_runner.py collapsed to the single mixed `(token_budget,)`
+        family; a new axis silently reintroduces the executable zoo
+        (compile-storm warm-up, mid-serving compile stalls), so it must
+        be an explicit, linted decision."""
+        seen: Set[Tuple[str, int]] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            else:
+                continue
+            for target in targets:
+                if isinstance(target, ast.Attribute):
+                    name = target.attr
+                elif isinstance(target, ast.Name):
+                    name = target.id
+                else:
+                    continue
+                if (not name.endswith("_buckets") or name in allowed
+                        or (name, node.lineno) in seen):
+                    continue
+                seen.add((name, node.lineno))
+                yield self.violation(
+                    mod, mod.rel, node.lineno,
+                    f"new jit bucket axis `{name}`: this module is "
+                    f"pinned to the {sorted(allowed)} dispatch family — "
+                    "every extra bucket axis multiplies the executable "
+                    "count (compile-storm warm-up, mid-serving compile "
+                    "stalls)",
+                    hint="route the new shape through the mixed "
+                         "(token_budget,) family, or extend "
+                         "Settings.bucket_axes with a written rationale")
 
     def _check_traced_body(self, mod: ModuleSource, fn: ast.AST,
                            qual: str) -> Iterator[Violation]:

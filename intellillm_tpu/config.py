@@ -242,19 +242,20 @@ class ParallelConfig:
         # XLA owns ICI collectives; kept for CLI parity with the reference's
         # --disable-custom-all-reduce (subsumed by jax.lax.psum).
         self.disable_custom_collectives = disable_custom_collectives
-        # Sequence-parallel prefill (exceeds reference, SURVEY §2.6 "SP
-        # absent"): a single prompt of >= this many tokens runs its prefill
-        # with the sequence dim sharded over the mesh "data" axis via ring
-        # attention (ops/ring_attention.py). None disables. Requires
-        # data_parallel_size > 1.
+        # Sequence-parallel prefill: accepted but currently INERT. The
+        # ring/ulysses attention ops (ops/ring_attention.py) remain, but
+        # their engine hook rode the legacy whole-prompt prefill path,
+        # which the mixed token-budget dispatch replaced — prompts now
+        # prefill as budget-sized chunks, which bounds per-step prefill
+        # latency without sequence sharding. Re-wiring SP under the mixed
+        # dispatch is tracked in ROADMAP.md.
         self.sp_prefill_threshold = sp_prefill_threshold
-        if sp_prefill_threshold is not None and data_parallel_size <= 1:
+        if sp_prefill_threshold is not None:
             logger.warning(
-                "sp_prefill_threshold=%d has no effect with "
-                "data_parallel_size=1: sequence-parallel prefill shards "
-                "the sequence over the mesh 'data' axis; long prompts "
-                "will keep the single-chip flash path.",
-                sp_prefill_threshold)
+                "sp_prefill_threshold=%d is currently inert: "
+                "sequence-parallel prefill was tied to the removed "
+                "whole-prompt prefill path; prompts prefill as chunked "
+                "mixed-dispatch rows instead.", sp_prefill_threshold)
         self.world_size = (tensor_parallel_size * data_parallel_size *
                            pipeline_parallel_size)
         self._verify_args()
@@ -329,7 +330,13 @@ class SchedulerConfig:
                 f"be >= max_model_len ({self.max_model_len}). Enable chunked "
                 "prefill (--enable-chunked-prefill) to use a per-step token "
                 "budget smaller than the longest admissible prompt.")
-        if self.max_num_batched_tokens < self.max_num_seqs:
+        if (self.max_num_batched_tokens < self.max_num_seqs
+                and not self.enable_chunked_prefill):
+            # Chunked admission seats every runnable decode before the
+            # token budget is consulted (the budget throttles chunk
+            # admission only, with the starvation guard covering the
+            # decode_rows > budget corner), so a budget below the seat
+            # count is legal there.
             raise ValueError(
                 "max_num_batched_tokens must be >= max_num_seqs")
         if self.num_decode_steps < 1:
@@ -406,7 +413,15 @@ def _get_and_verify_dtype(hf_config, dtype: Union[str, "object"]) -> str:
     """Resolve dtype string. TPU-first: 'auto' maps fp16 checkpoints to
     bfloat16 (fp16 has no TPU advantage and risks overflow); fp32 stays fp32
     for golden tests (reference `config.py:506-554` keeps fp16)."""
-    config_dtype = getattr(hf_config, "torch_dtype", None)
+    # Read `dtype` first (the current transformers field); fall back to a
+    # raw __dict__ lookup for `torch_dtype` on older checkpoints/configs.
+    # Never touch the `torch_dtype` attribute itself: on current
+    # transformers it is a deprecated alias property whose mere ACCESS
+    # logs "torch_dtype is deprecated! Use dtype instead!" at every
+    # engine init.
+    config_dtype = getattr(hf_config, "dtype", None)
+    if config_dtype is None:
+        config_dtype = hf_config.__dict__.get("torch_dtype")
     config_dtype = str(config_dtype).replace("torch.", "") if config_dtype else "float32"
 
     if isinstance(dtype, str):

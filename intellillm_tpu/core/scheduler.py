@@ -38,8 +38,7 @@ from intellillm_tpu.prediction import get_prediction_service
 from intellillm_tpu.prefix import PrefixPool
 from intellillm_tpu.sequence import (Sequence, SequenceData, SequenceGroup,
                                      SequenceGroupMetadata, SequenceStatus)
-from intellillm_tpu.utils import (default_batch_buckets, default_len_buckets,
-                                  pad_to_bucket)
+from intellillm_tpu.utils import default_len_buckets, pad_to_bucket
 
 logger = init_logger(__name__)
 
@@ -112,26 +111,33 @@ class Scheduler:
             # Chunked mode: the token budget caps per-step compute, not
             # prompt length — prompts longer than the budget are split.
             self.prompt_limit = scheduler_config.max_model_len
-            # Non-chunkable prompts (beam / best_of>1 / prompt_logprobs /
-            # prefix) still prefill homogeneously; give that fallback a
-            # budget that can hold any admissible prompt.
-            self._prefill_token_budget = max(
-                scheduler_config.max_num_batched_tokens,
-                scheduler_config.max_model_len)
         else:
+            # --disable-chunked-prefill escape hatch: prompts still run
+            # as (single-chunk) mixed rows, so they must fit the step
+            # budget whole — and the attention window on sliding-window
+            # models (a longer chunk would reuse ring slots in one step).
             self.prompt_limit = min(scheduler_config.max_model_len,
-                                    scheduler_config.max_num_batched_tokens)
-            self._prefill_token_budget = (
-                scheduler_config.max_num_batched_tokens)
+                                    scheduler_config.max_num_batched_tokens,
+                                    cache_config.sliding_window
+                                    or scheduler_config.max_model_len)
+        self._prefill_token_budget = scheduler_config.max_num_batched_tokens
 
-        # Bucketed-shape mirrors of the runner's padding (utils
-        # default_*_buckets — the runner builds its buckets from the same
-        # helpers), so max_paddings is charged against the shape the
-        # device actually runs, not the raw longest-prompt delta.
-        self._batch_buckets = default_batch_buckets(
-            scheduler_config.max_num_seqs)
-        self._len_buckets = default_len_buckets(
-            scheduler_config.max_model_len)
+        # Bucketed-shape mirror of the runner's mixed (token_budget,)
+        # family (worker/model_runner.py builds its list from the same
+        # helper with the same cap), so max_paddings and the starvation
+        # guard's headroom are charged against the flat-row shape the
+        # device actually runs.
+        max_blocks = (scheduler_config.max_model_len +
+                      cache_config.block_size - 1) // cache_config.block_size
+        self._mixed_token_buckets = default_len_buckets(
+            max(scheduler_config.max_num_batched_tokens,
+                scheduler_config.max_num_seqs, max_blocks, 16),
+            start=16)
+        # Sliding-window models: a chunk longer than the window would let
+        # two positions of one dispatch share a ring slot — cap chunks at
+        # the window (the ring layout is exact per step below it).
+        self._max_chunk_size = (cache_config.sliding_window
+                                or scheduler_config.max_model_len)
 
         self.policy: Policy = PolicyFactory.get_policy(
             scheduler_config.policy,
@@ -261,24 +267,34 @@ class Scheduler:
 
         now = time.monotonic()
 
-        # Chunked prefill: decode-first mixed steps whenever the state
-        # allows them. A None return means the mixed path does not apply
-        # right now (e.g. only non-chunkable prompts waiting) and the
-        # legacy homogeneous pass below should run instead.
+        # Chunked prefill (the default): decode-first mixed steps. Once
+        # any admitted sequence is mid-prefill, every step MUST go through
+        # the chunked pass until prefills drain — the decode pass below
+        # would treat a partially-prefilled sequence as a decode row over
+        # garbage KV. With nothing waiting and nothing mid-prefill the
+        # pass falls through so steady-state decode runs the fused
+        # multi-step program.
         if (self.scheduler_config.enable_chunked_prefill
-                and not prefill_only):
-            mixed = self._schedule_chunked(now)
-            if mixed is not None:
-                return mixed
+                and not prefill_only
+                and (self.waiting
+                     or any(self._is_prefilling(sg)
+                            for sg in list(self.running)
+                            + list(self.swapped)))):
+            return self._chunked_pass(now)
 
-        # Prefill-first: admit waiting prompts while nothing is swapped out
-        # (swapped groups have priority — they were already admitted once).
+        # Prompt admission: runs for --disable-chunked-prefill mode and
+        # for pipelined prefill-only passes. Prompts still execute as
+        # mixed token rows — each admission emits one whole-prompt chunk
+        # (flat token accounting against the mixed bucket family), so
+        # only the mixed program family ever runs.
+        # Admit while nothing is swapped out (swapped groups have
+        # priority — they were already admitted once).
         if not self.swapped:
             scheduled: List[SequenceGroup] = []
+            chunks: Dict[str, Tuple[int, int, bool]] = {}
             num_curr_seqs = sum(sg.get_max_num_running_seqs()
                                 for sg in self.running)
             num_batched_tokens = 0
-            seq_lens: List[int] = []
             curr_loras = self._running_loras()
             lora_deferred: List[SequenceGroup] = []
 
@@ -327,12 +343,22 @@ class Scheduler:
                     lora_deferred.append(seq_group)
                     continue
 
-                # Token budget counts the *padded* batch the runner will run
-                # (all prompts pad to the max in batch — same accounting as
-                # reference scheduler.py:230-245).
-                new_seq_lens = seq_lens + [num_prompt_tokens]
-                num_batched_tokens = len(new_seq_lens) * max(new_seq_lens)
-                if num_batched_tokens > self._prefill_token_budget:
+                # Computed prefix-cache tokens are skipped: their KV is
+                # already resident, so the chunk starts past them.
+                start = 0
+                prefix = seq_group.prefix
+                if prefix is not None and prefix.computed:
+                    start = min(prefix.get_length(), num_prompt_tokens - 1)
+                new_tokens = num_prompt_tokens - start
+                if new_tokens > self._max_chunk_size:
+                    # Sliding-window cap: this prompt needs real chunking —
+                    # leave it for a serial chunked pass.
+                    break
+
+                # Flat token accounting: the runner flattens prompt rows
+                # into one (token_budget,)-bucketed batch, so the budget
+                # caps the SUM of chunk tokens, not batch x max-len.
+                if num_batched_tokens + new_tokens > self._prefill_token_budget:
                     break
 
                 num_new_seqs = seq_group.get_max_num_running_seqs()
@@ -340,21 +366,20 @@ class Scheduler:
                         > self.scheduler_config.max_num_seqs):
                     break
 
-                # Padding waste counted against the *bucketed* shape the
-                # runner actually pads to (batch bucket x length bucket),
-                # not the raw longest-prompt delta. A lone prompt is always
+                # Padding waste counted against the *bucketed* flat shape
+                # the runner actually pads to. A lone prompt is always
                 # admitted: its bucket padding is intrinsic — no admission
                 # decision can shrink it.
+                total = num_batched_tokens + new_tokens
                 num_paddings = (
-                    pad_to_bucket(len(new_seq_lens), self._batch_buckets)
-                    * pad_to_bucket(max(new_seq_lens), self._len_buckets)
-                    - sum(new_seq_lens))
-                if seq_lens and num_paddings > self.scheduler_config.max_paddings:
+                    pad_to_bucket(total, self._mixed_token_buckets) - total)
+                if scheduled and num_paddings > self.scheduler_config.max_paddings:
                     break
-                seq_lens = new_seq_lens
+                num_batched_tokens = total
 
                 self.waiting.popleft()
                 self._allocate(seq_group)
+                chunks[seq_group.request_id] = (start, new_tokens, True)
                 self.running.append(seq_group)
                 num_curr_seqs += num_new_seqs
                 if curr_loras is not None and lora_id > 0:
@@ -374,12 +399,13 @@ class Scheduler:
                 return SchedulerOutputs(
                     scheduled_seq_groups=scheduled,
                     prompt_run=True,
-                    num_batched_tokens=(len(seq_lens) *
-                                        max(seq_lens) if seq_lens else 0),
+                    num_batched_tokens=num_batched_tokens,
                     blocks_to_swap_in=blocks_to_swap_in,
                     blocks_to_swap_out=blocks_to_swap_out,
                     blocks_to_copy=blocks_to_copy,
                     ignored_seq_groups=ignored_seq_groups,
+                    chunked_prefills=chunks,
+                    num_prefill_tokens=num_batched_tokens,
                 )
 
         if prefill_only:
@@ -487,78 +513,9 @@ class Scheduler:
     # --- chunked prefill (mixed decode+prefill steps) ---------------------
 
     @staticmethod
-    def _mixed_safe(seq_group: SequenceGroup) -> bool:
-        """Whether this group can decode inside a mixed flat batch: one
-        row per live stream, no host work between rows. Beam search and
-        best_of fan-out need the homogeneous multi-sample panels;
-        logits_processors need host round-trips."""
-        sp = seq_group.sampling_params
-        return (not sp.use_beam_search and sp.best_of == 1
-                and not sp.logits_processors)
-
-    @staticmethod
-    def _chunkable(seq_group: SequenceGroup) -> bool:
-        """Whether this prompt may be split into chunks. On top of
-        mixed-safety: prompt_logprobs needs the full-prompt logits panel
-        and prefix caching keys its reuse off whole-prompt prefills, so
-        both keep the legacy homogeneous path."""
-        return (Scheduler._mixed_safe(seq_group)
-                and seq_group.sampling_params.prompt_logprobs is None
-                and seq_group.prefix is None)
-
-    @staticmethod
     def _is_prefilling(seq_group: SequenceGroup) -> bool:
         return any(not s.data.prefill_complete
                    for s in seq_group.get_unfinished_seqs())
-
-    def _schedule_chunked(self, now: float) -> Optional[SchedulerOutputs]:
-        """Decide whether this step should be a mixed (decode-first) step.
-
-        Invariant: once any admitted sequence is mid-prefill, every step
-        MUST go through the chunked pass until all prefills drain — the
-        legacy decode pass would treat a partially-prefilled sequence as a
-        decode row over garbage KV. The chunked pass maintains the
-        invariant by only *starting* chunked prefills from a state where
-        all resident groups are mixed-safe and nothing is swapped out, and
-        by admitting only chunkable prompts while prefilling.
-        """
-        prefilling = any(
-            self._is_prefilling(sg)
-            for sg in list(self.running) + list(self.swapped))
-        if prefilling:
-            return self._chunked_pass(now)
-
-        # Not currently prefilling: only enter the mixed path when it can
-        # actually start a new chunked prefill this step — otherwise the
-        # legacy pass is strictly better (fused multi-step decode).
-        if self.swapped or not self.waiting:
-            return None
-        if any(not self._mixed_safe(sg) for sg in self.running):
-            return None
-        if self.scheduler_config.policy != "fcfs":
-            self.waiting = deque(
-                self.policy.sort_by_priority(now, self.waiting))
-        head = self.waiting[0]
-        if not self._chunkable(head):
-            return None
-        head_seqs = head.get_seqs(status=SequenceStatus.WAITING)
-        if (len(head_seqs) != 1
-                or head_seqs[0].get_len() > self.prompt_limit):
-            return None  # legacy pass owns the ignore/warn bookkeeping
-        if self.block_manager.can_allocate(head) != AllocStatus.OK:
-            return None
-        num_curr_seqs = sum(sg.get_max_num_running_seqs()
-                            for sg in self.running)
-        if num_curr_seqs + 1 > self.scheduler_config.max_num_seqs:
-            return None
-        if self._lora_cap_exceeded(self._running_loras(),
-                                   head.lora_int_id):
-            return None
-        decode_rows = sum(sg.num_seqs(status=SequenceStatus.RUNNING)
-                          for sg in self.running)
-        if decode_rows >= self.scheduler_config.max_num_batched_tokens:
-            return None  # no slack for even a 1-token chunk
-        return self._chunked_pass(now)
 
     def _chunked_pass(self, now: float) -> SchedulerOutputs:
         """One mixed step: admit every runnable decode first (preempting
@@ -646,13 +603,29 @@ class Scheduler:
 
         # Pass 3: spend the slack on prefill chunks — in-flight first.
         slack = budget - decode_rows
+        if slack <= 0 and (prefilling_groups
+                           or (self.waiting and not preempted
+                               and not self.swapped)):
+            # Starvation guard — prefills must advance every step even
+            # when decode rows alone fill the token budget. The padded
+            # bucket usually has free rows, so chunk tokens ride in the
+            # padding for free; if decode_rows lands exactly on a bucket
+            # edge, defer the lowest-priority decode group by one step
+            # instead (it stays RUNNING and rejoins next step).
+            slack = (pad_to_bucket(decode_rows, self._mixed_token_buckets)
+                     - decode_rows)
+            if slack <= 0 and decode_groups:
+                deferred = decode_groups.pop()
+                decode_rows -= deferred.num_seqs(
+                    status=SequenceStatus.RUNNING)
+                slack = budget - decode_rows
         chunk_groups: List[SequenceGroup] = []
         for seq_group in prefilling_groups:
             if slack <= 0:
                 break
             seq = seq_group.get_seqs(status=SequenceStatus.RUNNING)[0]
             remaining = seq.data.get_num_uncomputed_tokens()
-            size = min(remaining, slack)
+            size = min(remaining, slack, self._max_chunk_size)
             start = seq.data.get_num_computed_tokens()
             final = size == remaining
             seq.data.update_num_computed_tokens(size)
@@ -662,18 +635,21 @@ class Scheduler:
             chunk_groups.append(seq_group)
             slack -= size
 
-        # Pass 4: admit new chunkable prompts into whatever slack is left.
-        # Same gating as the legacy prefill pass (swapped groups keep
-        # priority; a preempting step admits nothing new).
+        # Pass 4: admit new prompts into whatever slack is left (every
+        # prompt is chunkable now — beam/best_of fan out through the
+        # mixed dispatch's multi-sample rows, prompt_logprobs accumulate
+        # across chunks, prefix hits start past the computed tokens).
+        # Swapped groups keep priority; a preempting step admits nothing.
         if not preempted and not self.swapped:
             num_curr_seqs = sum(sg.get_max_num_running_seqs()
                                 for sg in self.running)
             curr_loras = self._running_loras()
             lora_deferred: List[SequenceGroup] = []
+            if self.scheduler_config.policy != "fcfs":
+                self.waiting = deque(
+                    self.policy.sort_by_priority(now, self.waiting))
             while self.waiting and slack > 0:
                 seq_group = self.waiting[0]
-                if not self._chunkable(seq_group):
-                    break  # keeps policy order; legacy pass admits it later
                 waiting_seqs = seq_group.get_seqs(
                     status=SequenceStatus.WAITING)
                 assert len(waiting_seqs) == 1, (
@@ -707,21 +683,31 @@ class Scheduler:
                     self.waiting.popleft()
                     lora_deferred.append(seq_group)
                     continue
-                if num_curr_seqs + 1 > self.scheduler_config.max_num_seqs:
+                num_new_seqs = seq_group.get_max_num_running_seqs()
+                if (num_curr_seqs + num_new_seqs
+                        > self.scheduler_config.max_num_seqs):
                     break
                 self.waiting.popleft()
                 self._allocate(seq_group, mark_prefilled=False)
                 seq = seq_group.get_seqs(status=SequenceStatus.RUNNING)[0]
-                size = min(num_prompt_tokens, slack)
-                final = size == num_prompt_tokens
+                # Computed prefix-cache tokens are skipped: their KV is
+                # already resident, so the first chunk starts past them.
+                start = 0
+                prefix = seq_group.prefix
+                if prefix is not None and prefix.computed:
+                    start = min(prefix.get_length(), num_prompt_tokens - 1)
+                    seq.data.update_num_computed_tokens(start)
+                remaining = num_prompt_tokens - start
+                size = min(remaining, slack, self._max_chunk_size)
+                final = size == remaining
                 seq.data.update_num_computed_tokens(size)
                 if final:
                     seq.data.mark_prefill_complete()
-                chunks[seq_group.request_id] = (0, size, final)
+                chunks[seq_group.request_id] = (start, size, final)
                 chunk_groups.append(seq_group)
                 slack -= size
                 self.running.append(seq_group)
-                num_curr_seqs += 1
+                num_curr_seqs += num_new_seqs
                 if curr_loras is not None and lora_id > 0:
                     curr_loras.add(lora_id)
                 if seq_group.first_scheduled_time is None:
@@ -815,12 +801,11 @@ class Scheduler:
     def can_continue_decode(self) -> bool:
         """Whether the current decode batch may be extended in place (same
         rows, host state lagging) without a fresh scheduling pass: nothing
-        waiting for admission, nothing swapped out awaiting swap-in.
-        Chunked mode never extends in place — mixed steps are scheduled
-        one at a time (the engine disables pipelining with chunked
-        prefill anyway; this is defense in depth)."""
+        waiting for admission, nothing swapped out awaiting swap-in, and
+        no resident sequence mid-prefill (its next chunk needs a fresh
+        mixed scheduling pass)."""
         return (not self.waiting and not self.swapped
-                and not self.scheduler_config.enable_chunked_prefill)
+                and not any(self._is_prefilling(sg) for sg in self.running))
 
     # --- internals -------------------------------------------------------
 

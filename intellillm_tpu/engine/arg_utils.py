@@ -41,7 +41,8 @@ class EngineArgs:
     sjf_starvation_s: Optional[float] = None
     predictor_path: Optional[str] = None
     num_decode_steps: int = 8
-    enable_chunked_prefill: bool = False
+    enable_chunked_prefill: bool = True
+    disable_chunked_prefill: bool = False
     # Model
     dtype: str = "auto"
     load_format: str = "auto"
@@ -127,13 +128,22 @@ class EngineArgs:
         parser.add_argument("--num-decode-steps", type=int, default=8,
                             help="decode iterations fused per device call")
         parser.add_argument("--enable-chunked-prefill", action="store_true",
-                            help="split long prompts into token-budget-sized "
-                            "chunks and piggyback them onto decode batches "
-                            "(mixed steps); running decodes are admitted "
-                            "first, so a long prompt no longer stalls "
-                            "generation. --max-num-batched-tokens becomes a "
-                            "per-step compute budget (default 512) instead "
-                            "of a prompt-length ceiling")
+                            default=True,
+                            help="(default: on) split long prompts into "
+                            "token-budget-sized chunks and piggyback them "
+                            "onto decode batches (mixed steps); running "
+                            "decodes are admitted first, so a long prompt "
+                            "never stalls generation. "
+                            "--max-num-batched-tokens is the per-step "
+                            "compute budget (default 512), not a "
+                            "prompt-length ceiling")
+        parser.add_argument("--disable-chunked-prefill", action="store_true",
+                            help="one-release escape hatch: admit each "
+                            "prompt as a single whole-prompt chunk instead "
+                            "of splitting it (prompts must then fit "
+                            "--max-num-batched-tokens whole). Execution "
+                            "still uses the mixed dispatch — the legacy "
+                            "homogeneous prefill path is gone")
         parser.add_argument("--dtype", type=str, default="auto",
                             choices=["auto", "bfloat16", "float32", "float16"])
         parser.add_argument("--load-format", type=str, default="auto",
@@ -224,7 +234,8 @@ class EngineArgs:
             max_paddings=self.max_paddings,
             policy=self.scheduling_policy,
             num_decode_steps=self.num_decode_steps,
-            enable_chunked_prefill=self.enable_chunked_prefill,
+            enable_chunked_prefill=(self.enable_chunked_prefill
+                                    and not self.disable_chunked_prefill),
             sjf_starvation_s=self.sjf_starvation_s,
             predictor_path=self.predictor_path,
         )

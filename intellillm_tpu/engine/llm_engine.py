@@ -144,19 +144,17 @@ class LLMEngine:
 
         # Chunked prefill constraints, decided HERE (like the K clamp
         # above) so scheduler and runner agree. Speculative decoding owns
-        # its own dispatch pattern (draft + verify) — mixing chunk rows in
-        # is unsupported. Sliding-window attention needs whole-prompt
-        # prefill (the windowed ring layout is laid down in one pass).
-        if scheduler_config.enable_chunked_prefill:
-            if speculative_config is not None:
-                raise ValueError(
-                    "Chunked prefill (--enable-chunked-prefill) is "
-                    "incompatible with speculative decoding.")
-            if model_config.get_sliding_window() is not None:
-                logger.info(
-                    "Disabling chunked prefill: sliding-window attention "
-                    "requires whole-prompt prefill.")
-                scheduler_config.enable_chunked_prefill = False
+        # its own dispatch pattern (draft + verify) — serial mixed steps
+        # would defeat it, so spec drops to single-chunk prompt admission.
+        # Sliding-window models chunk fine: the scheduler caps chunks at
+        # the window so no two rows of one dispatch share a ring slot.
+        if (scheduler_config.enable_chunked_prefill
+                and speculative_config is not None):
+            logger.info(
+                "Disabling chunked prefill: speculative decoding owns its "
+                "own draft+verify dispatch (prompts still execute as "
+                "single-chunk mixed rows).")
+            scheduler_config.enable_chunked_prefill = False
 
         # Compute-efficiency ledger (obs/efficiency.py): derive the
         # analytic FLOPs model and this chip's peak FLOPs BEFORE warm-up
@@ -204,12 +202,11 @@ class LLMEngine:
         from intellillm_tpu.utils import pipeline_enabled_env
         # Speculative decoding owns its own dispatch pattern (draft +
         # teacher-forced verify per step) — no pipelined continuations.
-        # Chunked prefill schedules every mixed step fresh (chunk sizes
-        # depend on the live decode set), so it is serial too.
+        # Chunked mode pipelines too: steady-state decode runs the fused
+        # continuation programs, and mixed steps (any sequence mid-
+        # prefill) force a fresh schedule via can_continue_decode.
         self.pipeline_enabled = (pipeline_enabled_env()
-                                 and speculative_config is None
-                                 and not scheduler_config.
-                                 enable_chunked_prefill)
+                                 and speculative_config is None)
         self._pipeline_depth = max(
             1, int(_os.environ.get("INTELLILLM_PIPELINE_DEPTH", "2")))
         self._inflight: deque = deque()
@@ -275,6 +272,13 @@ class LLMEngine:
             self._init_cache_pool()
         with self._boot.phase("warmup_compile"):
             self.worker.warm_up_model()
+        # Structured warm-up outcome (executable count + wall seconds) in
+        # the boot timeline: serve_bench reads it off /health/detail and
+        # bench.py reads it in-process, so the "<30s, mixed family only"
+        # boot criterion is machine-checkable rather than log-grepped.
+        stats = getattr(self.worker, "warmup_stats", None)
+        if stats is not None:
+            self._boot.set_info("warmup", dict(stats))
 
     def _init_cache_pool(self) -> None:
         cc = self.cache_config
@@ -758,6 +762,12 @@ class LLMEngine:
                 if seq_group.is_finished():
                     break  # finished at an earlier fused substep
                 outputs = output[idx]
+                if not outputs.samples and outputs.prompt_logprobs is None:
+                    # Mid-prefill chunk: no token emitted yet. Skipping
+                    # here matters for beam/best_of groups — the fork/
+                    # prune bookkeeping would treat an empty sample list
+                    # as "every continuation pruned" and kill the group.
+                    continue
                 if seq_group.first_token_time is None and outputs.samples:
                     seq_group.first_token_time = now
                     self._flight.record(seq_group.request_id, "first_token")
@@ -789,11 +799,16 @@ class LLMEngine:
                         scheduler=self.scheduler)
             request_outputs.append(RequestOutput.from_seq_group(seq_group))
 
-        # Flip freshly computed prefixes (reference llm_engine.py:727-731).
-        if scheduler_outputs.prompt_run:
-            for seq_group in scheduled_seq_groups:
-                if seq_group.prefix is not None:
-                    seq_group.prefix.computed = True
+        # Flip freshly computed prefixes once their FINAL chunk ran
+        # (reference llm_engine.py:727-731; with chunked prefill the
+        # prefix KV is only fully resident at the last chunk).
+        chunks_ran = scheduler_outputs.chunked_prefills or {}
+        for seq_group in scheduled_seq_groups:
+            if seq_group.prefix is None or seq_group.prefix.computed:
+                continue
+            chunk = chunks_ran.get(seq_group.request_id)
+            if chunk is not None and chunk[2]:
+                seq_group.prefix.computed = True
 
         # Drain the step-phase tracer even with stats logging off, so the
         # breakdown stays readable off the engine (tests, benches). Only

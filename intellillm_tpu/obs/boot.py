@@ -20,6 +20,7 @@ class BootTimeline:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._phases_s: Dict[str, float] = {}
+        self._info: Dict[str, Any] = {}
         self._started = time.monotonic()
         self._completed_at: Optional[float] = None
 
@@ -36,6 +37,14 @@ class BootTimeline:
             self._phases_s[name] = (
                 self._phases_s.get(name, 0.0) + max(seconds, 0.0))
 
+    def set_info(self, name: str, value: Any) -> None:
+        """Attach a structured (JSON-safe) block to the snapshot — e.g.
+        warm-up's compiled-executable count next to its wall time, so
+        benches can machine-check boot criteria instead of grepping
+        logs."""
+        with self._lock:
+            self._info[name] = value
+
     def mark_complete(self) -> None:
         with self._lock:
             if self._completed_at is None:
@@ -45,12 +54,14 @@ class BootTimeline:
         with self._lock:
             total = (self._completed_at - self._started
                      if self._completed_at is not None else None)
-            return {
+            snap = {
                 "phases_s": {k: round(v, 3)
                              for k, v in self._phases_s.items()},
                 "total_s": round(total, 3) if total is not None else None,
                 "complete": self._completed_at is not None,
             }
+            snap.update(self._info)
+            return snap
 
     def reset_for_testing(self) -> None:
         self.__init__()

@@ -4,10 +4,16 @@ Role parity: reference `vllm/worker/model_runner.py` (ModelRunner :45:
 _prepare_prompt :95, _prepare_decode :234, _prepare_sample :360,
 execute_model :516, CUDAGraphRunner :701). TPU redesign:
 
-- CUDA graphs → XLA compilation with *shape bucketing*: every batch is
-  padded to (batch, seq-len, block-table-width) buckets so jit caches a
-  small fixed set of executables (the analogue of
-  `_BATCH_SIZES_TO_CAPTURE`, model_runner.py:26-28).
+- CUDA graphs → XLA compilation with *shape bucketing*: decode rows and
+  prefill-chunk rows flatten into ONE (token_budget,)-bucketed batch of
+  the single-step program (the "mixed" dispatch), so jit caches one
+  small executable family (the analogue of `_BATCH_SIZES_TO_CAPTURE`,
+  model_runner.py:26-28) regardless of the prompt-length mix. Prompt
+  rows are chunk tokens: each is one token with its own absolute
+  position / block table / context; KV is written to the pool before
+  attention reads, so a chunk token attends to the prompt's earlier
+  chunks plus the in-flight chunk's earlier rows — exact causal
+  attention with no whole-prompt prefill program.
 - The per-step driver→worker tensor broadcast (:432-514) disappears:
   single-controller JAX passes batch arrays straight into the jitted,
   mesh-sharded step function; XLA moves what each chip needs over ICI.
@@ -25,7 +31,6 @@ execute_model :516, CUDAGraphRunner :701). TPU redesign:
 """
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -42,15 +47,13 @@ from intellillm_tpu.layers.sampler import (LOGPROB_K_BUCKETS,
                                            penalty_tensors_from_tokens,
                                            sample, sample_row_host)
 from intellillm_tpu.logger import init_logger
-from intellillm_tpu.native import build_decode_batch, build_prompt_slots
+from intellillm_tpu.native import build_decode_batch
 from intellillm_tpu.obs import (get_compile_tracker,
                                 get_efficiency_tracker, get_step_tracer)
-from intellillm_tpu.ops.kv_cache import PAD_SLOT_ID
 from intellillm_tpu.sampling_params import SamplingParams, SamplingType
 from intellillm_tpu.sequence import (SamplerOutput, SequenceGroupMetadata,
                                      SequenceGroupOutput, SequenceOutput)
-from intellillm_tpu.utils import (default_batch_buckets, default_len_buckets,
-                                  pad_to_bucket)
+from intellillm_tpu.utils import default_len_buckets, pad_to_bucket
 
 logger = init_logger(__name__)
 
@@ -94,7 +97,7 @@ class InflightStep:
     the engine can overlap it with the next dispatched step."""
 
     def __init__(self, runner, packed, metas, rows, t1, t2, logprob_k,
-                 is_prompt, num_steps, proc=None, plp=None):
+                 is_prompt, num_steps, proc=None, mixed_plp=None, emit=None):
         self.runner = runner
         self.packed = packed            # device array (also the cont input)
         self.metas = metas
@@ -105,7 +108,12 @@ class InflightStep:
         self.is_prompt = is_prompt
         self.num_steps = num_steps
         self.proc = proc                # (proc_rows, fetched_dev, params, tokens, seeds)
-        self.plp = plp                  # (plp_device_array, plp_k, row_params)
+        # (plp_device_array [B,1+2K], K, jobs, finals) — per-chunk prompt
+        # logprob rows accumulated host-side (see _attach_prompt_logprobs).
+        self.mixed_plp = mixed_plp
+        # (emit_idx, emit_rows): the flat-row subset that emits samples in
+        # a mixed step (decode rows + final chunks' last rows).
+        self.emit = emit
         self.cont_state: Optional[DecodeContState] = None
 
     def finalize(self) -> List[SamplerOutput]:
@@ -114,11 +122,15 @@ class InflightStep:
 
     def _finalize(self) -> List[SamplerOutput]:
         r = self.runner
-        if self.plp is not None:
-            plp_dev, plp_k, plp_params = self.plp
-            # lint: allow(host-sync) reason=the designed single D2H point: prompt logprobs must reach the host to be attached to request output
-            r._attach_prompt_logprobs(np.asarray(plp_dev), plp_k,
-                                      self.metas, self.rows, plp_params)
+        if self.mixed_plp is not None:
+            plp_dev, plp_k, jobs, finals = self.mixed_plp
+            # plp_dev is None when the step carried no panel rows (e.g.
+            # a 1-token prompt's final chunk) — only finals to assemble.
+            host_plp = None
+            if plp_dev is not None:
+                # lint: allow(host-sync) reason=the designed single D2H point for prompt logprobs: the panel must reach the host to be attached to request output
+                host_plp = np.asarray(plp_dev)
+            r._attach_prompt_logprobs(host_plp, plp_k, jobs, finals)
         # lint: allow(host-sync) reason=the one intentional fetch per step: sampled ids must cross to the host here so the engine can emit tokens; everything upstream stays async
         packed = np.array(self.packed) if self.proc else np.asarray(
             self.packed)
@@ -130,7 +142,17 @@ class InflightStep:
                 # lint: allow(host-sync) reason=processor rows resample on the host by design; fetched was produced by the same dispatch the packed fetch above already waited on
                 proc_rows, np.asarray(fetched), row_params, row_tokens,
                 row_seeds, sampled, sampled_lp, topk_ids, topk_lp, self.t1)
-        return r._process_sampling(self.metas, self.rows, sampled,
+        rows = self.rows
+        if self.emit is not None:
+            emit_idx, emit_rows = self.emit
+            # lint: allow(host-sync) reason=emit_idx is host-resident numpy built during batch prep; asarray here is a dtype cast, not a device fetch
+            idx = np.asarray(emit_idx, np.int64)
+            sampled = sampled[idx]
+            sampled_lp = sampled_lp[idx]
+            topk_ids = topk_ids[idx]
+            topk_lp = topk_lp[idx]
+            rows = emit_rows
+        return r._process_sampling(self.metas, rows, sampled,
                                    sampled_lp, topk_ids, topk_lp,
                                    self.is_prompt, self.num_steps)
 
@@ -180,30 +202,19 @@ class ModelRunner:
                            "using the default (16)", raw_chunk)
             self.decode_chunk = 16
 
-        self.batch_buckets = default_batch_buckets(
-            scheduler_config.max_num_seqs)
-        self.len_buckets = default_len_buckets(scheduler_config.max_model_len)
+        # ONE bucket family: decode rows + prefill-chunk rows flatten into
+        # a single (token_budget,)-bucketed batch, and block-table widths
+        # pad onto the SAME list — no batch×len×width shape zoo. The list
+        # covers up to max(budget, max table width) so every dimension the
+        # step programs see comes from this family.
         max_blocks = (scheduler_config.max_model_len + self.block_size -
                       1) // self.block_size
-        self.block_width_buckets = default_len_buckets(
-            max(max_blocks, _MIN_BLOCK_TABLE_WIDTH),
-            start=_MIN_BLOCK_TABLE_WIDTH)
-        # Chunked-prefill mixed steps: decode rows + prefill-chunk rows
-        # flatten into ONE (token_budget,)-bucketed batch, so the shape
-        # zoo collapses to a handful of flat-row executables regardless of
-        # the prompt-length mix.
         self.mixed_token_buckets = default_len_buckets(
             max(scheduler_config.max_num_batched_tokens,
+                scheduler_config.max_num_seqs, max_blocks,
                 _MIN_BLOCK_TABLE_WIDTH),
             start=_MIN_BLOCK_TABLE_WIDTH)
 
-        self._jit_prefill = jax.jit(
-            self._prefill_fn,
-            static_argnames=("num_samples", "logprob_k", "do_topk", "do_topp",
-                             "do_minp", "do_penalties", "do_random",
-                             "prompt_logprob_k"),
-            donate_argnames=("kv_caches", ),
-        )
         self._jit_decode = jax.jit(
             self._decode_fn,
             static_argnames=("num_steps", "logprob_k", "do_topk", "do_topp",
@@ -212,8 +223,9 @@ class ModelRunner:
         )
         self._jit_decode_single = jax.jit(
             self._decode_fn_single,
-            static_argnames=("logprob_k", "do_topk", "do_topp", "do_minp",
-                             "do_penalties", "do_random"),
+            static_argnames=("num_samples", "plp_k", "logprob_k", "do_topk",
+                             "do_topp", "do_minp", "do_penalties",
+                             "do_random"),
             donate_argnames=("kv_caches", ),
         )
         self._jit_decode_teacher = jax.jit(
@@ -292,12 +304,20 @@ class ModelRunner:
                                    output_tokens, lora=None, *, num_samples,
                                    logprob_k, do_topk, do_topp, do_minp,
                                    do_penalties, do_random=True,
-                                   fetch_indices=None):
+                                   fetch_indices=None, plp_targets=None,
+                                   plp_k=0):
         """fetch_indices: optional [M] row indices whose RAW (pre-penalty)
         logits are additionally returned for the host logits_processors
         escape path (reference sampler.py `_apply_logits_processors` runs
         arbitrary Python callables on the driver; here such rows are
-        re-sampled on host — see execute_model)."""
+        re-sampled on host — see execute_model).
+
+        plp_targets/plp_k: prompt-logprob panel for chunk-token rows —
+        RAW (pre-penalty, vocab-pad-masked) log_softmax of each row's
+        logits, packed [B, 1 + 2*plp_k] (target logprob bitcast, top ids,
+        top logprobs bitcast). Position p's row predicts prompt token
+        p+1; the host accumulates rows across chunks into the reference
+        prompt-logprob panel (see _attach_prompt_logprobs)."""
         lora_vocab = lora is not None and "vocab" in lora
         if lora_vocab:
             # Extra-vocab LoRA: the model returns EXACTLY vocab+extra
@@ -315,6 +335,19 @@ class ModelRunner:
             logits = jnp.where(pad[None, :], -1e30, logits)
         fetched = (logits[fetch_indices]
                    if fetch_indices is not None else None)
+        plp_out = None
+        if plp_k:
+            # Pre-penalty, like the legacy whole-prompt panel: penalties
+            # condition SAMPLING on the generation so far; the prompt's
+            # own per-position distribution is reported raw.
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            tgt_lp = jnp.take_along_axis(lp, plp_targets[:, None], axis=-1)
+            top_lp, top_ids = jax.lax.top_k(lp, plp_k)
+            plp_out = jnp.concatenate([
+                jax.lax.bitcast_convert_type(tgt_lp, jnp.int32),
+                top_ids.astype(jnp.int32),
+                jax.lax.bitcast_convert_type(top_lp, jnp.int32),
+            ], axis=-1)                                  # [B, 1 + 2K]
         if do_penalties:
             # Token histories scatter into [N, V] mask/counts ON DEVICE —
             # the host ships only the padded id lists.
@@ -326,84 +359,7 @@ class ModelRunner:
                      logprob_k=logprob_k, num_samples=num_samples,
                      do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
                      do_random=do_random)
-        return out + (fetched, )
-
-    def _prompt_logprobs(self, params, hidden, token_ids, lora=None, *,
-                         k: int):
-        """Per-position prompt logprobs (reference sampler.py prompt-
-        logprob path): position t's logits predict token t+1. Logits are
-        computed in 128-position chunks via scan so [B, C, V] — not
-        [B, L, V] — is the peak memory."""
-        b, l, e = hidden.shape
-        chunk = 128
-        pad_l = ((l + chunk - 1) // chunk) * chunk
-        h = jnp.pad(hidden, ((0, 0), (0, pad_l - l), (0, 0)))
-        targets = jnp.pad(token_ids[:, 1:], ((0, 0), (0, pad_l - l + 1)))
-        nc = pad_l // chunk
-        h = h.reshape(b, nc, chunk, e).swapaxes(0, 1)        # [nc, B, C, E]
-        tg = targets.reshape(b, nc, chunk).swapaxes(0, 1)    # [nc, B, C]
-        lora_vocab = lora is not None and "vocab" in lora
-
-        def body(carry, inp):
-            h_c, t_c = inp
-            if lora_vocab:
-                # Extra-vocab LoRA: adapter head delta + extra-token
-                # columns, exact vocab+extra width (invalid extras -inf)
-                # — keeps prompt logprobs consistent with the sampler and
-                # makes adapter-added prompt ids index real columns.
-                logits = self.model.compute_logits(params, h_c, lora)
-            else:
-                logits = self.model.compute_logits(params, h_c)
-            logits = logits.astype(jnp.float32)
-            if not lora_vocab and logits.shape[-1] > self.vocab_size:
-                # TP vocab padding: exclude padded columns (same mask as
-                # the sampling path) so log_softmax normalizes over the
-                # real vocab and top_k can't emit out-of-vocab ids.
-                pad = jnp.arange(logits.shape[-1]) >= self.vocab_size
-                logits = jnp.where(pad, -1e30, logits)
-            lp = jax.nn.log_softmax(logits, axis=-1)
-            tgt_lp = jnp.take_along_axis(lp, t_c[..., None],
-                                         axis=-1)[..., 0]   # [B, C]
-            top_lp, top_ids = jax.lax.top_k(lp, k)           # [B, C, K]
-            return carry, (tgt_lp, top_ids.astype(jnp.int32), top_lp)
-
-        _, (tgt_lp, top_ids, top_lp) = jax.lax.scan(body, None, (h, tg))
-        # [nc, B, C, ...] → [B, L, ...]
-        tgt_lp = tgt_lp.swapaxes(0, 1).reshape(b, pad_l)[:, :l]
-        top_ids = top_ids.swapaxes(0, 1).reshape(b, pad_l, k)[:, :l]
-        top_lp = top_lp.swapaxes(0, 1).reshape(b, pad_l, k)[:, :l]
-        # Pack [B, L, 1 + 2K] int32 for the single D2H fetch.
-        return jnp.concatenate([
-            jax.lax.bitcast_convert_type(tgt_lp, jnp.int32)[..., None],
-            top_ids,
-            jax.lax.bitcast_convert_type(top_lp, jnp.int32),
-        ], axis=-1)
-
-    def _prefill_fn(self, params, kv_caches, token_ids, positions,
-                    attn_metadata, logits_indices, temperatures, top_ks,
-                    top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
-                    prompt_tokens, output_tokens, lora=None,
-                    fetch_indices=None, *, num_samples,
-                    logprob_k, do_topk, do_topp, do_minp, do_penalties,
-                    do_random=True, prompt_logprob_k=0):
-        hidden, new_caches = self._call_model(params, token_ids, positions,
-                                              kv_caches, attn_metadata, lora)
-        b = token_ids.shape[0]
-        sel = hidden[jnp.arange(b), logits_indices]          # [B, E]
-        sampled, lp, tk_ids, tk_lp, fetched = self._compute_logits_and_sample(
-            params, sel, temperatures, top_ks, top_ps, min_ps, seeds,
-            pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens, lora,
-            num_samples=num_samples, logprob_k=logprob_k, do_topk=do_topk,
-            do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties,
-            do_random=do_random, fetch_indices=fetch_indices)
-        packed = self._pack(sampled, lp, tk_ids[:, None, :], tk_lp[:, None, :])
-        extras = ()
-        if prompt_logprob_k:
-            extras += (self._prompt_logprobs(params, hidden, token_ids,
-                                             lora, k=prompt_logprob_k), )
-        if fetched is not None:
-            extras += (fetched, )
-        return (packed, ) + extras + (new_caches, )
+        return out + (fetched, plp_out)
 
     def _decode_cont_fn(self, params, kv_caches, prev_packed, positions,
                         block_tables, context_lens, temperatures, top_ks,
@@ -521,7 +477,7 @@ class ModelRunner:
                 g = (chunk_base + k).astype(jnp.uint32)
                 seeds_k = seeds + g * _SEED_STRIDE
                 (sampled, lp, tk_ids,
-                 tk_lp, _) = self._compute_logits_and_sample(
+                 tk_lp, _, _) = self._compute_logits_and_sample(
                     params, hidden[:, 0], temperatures, top_ks, top_ps,
                     min_ps, seeds_k, pres_pen, freq_pen, rep_pen,
                     prompt_tokens, output_tokens, lora, num_samples=1,
@@ -585,12 +541,19 @@ class ModelRunner:
                           block_tables, context_lens, temperatures, top_ks,
                           top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
                           prompt_tokens, output_tokens, lora=None,
-                          fetch_indices=None, *,
+                          fetch_indices=None, plp_targets=None, *,
+                          num_samples=1, plp_k=0,
                           logprob_k, do_topk, do_topp, do_minp,
                           do_penalties, do_random=True):
-        """Unstaged single-step decode: writes KV to the pool before
-        attention. Required for sliding-window models (exact window
-        semantics need the ring layout) and used whenever K == 1."""
+        """Unstaged single-step program — THE mixed dispatch: writes KV to
+        the pool before attention, so decode rows and prefill-chunk rows
+        run side by side in one flat batch. Also exact for sliding-window
+        models (ring layout) and used whenever K == 1.
+
+        num_samples > 1 serves final-chunk `best_of` fan-out (every row
+        draws num_samples gumbel streams; a row's sample 0 is bit-equal
+        to its num_samples=1 draw, so co-batched decode rows are
+        unaffected). plp_k > 0 adds the per-row prompt-logprob panel."""
         bs = self.block_size
         wb = (self.sliding_window // bs) if self.sliding_window else None
         b = token_ids.shape[0]
@@ -614,136 +577,25 @@ class ModelRunner:
         hidden, new_caches = self._call_model(params, token_ids,
                                               pos[:, None], kv_caches, meta,
                                               lora)
-        sampled, lp, tk_ids, tk_lp, fetched = self._compute_logits_and_sample(
+        (sampled, lp, tk_ids, tk_lp, fetched,
+         plp_out) = self._compute_logits_and_sample(
             params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
             seeds, pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens,
-            lora, num_samples=1, logprob_k=logprob_k, do_topk=do_topk,
-            do_topp=do_topp, do_minp=do_minp, do_penalties=do_penalties,
-            do_random=do_random, fetch_indices=fetch_indices)
+            lora, num_samples=num_samples, logprob_k=logprob_k,
+            do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
+            do_penalties=do_penalties, do_random=do_random,
+            fetch_indices=fetch_indices, plp_targets=plp_targets,
+            plp_k=plp_k)
         packed = self._pack(sampled, lp, tk_ids[:, None, :],
                             tk_lp[:, None, :])
+        extras = ()
+        if plp_out is not None:
+            extras += (plp_out, )
         if fetched is not None:
-            return packed, fetched, new_caches
-        return packed, new_caches
+            extras += (fetched, )
+        return (packed, ) + extras + (new_caches, )
 
     # --- batch prep -------------------------------------------------------
-
-    def _prepare_prompt(
-        self,
-        seq_group_metadata_list: List[SequenceGroupMetadata],
-    ) -> Tuple[Dict[str, np.ndarray], AttentionMetadata, List[Tuple[str, int]]]:
-        rows: List[Tuple[str, int]] = []
-        token_rows: List[List[int]] = []
-        slot_rows: List[List[int]] = []
-        ctx_lens: List[int] = []
-
-        use_prefix = False
-        prefix_lens: List[int] = []
-        block_tables: List[List[int]] = []
-
-        for meta in seq_group_metadata_list:
-            assert meta.is_prompt
-            (seq_id, ) = meta.seq_data.keys()
-            data = meta.seq_data[seq_id]
-            tokens = data.get_token_ids()  # prompt (+ recomputed outputs)
-            n = len(tokens)
-
-            prefix_len = 0
-            if meta.prefix is not None and meta.prefix.computed:
-                prefix_len = meta.prefix.get_length()
-                use_prefix = True
-            prefix_lens.append(prefix_len)
-
-            table = meta.block_tables[seq_id]
-            block_tables.append(list(table))
-
-            # Slot for token i: physical block for logical block i//bs.
-            # Sliding window: ring reuse means later tokens overwrite early
-            # slots; suppress writes for tokens that would be overwritten in
-            # this same prefill (scatter order is unspecified). Computed by
-            # the native batch-prep kernel (native/batch_prep.cc) with a
-            # pure-Python fallback.
-            wb = (self.sliding_window // self.block_size
-                  if self.sliding_window else None)
-            slots = build_prompt_slots(table, prefix_len, n,
-                                       self.block_size, wb, PAD_SLOT_ID)
-
-            rows.append((meta.request_id, seq_id))
-            token_rows.append(list(tokens[prefix_len:]))
-            slot_rows.append(slots)
-            ctx_lens.append(n)
-
-        b = pad_to_bucket(len(rows), self.batch_buckets)
-        max_new = max(len(t) for t in token_rows)
-        l = pad_to_bucket(max_new, self.len_buckets)
-
-        token_ids = np.zeros((b, l), np.int32)
-        positions = np.zeros((b, l), np.int32)
-        slot_mapping = np.full((b, l), PAD_SLOT_ID, np.int32)
-        context_lens = np.zeros(b, np.int32)
-        logits_indices = np.zeros(b, np.int32)
-        np_prefix_lens = np.zeros(b, np.int32)
-
-        for i, toks in enumerate(token_rows):
-            n = len(toks)
-            token_ids[i, :n] = toks
-            positions[i, :n] = np.arange(prefix_lens[i], prefix_lens[i] + n)
-            slot_mapping[i, :n] = slot_rows[i]
-            context_lens[i] = ctx_lens[i]
-            logits_indices[i] = n - 1
-            np_prefix_lens[i] = prefix_lens[i]
-
-        bt = None
-        if use_prefix:
-            w = pad_to_bucket(
-                max(max(len(t) for t in block_tables),
-                    _MIN_BLOCK_TABLE_WIDTH), self.block_width_buckets)
-            bt = np.zeros((b, w), np.int32)
-            for i, table in enumerate(block_tables):
-                bt[i, :len(table)] = table
-
-        # Sequence-parallel prefill: one long prompt shards its sequence
-        # dim over the mesh "data" axis (ring attention) instead of
-        # running the whole context on one chip's flash kernel. ALiBi and
-        # sliding-window prompts keep the flash path (the ring kernel has
-        # no bias/window support), as do prefix-cache hits.
-        sp = None
-        threshold = self.parallel_config.sp_prefill_threshold
-        if (threshold is not None and len(rows) == 1 and not use_prefix
-                and self._dp > 1 and max_new >= threshold
-                and self.sliding_window is None and not self._uses_alibi):
-            if l % self._dp == 0:
-                sp = (self.mesh, "data")
-            else:
-                logger.warning(
-                    "SP prefill skipped for a %d-token prompt: padded "
-                    "length %d does not divide the data axis (%d); "
-                    "falling back to single-chip flash attention.",
-                    max_new, l, self._dp)
-
-        place = self._place_batch_array
-        attn_metadata = AttentionMetadata(
-            is_prompt=True,
-            slot_mapping=place(slot_mapping),
-            context_lens=place(context_lens),
-            block_tables=place(bt) if bt is not None else None,
-            prefix_lens=place(np_prefix_lens) if use_prefix else None,
-            use_prefix=use_prefix,
-            sp=sp,
-        )
-        arrays = {"token_ids": token_ids, "positions": positions,
-                  "logits_indices": logits_indices}
-        # Real-vs-padded extents for the efficiency ledger; popped (and
-        # recorded with the dispatch shape) by execute_model.
-        arrays["_eff"] = {
-            "real_rows": len(rows),
-            "real_tokens": sum(len(t) for t in token_rows),
-            "len_real": max_new, "len_padded": l,
-            "width_real": (max(len(t) for t in block_tables)
-                           if use_prefix else None),
-            "width_padded": bt.shape[1] if bt is not None else None,
-        }
-        return arrays, attn_metadata, rows
 
     def _prepare_decode(
         self,
@@ -765,10 +617,10 @@ class ModelRunner:
                 ctxs.append(n)
                 tables.append(list(meta.block_tables[seq_id]))
 
-        b = pad_to_bucket(len(rows), self.batch_buckets)
+        b = pad_to_bucket(len(rows), self.mixed_token_buckets)
         w = pad_to_bucket(max(max(len(t) for t in tables),
                               _MIN_BLOCK_TABLE_WIDTH),
-                          self.block_width_buckets)
+                          self.mixed_token_buckets)
 
         token_ids, positions, context_lens, block_tables = \
             build_decode_batch(tables, tokens, poss, ctxs, b, w)
@@ -813,7 +665,7 @@ class ModelRunner:
 
     def _sampling_args_device(self, st: SamplingTensors, padded_n: int):
         """The positional device-arg tuple every step program takes after
-        context_lens — order must match _decode_fn/_prefill_fn."""
+        context_lens — order must match _decode_fn/_decode_fn_single."""
         place = self._place_batch_array
         zeros = np.zeros(padded_n, np.float32)
         return (
@@ -851,29 +703,21 @@ class ModelRunner:
 
         if any(m.token_chunk_size is not None
                for m in seq_group_metadata_list):
-            assert not defer_fetch, (
-                "mixed chunked-prefill steps cannot be pipelined")
             assert num_decode_steps == 1, (
                 "mixed chunked-prefill steps are single-step")
-            return self._execute_mixed(seq_group_metadata_list, kv_caches)
+            return self._execute_mixed(seq_group_metadata_list, kv_caches,
+                                       defer_fetch=defer_fetch)
 
-        is_prompt = seq_group_metadata_list[0].is_prompt
-        if any(m.is_prompt != is_prompt
-               for m in seq_group_metadata_list[1:]):
+        if any(m.is_prompt for m in seq_group_metadata_list):
             raise ValueError(
-                "seq_group_metadata_list mixes prefill and decode entries "
-                "but carries no chunked-prefill metadata; the homogeneous "
-                "execute path batches a single phase. Schedule mixed "
-                "batches through chunked prefill (--enable-chunked-prefill) "
-                "instead.")
+                "prompt entry without chunked-prefill metadata reached "
+                "execute_model; the legacy homogeneous prefill path is "
+                "gone — prompts execute as chunk tokens of the mixed "
+                "dispatch (the scheduler sets token_chunk_size).")
         place = self._place_batch_array
 
         with self._tracer.span("prepare_inputs"):
-            if is_prompt:
-                arrays, attn_metadata, rows = self._prepare_prompt(
-                    seq_group_metadata_list)
-            else:
-                arrays, rows = self._prepare_decode(seq_group_metadata_list)
+            arrays, rows = self._prepare_decode(seq_group_metadata_list)
 
             eff_info = arrays.pop("_eff")
             padded_n = arrays["token_ids"].shape[0]
@@ -901,23 +745,14 @@ class ModelRunner:
             st = SamplingTensors.build(row_params, row_seeds, row_tokens,
                                        eff_vocab, padded_n)
 
-            num_samples = 1
-            if is_prompt:
-                for sp in row_params:
-                    if (sp.sampling_type == SamplingType.RANDOM
-                            and sp.best_of > 1):
-                        num_samples = max(num_samples, sp.best_of)
-                num_samples = pad_to_bucket(num_samples, _SAMPLE_BUCKETS)
-
             # logits_processors escape path: rows carrying Python
             # processors get their RAW logits fetched and are re-sampled
-            # on host (the scheduler forces K=1 for such batches; prefill
-            # is always 1 step).
+            # on host (the scheduler forces K=1 for such batches).
             proc_rows = [i for i, sp in enumerate(row_params)
                          if sp.logits_processors]
             fetch_indices = None
             if proc_rows:
-                m = pad_to_bucket(len(proc_rows), self.batch_buckets)
+                m = pad_to_bucket(len(proc_rows), self.mixed_token_buckets)
                 fetch_indices = np.zeros(m, np.int32)
                 fetch_indices[:len(proc_rows)] = proc_rows
 
@@ -928,113 +763,74 @@ class ModelRunner:
             )
             sampling_args = self._sampling_args_device(st, padded_n)
 
-        if is_prompt:
-            # prompt_logprobs: bucketed panel width, 0 = not requested.
-            plp_k = 0
-            for sp in row_params:
-                if sp.prompt_logprobs is not None:
-                    plp_k = max(plp_k, sp.prompt_logprobs, 1)
-            if plp_k:
-                plp_k = pad_to_bucket(plp_k, LOGPROB_K_BUCKETS)
+        num_steps = num_decode_steps
+        # The engine clamps num_decode_steps to 1 at init for sliding
+        # window (window semantics need the ring layout) and ALiBi
+        # (bias needs the true query position per substep); the staged
+        # decode program would be silently wrong for both.
+        assert num_steps == 1 or (self.sliding_window is None
+                                  and not self._uses_alibi), (
+            "fused multi-step decode requested for a sliding-window or "
+            "ALiBi model; the engine should have clamped K to 1")
+        decode_args = (
+            self.params, kv_caches,
+            place(arrays["token_ids"]), place(arrays["positions"]),
+            place(arrays["block_tables"]), place(arrays["context_lens"]),
+            *sampling_args, lora_state)
+        fetched = None
+        if num_steps == 1:
             # Mirror of jit's dispatch-cache key: padded shapes + static
             # args + pytree-structure toggles (see obs/compile_tracker.py).
-            bucket = (padded_n, arrays["token_ids"].shape[1], num_samples,
-                      plp_k,
-                      fetch_indices.shape[0] if fetch_indices is not None
-                      else None,
-                      lora_state is not None, attn_metadata.use_prefix,
-                      attn_metadata.sp is not None,
-                      tuple(sorted(common.items())))
-            with self._tracer.span("execute"):
-                result = self._guarded_call(
-                    "prefill", bucket, self._jit_prefill,
-                    self.params, kv_caches,
-                    place(arrays["token_ids"]), place(arrays["positions"]),
-                    attn_metadata, place(arrays["logits_indices"]),
-                    *sampling_args, lora_state,
-                    place(fetch_indices) if fetch_indices is not None
-                    else None,
-                    num_samples=num_samples,
-                    prompt_logprob_k=plp_k, **common)
-            result = list(result)
-            packed = result.pop(0)
-            plp = (result.pop(0), plp_k, row_params) if plp_k else None
-            fetched = result.pop(0) if proc_rows else None
-            new_caches = result.pop(0)
-            t1, t2 = num_samples, 1
-            num_steps = 1
-        else:
-            num_steps = num_decode_steps
-            # The engine clamps num_decode_steps to 1 at init for sliding
-            # window (window semantics need the ring layout) and ALiBi
-            # (bias needs the true query position per substep); the staged
-            # decode program would be silently wrong for both.
-            assert num_steps == 1 or (self.sliding_window is None
-                                      and not self._uses_alibi), (
-                "fused multi-step decode requested for a sliding-window or "
-                "ALiBi model; the engine should have clamped K to 1")
-            decode_args = (
-                self.params, kv_caches,
-                place(arrays["token_ids"]), place(arrays["positions"]),
-                place(arrays["block_tables"]), place(arrays["context_lens"]),
-                *sampling_args, lora_state)
-            fetched = None
-            plp = None
-            bucket = (padded_n, arrays["block_tables"].shape[1],
-                      num_steps,
+            # Same key layout as _execute_mixed — a decode-only step IS a
+            # mixed step with zero chunk rows and hits the same
+            # executable.
+            bucket = (padded_n, arrays["block_tables"].shape[1], 1, 0,
                       fetch_indices.shape[0] if fetch_indices is not None
                       else None,
                       lora_state is not None,
                       tuple(sorted(common.items())))
-            if num_steps == 1:
-                with self._tracer.span("execute"):
-                    result = self._guarded_call(
-                        "decode_single", bucket, self._jit_decode_single,
-                        *decode_args,
-                        place(fetch_indices) if fetch_indices is not None
-                        else None, **common)
-                if proc_rows:
-                    packed, fetched, new_caches = result
-                else:
-                    packed, new_caches = result
+            with self._tracer.span("execute"):
+                result = self._guarded_call(
+                    "mixed", bucket, self._jit_decode_single,
+                    *decode_args,
+                    place(fetch_indices) if fetch_indices is not None
+                    else None, **common)
+            if proc_rows:
+                packed, fetched, new_caches = result
             else:
-                assert not proc_rows, (
-                    "logits_processors present in a fused K>1 decode batch; "
-                    "the scheduler should have forced K=1")
-                with self._tracer.span("execute"):
-                    packed, new_caches = self._guarded_call(
-                        "decode_fused", bucket, self._jit_decode,
-                        *decode_args, num_steps=num_steps, **common)
-            t1 = t2 = num_steps
-
-        if is_prompt:
-            self._efficiency.record_dispatch(
-                "prefill", eff_info["real_rows"], padded_n,
-                real_tokens=eff_info["real_tokens"],
-                padded_tokens=padded_n * arrays["token_ids"].shape[1],
-                len_real=eff_info["len_real"],
-                len_padded=eff_info["len_padded"],
-                width_real=eff_info["width_real"],
-                width_padded=eff_info["width_padded"])
+                packed, new_caches = result
         else:
-            # Each substep computes one token per row, pad rows included.
-            self._efficiency.record_dispatch(
-                "decode", eff_info["real_rows"], padded_n,
-                real_tokens=eff_info["real_rows"] * num_steps,
-                padded_tokens=padded_n * num_steps,
-                width_real=eff_info["width_real"],
-                width_padded=eff_info["width_padded"])
+            assert not proc_rows, (
+                "logits_processors present in a fused K>1 decode batch; "
+                "the scheduler should have forced K=1")
+            bucket = (padded_n, arrays["block_tables"].shape[1],
+                      num_steps,
+                      None,
+                      lora_state is not None,
+                      tuple(sorted(common.items())))
+            with self._tracer.span("execute"):
+                packed, new_caches = self._guarded_call(
+                    "decode_fused", bucket, self._jit_decode,
+                    *decode_args, num_steps=num_steps, **common)
+        t1 = t2 = num_steps
+
+        # Each substep computes one token per row, pad rows included.
+        self._efficiency.record_dispatch(
+            "decode", eff_info["real_rows"], padded_n,
+            real_tokens=eff_info["real_rows"] * num_steps,
+            padded_tokens=padded_n * num_steps,
+            width_real=eff_info["width_real"],
+            width_padded=eff_info["width_padded"])
 
         # ONE device→host transfer for everything, performed by
         # InflightStep.finalize() — immediately on the eager path, or
         # overlapped with later dispatches on the pipelined path.
         step = InflightStep(
             self, packed, seq_group_metadata_list, rows, t1, t2,
-            st.logprob_k, is_prompt, num_steps,
+            st.logprob_k, False, num_steps,
             proc=((proc_rows, fetched, row_params, row_tokens, row_seeds)
-                  if proc_rows else None),
-            plp=plp if is_prompt else None)
-        if not is_prompt and num_steps > 1:
+                  if proc_rows else None))
+        if num_steps > 1:
             step.cont_state = DecodeContState(
                 seq_group_metadata_list, rows,
                 arrays["context_lens"].copy(), row_out_lens, row_params,
@@ -1047,20 +843,30 @@ class ModelRunner:
         self,
         seq_group_metadata_list: List[SequenceGroupMetadata],
         kv_caches,
-    ) -> Tuple[List[SamplerOutput], Any]:
-        """Chunked-prefill mixed step: decode tokens and prefill-chunk
-        tokens lie in ONE flat (token_budget,)-bucketed batch of the
-        single-step decode program. Each row is one token with its own
-        absolute position, block table, and context_lens = position + 1;
-        the program writes every row's KV to its pool slot BEFORE
-        attention reads, so a chunk token at position p attends to the
-        prompt's earlier chunks (already in the pool) plus the in-flight
-        chunk's earlier rows — exact per-sequence causal attention with no
-        cross-sequence leakage (each row reads only its own block table).
-        Only decode rows and the final chunk's last row emit samples."""
-        assert self.sliding_window is None, (
-            "chunked prefill is disabled for sliding-window models; the "
-            "engine should not have scheduled a mixed step")
+        defer_fetch: bool = False,
+    ) -> Tuple[Any, Any]:
+        """Mixed token-budget step — THE execution path for prefill work:
+        decode tokens and prefill-chunk tokens lie in ONE flat
+        (token_budget,)-bucketed batch of the single-step program. Each
+        row is one token with its own absolute position, block table, and
+        context_lens = position + 1; the program writes every row's KV to
+        its pool slot BEFORE attention reads, so a chunk token at
+        position p attends to the prompt's earlier chunks (already in the
+        pool — including a prefix-cache hit's reused blocks, which the
+        scheduler skips by starting the first chunk at the computed-token
+        count) plus the in-flight chunk's earlier rows — exact
+        per-sequence causal attention with no cross-sequence leakage
+        (each row reads only its own block table).
+
+        Only decode rows and the final chunk's last row emit samples.
+        The features the legacy homogeneous prefill served are flat-row
+        concerns here: final-chunk RANDOM `best_of` fan-out raises the
+        program's num_samples (co-batched rows' sample 0 is unchanged),
+        beam fan-out reads the emitted row's top-k panel in
+        _process_sampling, prompt_logprobs rows carry per-row panel
+        targets accumulated host-side across chunks, and
+        logits_processors rows on the emission subset take the host
+        resample escape path."""
         place = self._place_batch_array
 
         with self._tracer.span("prepare_inputs"):
@@ -1073,18 +879,24 @@ class ModelRunner:
             row_seeds: List[int] = []
             row_tokens: List[Tuple[np.ndarray, np.ndarray]] = []
             row_loras_src: List[Any] = []
-            # Per metadata entry: the (row, seq_id) pairs that emit a
-            # sample this step (all decode rows; only the LAST row of a
-            # FINAL chunk — mid-prompt rows' samples are meaningless).
-            emit_rows: List[List[Tuple[int, int]]] = []
+            # Flat-row emission subset: all decode rows; only the LAST
+            # row of a FINAL chunk (mid-prompt rows' samples are
+            # meaningless).
+            emit_idx: List[int] = []
+            emit_rows: List[Tuple[str, int]] = []
+            # prompt_logprobs: each chunk row at position p contributes
+            # prompt position p+1's panel entry; accumulated on the
+            # SequenceData across chunks (see _attach_prompt_logprobs).
+            plp_jobs: List[Tuple[int, int, Any, int, int]] = []
+            plp_finals: List[Tuple[Any, Any]] = []
+            plp_k = 0
+            num_samples = 1
             n_chunk_tokens = 0
             n_chunk_groups = 0
             n_decode_rows = 0
 
             for meta in seq_group_metadata_list:
                 sp = meta.sampling_params
-                assert not sp.logits_processors, (
-                    "logits_processors row scheduled into a mixed step")
                 if meta.token_chunk_size is not None:
                     (seq_id,) = meta.seq_data.keys()
                     data = meta.seq_data[seq_id]
@@ -1093,11 +905,13 @@ class ModelRunner:
                     final = start + size == data.get_len()
                     all_ids = data.get_token_ids()
                     table = list(meta.block_tables[seq_id])
-                    # Same (seed, penalty-window) a homogeneous prefill of
-                    # this prompt would use, so the final chunk's sample
-                    # reproduces legacy output exactly.
+                    # Same (seed, penalty-window) a whole-prompt prefill
+                    # of this prompt would use, so the final chunk's
+                    # sample reproduces legacy output exactly.
                     seed = self._row_seed(seq_id, data.get_output_len())
                     views = data.token_views()
+                    want_plp = sp.prompt_logprobs is not None
+                    n_prompt = data.get_prompt_len()
                     for j in range(size):
                         pos = start + j
                         rows.append((meta.request_id, seq_id))
@@ -1109,12 +923,22 @@ class ModelRunner:
                         row_seeds.append(seed)
                         row_tokens.append(views)
                         row_loras_src.append(meta.lora_request)
+                        if want_plp and pos + 1 < n_prompt:
+                            plp_jobs.append((len(rows) - 1,
+                                             sp.prompt_logprobs, data,
+                                             int(all_ids[pos + 1]), pos + 1))
+                            plp_k = max(plp_k, sp.prompt_logprobs, 1)
                     n_chunk_tokens += size
                     n_chunk_groups += 1
-                    emit_rows.append([(len(rows) - 1, seq_id)]
-                                     if final else [])
+                    if final:
+                        emit_idx.append(len(rows) - 1)
+                        emit_rows.append((meta.request_id, seq_id))
+                        if (sp.sampling_type == SamplingType.RANDOM
+                                and sp.best_of > 1):
+                            num_samples = max(num_samples, sp.best_of)
+                        if want_plp:
+                            plp_finals.append((meta, data))
                 else:
-                    group_rows: List[Tuple[int, int]] = []
                     for seq_id, data in meta.seq_data.items():
                         n = data.get_len()
                         rows.append((meta.request_id, seq_id))
@@ -1127,14 +951,37 @@ class ModelRunner:
                             self._row_seed(seq_id, data.get_output_len()))
                         row_tokens.append(data.token_views())
                         row_loras_src.append(meta.lora_request)
-                        group_rows.append((len(rows) - 1, seq_id))
+                        emit_idx.append(len(rows) - 1)
+                        emit_rows.append((meta.request_id, seq_id))
                         n_decode_rows += 1
-                    emit_rows.append(group_rows)
+
+            num_samples = pad_to_bucket(num_samples, _SAMPLE_BUCKETS)
+            if plp_jobs:
+                plp_k = pad_to_bucket(plp_k, LOGPROB_K_BUCKETS)
+            else:
+                plp_k = 0
+            plp_targets = None
+            if plp_k:
+                plp_targets = np.zeros(
+                    pad_to_bucket(len(rows), self.mixed_token_buckets),
+                    np.int32)
+                for row, _, _, tgt, _ in plp_jobs:
+                    plp_targets[row] = tgt
+
+            # logits_processors escape: only emitting rows matter (the
+            # panel is pre-penalty, mid-chunk samples are discarded).
+            proc_rows = [i for i in emit_idx
+                         if row_params[i].logits_processors]
+            fetch_indices = None
+            if proc_rows:
+                m = pad_to_bucket(len(proc_rows), self.mixed_token_buckets)
+                fetch_indices = np.zeros(m, np.int32)
+                fetch_indices[:len(proc_rows)] = proc_rows
 
             padded_n = pad_to_bucket(len(rows), self.mixed_token_buckets)
             w = pad_to_bucket(max(max(len(t) for t in tables),
                                   _MIN_BLOCK_TABLE_WIDTH),
-                              self.block_width_buckets)
+                              self.mixed_token_buckets)
             token_ids, positions, context_lens, block_tables = \
                 build_decode_batch(tables, tokens, poss, ctxs, padded_n, w)
 
@@ -1150,15 +997,26 @@ class ModelRunner:
             )
             sampling_args = self._sampling_args_device(st, padded_n)
 
-        bucket = (padded_n, w, 1, None, lora_state is not None,
+        bucket = (padded_n, w, num_samples, plp_k,
+                  fetch_indices.shape[0] if fetch_indices is not None
+                  else None,
+                  lora_state is not None,
                   tuple(sorted(common.items())))
         with self._tracer.span("execute"):
-            packed, new_caches = self._guarded_call(
+            result = self._guarded_call(
                 "mixed", bucket, self._jit_decode_single,
                 self.params, kv_caches,
                 place(token_ids), place(positions),
                 place(block_tables), place(context_lens),
-                *sampling_args, lora_state, None, **common)
+                *sampling_args, lora_state,
+                place(fetch_indices) if fetch_indices is not None else None,
+                place(plp_targets) if plp_k else None,
+                num_samples=num_samples, plp_k=plp_k, **common)
+        result = list(result)
+        packed = result.pop(0)
+        plp_dev = result.pop(0) if plp_k else None
+        fetched = result.pop(0) if proc_rows else None
+        new_caches = result.pop(0)
 
         # Per-phase efficiency attribution: each real token is counted
         # exactly once under its own phase; the flat batch's bucket
@@ -1179,24 +1037,17 @@ class ModelRunner:
                 width_real=max(len(t) for t in tables),
                 width_padded=w)
 
-        with self._tracer.span("sample"):
-            sampled, sampled_lp, topk_ids, topk_lp = self._unpack(
-                # lint: allow(host-sync) reason=the mixed step's single designed D2H: sampled ids must reach the host to emit tokens this step
-                np.asarray(packed), 1, 1, st.logprob_k)
-            output: SamplerOutput = []
-            for mi, meta in enumerate(seq_group_metadata_list):
-                sp = meta.sampling_params
-                samples: List[SequenceOutput] = []
-                for row, seq_id in emit_rows[mi]:
-                    tok = int(sampled[row, 0])
-                    d = {tok: float(sampled_lp[row, 0])}
-                    if sp.logprobs:
-                        for tt, lp in zip(topk_ids[row, 0, :sp.logprobs],
-                                          topk_lp[row, 0, :sp.logprobs]):
-                            d.setdefault(int(tt), float(lp))
-                    samples.append(SequenceOutput(seq_id, tok, d))
-                output.append(SequenceGroupOutput(samples))
-        return [output], new_caches
+        step = InflightStep(
+            self, packed, seq_group_metadata_list, rows, num_samples, 1,
+            st.logprob_k, False, 1,
+            proc=((proc_rows, fetched, row_params, row_tokens, row_seeds)
+                  if proc_rows else None),
+            mixed_plp=((plp_dev, plp_k, plp_jobs, plp_finals)
+                       if (plp_jobs or plp_finals) else None),
+            emit=(emit_idx, emit_rows))
+        if defer_fetch:
+            return step, new_caches
+        return step.finalize(), new_caches
 
     def execute_decode_cont(
         self,
@@ -1224,7 +1075,7 @@ class ModelRunner:
             positions = np.maximum(ctx - 1, 0).astype(np.int32)[:, None]
             w = pad_to_bucket(max(max((len(t) for t in tables), default=1),
                                   _MIN_BLOCK_TABLE_WIDTH),
-                              self.block_width_buckets)
+                              self.mixed_token_buckets)
             block_tables = np.zeros((b, w), np.int32)
             for i, t in enumerate(tables):
                 block_tables[i, :len(t)] = t
@@ -1332,33 +1183,37 @@ class ModelRunner:
                             num_steps)
         return step.finalize(), new_caches
 
-    def _attach_prompt_logprobs(self, plp_packed, k, metas, rows,
-                                row_params):
-        """Unpack [B, L, 1+2K] and store the reference-format
-        PromptLogprobs list (None for token 0, then {token_id: logprob}
-        with the top-k panel) onto each requesting metadata object; the
-        engine copies it to the SequenceGroup."""
-        meta_by_req = {m.request_id: m for m in metas}
-        for i, (req_id, seq_id) in enumerate(rows):
-            sp = row_params[i]
-            if sp.prompt_logprobs is None:
-                continue
-            meta = meta_by_req[req_id]
-            data = meta.seq_data[seq_id]
+    def _attach_prompt_logprobs(self, plp_packed, k, jobs, finals):
+        """Accumulate per-chunk prompt-logprob rows and, on a prompt's
+        final chunk, assemble the reference-format PromptLogprobs list
+        (None for token 0, then {token_id: logprob} with the top-k panel)
+        onto the requesting metadata object; the engine copies it to the
+        SequenceGroup.
+
+        plp_packed: [B, 1+2K] per flat row (target logprob bitcast, top
+        ids, top logprobs bitcast). jobs: (row, requested_k, seq_data,
+        target_token, prompt_position) — the chunk rows whose panel entry
+        lands at prompt_position. Entries accumulate on the SequenceData
+        (survives across the prompt's chunk steps; reset on recompute
+        preemption) keyed by position, so out-of-order recomputation
+        simply overwrites."""
+        for row, req_k, data, tgt_tok, t in jobs:
+            tgt_lp = plp_packed[row, 0:1].view(np.float32)[0]
+            top_ids = plp_packed[row, 1:1 + k]
+            top_lp = plp_packed[row, 1 + k:1 + 2 * k].view(np.float32)
+            d = {int(tgt_tok): float(tgt_lp)}
+            for tt, lpv in zip(top_ids[:req_k], top_lp[:req_k]):
+                d.setdefault(int(tt), float(lpv))
+            acc = data._chunk_prompt_logprobs
+            if acc is None:
+                acc = data._chunk_prompt_logprobs = {}
+            acc[t] = d
+        for meta, data in finals:
             n = data.get_prompt_len()
-            tokens = data.prompt_token_ids
-            tgt_lp = plp_packed[i, :, 0].view(np.float32)
-            top_ids = plp_packed[i, :, 1:1 + k]
-            top_lp = plp_packed[i, :, 1 + k:].view(np.float32)
-            out = [None]
-            for t in range(1, n):
-                # Position t-1's logits predict token t.
-                d = {int(tokens[t]): float(tgt_lp[t - 1])}
-                for tt, lpv in zip(top_ids[t - 1, :sp.prompt_logprobs],
-                                   top_lp[t - 1, :sp.prompt_logprobs]):
-                    d.setdefault(int(tt), float(lpv))
-                out.append(d)
-            meta.computed_prompt_logprobs = out
+            acc = data._chunk_prompt_logprobs or {}
+            meta.computed_prompt_logprobs = (
+                [None] + [acc.get(t) for t in range(1, n)])
+            data._chunk_prompt_logprobs = None
 
     # --- sampler post-processing -----------------------------------------
 
@@ -1413,7 +1268,13 @@ class ModelRunner:
             t = 0 if is_prompt else k
             output: SamplerOutput = []
             for meta in seq_group_metadata_list:
-                group_rows = row_idx_by_req[meta.request_id]
+                group_rows = row_idx_by_req.get(meta.request_id, [])
+                if not group_rows:
+                    # Mid-prompt chunk group in a mixed step: no sample
+                    # this step; the engine treats the empty group as
+                    # still prefilling.
+                    output.append(SequenceGroupOutput([]))
+                    continue
                 sp = meta.sampling_params
                 stype = sp.sampling_type
 

@@ -126,6 +126,7 @@ class SpecDecodeWorker(Worker):
         n = super().warm_up_model()
         if n is None:
             return None
+        target_stats = dict(self.warmup_stats)
         saved = (self.model_runner, self.cache_engine, self.params)
         self.model_runner = self.draft_runner
         self.cache_engine = self.draft_cache_engine
@@ -134,20 +135,36 @@ class SpecDecodeWorker(Worker):
             n_draft = super().warm_up_model()
         finally:
             self.model_runner, self.cache_engine, self.params = saved
+        draft_stats = dict(self.warmup_stats)
+        import time as _time
+        t0 = _time.monotonic()
         n_teacher = self._warm_teacher()
-        return n + (n_draft or 0) + n_teacher
+        teacher_seconds = _time.monotonic() - t0
+        total = n + (n_draft or 0) + n_teacher
+        self.warmup_stats = {
+            "executables": (target_stats.get("executables", 0)
+                            + draft_stats.get("executables", 0)
+                            + n_teacher),
+            "seconds": round(target_stats.get("seconds", 0.0)
+                             + draft_stats.get("seconds", 0.0)
+                             + teacher_seconds, 3),
+        }
+        return total
 
     def _warm_teacher(self) -> int:
-        """Compile the teacher-forced program at the top batch bucket /
+        """Compile the teacher-forced program at the max-seat row bucket /
         narrowest width for the greedy sampler variant (spec eligibility
         is greedy-only)."""
         import numpy as np
 
+        from intellillm_tpu.utils import pad_to_bucket
+
         runner = self.model_runner
         k1 = self.k_spec + 1
         try:
-            b = runner.batch_buckets[-1]
-            w = runner.block_width_buckets[0]
+            b = pad_to_bucket(self.scheduler_config.max_num_seqs,
+                              runner.mixed_token_buckets)
+            w = runner.mixed_token_buckets[0]
             place = runner._place_batch_array
             args = (place(np.zeros((b, k1), np.int32)),      # teacher
                     place(np.zeros((b, 1), np.int32)),       # positions

@@ -170,18 +170,15 @@ class Worker:
         return 0
 
     def _estimate_step_temp_bytes(self) -> int:
-        """Compile the largest prefill shape against a tiny dummy cache and
-        read temp memory from XLA's memory analysis."""
+        """Lower the largest mixed-dispatch shape against a tiny dummy
+        cache and read temp memory from XLA's memory analysis."""
         try:
-            from intellillm_tpu.layers.attention import AttentionMetadata
             from intellillm_tpu.utils import pad_to_bucket
 
             runner = self.model_runner
-            max_bt = self.scheduler_config.max_num_batched_tokens
-            l = pad_to_bucket(min(max_bt, self.scheduler_config.max_model_len),
-                              runner.len_buckets)
-            b = max(max_bt // l, 1)
-            b = pad_to_bucket(b, runner.batch_buckets)
+            b = pad_to_bucket(self.scheduler_config.max_num_batched_tokens,
+                              runner.mixed_token_buckets)
+            w = runner.mixed_token_buckets[-1]
 
             from intellillm_tpu.utils import STR_DTYPE_TO_JNP
             nkv = self.model_config.get_total_num_kv_heads()
@@ -196,17 +193,12 @@ class Worker:
                 jnp.dtype(STR_DTYPE_TO_JNP[cache_dtype]))
             kv_struct = [(cache_shape, cache_shape) for _ in range(nl)]
 
-            meta = AttentionMetadata(
-                is_prompt=True,
-                slot_mapping=jax.ShapeDtypeStruct((b, l), jnp.int32),
-                context_lens=jax.ShapeDtypeStruct((b, ), jnp.int32),
-            )
             i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
             f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)
             u32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.uint32)
-            lowered = runner._jit_prefill.lower(
-                self.params, kv_struct, i32(b, l), i32(b, l), meta, i32(b),
-                f32(b), i32(b), f32(b), f32(b), u32(b),
+            lowered = runner._jit_decode_single.lower(
+                self.params, kv_struct, i32(b, 1), i32(b, 1), i32(b, w),
+                i32(b), f32(b), i32(b), f32(b), f32(b), u32(b),
                 f32(b), f32(b), f32(b), None, None,
                 num_samples=1, logprob_k=8,
                 do_topk=False, do_topp=False, do_minp=False,
@@ -256,24 +248,28 @@ class Worker:
         return ledger
 
     def warm_up_model(self):
-        """Pre-compile the steady-state decode executables (CUDA-graph-
-        capture analogue, reference model_runner.py:629-698): the top batch
-        bucket at the two narrowest block-table widths, greedy sampling
-        flags, for both the single-step and fused-K decode programs.
-        Populates the (persistent) XLA compilation cache so the first real
-        decode hit doesn't pay compile latency mid-serving.
+        """Pre-compile the mixed program family (CUDA-graph-capture
+        analogue, reference model_runner.py:629-698): the single
+        (token_budget,)-bucketed program at the top token bucket and the
+        narrowest block-table width, in its two steady-state sampler
+        variants (greedy and plain random) — exactly 2 executables by
+        default. Populates the (persistent) XLA compilation cache so the
+        first real step doesn't pay compile latency mid-serving.
 
-        INTELLILLM_WARMUP_FULL=1 extends warm-up to EVERY batch bucket
-        AND every block-table width bucket (default: top batch bucket x
-        two narrowest widths): any (batch-bucket x width-bucket) decode
-        executable left cold compiles mid-serving on first touch, which
-        stalls the engine for tens of seconds (measured: a cold
-        (bs=64, width=32) compile collapsed a steady rate-8 serving run
-        to 188 tok/s). With the persistent compilation cache the full
-        sweep is only expensive on the first boot per configuration.
+        INTELLILLM_WARMUP_FULL=1 extends warm-up to every token bucket up
+        to the top, a second block-table width, the logits-processor
+        fetch variant, and the fused-K decode + pipelined continuation
+        programs: any executable left cold compiles mid-serving on first
+        touch, which stalls the engine for tens of seconds (measured: a
+        cold compile collapsed a steady rate-8 serving run to 188 tok/s).
+        With the persistent compilation cache the full sweep is only
+        expensive on the first boot per configuration.
 
         Skipped under enforce_eager and on CPU (tests): jit still compiles
-        lazily on first use, warm-up only front-loads the latency."""
+        lazily on first use, warm-up only front-loads the latency.
+        `warmup_stats` records the structured outcome either way (bench
+        probes machine-check the warm-up exit criterion from it)."""
+        self.warmup_stats = {"executables": 0, "seconds": 0.0}
         if self.model_config.enforce_eager or jax.default_backend() == "cpu":
             return
         runner = self.model_runner
@@ -294,11 +290,12 @@ class Worker:
         from intellillm_tpu.utils import parse_env_flag, pad_to_bucket
 
         start = _time.monotonic()
-        top = pad_to_bucket(self.scheduler_config.max_num_seqs,
-                            runner.batch_buckets)
+        buckets = runner.mixed_token_buckets
+        top = pad_to_bucket(self.scheduler_config.max_num_batched_tokens,
+                            buckets)
         full = parse_env_flag(
             os.environ.get("INTELLILLM_WARMUP_FULL", "")) is True
-        batch_sizes = ([bb for bb in runner.batch_buckets if bb <= top]
+        batch_sizes = ([bb for bb in buckets if bb <= top]
                        if full else [top])
         place = runner._place_batch_array
         # All-pad batch: context_lens == 0 rows map every KV slot to the
@@ -319,10 +316,10 @@ class Worker:
         ]
         n = 0
         try:
-            # The serving path (execute_model) binds every arg
-            # POSITIONALLY, and jax.jit keys its dispatch cache on the
-            # call structure — a keyword-bound warm-up would compile
-            # executables serving never reuses. Guard against
+            # The serving path (execute_model / _execute_mixed) binds
+            # every arg POSITIONALLY, and jax.jit keys its dispatch cache
+            # on the call structure — a keyword-bound warm-up would
+            # compile executables serving never reuses. Guard against
             # parameter-order drift (ADVICE r3) with a signature check;
             # inside the try so drift degrades to lazy compilation (the
             # documented best-effort contract), not a boot failure.
@@ -330,10 +327,9 @@ class Worker:
             names = list(inspect.signature(
                 runner._decode_fn_single).parameters)
             idx = names.index("output_tokens")
-            assert names[idx + 1:idx + 3] == \
-                ["lora", "fetch_indices"], names
-            widths = (runner.block_width_buckets if full
-                      else runner.block_width_buckets[:2])
+            assert names[idx + 1:idx + 4] == \
+                ["lora", "fetch_indices", "plp_targets"], names
+            widths = buckets[:2] if full else buckets[:1]
             for b in batch_sizes:
                 zeros_i = place(np.zeros((b, 1), np.int32))
                 for w in widths:
@@ -354,14 +350,14 @@ class Worker:
                             *args, **flags)
                         self.cache_engine.device_cache = caches
                         n += 1
-                        if (not flags["do_random"] and b == top
-                                and w == runner.block_width_buckets[0]):
+                        if (full and not flags["do_random"] and b == top
+                                and w == buckets[0]):
                             # Passing fetch_indices changes the jit arg
                             # pytree (logits_processors escape path) —
                             # warm it too, so the first processor-bearing
                             # request doesn't trigger a full XLA compile
                             # mid-serving.
-                            m = pad_to_bucket(1, runner.batch_buckets)
+                            m = pad_to_bucket(1, buckets)
                             fargs = args + (None,
                                             place(np.zeros(m, np.int32)))
                             packed, _fetched, caches = \
@@ -372,7 +368,7 @@ class Worker:
                             self.cache_engine.device_cache = caches
                             n += 1
                         k = self.scheduler_config.num_decode_steps
-                        if k > 1:
+                        if full and k > 1:
                             packed, caches = runner._jit_decode(
                                 self.params, self.cache_engine.device_cache,
                                 *args, num_steps=k, **flags)
@@ -393,14 +389,21 @@ class Worker:
                                 n += 1
                         # lint: allow(host-sync) reason=warm-up runs before serving; blocking here ensures executables are resident and the logged compile wall-time is honest
                         jax.block_until_ready(packed)
-            logger.info("Warm-up: compiled %d decode executables "
-                        "(bs=%s) in %.1fs", n,
-                        "/".join(str(x) for x in batch_sizes),
-                        _time.monotonic() - start)
+            seconds = _time.monotonic() - start
+            self.warmup_stats = {"executables": n,
+                                 "seconds": round(seconds, 3)}
+            logger.info("Warm-up: compiled %d mixed-family executables "
+                        "(token buckets=%s) in %.1fs", n,
+                        "/".join(str(x) for x in batch_sizes), seconds)
             return n
         except Exception as e:  # warm-up is best-effort
             logger.warning("Warm-up failed (%s); compiling lazily instead",
                            e)
+            self.warmup_stats = {
+                "executables": n,
+                "seconds": round(_time.monotonic() - start, 3),
+                "error": str(e),
+            }
             return None
 
     # --- step ------------------------------------------------------------

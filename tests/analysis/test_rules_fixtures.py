@@ -195,3 +195,69 @@ class TestEngineChecks:
             "docs-metrics": 2,
         }
         assert len(result.suppressed) == 8
+
+
+class TestBucketAxisGuard:
+    """recompile-hazard's bucket-axis pin: a module listed in
+    Settings.bucket_axes may only define the dispatch-bucket axes named
+    there — any new `*_buckets` attribute/global is a fresh jit dispatch
+    axis (one executable per value) and fails the lint."""
+
+    def _run(self, tmp_path, source):
+        import pathlib
+
+        from intellillm_tpu.analysis import Settings, run_analysis
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir(exist_ok=True)
+        (pkg / "__init__.py").write_text("")
+        (pkg / "runner.py").write_text(source)
+        settings = Settings(
+            repo_root=pathlib.Path(tmp_path),
+            hot_paths={}, extra_traced={},
+            bucket_axes={"pkg/runner.py": ("mixed_token_buckets", )})
+        return run_analysis(repo_root=pathlib.Path(tmp_path),
+                            targets=("pkg", ),
+                            rule_ids=["recompile-hazard"],
+                            settings=settings, use_baseline=False)
+
+    def test_new_axis_flagged(self, tmp_path):
+        result = self._run(tmp_path, (
+            "class Runner:\n"
+            "    def __init__(self):\n"
+            "        self.mixed_token_buckets = [16, 32]\n"
+            "        self.batch_buckets = [1, 2, 4]\n"))
+        assert len(result.violations) == 1
+        violation = result.violations[0]
+        assert violation.rule == "recompile-hazard"
+        assert ("pkg/runner.py", 4) == (violation.path, violation.line)
+        assert "batch_buckets" in violation.message
+        assert "mixed_token_buckets" in violation.message
+
+    def test_pinned_axis_clean(self, tmp_path):
+        result = self._run(tmp_path, (
+            "class Runner:\n"
+            "    def __init__(self):\n"
+            "        self.mixed_token_buckets = [16, 32]\n"
+            "        top = self.mixed_token_buckets[-1]\n"
+            "        assert top\n"))
+        assert result.violations == []
+
+    def test_pragma_suppresses_with_reason(self, tmp_path):
+        result = self._run(tmp_path, (
+            "class Runner:\n"
+            "    def __init__(self):\n"
+            "        self.mixed_token_buckets = [16, 32]\n"
+            "        # lint: allow(recompile-hazard) reason=fixture\n"
+            "        self.len_buckets = [8]\n"))
+        assert result.violations == []
+        assert len(result.suppressed) == 1
+
+    def test_real_repo_pin_present(self):
+        """The default Settings must keep model_runner.py pinned to the
+        mixed family — deleting the pin would silently disable the
+        guard this test exists for."""
+        from intellillm_tpu.analysis.core import DEFAULT_BUCKET_AXES
+        assert DEFAULT_BUCKET_AXES[
+            "intellillm_tpu/worker/model_runner.py"] == (
+                "mixed_token_buckets", )

@@ -2,11 +2,13 @@
 
 Covers the acceptance properties of the mixed decode+prefill pass:
 decode-first admission (decodes are never starved by prefill chunks),
-per-step token budget is respected by chunk sizing, and
-`num_computed_tokens` survives preemption — recompute resets it, swap
+the converse starvation guarantee (prompts progress even when decodes
+fill the budget — padding headroom first, then a one-step decode
+deferral), per-step token budget respected by chunk sizing, and
+`num_computed_tokens` surviving preemption — recompute resets it, swap
 preserves it. Plus a golden step-trace test pinning the exact chunk
-schedule, and the bucketed-padding admission accounting (the legacy
-pass charges max_paddings against the runner's bucket shapes).
+schedule, the --disable-chunked-prefill whole-prompt-chunk mode, and
+the flat-batch padding admission accounting.
 """
 import pytest
 
@@ -146,10 +148,10 @@ def test_golden_chunk_trace():
     ]
 
 
-def test_chunked_off_never_produces_mixed_steps():
-    """Legacy mode golden property: with the flag off the scheduler never
-    emits chunk metadata — the runner's homogeneous paths see exactly the
-    pre-chunking inputs."""
+def test_chunked_off_admits_whole_prompt_chunks():
+    """--disable-chunked-prefill mode: each prompt is admitted as ONE
+    whole-prompt chunk (never split), executed through the same mixed
+    dispatch; pure-decode steps carry no chunk metadata at all."""
     cache_config = CacheConfig(block_size=4, swap_space_gib=0.001)
     cache_config.num_device_blocks = 64
     cache_config.num_cpu_blocks = 8
@@ -158,12 +160,36 @@ def test_chunked_off_never_produces_mixed_steps():
         max_paddings=256), cache_config)
     add_request(s, "0", 20)
     add_request(s, "1", 5)
-    for _ in range(3):
+    metas, out = run_step(s)
+    assert out.prompt_run
+    assert out.chunked_prefills == {"0": (0, 20, True), "1": (0, 5, True)}
+    assert out.num_prefill_tokens == 25
+    assert all(m.is_prompt for m in metas)
+    # Subsequent steps are plain decode passes: no chunk metadata.
+    for _ in range(2):
         metas, out = run_step(s)
         assert not out.is_mixed
         assert out.chunked_prefills is None
-        assert all(m.token_chunk_size is None for m in metas)
-        assert all(m.num_computed_tokens == 0 for m in metas)
+        assert not out.prompt_run
+
+
+def test_chunked_off_never_splits_a_prompt():
+    """A prompt exceeding the per-step budget is deferred whole in
+    --disable-chunked-prefill mode, not split across steps."""
+    cache_config = CacheConfig(block_size=4, swap_space_gib=0.001)
+    cache_config.num_device_blocks = 64
+    cache_config.num_cpu_blocks = 8
+    s = Scheduler(SchedulerConfig(
+        max_num_batched_tokens=16, max_num_seqs=8, max_model_len=16,
+        max_paddings=256), cache_config)
+    add_request(s, "0", 12)
+    add_request(s, "1", 12)   # 12 + 12 > 16 → deferred to its own step
+    metas, out = run_step(s)
+    assert [m.request_id for m in metas] == ["0"]
+    assert out.chunked_prefills == {"0": (0, 12, True)}
+    metas, out = run_step(s)
+    assert [m.request_id for m in metas] == ["1"]
+    assert out.chunked_prefills == {"1": (0, 12, True)}
 
 
 def test_recompute_preemption_resets_computed_tokens():
@@ -236,50 +262,59 @@ def test_swap_preemption_preserves_computed_tokens():
     assert out.chunked_prefills["0"] == (8, 8, False)
 
 
-def test_non_chunkable_prompts_fall_back_to_legacy_prefill():
-    """prompt_logprobs needs the full-prompt logits panel → the prompt
-    must be scheduled as a homogeneous prefill even in chunked mode."""
+def test_prompt_logprobs_prompts_chunk_like_any_other():
+    """prompt_logprobs rides the mixed dispatch (per-chunk logits panels
+    accumulate host-side): the prompt splits across steps under the
+    budget like any other prompt."""
     s = make_chunked_scheduler(budget=8, max_model_len=32)
-    add_request(s, "0", 12, temperature=0.0, max_tokens=4,
-                prompt_logprobs=5)
+    _, seq = add_request(s, "0", 12, temperature=0.0, max_tokens=4,
+                         prompt_logprobs=5)
     metas, out = s.schedule()
-    assert not out.is_mixed
-    assert out.prompt_run
-    assert metas[0].token_chunk_size is None
+    assert out.is_mixed
+    assert out.chunked_prefills["0"] == (0, 8, False)
+    assert metas[0].token_chunk_size == 8
+    _, out = s.schedule()
+    assert out.chunked_prefills["0"] == (8, 4, True)
+    assert seq.data.prefill_complete
 
 
-def test_mixed_pass_not_entered_while_nonchunkable_decodes_run():
-    """best_of>1 groups cannot share a mixed flat batch; a waiting
-    chunkable prompt must wait for the homogeneous path instead."""
+def test_best_of_groups_share_mixed_steps():
+    """best_of>1 groups fan out through the dispatch's multi-sample
+    axis: their prompts chunk normally, and a new prompt chunks into
+    the same mixed step their decodes run in."""
     s = make_chunked_scheduler(budget=16, max_num_seqs=8)
     g_multi, _ = add_request(s, "0", 4, temperature=0.8, best_of=2, n=2,
                              max_tokens=8)
-    _, out = s.schedule()   # homogeneous prefill of the best_of group
-    assert not out.is_mixed
+    _, out = s.schedule()
+    assert out.is_mixed
+    assert out.chunked_prefills["0"] == (0, 4, True)
     for seq in g_multi.get_seqs(SequenceStatus.RUNNING):
         seq.append_token_id(1, {1: 0.0})
     add_request(s, "1", 10)
-    _, out = s.schedule()
-    # Must NOT be mixed: the running group is not mixed-safe. The legacy
-    # pass runs a homogeneous prefill for the new prompt instead.
-    assert not out.is_mixed
+    metas, out = s.schedule()
+    # One mixed step: the best_of group's decode rows plus the new
+    # prompt's first chunk.
+    assert out.is_mixed
+    assert {m.request_id for m in metas} == {"0", "1"}
+    assert out.num_mixed_decode_tokens >= 1
+    assert out.chunked_prefills["1"][0] == 0
 
 
-def test_legacy_padding_budget_counts_bucketed_shapes():
-    """The legacy prefill pass charges max_paddings against the bucketed
-    (batch x len) shape the runner pads to, not the raw longest-prompt
-    delta — and a lone prompt is always admitted (its bucket padding is
-    intrinsic)."""
+def test_whole_prompt_padding_budget_counts_flat_buckets():
+    """--disable-chunked-prefill admission charges max_paddings against
+    the mixed flat-batch token bucket the runner pads to, not the raw
+    token count — and a lone prompt is always admitted (its bucket
+    padding is intrinsic)."""
     cache_config = CacheConfig(block_size=4, swap_space_gib=0.001)
     cache_config.num_device_blocks = 64
     cache_config.num_cpu_blocks = 8
     s = Scheduler(SchedulerConfig(
         max_num_batched_tokens=128, max_num_seqs=8, max_model_len=64,
         max_paddings=48), cache_config)
-    # Prompt 0: 60 tokens → len bucket 64, batch bucket 1 → 4 paddings,
-    # admitted (and would be even if it exceeded the cap: lone-prompt
-    # exemption). Prompt 1: 5 tokens → batch becomes 2x64=128 padded
-    # tokens vs 65 real = 63 paddings > 48 → deferred to its own step.
+    # Prompt 0: 60 tokens → flat bucket 64 → 4 paddings, admitted (and
+    # would be even over the cap: lone-prompt exemption). Prompt 1:
+    # 5 tokens → 65 total rows → flat bucket 128 → 63 paddings > 48 →
+    # deferred to its own step.
     add_request(s, "0", 60)
     add_request(s, "1", 5)
     metas, out = s.schedule()
@@ -299,3 +334,71 @@ def test_lone_prompt_exempt_from_padding_cap():
     add_request(s, "0", 33)  # bucket 64 → 31 paddings > cap, but lone
     metas, out = s.schedule()
     assert [m.request_id for m in metas] == ["0"]
+
+
+def test_prompt_progress_via_padding_headroom_when_decodes_fill_budget():
+    """Starvation corner, cheap half: decodes exactly consume the token
+    budget but the flat bucket already pays for more rows — the waiting
+    prompt's first chunk rides the padding headroom (free compute), and
+    every decode still runs."""
+    s = make_chunked_scheduler(budget=4, max_num_seqs=8, num_blocks=64)
+    decode_groups = []
+    for i in range(4):
+        g, _ = add_request(s, str(i), 1)
+        decode_groups.append(g)
+    run_step(s)   # all four 1-token prompts prefill in one step
+    assert all(g.get_seqs()[0].data.prefill_complete
+               for g in decode_groups)
+
+    _, seq = add_request(s, "9", 12)
+    metas, out = run_step(s)
+    assert out.is_mixed
+    # All 4 decodes scheduled AND the prompt chunked: the chunk rows sit
+    # in the bucket padding above the 4-token budget (smallest flat
+    # bucket is 16 rows).
+    assert out.num_mixed_decode_tokens == 4
+    chunk = out.chunked_prefills.get("9")
+    assert chunk is not None and chunk[0] == 0 and chunk[1] > 0
+    assert {m.request_id for m in metas} == {"0", "1", "2", "3", "9"}
+
+
+def test_prompt_progress_via_decode_deferral_at_bucket_boundary():
+    """Starvation corner, hard half (the core/scheduler.py:266 fix):
+    decode rows land exactly ON a flat bucket boundary, so there is no
+    padding headroom — the scheduler defers ONE lowest-priority decode
+    group for a single step so the waiting prompt still progresses, and
+    the deferred group resumes decoding afterwards."""
+    s = make_chunked_scheduler(budget=16, max_num_seqs=20, num_blocks=256,
+                               max_model_len=64)
+    decode_groups = []
+    for i in range(16):
+        g, _ = add_request(s, f"{i:02d}", 1)
+        decode_groups.append(g)
+    run_step(s)
+    assert all(g.get_seqs()[0].data.prefill_complete
+               for g in decode_groups)
+
+    _, seq = add_request(s, "99", 6)
+    tokens_before = {g.request_id: g.get_seqs()[0].data.get_len()
+                     for g in decode_groups}
+    steps = 0
+    while not seq.data.prefill_complete:
+        metas, out = run_step(s)
+        steps += 1
+        assert steps <= 12, "prompt starved: no prefill progress"
+        assert out.is_mixed
+        # Budget holds: scheduled decode rows + chunk tokens <= 16.
+        assert (out.num_mixed_decode_tokens
+                + out.num_prefill_tokens) <= 16
+        # At most one decode group deferred per step.
+        assert out.num_mixed_decode_tokens >= 15
+        chunk = (out.chunked_prefills or {}).get("99")
+        assert chunk is not None and chunk[1] >= 1, (
+            "step made no prompt progress while decodes filled the "
+            "budget")
+    # Prefill completed; afterwards every decode group keeps decoding
+    # (deferral was one step, not a starvation of its own).
+    for _ in range(3):
+        run_step(s)
+    for g in decode_groups:
+        assert g.get_seqs()[0].data.get_len() > tokens_before[g.request_id]

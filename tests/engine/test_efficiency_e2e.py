@@ -60,11 +60,12 @@ def test_engine_run_populates_ledger_and_both_servers(
     assert tok["decode"]["real"] > 0
     assert snap["pad_fraction"] is not None and 0 < snap["pad_fraction"] < 1
 
-    # Per-axis fill ratios: batch + len for prefill, batch + block
-    # width for decode (prefill block_width needs prefix caching).
+    # Per-axis fill ratios: batch for prefill (chunk rows are one token
+    # per row of the flat mixed batch — there is no padded len axis any
+    # more), batch + block width for decode.
     fills = snap["fill_ratio_avg"]
     assert 0 < fills["prefill"]["batch"] <= 1
-    assert 0 < fills["prefill"]["len"] <= 1
+    assert fills["prefill"]["len"] is None
     assert 0 < fills["decode"]["batch"] <= 1
     assert 0 < fills["decode"]["block_width"] <= 1
 

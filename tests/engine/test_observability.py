@@ -90,7 +90,10 @@ def test_compile_counters_once_per_bucket(tiny_opt_dir, fresh_obs):
     engine.add_request("11", "hello my name is", params)
     llm._run_engine(use_tqdm=False)
     snap1 = get_compile_tracker().snapshot()
-    assert snap1["compiles"].get("prefill") == 1, snap1
+    # Prompts execute as chunk rows of the mixed program — there is no
+    # separate "prefill" executable anymore.
+    assert snap1["compiles"].get("mixed") == 1, snap1
+    assert "prefill" not in snap1["compiles"], snap1
     decode_compiles1 = sum(v for k, v in snap1["compiles"].items()
                            if k.startswith("decode"))
     assert decode_compiles1 >= 1, snap1

@@ -93,13 +93,16 @@ def test_mixed_steps_attribute_tokens_exactly_once(tiny_opt_dir, trackers):
         f"no 'mixed' program in compile tracker: {csnap['compiles']}")
 
 
-def test_legacy_run_records_no_mixed_program(tiny_opt_dir, trackers):
-    """Chunked off: no mixed program may be dispatched, and prefill
-    tokens still attribute exactly once (the legacy homogeneous path)."""
+def test_chunked_off_still_runs_only_mixed_family(tiny_opt_dir, trackers):
+    """--disable-chunked-prefill changes ADMISSION (whole-prompt chunks),
+    not execution: the compile tracker must show only the mixed program
+    family — the legacy homogeneous prefill program is gone — and
+    prefill tokens still attribute exactly once."""
     eff, comp = trackers
     llm = LLM(model=tiny_opt_dir, dtype="float32",
               num_device_blocks_override=128, max_model_len=128,
-              max_num_seqs=8, max_paddings=512, num_decode_steps=1)
+              max_num_seqs=8, max_paddings=512, num_decode_steps=1,
+              enable_chunked_prefill=False)
     eff.reset_for_testing()
     comp.reset_for_testing()
     engine = llm.llm_engine
@@ -110,6 +113,10 @@ def test_legacy_run_records_no_mixed_program(tiny_opt_dir, trackers):
             temperature=0.0, max_tokens=MAX_TOKENS, ignore_eos=True))
     list(llm._run_engine(use_tqdm=False))
 
-    assert "mixed" not in comp.snapshot()["compiles"]
+    compiles = comp.snapshot()["compiles"]
+    assert "mixed" in compiles, compiles
+    allowed = {"mixed", "decode_fused", "decode_cont", "decode_teacher"}
+    assert set(compiles) <= allowed, (
+        f"non-mixed-family program dispatched: {compiles}")
     tokens = get_efficiency_tracker().snapshot()["tokens_total"]
     assert tokens["prefill"]["real"] == sum(prompt_lens)

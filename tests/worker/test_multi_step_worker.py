@@ -47,7 +47,9 @@ def _make_worker():
 
 
 def _metadata(prompts_out, is_prompt):
-    """prompts_out: list of (prompt_ids, output_ids)."""
+    """prompts_out: list of (prompt_ids, output_ids). Prompt entries
+    carry whole-prompt chunk metadata (token_chunk_size) — prompts only
+    execute as chunk rows of the mixed dispatch now."""
     params = SamplingParams(temperature=0.0, max_tokens=32, ignore_eos=True)
     metas = []
     for i, (prompt, out) in enumerate(prompts_out):
@@ -57,7 +59,8 @@ def _metadata(prompts_out, is_prompt):
         metas.append(SequenceGroupMetadata(
             request_id=str(i), is_prompt=is_prompt, seq_data={i: data},
             sampling_params=params,
-            block_tables={i: [2 * i, 2 * i + 1]}))
+            block_tables={i: [2 * i, 2 * i + 1]},
+            token_chunk_size=len(prompt) if is_prompt else None))
     return metas
 
 

@@ -7,6 +7,13 @@ fetch_indices (logits_processors) pytree variant and the fused-K program
 — executes on CPU. Regressions in the warm-up argument plumbing
 otherwise only surface as a swallowed best-effort warning on real
 hardware.
+
+Since the bucket-zoo deletion, default warm-up compiles the mixed
+`(token_budget,)` family alone: exactly TWO executables (greedy +
+sampled single-step decode at the top token bucket, narrowest width).
+Everything else — the per-bucket sweep, fetch_indices pytree variant,
+fused-K and pipelined-continuation programs — compiles lazily on first
+use unless INTELLILLM_WARMUP_FULL=1.
 """
 import jax
 import pytest
@@ -16,7 +23,8 @@ from intellillm_tpu.config import (CacheConfig, ModelConfig, ParallelConfig,
 from intellillm_tpu.worker.worker import Worker
 
 
-def _make_worker(num_decode_steps, max_model_len=128):
+def _make_worker(num_decode_steps, max_model_len=128,
+                 max_num_batched_tokens=2048, enable_chunked_prefill=False):
     from transformers import LlamaConfig
 
     hf = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
@@ -32,11 +40,13 @@ def _make_worker(num_decode_steps, max_model_len=128):
                                swap_space_gib=0.01)
     cache_config.num_device_blocks = 64
     cache_config.num_cpu_blocks = 4
-    scheduler_config = SchedulerConfig(max_num_batched_tokens=2048,
-                                       max_num_seqs=8,
-                                       max_model_len=max_model_len,
-                                       max_paddings=512,
-                                       num_decode_steps=num_decode_steps)
+    scheduler_config = SchedulerConfig(
+        max_num_batched_tokens=max_num_batched_tokens,
+        max_num_seqs=8,
+        max_model_len=max_model_len,
+        max_paddings=512,
+        num_decode_steps=num_decode_steps,
+        enable_chunked_prefill=enable_chunked_prefill)
     worker = Worker(model_config, ParallelConfig(), scheduler_config,
                     cache_config)
     worker.init_model()
@@ -46,53 +56,63 @@ def _make_worker(num_decode_steps, max_model_len=128):
 
 
 @pytest.mark.parametrize("num_decode_steps", [1, 4])
-def test_warm_up_compiles_all_variants(monkeypatch, num_decode_steps):
+def test_warm_up_default_is_two_mixed_executables(monkeypatch,
+                                                  num_decode_steps):
+    """Default warm-up compiles exactly the two steady-state sampler
+    variants (greedy + sampled) of the mixed single-step program —
+    regardless of --num-decode-steps (fused/continuation compile
+    lazily). This is the <30s boot criterion's executable count."""
     worker = _make_worker(num_decode_steps)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     n = worker.warm_up_model()
     # None means the best-effort except path fired — in this controlled
     # environment that's a broken call sequence, not a hardware limit.
     assert n is not None, "warm-up fell back to lazy compilation"
-    # Per warmed (width, sampler-variant): single-step + (fused +
-    # pipelined continuation if K>1 and pipelining enabled); two sampler
-    # variants (greedy fast path + sampled); plus one fetch_indices
-    # variant on the first width (greedy only).
-    from intellillm_tpu.utils import pipeline_enabled_env
-    n_widths = len(worker.model_runner.block_width_buckets[:2])
-    per_combo = ((3 if pipeline_enabled_env() else 2)
-                 if num_decode_steps > 1 else 1)
-    assert n == n_widths * 2 * per_combo + 1
+    assert n == 2
+    # Structured stats must agree with the return value (they feed the
+    # boot timeline -> /health/detail -> bench warmup_compile field).
+    assert worker.warmup_stats["executables"] == 2
+    assert worker.warmup_stats["seconds"] > 0.0
+    assert "error" not in worker.warmup_stats
 
 
 def test_warm_up_skipped_on_cpu():
     worker = _make_worker(1)
     assert worker.warm_up_model() is None
+    assert worker.warmup_stats == {"executables": 0, "seconds": 0.0}
 
 
-def test_warm_up_full_covers_every_batch_bucket(monkeypatch):
-    """INTELLILLM_WARMUP_FULL=1 sweeps every batch bucket AND every
-    width bucket so no (bs, width) decode executable is left to compile
+def test_warm_up_full_covers_every_token_bucket(monkeypatch):
+    """INTELLILLM_WARMUP_FULL=1 sweeps every token bucket up to the
+    budget plus the two narrowest widths, both sampler variants, the
+    fetch_indices pytree variant, and the fused(+continuation) K-step
+    programs — so nothing of the mixed family is left to compile
     mid-serving."""
-    worker = _make_worker(num_decode_steps=4, max_model_len=1024)
+    worker = _make_worker(num_decode_steps=4, max_model_len=128,
+                          max_num_batched_tokens=64,
+                          enable_chunked_prefill=True)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     monkeypatch.setenv("INTELLILLM_WARMUP_FULL", "1")
     n = worker.warm_up_model()
     assert n is not None
-    buckets = worker.model_runner.batch_buckets  # 1,2,4,8 for max_seqs=8
-    # Full mode must cover ALL width buckets (>2 of them at mml=1024:
-    # 16/32/64), two sampler variants, single+fused(+continuation when
-    # pipelining is enabled) per combo.
-    from intellillm_tpu.utils import pipeline_enabled_env
-    n_widths = len(worker.model_runner.block_width_buckets)
-    assert n_widths > 2
+    from intellillm_tpu.utils import pad_to_bucket, pipeline_enabled_env
+    buckets = worker.model_runner.mixed_token_buckets
+    top = pad_to_bucket(64, buckets)
+    batch_sizes = [bb for bb in buckets if bb <= top]
+    assert len(batch_sizes) > 1   # full mode must sweep, not just top
+    n_widths = len(buckets[:2])
+    # Per (bucket, width, sampler-variant): single-step + fused +
+    # (continuation when pipelining is enabled); plus ONE fetch_indices
+    # variant (top bucket, narrowest width, greedy).
     per_combo = 3 if pipeline_enabled_env() else 2
-    assert n == len(buckets) * n_widths * 2 * per_combo + 1
+    assert n == len(batch_sizes) * n_widths * 2 * per_combo + 1
+    assert worker.warmup_stats["executables"] == n
 
 
 def test_spec_worker_warmup_covers_teacher_and_draft(monkeypatch):
-    """Speculative serving warm-up must compile the draft model's decode
-    programs and the teacher-forced verification program (and must NOT
-    compile the pipelined-continuation program spec mode never uses)."""
+    """Speculative serving warm-up must compile the target's mixed pair,
+    the draft model's mixed pair, and the teacher-forced verification
+    program — and aggregate all five into warmup_stats."""
     from transformers import LlamaConfig
 
     from intellillm_tpu.config import SpeculativeConfig
@@ -129,8 +149,8 @@ def test_spec_worker_warmup_covers_teacher_and_draft(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     n = worker.warm_up_model()
     assert n is not None, "spec warm-up fell back to lazy compilation"
-    # target standard programs + the same set for the draft + 1 teacher;
-    # no continuation programs in either pass.
-    n_widths = len(worker.model_runner.block_width_buckets[:2])
-    per_model = n_widths * 2 * 2 + 1   # single+fused, 2 sampler variants
-    assert n == 2 * per_model + 1
+    # 2 target mixed variants + 2 draft mixed variants + 1 teacher;
+    # no fused/continuation programs in either pass.
+    assert n == 5
+    assert worker.warmup_stats["executables"] == 5
+    assert worker.warmup_stats["seconds"] > 0.0
