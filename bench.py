@@ -325,8 +325,15 @@ def build_engine(size: str, max_num_seqs: int, max_model_len: int,
         draft_mc = ModelConfig.from_hf_config(
             draft_hf, dtype="bfloat16", max_model_len=max_model_len,
             load_format="dummy")
+        spec_k = int(os.environ.get("INTELLILLM_BENCH_SPEC_K", "4"))
+        # Optional adaptive band (benchmarks/spec_bench.py --adaptive):
+        # warm the whole K-ladder and let the controller move inside it.
         speculative_config = SpeculativeConfig(
-            draft_mc, int(os.environ.get("INTELLILLM_BENCH_SPEC_K", "4")))
+            draft_mc, spec_k,
+            k_min=int(os.environ.get("INTELLILLM_BENCH_SPEC_K_MIN",
+                                     spec_k)),
+            k_max=int(os.environ.get("INTELLILLM_BENCH_SPEC_K_MAX",
+                                     spec_k)))
     return LLMEngine(model_config, cache_config, ParallelConfig(),
                      scheduler_config,
                      speculative_config=speculative_config,

@@ -65,6 +65,14 @@ def launch_server(model_dir: str, args,
         cmd += ["--sjf-starvation-s", str(args.sjf_starvation_s)]
     if getattr(args, "predictor_path", None):
         cmd += ["--predictor-path", args.predictor_path]
+    if getattr(args, "_spec_model_dir", None):
+        cmd += ["--speculative-model", args._spec_model_dir,
+                "--num-speculative-tokens",
+                str(args.num_speculative_tokens)]
+        if args.spec_k_min is not None:
+            cmd += ["--spec-k-min", str(args.spec_k_min)]
+        if args.spec_k_max is not None:
+            cmd += ["--spec-k-max", str(args.spec_k_max)]
     env = dict(os.environ)
     env.setdefault("HF_HUB_OFFLINE", "1")
     # Server logs go to a file, not an undrained pipe (a full pipe buffer
@@ -552,6 +560,53 @@ def _compare_policies(args, model_dir, tokenizer, policies) -> dict:
     return {"policy_comparison": block, "summaries": summaries}
 
 
+def _compare_spec(args, model_dir, tokenizer) -> dict:
+    """Run the rate sweep twice — target-only, then with the draft model
+    speculating — one server lifecycle each, and print a spec on/off
+    comparison block. Greedy spec emits the target's exact stream, so
+    the delta is pure serving throughput/latency, not a quality trade
+    (with dummy weights acceptance is ~0: this measures the overhead
+    floor; real checkpoints measure the win)."""
+    spec_dir = args._spec_model_dir
+    args._spec_model_dir = None
+    baseline = run_single(args, model_dir, tokenizer)
+    args._spec_model_dir = spec_dir
+    spec = run_single(args, model_dir, tokenizer)
+
+    def _row(summary):
+        results = summary.get("results") or []
+        rates = {}
+        for m in results:
+            rates[m.get("request_rate", "?")] = {
+                "output_tok_s": m.get("output_tok_s"),
+                "ttft_p99_ms": (m.get("ttft_percentiles_ms")
+                                or {}).get("p99"),
+                "tpot_p99_ms": (m.get("tpot_percentiles_ms")
+                                or {}).get("p99"),
+            }
+        return rates
+
+    base_rates, spec_rates = _row(baseline), _row(spec)
+    for rate, row in spec_rates.items():
+        base = base_rates.get(rate) or {}
+        if (row.get("output_tok_s") is not None
+                and base.get("output_tok_s")):
+            row["output_tok_s_ratio_vs_off"] = round(
+                row["output_tok_s"] / base["output_tok_s"], 3)
+    block = {
+        "num_speculative_tokens": args.num_speculative_tokens,
+        "spec_k_min": args.spec_k_min,
+        "spec_k_max": args.spec_k_max,
+        "spec_off": base_rates,
+        "spec_on": spec_rates,
+        # Acceptance/K/waste as the spec run ended (from /health/detail).
+        "spec_stats": spec.get("spec"),
+    }
+    print(json.dumps({"serve_bench_spec_comparison": block}), flush=True)
+    return {"spec_comparison": block,
+            "summaries": {"spec_off": baseline, "spec_on": spec}}
+
+
 def main(args) -> dict:
     from transformers import AutoTokenizer
 
@@ -562,8 +617,25 @@ def main(args) -> dict:
         save_dummy_checkpoint(f"dummy:{args.size}", model_dir)
     tokenizer = AutoTokenizer.from_pretrained(model_dir)
 
+    # Draft checkpoint for --speculative-size: its own dir, same dummy
+    # materialization rule (vocab must match the target's, which holds
+    # for the shared DUMMY_SIZES table).
+    args._spec_model_dir = None
+    if args.speculative_size:
+        spec_dir = tempfile.mkdtemp(prefix="serve-bench-draft-")
+        save_dummy_checkpoint(f"dummy:{args.speculative_size}", spec_dir)
+        args._spec_model_dir = spec_dir
+
     if args.scenario == "fleet":
         return run_fleet(args, model_dir, tokenizer)
+
+    if args.compare_spec:
+        if not args._spec_model_dir:
+            raise SystemExit("--compare-spec requires --speculative-size")
+        if args.scenario != "rate-sweep":
+            raise SystemExit(
+                "--compare-spec only supports --scenario rate-sweep")
+        return _compare_spec(args, model_dir, tokenizer)
 
     policies = [p.strip() for p in (args.scheduling_policy or "").split(",")
                 if p.strip()]
@@ -654,6 +726,9 @@ def run_single(args, model_dir, tokenizer, scheduling_policy=None) -> dict:
             if warmup else None)
         summary["slo"] = detail.get("slo") or {}
         summary["predictor"] = detail.get("predictor")
+        # Spec-decode stats (acceptance rate, current K, verify waste)
+        # from /health/detail; None when serving without a draft model.
+        summary["spec"] = detail.get("spec")
         summary["device_telemetry"] = distill_device_telemetry(detail)
         summary["efficiency"] = snapshot_efficiency(base)
         summary["alerts"] = distill_alerts(snapshot_alerts(base))
@@ -737,6 +812,23 @@ def make_arg_parser() -> argparse.ArgumentParser:
                    help="pass --max-num-batched-tokens to the server "
                         "(per-step token budget; with chunked prefill "
                         "this caps mixed-step compute)")
+    p.add_argument("--speculative-size", type=str, default=None,
+                   help="dummy draft model size (see common.DUMMY_SIZES); "
+                        "materializes a draft checkpoint and passes "
+                        "--speculative-model to the server")
+    p.add_argument("--num-speculative-tokens", type=int, default=4,
+                   help="draft length K passed to the server with "
+                        "--speculative-size")
+    p.add_argument("--spec-k-min", type=int, default=None,
+                   help="pass --spec-k-min to the server (adaptive-K "
+                        "band floor)")
+    p.add_argument("--spec-k-max", type=int, default=None,
+                   help="pass --spec-k-max to the server (adaptive-K "
+                        "band ceiling)")
+    p.add_argument("--compare-spec", action="store_true",
+                   help="with --speculative-size: run the rate sweep "
+                        "twice (spec off, then on) and print a "
+                        "serve_bench_spec_comparison block")
     return p
 
 

@@ -394,11 +394,29 @@ class SpeculativeConfig:
     """
 
     def __init__(self, draft_model_config: ModelConfig,
-                 num_speculative_tokens: int) -> None:
+                 num_speculative_tokens: int,
+                 k_min: Optional[int] = None,
+                 k_max: Optional[int] = None) -> None:
         if num_speculative_tokens < 1:
             raise ValueError("num_speculative_tokens must be >= 1")
         self.draft_model_config = draft_model_config
         self.num_speculative_tokens = num_speculative_tokens
+        # Adaptive draft-length band: the SLO-adaptive controller holds K
+        # in [k_min, k_max] at runtime (boot warms the whole ladder of
+        # draft/teacher executables). Defaults pin the band at the
+        # configured K — a fixed draft length.
+        self.k_min = k_min if k_min is not None else num_speculative_tokens
+        self.k_max = k_max if k_max is not None else num_speculative_tokens
+        if not 1 <= self.k_min <= self.k_max:
+            raise ValueError(
+                f"speculative K band invalid: need 1 <= spec_k_min "
+                f"({self.k_min}) <= spec_k_max ({self.k_max})")
+        if not self.k_min <= num_speculative_tokens <= self.k_max:
+            raise ValueError(
+                f"num_speculative_tokens ({num_speculative_tokens}) must "
+                f"lie inside [spec_k_min={self.k_min}, "
+                f"spec_k_max={self.k_max}] — it is the controller's "
+                "initial K")
 
     def verify_with_model_config(self, model_config: ModelConfig) -> None:
         dv = self.draft_model_config.get_vocab_size()

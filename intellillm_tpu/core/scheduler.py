@@ -39,6 +39,8 @@ from intellillm_tpu.prefix import PrefixPool
 from intellillm_tpu.sequence import (Sequence, SequenceData, SequenceGroup,
                                      SequenceGroupMetadata, SequenceStatus)
 from intellillm_tpu.utils import default_len_buckets, pad_to_bucket
+from intellillm_tpu.worker.spec_decode.eligibility import (
+    seq_group_spec_eligible)
 
 logger = init_logger(__name__)
 
@@ -67,6 +69,7 @@ class SchedulerOutputs:
         chunked_prefills: Optional[Dict[str, Tuple[int, int, bool]]] = None,
         num_prefill_tokens: int = 0,
         num_mixed_decode_tokens: int = 0,
+        spec_plan: Optional[Set[str]] = None,
     ) -> None:
         self.scheduled_seq_groups = scheduled_seq_groups
         self.prompt_run = prompt_run
@@ -84,6 +87,11 @@ class SchedulerOutputs:
         self.chunked_prefills = chunked_prefills
         self.num_prefill_tokens = num_prefill_tokens
         self.num_mixed_decode_tokens = num_mixed_decode_tokens
+        # Speculative step plan: request_ids whose decode rows reserved
+        # num_decode_steps KV slots and may run the draft+teacher pass
+        # this round (per-row eligibility — the rest of the batch decodes
+        # one plain token). None on non-speculative engines.
+        self.spec_plan = spec_plan
         assert not (blocks_to_swap_in and blocks_to_swap_out)
 
     @property
@@ -161,6 +169,12 @@ class Scheduler:
         # deferred until the engine unguards them (see LLMEngine pipeline).
         self._free_guard: Dict[int, int] = {}       # seq_id -> refcount
         self._deferred_free: Dict[int, Sequence] = {}
+
+        # Speculative decoding (set by the engine when a draft model is
+        # configured): decode scheduling turns per-row — spec-eligible
+        # groups reserve scheduler_config.num_decode_steps (= K+1) slots
+        # and join the step's spec_plan, everyone else reserves 1.
+        self.spec_decode_enabled = False
 
         self._tracer = get_step_tracer()
         self._flight = get_flight_recorder()
@@ -433,14 +447,23 @@ class Scheduler:
         # batch. Swapped groups are included since they may join this very
         # batch via swap-in.
         num_steps = self.scheduler_config.num_decode_steps
-        for sg in list(self.running) + list(self.swapped):
-            sp = sg.sampling_params
-            if (sp.use_beam_search or sp.presence_penalty
-                    or sp.frequency_penalty
-                    or sp.repetition_penalty != 1.0
-                    or sp.logits_processors):
-                num_steps = 1
-                break
+        spec_requests: Optional[Set[str]] = None
+        if self.spec_decode_enabled:
+            # Per-row speculation replaces the batch-wide fused K: each
+            # eligible group reserves K+1 slots (draft proposals + bonus)
+            # and joins the spec plan as it is scheduled below; every
+            # other group reserves 1 and decodes a single plain token in
+            # the same round.
+            spec_requests = set()
+        else:
+            for sg in list(self.running) + list(self.swapped):
+                sp = sg.sampling_params
+                if (sp.use_beam_search or sp.presence_penalty
+                        or sp.frequency_penalty
+                        or sp.repetition_penalty != 1.0
+                        or sp.logits_processors):
+                    num_steps = 1
+                    break
         # K is deliberately NOT clamped to remaining max_tokens: a varying K
         # would compile a fresh decode executable per value. Overshoot
         # tokens are discarded by the engine's stop checks; only {1, K}
@@ -450,8 +473,9 @@ class Scheduler:
         preempted: List[SequenceGroup] = []
         while self.running:
             seq_group = self.running.popleft()
+            steps = self._row_steps(seq_group, num_steps, spec_requests)
             while not self.block_manager.can_append_slots(
-                    seq_group, self._clamped_steps(seq_group, num_steps)):
+                    seq_group, self._clamped_steps(seq_group, steps)):
                 if self.running:
                     victim = self._pop_preemption_victim()
                     self._preempt(victim, blocks_to_swap_out)
@@ -461,7 +485,9 @@ class Scheduler:
                     preempted.append(seq_group)
                     break
             else:
-                self._append_slots(seq_group, num_steps, blocks_to_copy)
+                self._append_slots(seq_group, steps, blocks_to_copy)
+                if spec_requests is not None and steps > 1:
+                    spec_requests.add(seq_group.request_id)
                 running.append(seq_group)
         self.running = running
 
@@ -474,9 +500,9 @@ class Scheduler:
             lora_deferred_swap: List[SequenceGroup] = []
             while self.swapped:
                 seq_group = self.swapped[0]
+                steps = self._row_steps(seq_group, num_steps, spec_requests)
                 if not self.block_manager.can_swap_in(
-                        seq_group, self._clamped_steps(seq_group,
-                                                       num_steps)):
+                        seq_group, self._clamped_steps(seq_group, steps)):
                     break
                 lora_id = seq_group.lora_int_id
                 if self._lora_cap_exceeded(curr_loras, lora_id):
@@ -489,7 +515,9 @@ class Scheduler:
                     break
                 self.swapped.popleft()
                 self._swap_in(seq_group, blocks_to_swap_in)
-                self._append_slots(seq_group, num_steps, blocks_to_copy)
+                self._append_slots(seq_group, steps, blocks_to_copy)
+                if spec_requests is not None and steps > 1:
+                    spec_requests.add(seq_group.request_id)
                 num_curr_seqs += num_new_seqs
                 if curr_loras is not None and lora_id > 0:
                     curr_loras.add(lora_id)
@@ -499,6 +527,11 @@ class Scheduler:
 
         num_batched_tokens = sum(
             sg.num_seqs(status=SequenceStatus.RUNNING) for sg in self.running)
+        if spec_requests is not None:
+            # Multi-step only when at least one row actually speculates;
+            # a fully ineligible batch is a plain single-step decode.
+            num_steps = (self.scheduler_config.num_decode_steps
+                         if spec_requests else 1)
         return SchedulerOutputs(
             scheduled_seq_groups=list(self.running),
             prompt_run=False,
@@ -508,6 +541,7 @@ class Scheduler:
             blocks_to_copy=blocks_to_copy,
             ignored_seq_groups=[],
             num_decode_steps=num_steps,
+            spec_plan=spec_requests or None,
         )
 
     # --- chunked prefill (mixed decode+prefill steps) ---------------------
@@ -538,13 +572,24 @@ class Scheduler:
         prefilling_groups: List[SequenceGroup] = []
         preempted: List[SequenceGroup] = []
         decode_rows = 0
+        # Compute charged against the token budget by decode rows: a
+        # plain row costs 1, a speculative row costs K+1 (the teacher
+        # verifies K+1 positions for it) — prefill slack shrinks
+        # accordingly so a spec-heavy batch doesn't overcommit the step.
+        decode_charge = 0
+        spec_rows = 0
+        spec_requests: Optional[Set[str]] = None
+        if self.spec_decode_enabled:
+            spec_requests = set()
         while self.running:
             seq_group = self.running.popleft()
             if self._is_prefilling(seq_group):
                 prefilling_groups.append(seq_group)
                 running.append(seq_group)
                 continue
-            while not self.block_manager.can_append_slots(seq_group, 1):
+            steps = self._row_steps(seq_group, 1, spec_requests)
+            while not self.block_manager.can_append_slots(
+                    seq_group, self._clamped_steps(seq_group, steps)):
                 if self.running:
                     victim = self._pop_preemption_victim()
                     self._preempt(victim, blocks_to_swap_out)
@@ -554,11 +599,16 @@ class Scheduler:
                     preempted.append(seq_group)
                     break
             else:
-                self._append_slots(seq_group, 1, blocks_to_copy)
+                self._append_slots(seq_group, steps, blocks_to_copy)
+                if spec_requests is not None and steps > 1:
+                    spec_requests.add(seq_group.request_id)
                 running.append(seq_group)
                 decode_groups.append(seq_group)
-                decode_rows += seq_group.num_seqs(
-                    status=SequenceStatus.RUNNING)
+                n = seq_group.num_seqs(status=SequenceStatus.RUNNING)
+                decode_rows += n
+                decode_charge += n * steps
+                if steps > 1:
+                    spec_rows += n
         self.running = running
         # A preempted victim may have been mid-prefill; drop stale entries.
         prefilling_groups = [sg for sg in prefilling_groups
@@ -574,7 +624,9 @@ class Scheduler:
             lora_deferred_swap: List[SequenceGroup] = []
             while self.swapped:
                 seq_group = self.swapped[0]
-                if not self.block_manager.can_swap_in(seq_group, 1):
+                steps = self._row_steps(seq_group, 1, spec_requests)
+                if not self.block_manager.can_swap_in(
+                        seq_group, self._clamped_steps(seq_group, steps)):
                     break
                 lora_id = seq_group.lora_int_id
                 if self._lora_cap_exceeded(curr_loras, lora_id):
@@ -590,10 +642,15 @@ class Scheduler:
                 if self._is_prefilling(seq_group):
                     prefilling_groups.append(seq_group)
                 else:
-                    self._append_slots(seq_group, 1, blocks_to_copy)
+                    self._append_slots(seq_group, steps, blocks_to_copy)
+                    if spec_requests is not None and steps > 1:
+                        spec_requests.add(seq_group.request_id)
                     decode_groups.append(seq_group)
-                    decode_rows += seq_group.num_seqs(
-                        status=SequenceStatus.RUNNING)
+                    n = seq_group.num_seqs(status=SequenceStatus.RUNNING)
+                    decode_rows += n
+                    decode_charge += n * steps
+                    if steps > 1:
+                        spec_rows += n
                 num_curr_seqs += num_new_seqs
                 if curr_loras is not None and lora_id > 0:
                     curr_loras.add(lora_id)
@@ -602,23 +659,34 @@ class Scheduler:
                 self.swapped.appendleft(sg)
 
         # Pass 3: spend the slack on prefill chunks — in-flight first.
-        slack = budget - decode_rows
+        slack = budget - decode_charge
         if slack <= 0 and (prefilling_groups
                            or (self.waiting and not preempted
                                and not self.swapped)):
             # Starvation guard — prefills must advance every step even
-            # when decode rows alone fill the token budget. The padded
-            # bucket usually has free rows, so chunk tokens ride in the
-            # padding for free; if decode_rows lands exactly on a bucket
-            # edge, defer the lowest-priority decode group by one step
-            # instead (it stays RUNNING and rejoins next step).
-            slack = (pad_to_bucket(decode_rows, self._mixed_token_buckets)
-                     - decode_rows)
+            # when decode work alone fills the token budget. The padded
+            # bucket usually has free rows (headroom is measured against
+            # the MIXED flat batch only: spec rows ride the separate
+            # teacher program, not these buckets), so chunk tokens ride
+            # in the padding for free; if the resident rows land exactly
+            # on a bucket edge, defer the lowest-priority decode group by
+            # one step instead (it stays RUNNING and rejoins next step).
+            mixed_rows = decode_rows - spec_rows
+            slack = (pad_to_bucket(max(mixed_rows, 1),
+                                   self._mixed_token_buckets) - mixed_rows)
             if slack <= 0 and decode_groups:
                 deferred = decode_groups.pop()
-                decode_rows -= deferred.num_seqs(
-                    status=SequenceStatus.RUNNING)
-                slack = budget - decode_rows
+                n = deferred.num_seqs(status=SequenceStatus.RUNNING)
+                decode_rows -= n
+                if (spec_requests is not None
+                        and deferred.request_id in spec_requests):
+                    spec_requests.discard(deferred.request_id)
+                    spec_rows -= n
+                    decode_charge -= (
+                        n * self.scheduler_config.num_decode_steps)
+                else:
+                    decode_charge -= n
+                slack = budget - decode_charge
         chunk_groups: List[SequenceGroup] = []
         for seq_group in prefilling_groups:
             if slack <= 0:
@@ -728,10 +796,12 @@ class Scheduler:
             blocks_to_swap_out=blocks_to_swap_out,
             blocks_to_copy=blocks_to_copy,
             ignored_seq_groups=ignored_seq_groups,
-            num_decode_steps=1,
+            num_decode_steps=(self.scheduler_config.num_decode_steps
+                              if spec_requests else 1),
             chunked_prefills=chunks,
             num_prefill_tokens=num_prefill_tokens,
             num_mixed_decode_tokens=decode_rows,
+            spec_plan=spec_requests or None,
         )
 
     def schedule(
@@ -818,6 +888,18 @@ class Scheduler:
                 # Homogeneous admission computes the whole history this
                 # step; chunked admission advances per chunk instead.
                 seq.data.mark_prefill_complete()
+
+    def _row_steps(self, seq_group: SequenceGroup, num_steps: int,
+                   spec_requests: Optional[Set[str]]) -> int:
+        """Decode-slot lookahead for one group this round. Non-spec
+        engines use the batch-wide fused K; spec engines reserve K+1 for
+        eligible rows (the draft proposals + the bonus position all land
+        before the next scheduling pass) and 1 for everyone else."""
+        if spec_requests is None:
+            return num_steps
+        if seq_group_spec_eligible(seq_group):
+            return self.scheduler_config.num_decode_steps
+        return 1
 
     def _clamped_steps(self, seq_group: SequenceGroup,
                        num_steps: int) -> int:

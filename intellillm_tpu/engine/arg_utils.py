@@ -49,9 +49,13 @@ class EngineArgs:
     revision: Optional[str] = None
     quantization: Optional[str] = None
     enforce_eager: bool = False
-    # Speculative decoding (draft model + greedy verify)
+    # Speculative decoding (draft model + greedy verify). The adaptive
+    # controller holds the live draft length K inside [spec_k_min,
+    # spec_k_max]; both default to num_speculative_tokens (fixed K).
     speculative_model: Optional[str] = None
     num_speculative_tokens: int = 5
+    spec_k_min: Optional[int] = None
+    spec_k_max: Optional[int] = None
     # LoRA
     enable_lora: bool = False
     max_loras: int = 1
@@ -181,6 +185,17 @@ class EngineArgs:
         parser.add_argument("--speculative-model", type=str, default=None)
         parser.add_argument("--num-speculative-tokens", type=int,
                             default=5)
+        parser.add_argument("--spec-k-min", type=int, default=None,
+                            help="lower bound of the SLO-adaptive "
+                            "speculative draft length K (default: "
+                            "num_speculative_tokens, i.e. fixed K; see "
+                            "docs/scheduling.md)")
+        parser.add_argument("--spec-k-max", type=int, default=None,
+                            help="upper bound of the SLO-adaptive "
+                            "speculative draft length K; the boot warm-up "
+                            "compiles one draft+teacher executable pair "
+                            "per K in [spec-k-min, spec-k-max] (default: "
+                            "num_speculative_tokens)")
         return parser
 
     @classmethod
@@ -252,7 +267,26 @@ class EngineArgs:
             lora_config.verify_with_scheduler_config(scheduler_config)
         speculative_config = None
         if self.speculative_model is not None:
+            import os
+
             from intellillm_tpu.config import SpeculativeConfig
+            from intellillm_tpu.utils import parse_env_flag
+            if parse_env_flag(os.environ.get("INTELLILLM_PIPELINE")) is True:
+                # The draft+teacher round trip needs every substep's
+                # sampled ids on host before the next dispatch, so there
+                # is nothing to overlap — deferred-fetch pipelining and
+                # speculative decoding are mutually exclusive (see
+                # docs/scheduling.md). INTELLILLM_PIPELINE defaults on
+                # and the engine quietly drops it under spec; an EXPLICIT
+                # opt-in plus a draft model is a contradiction — fail at
+                # config time instead of on the first decode step deep
+                # inside the worker.
+                raise ValueError(
+                    "speculative decoding (--speculative-model) is "
+                    "incompatible with pipelined/deferred dispatch: "
+                    "INTELLILLM_PIPELINE=1 was set explicitly alongside "
+                    "a draft model; unset it (the engine cannot overlap "
+                    "fetches across the draft/verify round trip)")
             draft_mc = ModelConfig(
                 model=self.speculative_model,
                 tokenizer=self.speculative_model,
@@ -262,7 +296,8 @@ class EngineArgs:
                 max_model_len=model_config.max_model_len,
             )
             speculative_config = SpeculativeConfig(
-                draft_mc, self.num_speculative_tokens)
+                draft_mc, self.num_speculative_tokens,
+                k_min=self.spec_k_min, k_max=self.spec_k_max)
             speculative_config.verify_with_model_config(model_config)
         return (model_config, cache_config, parallel_config, scheduler_config,
                 lora_config, speculative_config)

@@ -142,19 +142,11 @@ class LLMEngine:
                 is not None else "ALiBi")
             scheduler_config.num_decode_steps = 1
 
-        # Chunked prefill constraints, decided HERE (like the K clamp
-        # above) so scheduler and runner agree. Speculative decoding owns
-        # its own dispatch pattern (draft + verify) — serial mixed steps
-        # would defeat it, so spec drops to single-chunk prompt admission.
-        # Sliding-window models chunk fine: the scheduler caps chunks at
-        # the window so no two rows of one dispatch share a ring slot.
-        if (scheduler_config.enable_chunked_prefill
-                and speculative_config is not None):
-            logger.info(
-                "Disabling chunked prefill: speculative decoding owns its "
-                "own draft+verify dispatch (prompts still execute as "
-                "single-chunk mixed rows).")
-            scheduler_config.enable_chunked_prefill = False
+        # Chunked prefill + speculative decoding compose since the
+        # per-row spec plan: chunk rows ride the target's mixed dispatch
+        # (their KV mirrored into the draft pool each step) while
+        # eligible decode rows run the draft+verify pass in the same
+        # scheduler round — no force-disable needed.
 
         # Compute-efficiency ledger (obs/efficiency.py): derive the
         # analytic FLOPs model and this chip's peak FLOPs BEFORE warm-up
@@ -190,6 +182,9 @@ class LLMEngine:
         self._device_telemetry.attach()
 
         self.scheduler = Scheduler(scheduler_config, cache_config, lora_config)
+        # Per-row speculative scheduling: eligible decode rows reserve
+        # K+1 slots and join SchedulerOutputs.spec_plan.
+        self.scheduler.spec_decode_enabled = speculative_config is not None
         self.stat_logger = StatLogger(
             local_interval=_LOG_STATS_INTERVAL,
             labels=dict(model_name=model_config.model)) if log_stats else None
@@ -497,16 +492,33 @@ class LLMEngine:
             "serial step() called with pipelined steps in flight; use "
             "step_pipelined() or drain_pipeline() first")
         self._tracer.begin_step()
+        if self.speculative_config is not None:
+            # Adaptive draft length: the controller's current K becomes
+            # this round's K+1 slot reservation BEFORE scheduling, so the
+            # scheduler's plan and the worker's draft/teacher programs
+            # agree (all K in [k_min, k_max] are warm — no compiles).
+            self.scheduler.scheduler_config.num_decode_steps = (
+                self.worker.adaptive_num_decode_steps())
         seq_group_metadata_list, scheduler_outputs = self.scheduler.schedule()
 
         if not scheduler_outputs.is_empty():
-            outputs = self.worker.execute_model(
-                seq_group_metadata_list,
-                scheduler_outputs.blocks_to_swap_in,
-                scheduler_outputs.blocks_to_swap_out,
-                scheduler_outputs.blocks_to_copy,
-                scheduler_outputs.num_decode_steps,
-            )
+            if self.speculative_config is not None:
+                outputs = self.worker.execute_model(
+                    seq_group_metadata_list,
+                    scheduler_outputs.blocks_to_swap_in,
+                    scheduler_outputs.blocks_to_swap_out,
+                    scheduler_outputs.blocks_to_copy,
+                    scheduler_outputs.num_decode_steps,
+                    spec_plan=scheduler_outputs.spec_plan,
+                )
+            else:
+                outputs = self.worker.execute_model(
+                    seq_group_metadata_list,
+                    scheduler_outputs.blocks_to_swap_in,
+                    scheduler_outputs.blocks_to_swap_out,
+                    scheduler_outputs.blocks_to_copy,
+                    scheduler_outputs.num_decode_steps,
+                )
         else:
             outputs = []
 
@@ -786,6 +798,20 @@ class LLMEngine:
                 # record() returns False for sealed traces (zombie rows
                 # re-reported by pipelined steps), so the SLO finish hook
                 # fires exactly once per request.
+                if self.speculative_config is not None:
+                    # One spec event per request, BEFORE the terminal
+                    # "finished" record seals the trace (pop() makes this
+                    # exactly-once; per-pass records would evict the
+                    # interesting scheduling history from the capped
+                    # event buffer).
+                    from intellillm_tpu.worker.spec_decode.metrics import (
+                        get_spec_stats)
+                    accepted = get_spec_stats().pop_request_accepted(
+                        seq_group.request_id)
+                    if accepted is not None:
+                        self._flight.record(
+                            seq_group.request_id, "spec_accepted",
+                            detail=str(accepted))
                 if self._flight.record(seq_group.request_id, "finished",
                                        detail=",".join(reasons) or None):
                     actual_len = sum(s.get_output_len()
@@ -1063,19 +1089,28 @@ class LLMEngine:
         # per-phase counts come from the scheduler, so nothing is double
         # counted or misattributed.
         k_eff = scheduler_outputs.num_decode_steps
-        if scheduler_outputs.is_mixed:
+        if (self.speculative_config is not None
+                and not scheduler_outputs.prompt_run):
+            # Spec decode pass (plain or mixed with prefill chunks): the
+            # emission count is VARIABLE (accepted+1 per eligible row,
+            # 1 per plain row, 0 per mid-prefill chunk) — the worker's
+            # actual per-pass emission is authoritative; the scheduler's
+            # row counts would under/over-report by the acceptance rate.
+            rows = (scheduler_outputs.num_mixed_decode_tokens
+                    if scheduler_outputs.is_mixed else
+                    scheduler_outputs.num_batched_tokens)
+            prompt_tokens = (scheduler_outputs.num_prefill_tokens
+                             if scheduler_outputs.is_mixed else 0)
+            generation_tokens = getattr(self.worker, "last_pass_emitted",
+                                        rows)
+            k_eff = max(generation_tokens / max(rows, 1), 1e-6)
+        elif scheduler_outputs.is_mixed:
             prompt_tokens = scheduler_outputs.num_prefill_tokens
             generation_tokens = scheduler_outputs.num_mixed_decode_tokens
             k_eff = 1
         elif scheduler_outputs.prompt_run:
             prompt_tokens = scheduler_outputs.num_batched_tokens
             generation_tokens = 0
-        elif self.speculative_config is not None:
-            prompt_tokens = 0
-            generation_tokens = getattr(self.worker, "last_pass_emitted",
-                                        scheduler_outputs.num_batched_tokens)
-            rows = max(scheduler_outputs.num_batched_tokens, 1)
-            k_eff = max(generation_tokens / rows, 1e-6)
         else:
             prompt_tokens = 0
             generation_tokens = (scheduler_outputs.num_batched_tokens *

@@ -28,6 +28,12 @@
                                         table (per-bucket factors) +
                                         recent predicted-vs-actual
                                         samples (docs/scheduling.md)
+    GET  /debug/spec                    speculative-decoding stats:
+                                        current/band K, rolling
+                                        acceptance rate, verify-waste
+                                        ratio, lifetime token totals
+                                        (404 when the engine runs
+                                        without a draft model)
     GET  /health/detail                 structured liveness: last-step
                                         age, watchdog state, queue
                                         depths, KV usage, SLO summary,
@@ -62,6 +68,7 @@ from intellillm_tpu.obs import (get_alert_manager, get_boot_timeline,
                                 get_flight_recorder, get_metrics_history,
                                 get_slo_tracker, get_watchdog)
 from intellillm_tpu.prediction import get_prediction_service
+from intellillm_tpu.worker.spec_decode.metrics import get_spec_stats
 
 
 def _parse_window(raw: Optional[str], default: float = 600.0) -> float:
@@ -114,6 +121,19 @@ async def debug_predictor(request: web.Request) -> web.Response:
     level like `metrics`: the prediction service is process-global, so
     the handler has no engine dependency."""
     return web.json_response(get_prediction_service().snapshot())
+
+
+async def debug_spec(request: web.Request) -> web.Response:
+    """Speculative-decoding stats (module-level like `metrics`: the
+    stats singleton is process-global). 404 when no draft model is
+    configured, so dashboards can distinguish 'spec off' from 'spec on
+    but cold'."""
+    stats = get_spec_stats()
+    if not stats.enabled:
+        return web.json_response(
+            {"error": "speculative decoding is not enabled "
+             "(no --speculative-model)"}, status=404)
+    return web.json_response(stats.summary())
 
 
 async def metrics(request: web.Request) -> web.Response:
@@ -204,6 +224,12 @@ def add_debug_routes(app: web.Application,
             # from here to correct its own predicted lengths.
             "predictor": get_prediction_service().health_block(),
         }
+        # Spec-decode block only when a draft model is serving; fleet
+        # aggregation treats a missing key as "spec off" (full table at
+        # /debug/spec).
+        spec_stats = get_spec_stats()
+        if spec_stats.enabled:
+            body["spec"] = spec_stats.summary()
         engine = get_engine()
         if engine is None:
             body["status"] = "initializing"
@@ -257,6 +283,7 @@ def add_debug_routes(app: web.Application,
     app.router.add_get("/debug/history", debug_history)
     app.router.add_get("/debug/alerts", debug_alerts)
     app.router.add_get("/debug/predictor", debug_predictor)
+    app.router.add_get("/debug/spec", debug_spec)
     app.router.add_get("/health/detail", health_detail)
     if enable_profiling:
         app.router.add_post("/debug/profiler/start", profiler_start)

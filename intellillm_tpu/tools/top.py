@@ -276,6 +276,8 @@ def render_frame(health: Optional[Dict[str, Any]],
 
     lines.extend(_predictor_lines(health.get("predictor")))
 
+    lines.extend(_spec_lines(health.get("spec")))
+
     lines.extend(_alerts_lines(alerts))
 
     lines.extend(_slowest_lines(slo.get("slowest") or []))
@@ -309,6 +311,27 @@ def _predictor_lines(pred: Optional[Dict[str, Any]]) -> List[str]:
     if failures:
         parts.append(f"failures {failures} **")
     return ["", "Predictor: " + "  ".join(parts)]
+
+
+def _spec_lines(spec: Optional[Dict[str, Any]]) -> List[str]:
+    """SPEC panel from /health/detail's spec block (the full table lives
+    at /debug/spec). Absent key = serving without a draft model."""
+    if not spec or not spec.get("enabled"):
+        return []
+    acc = spec.get("acceptance_rate")
+    acc_s = f"{acc:.0%}" if isinstance(acc, (int, float)) else "n/a"
+    waste = spec.get("verify_waste_ratio")
+    waste_s = (f"{waste:.0%}" if isinstance(waste, (int, float))
+               else "n/a")
+    totals = spec.get("totals") or {}
+    parts = [
+        f"K={spec.get('k', '?')} "
+        f"[{spec.get('k_min', '?')}..{spec.get('k_max', '?')}]",
+        f"accept {acc_s}",
+        f"verify-waste {waste_s}",
+        f"emitted {totals.get('emitted_tokens', 0)}",
+    ]
+    return ["", "Spec decode: " + "  ".join(parts)]
 
 
 def _efficiency_lines(eff: Dict[str, Any]) -> List[str]:

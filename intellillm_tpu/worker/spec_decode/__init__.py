@@ -1,4 +1,30 @@
-from intellillm_tpu.worker.spec_decode.multi_step_worker import (
-    MultiStepWorker)
+"""Speculative decoding package: worker, eligibility, adaptive K, stats.
 
-__all__ = ["MultiStepWorker"]
+Light submodules (eligibility, adaptive, metrics) import eagerly — the
+scheduler and obs stack use them without pulling in jax. The worker
+itself is lazy: importing it drags the full model/runner stack, which
+`core.scheduler` (an eligibility consumer) must not pay for.
+"""
+from intellillm_tpu.worker.spec_decode.adaptive import AdaptiveKController
+from intellillm_tpu.worker.spec_decode.eligibility import (
+    meta_spec_eligible, seq_group_spec_eligible, spec_params_eligible)
+from intellillm_tpu.worker.spec_decode.metrics import (SpecStats,
+                                                       get_spec_stats)
+
+__all__ = [
+    "AdaptiveKController",
+    "SpecDecodeWorker",
+    "SpecStats",
+    "get_spec_stats",
+    "meta_spec_eligible",
+    "seq_group_spec_eligible",
+    "spec_params_eligible",
+]
+
+
+def __getattr__(name):
+    if name == "SpecDecodeWorker":
+        from intellillm_tpu.worker.spec_decode.spec_worker import (
+            SpecDecodeWorker)
+        return SpecDecodeWorker
+    raise AttributeError(name)

@@ -1,0 +1,165 @@
+"""SLO-adaptive speculative draft length: the K controller.
+
+A fixed draft length K is wrong under load: when the batch is deep or
+acceptance collapses, every verify pass burns (K+1) target positions to
+emit ~1 token — wasted compute that shows up directly as TPOT-P99 burn.
+This controller holds K inside `[--spec-k-min, --spec-k-max]` and, once
+per evaluation window:
+
+- SHRINKS by one when pressure is on: the `slo_burn_rate` alert (PR 9's
+  dual-window error-budget burn) is pending/firing, the rolling TPOT
+  P99 exceeds the configured SLO, or the rolling acceptance rate drops
+  below the floor (acceptance-weighted goodput: emitting a/K of the
+  drafted tokens while paying for K+1 verifies),
+- GROWS by one only after `grow_patience` consecutive clean windows
+  (hysteresis — a single quiet window after a burn must not bounce K
+  straight back up), including the idle case (no recent finishes means
+  light load: spare verify compute is free speedup).
+
+The controller is deliberately clock- and signal-injectable (`now_fn`,
+`signals_fn`) so unit tests drive it with a fake clock and synthetic
+pressure instead of a live engine. It never emits a K outside the
+configured band, which is what makes the boot-time K-ladder warm-up
+sufficient: every (K+1) the controller can choose has its draft and
+teacher executables compiled before serving starts, so K transitions
+reuse warm executables and trigger zero new XLA compiles.
+
+Env knobs (defaults tuned for ~2 s alert-sampling cadence):
+    INTELLILLM_SPEC_K_EVAL_S          evaluation window seconds (2.0)
+    INTELLILLM_SPEC_K_MIN_ACCEPT      acceptance floor (0.4)
+    INTELLILLM_SPEC_K_GROW_PATIENCE   clean windows before a grow (3)
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def default_signals() -> Dict[str, Any]:
+    """Live control signals from the process-global obs singletons."""
+    from intellillm_tpu.obs import get_alert_manager, get_slo_tracker
+    from intellillm_tpu.worker.spec_decode.metrics import get_spec_stats
+
+    slo = get_slo_tracker()
+    summary = slo.summary()
+    tpot = (summary.get("tpot_ms") or {}).get("p99")
+    burn = False
+    try:
+        states = get_alert_manager().snapshot().get("rules") or {}
+        burn_state = (states.get("slo_burn_rate") or {}).get("state")
+        burn = burn_state in ("pending", "firing")
+    except Exception:
+        pass
+    stats = get_spec_stats()
+    acceptance = (stats.acceptance_rate()
+                  if stats.total_passes > 0 else None)
+    return {
+        "burn_firing": burn,
+        "tpot_p99_ms": tpot,
+        "slo_tpot_ms": summary.get("slo_tpot_ms"),
+        "acceptance": acceptance,
+    }
+
+
+class AdaptiveKController:
+    """Hysteresis controller for the speculative draft length."""
+
+    def __init__(
+        self,
+        k_min: int,
+        k_max: int,
+        k_init: Optional[int] = None,
+        eval_interval_s: Optional[float] = None,
+        min_acceptance: Optional[float] = None,
+        grow_patience: Optional[int] = None,
+        now_fn: Callable[[], float] = time.monotonic,
+        signals_fn: Callable[[], Dict[str, Any]] = default_signals,
+    ) -> None:
+        assert 1 <= k_min <= k_max
+        self.k_min = k_min
+        self.k_max = k_max
+        self.k = min(max(k_init if k_init is not None else k_max, k_min),
+                     k_max)
+        self.eval_interval_s = (
+            eval_interval_s if eval_interval_s is not None
+            else _env_f("INTELLILLM_SPEC_K_EVAL_S", 2.0))
+        self.min_acceptance = (
+            min_acceptance if min_acceptance is not None
+            else _env_f("INTELLILLM_SPEC_K_MIN_ACCEPT", 0.4))
+        self.grow_patience = int(
+            grow_patience if grow_patience is not None
+            else _env_f("INTELLILLM_SPEC_K_GROW_PATIENCE", 3))
+        self._now = now_fn
+        self._signals = signals_fn
+        self._last_eval = now_fn()
+        self._good_windows = 0
+        self.shrinks = 0
+        self.grows = 0
+        self.last_signals: Dict[str, Any] = {}
+
+    def _pressure(self, sig: Dict[str, Any]) -> Optional[str]:
+        """The shrink reason, or None when the window looks clean."""
+        if sig.get("burn_firing"):
+            return "slo_burn_rate"
+        tpot = sig.get("tpot_p99_ms")
+        slo_tpot = sig.get("slo_tpot_ms")
+        if tpot is not None and slo_tpot and tpot > slo_tpot:
+            return f"tpot_p99={tpot:.0f}ms>slo={slo_tpot:.0f}ms"
+        acceptance = sig.get("acceptance")
+        if acceptance is not None and acceptance < self.min_acceptance:
+            return f"acceptance={acceptance:.2f}<{self.min_acceptance:.2f}"
+        return None
+
+    def tick(self) -> int:
+        """Evaluate at most once per window; returns the current K.
+        Cheap when called every engine step (one clock read between
+        evaluations)."""
+        now = self._now()
+        if now - self._last_eval < self.eval_interval_s:
+            return self.k
+        self._last_eval = now
+        sig = self._signals()
+        self.last_signals = sig
+        reason = self._pressure(sig)
+        if reason is not None:
+            self._good_windows = 0
+            if self.k > self.k_min:
+                self.k -= 1
+                self.shrinks += 1
+                logger.info("Adaptive spec K: %d -> %d (%s)",
+                            self.k + 1, self.k, reason)
+        else:
+            self._good_windows += 1
+            if self._good_windows >= self.grow_patience and self.k < self.k_max:
+                self.k += 1
+                self.grows += 1
+                self._good_windows = 0
+                logger.info("Adaptive spec K: %d -> %d (clean windows)",
+                            self.k - 1, self.k)
+        return self.k
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "k": self.k,
+            "k_min": self.k_min,
+            "k_max": self.k_max,
+            "eval_interval_s": self.eval_interval_s,
+            "min_acceptance": self.min_acceptance,
+            "grow_patience": self.grow_patience,
+            "good_windows": self._good_windows,
+            "shrinks": self.shrinks,
+            "grows": self.grows,
+            "last_signals": dict(self.last_signals),
+        }

@@ -1,0 +1,58 @@
+"""Per-row speculative-decoding eligibility — ONE predicate, two views.
+
+The scheduler (planning slot reservations and the per-step spec plan)
+and the worker (partitioning the executed batch) must agree exactly on
+which rows may speculate; a disagreement either overflows reserved KV
+slots or silently drops speculation. Both sides therefore call into
+this module instead of duplicating the rule.
+
+A row is eligible when greedy acceptance reproduces the target stream
+bit-exactly and the teacher program can verify it:
+
+- greedy sampling only (sampled acceptance — rejection sampling against
+  draft probabilities — is not wired; beam search fans out),
+- no repetition/presence/frequency penalties (the teacher-forced
+  program asserts a penalty-free batch),
+- no logits_processors (the host-resample escape path needs raw logits
+  the teacher program does not fetch),
+- single sequence stream (best_of fan-out emits multiple rows),
+- no LoRA adapter (the draft model carries no adapter weights).
+
+Chunked-prefill rows are never eligible for the current step (they are
+mid-prompt), but their requests become eligible decode rows once the
+prompt completes — chunk KV is mirrored into the draft pool so that
+transition costs no acceptance.
+"""
+from __future__ import annotations
+
+from intellillm_tpu.sampling_params import SamplingParams, SamplingType
+from intellillm_tpu.sequence import SequenceGroup, SequenceGroupMetadata
+
+_SAMPLING_EPS = 1e-5
+
+
+def spec_params_eligible(sp: SamplingParams) -> bool:
+    """Sampling-params half of the predicate (shared by both views)."""
+    return (sp.sampling_type == SamplingType.GREEDY
+            and sp.best_of == 1
+            and not sp.logits_processors
+            and abs(sp.presence_penalty) < _SAMPLING_EPS
+            and abs(sp.frequency_penalty) < _SAMPLING_EPS
+            and abs(sp.repetition_penalty - 1.0) < _SAMPLING_EPS)
+
+
+def seq_group_spec_eligible(seq_group: SequenceGroup) -> bool:
+    """Scheduler view: may this running group speculate this round?"""
+    return (seq_group.lora_request is None
+            and seq_group.get_max_num_running_seqs() == 1
+            and spec_params_eligible(seq_group.sampling_params))
+
+
+def meta_spec_eligible(meta: SequenceGroupMetadata) -> bool:
+    """Worker view: the executed-batch mirror of the scheduler check.
+    Chunk rows (token_chunk_size set) are mid-prompt — never eligible."""
+    return (meta.token_chunk_size is None
+            and not meta.is_prompt
+            and meta.lora_request is None
+            and len(meta.seq_data) == 1
+            and spec_params_eligible(meta.sampling_params))

@@ -1,0 +1,232 @@
+"""Speculative-decoding metrics: rolling acceptance stats + Prometheus.
+
+Process-global singleton, same pattern as `prediction/metrics.py`: the
+collectors are built once and unregistered via `reset_for_testing` so
+tests can rebuild engines. Every gauge/counter here carries the
+`intellillm_spec_` prefix, so the in-process `MetricsHistory` store
+samples the family automatically (it walks every `intellillm_*`
+gauge/counter) and the alert engine can rule over it — no extra wiring.
+
+`SpecStats` is the rolling-window accounting object that replaced the
+old unbounded `SpecDecodeWorker.num_draft_tokens/num_accepted_tokens`
+counters: per-pass records land in a bounded deque, so the acceptance
+rate the adaptive-K controller steers on reflects *recent* traffic, not
+the lifetime average (a cold-start acceptance collapse must not be
+diluted away by an hour of good history). Lifetime totals are kept as
+plain ints for the Prometheus counters and test back-compat accessors.
+
+Per-request accepted-token counts (for the flight recorder's finish
+record) live in a bounded OrderedDict keyed by request id — capped,
+oldest-evicted, popped by the engine at request finish.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Dict, Optional
+
+try:
+    from prometheus_client import Counter, Gauge
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+# Rolling window: spec passes, not wall time — the controller evaluates
+# on its own clock, the window just bounds what "recent" means.
+_DEFAULT_WINDOW_PASSES = 256
+_MAX_REQUEST_ENTRIES = 4096
+
+
+class _SpecMetrics:
+    """Collectors for the speculative-decoding serving path."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.counter_draft_tokens = Counter(
+            "intellillm_spec_draft_tokens_total",
+            "Draft-model proposal tokens dispatched for verification.")
+        self.counter_accepted_tokens = Counter(
+            "intellillm_spec_accepted_tokens_total",
+            "Draft proposals the target model agreed with (greedy "
+            "acceptance).")
+        self.counter_emitted_tokens = Counter(
+            "intellillm_spec_emitted_tokens_total",
+            "Tokens emitted by speculative passes (accepted prefix + the "
+            "target's bonus token per row).")
+        self.gauge_current_k = Gauge(
+            "intellillm_spec_current_k",
+            "Current speculative draft length K chosen by the adaptive "
+            "controller (spec_k_min..spec_k_max).")
+        self.gauge_verify_waste = Gauge(
+            "intellillm_spec_verify_waste_ratio",
+            "Rolling fraction of verified target positions whose output "
+            "was discarded: 1 - emitted/verified over the stats window. "
+            "High waste means K is too long for current acceptance.")
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+class SpecStats:
+    """Thread-safe rolling accounting for speculative decode passes."""
+
+    def __init__(self, window_passes: int = _DEFAULT_WINDOW_PASSES) -> None:
+        self._lock = threading.Lock()
+        # (drafted, accepted, emitted, verified) per spec pass.
+        self._window: deque = deque(maxlen=window_passes)
+        self._per_request: "OrderedDict[str, int]" = OrderedDict()
+        self.enabled = False
+        self.k_min = 1
+        self.k_max = 1
+        self.current_k = 1
+        self.total_drafted = 0
+        self.total_accepted = 0
+        self.total_emitted = 0
+        self.total_verified = 0
+        self.total_passes = 0
+        self._metrics = _SpecMetrics() if _PROMETHEUS else None
+
+    # --- configuration ---------------------------------------------------
+
+    def configure(self, k_min: int, k_max: int, k_init: int) -> None:
+        """Engine init: mark spec serving active and start a fresh
+        rolling window (one serving engine per process; the Prometheus
+        counters stay monotonic across reconfigures)."""
+        with self._lock:
+            self._window.clear()
+            self._per_request = OrderedDict()
+            self.total_drafted = self.total_accepted = 0
+            self.total_emitted = self.total_verified = 0
+            self.total_passes = 0
+            self.enabled = True
+            self.k_min = k_min
+            self.k_max = k_max
+        self.set_current_k(k_init)
+
+    def set_current_k(self, k: int) -> None:
+        with self._lock:
+            self.current_k = k
+        if self._metrics is not None:
+            self._metrics.gauge_current_k.set(k)
+
+    # --- recording -------------------------------------------------------
+
+    def record_pass(self, drafted: int, accepted: int, emitted: int,
+                    verified: int) -> None:
+        """One speculative pass (all spec rows of one scheduler round)."""
+        with self._lock:
+            self._window.append((drafted, accepted, emitted, verified))
+            self.total_drafted += drafted
+            self.total_accepted += accepted
+            self.total_emitted += emitted
+            self.total_verified += verified
+            self.total_passes += 1
+            waste = self._verify_waste_locked()
+        if self._metrics is not None:
+            self._metrics.counter_draft_tokens.inc(drafted)
+            self._metrics.counter_accepted_tokens.inc(accepted)
+            self._metrics.counter_emitted_tokens.inc(emitted)
+            if waste is not None:
+                self._metrics.gauge_verify_waste.set(waste)
+
+    def record_request_accepted(self, request_id: str,
+                                accepted: int) -> None:
+        """Accumulate a request's accepted-draft-token count (read back
+        once by the engine's finish hook for the flight recorder)."""
+        with self._lock:
+            self._per_request[request_id] = (
+                self._per_request.get(request_id, 0) + accepted)
+            self._per_request.move_to_end(request_id)
+            while len(self._per_request) > _MAX_REQUEST_ENTRIES:
+                self._per_request.popitem(last=False)
+
+    def pop_request_accepted(self, request_id: str) -> Optional[int]:
+        with self._lock:
+            return self._per_request.pop(request_id, None)
+
+    # --- reads -----------------------------------------------------------
+
+    def acceptance_rate(self) -> float:
+        """Rolling accepted/drafted over the stats window (0.0 cold)."""
+        with self._lock:
+            drafted = sum(d for d, _, _, _ in self._window)
+            accepted = sum(a for _, a, _, _ in self._window)
+        if drafted == 0:
+            return 0.0
+        return accepted / drafted
+
+    def _verify_waste_locked(self) -> Optional[float]:
+        verified = sum(v for _, _, _, v in self._window)
+        emitted = sum(e for _, _, e, _ in self._window)
+        if verified == 0:
+            return None
+        return max(0.0, 1.0 - emitted / verified)
+
+    def verify_waste_ratio(self) -> Optional[float]:
+        with self._lock:
+            return self._verify_waste_locked()
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact block for /health/detail and GET /debug/spec."""
+        with self._lock:
+            window_len = len(self._window)
+            body = {
+                "enabled": self.enabled,
+                "k": self.current_k,
+                "k_min": self.k_min,
+                "k_max": self.k_max,
+                "passes": self.total_passes,
+                "window_passes": window_len,
+                "totals": {
+                    "draft_tokens": self.total_drafted,
+                    "accepted_tokens": self.total_accepted,
+                    "emitted_tokens": self.total_emitted,
+                    "verified_tokens": self.total_verified,
+                },
+            }
+        body["acceptance_rate"] = round(self.acceptance_rate(), 4)
+        waste = self.verify_waste_ratio()
+        body["verify_waste_ratio"] = (round(waste, 4)
+                                      if waste is not None else None)
+        return body
+
+    def reset(self) -> None:
+        with self._lock:
+            self._window.clear()
+            self._per_request = OrderedDict()
+            self.enabled = False
+            self.k_min = self.k_max = self.current_k = 1
+            self.total_drafted = self.total_accepted = 0
+            self.total_emitted = self.total_verified = 0
+            self.total_passes = 0
+
+
+_SPEC_STATS = SpecStats()
+
+
+def get_spec_stats() -> SpecStats:
+    return _SPEC_STATS
+
+
+def reset_for_testing() -> None:
+    """Clear the rolling stats and unregister the collector family (tests
+    rebuild engines; duplicate registration raises)."""
+    global _SPEC_STATS
+    _SpecMetrics.reset_for_testing()
+    _SPEC_STATS = SpecStats()

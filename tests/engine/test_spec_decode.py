@@ -99,12 +99,16 @@ def test_spec_decode_with_stops(tiny_llama_dir, draft_llama_dir,
     assert got == ref
 
 
-def test_spec_decode_mixed_batch_falls_back(tiny_llama_dir,
-                                            draft_llama_dir,
-                                            example_prompts):
-    """A batch containing a sampled request is ineligible for the
-    speculative path; the fallback still produces the exact same outputs
-    as the plain engine (seeded sampling included)."""
+def test_spec_decode_partial_eligibility_mixed_batch(tiny_llama_dir,
+                                                     draft_llama_dir,
+                                                     example_prompts):
+    """A batch mixing a greedy (spec-eligible) request with a sampled
+    (ineligible) one: the greedy row takes the draft+verify round while
+    the sampled row rides the plain dispatch in the SAME step, and both
+    streams are token-exact vs the plain engine. Seeded sampling streams
+    are K-dependent per fused call; the ineligible row advances one
+    token per pass under the spec engine, so the plain twin runs
+    num_decode_steps=1 (the greedy stream is K-independent)."""
     params = [
         SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True),
         SamplingParams(temperature=0.8, top_p=0.9, max_tokens=12,
@@ -112,14 +116,59 @@ def test_spec_decode_mixed_batch_falls_back(tiny_llama_dir,
     ]
     reqs = [(str(i), p, sp)
             for i, (p, sp) in enumerate(zip(example_prompts, params))]
-    # Seeded sampling streams are K-dependent (per-fused-call seed base =
-    # hash(output_len)); speculative mode forces K = num_spec_tokens + 1,
-    # so the plain twin must run the same K for token-exact comparison.
-    ref, _ = _run(tiny_llama_dir, reqs, num_decode_steps=5)
-    got, _ = _run(tiny_llama_dir, reqs,
-                  speculative_model=draft_llama_dir,
-                  num_speculative_tokens=4)
+    ref, _ = _run(tiny_llama_dir, reqs, num_decode_steps=1)
+    got, engine = _run(tiny_llama_dir, reqs,
+                       speculative_model=draft_llama_dir,
+                       num_speculative_tokens=4)
     assert got == ref
+    # The eligible row actually speculated — this was a mixed round,
+    # not a whole-batch fallback.
+    assert engine.worker.num_draft_tokens > 0
+
+
+def test_spec_decode_chunked_prefill_bit_identical(tiny_llama_dir,
+                                                   draft_llama_dir,
+                                                   example_prompts):
+    """Spec + chunked prefill compose: a tiny token budget forces real
+    chunk splits and mixed steps (prefill chunks mirrored into the draft
+    KV pool while resident decodes speculate), and the emitted greedy
+    streams are still bit-identical to the plain engine."""
+    prompts = example_prompts + [
+        " ".join(["the cat runs fast and the dog"] * 5)]  # 35 tokens
+    reqs = [(str(i), p, SamplingParams(temperature=0.0, max_tokens=16,
+                                       ignore_eos=True))
+            for i, p in enumerate(prompts)]
+    ref, _ = _run(tiny_llama_dir, reqs)
+
+    from intellillm_tpu.core import scheduler as sched_mod
+    seen = {"mixed": 0, "split": 0, "spec_mixed": 0}
+    orig = sched_mod.Scheduler._chunked_pass
+
+    def spy(self, now):
+        out = orig(self, now)
+        seen["mixed"] += 1
+        if any(start > 0 for start, _, _ in out.chunked_prefills.values()):
+            seen["split"] += 1
+        if out.spec_plan and out.chunked_prefills:
+            seen["spec_mixed"] += 1
+        return out
+
+    sched_mod.Scheduler._chunked_pass = spy
+    try:
+        got, engine = _run(tiny_llama_dir, reqs,
+                           speculative_model=draft_llama_dir,
+                           num_speculative_tokens=4,
+                           max_num_batched_tokens=12)
+    finally:
+        sched_mod.Scheduler._chunked_pass = orig
+
+    assert got == ref
+    assert engine.worker.num_draft_tokens > 0
+    assert seen["split"] > 0, (
+        "budget was sized to split the long prompt but no chunk split "
+        "happened — the scenario degenerated to whole-prompt prefill")
+    assert seen["spec_mixed"] > 0, (
+        "no step combined prefill chunks with a speculating decode row")
 
 
 def test_spec_decode_vocab_mismatch_rejected(tiny_llama_dir,
@@ -136,6 +185,31 @@ def test_spec_decode_vocab_mismatch_rejected(tiny_llama_dir,
     model.save_pretrained(d, safe_serialization=True)
     with pytest.raises(ValueError, match="vocab"):
         _run(tiny_llama_dir, [], speculative_model=d)
+
+
+def test_spec_decode_rejects_explicit_pipeline(tiny_llama_dir,
+                                               draft_llama_dir,
+                                               monkeypatch):
+    """INTELLILLM_PIPELINE=1 set explicitly alongside a draft model is a
+    config error at EngineArgs.create_engine_configs (the engine cannot
+    overlap fetches across the draft/verify round trip). The DEFAULT
+    auto-pipelining must NOT trip this — spec engines silently run
+    synchronous stepping (every other test in this file relies on it)."""
+    monkeypatch.setenv("INTELLILLM_PIPELINE", "1")
+    with pytest.raises(ValueError, match="pipelined"):
+        _run(tiny_llama_dir, [], speculative_model=draft_llama_dir,
+             num_speculative_tokens=2)
+
+
+def test_spec_decode_k_band_validation(tiny_llama_dir, draft_llama_dir):
+    """--spec-k-min/--spec-k-max must bracket the initial K and be a
+    sane band."""
+    with pytest.raises(ValueError, match="spec_k_min"):
+        _run(tiny_llama_dir, [], speculative_model=draft_llama_dir,
+             num_speculative_tokens=2, spec_k_min=3, spec_k_max=2)
+    with pytest.raises(ValueError, match="initial K"):
+        _run(tiny_llama_dir, [], speculative_model=draft_llama_dir,
+             num_speculative_tokens=5, spec_k_min=1, spec_k_max=4)
 
 
 def test_spec_decode_tp2(tiny_llama_dir, draft_llama_dir, example_prompts):

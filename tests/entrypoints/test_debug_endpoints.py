@@ -328,6 +328,53 @@ def test_predictor_endpoint_and_health_block_on_both_servers():
         reset_prediction_service_for_testing()
 
 
+def test_spec_endpoint_and_health_block_on_both_servers():
+    """/debug/spec serves the rolling spec stats (404 when no draft
+    model is configured); /health/detail carries the compact spec block
+    only while spec serving is active."""
+    from intellillm_tpu.worker.spec_decode import metrics as spec_metrics
+
+    spec_metrics.reset_for_testing()
+    try:
+        async def scenario_disabled(client):
+            resp = await client.get("/debug/spec")
+            assert resp.status == 404
+            resp = await client.get("/health/detail")
+            data = await resp.json()
+            assert "spec" not in data
+
+        _run(demo_server.build_app(), scenario_disabled)
+        _run(openai_server.build_app(), scenario_disabled)
+
+        stats = spec_metrics.get_spec_stats()
+        stats.configure(k_min=2, k_max=5, k_init=4)
+        stats.record_pass(drafted=8, accepted=6, emitted=8, verified=10)
+        stats.record_pass(drafted=8, accepted=2, emitted=4, verified=10)
+
+        async def scenario_enabled(client):
+            resp = await client.get("/debug/spec")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["enabled"] is True
+            assert data["k"] == 4
+            assert data["k_min"] == 2 and data["k_max"] == 5
+            assert data["passes"] == 2
+            assert data["acceptance_rate"] == pytest.approx(0.5)
+            assert data["verify_waste_ratio"] == pytest.approx(0.4)
+            assert data["totals"]["draft_tokens"] == 16
+            assert data["totals"]["emitted_tokens"] == 12
+
+            resp = await client.get("/health/detail")
+            data = await resp.json()
+            assert data["spec"]["k"] == 4
+            assert data["spec"]["acceptance_rate"] == pytest.approx(0.5)
+
+        _run(demo_server.build_app(), scenario_enabled)
+        _run(openai_server.build_app(), scenario_enabled)
+    finally:
+        spec_metrics.reset_for_testing()
+
+
 def test_demo_server_has_debug_routes():
     _seed_recorder()
     try:

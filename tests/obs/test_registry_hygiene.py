@@ -33,7 +33,7 @@ def test_constructors_are_found():
     # Guard the guard: the scrape must keep seeing the known collectors,
     # or the assertions below pass vacuously.
     names = {name for _, name in _metric_constructors()}
-    assert len(names) >= 25, sorted(names)
+    assert len(names) >= 30, sorted(names)
     assert "intellillm_step_phase_seconds" in names
     assert "intellillm_device_hbm_bytes_in_use" in names
     assert "intellillm_swap_bytes_total" in names
@@ -44,6 +44,12 @@ def test_constructors_are_found():
     # Distributed-tracing families (PR 7).
     assert "intellillm_trace_exported_total" in names
     assert "intellillm_trace_hop_seconds" in names
+    # Speculative-decoding families (PR 13).
+    assert "intellillm_spec_draft_tokens_total" in names
+    assert "intellillm_spec_accepted_tokens_total" in names
+    assert "intellillm_spec_emitted_tokens_total" in names
+    assert "intellillm_spec_current_k" in names
+    assert "intellillm_spec_verify_waste_ratio" in names
 
 
 def test_every_metric_name_is_prefixed():

@@ -1,0 +1,137 @@
+"""Fake-clock unit tests for the SLO-adaptive speculative-K controller
+(worker/spec_decode/adaptive.py). The controller is clock- and
+signal-injectable, so every scenario here drives a synthetic clock and
+synthetic pressure — no engine, no models, no sleeps."""
+import pytest
+
+from intellillm_tpu.worker.spec_decode.adaptive import AdaptiveKController
+
+
+class FakeClock:
+    def __init__(self, t0: float = 1000.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+CLEAN = {"burn_firing": False, "tpot_p99_ms": None, "slo_tpot_ms": None,
+         "acceptance": None}
+
+
+def _controller(clock, signals, k_min=1, k_max=6, k_init=4, **kw):
+    return AdaptiveKController(
+        k_min, k_max, k_init=k_init, eval_interval_s=2.0,
+        min_acceptance=0.4, grow_patience=3, now_fn=clock,
+        signals_fn=lambda: signals[0], **kw)
+
+
+def test_no_eval_inside_window():
+    clock = FakeClock()
+    signals = [dict(CLEAN, burn_firing=True)]
+    c = _controller(clock, signals)
+    # Sub-window ticks never evaluate: pressure is on but K holds.
+    for _ in range(5):
+        clock.advance(0.3)
+        assert c.tick() == 4
+    assert c.shrinks == 0
+
+
+def test_shrinks_within_one_window_of_burn():
+    clock = FakeClock()
+    signals = [dict(CLEAN)]
+    c = _controller(clock, signals)
+    signals[0] = dict(CLEAN, burn_firing=True)
+    clock.advance(2.1)
+    assert c.tick() == 3, "burn signal must shrink K at the next window"
+    assert c.shrinks == 1
+
+
+def test_shrinks_on_tpot_over_slo_and_on_acceptance_floor():
+    clock = FakeClock()
+    signals = [dict(CLEAN, tpot_p99_ms=250.0, slo_tpot_ms=200.0)]
+    c = _controller(clock, signals)
+    clock.advance(2.1)
+    assert c.tick() == 3
+    signals[0] = dict(CLEAN, acceptance=0.1)
+    clock.advance(2.1)
+    assert c.tick() == 2
+    # Acceptance above the floor is not pressure.
+    signals[0] = dict(CLEAN, acceptance=0.9)
+    clock.advance(2.1)
+    assert c.tick() == 2
+
+
+def test_grows_under_light_load_after_patience():
+    clock = FakeClock()
+    signals = [dict(CLEAN)]  # idle: no signals at all = clean window
+    c = _controller(clock, signals, k_init=2)
+    ks = []
+    for _ in range(7):
+        clock.advance(2.1)
+        ks.append(c.tick())
+    # Grows on the 3rd, then 6th clean window (patience resets per grow).
+    assert ks == [2, 2, 3, 3, 3, 4, 4]
+    assert c.grows == 2
+
+
+def test_hysteresis_one_clean_window_never_undoes_a_shrink():
+    clock = FakeClock()
+    signals = [dict(CLEAN, burn_firing=True)]
+    c = _controller(clock, signals)
+    clock.advance(2.1)
+    assert c.tick() == 3
+    # One clean window: K must NOT bounce back.
+    signals[0] = dict(CLEAN)
+    clock.advance(2.1)
+    assert c.tick() == 3
+    # A new burn resets the good-window streak...
+    signals[0] = dict(CLEAN, burn_firing=True)
+    clock.advance(2.1)
+    assert c.tick() == 2
+    # ...so recovery needs the FULL patience again.
+    signals[0] = dict(CLEAN)
+    for expected in (2, 2, 3):
+        clock.advance(2.1)
+        assert c.tick() == expected
+
+
+def test_never_leaves_band():
+    clock = FakeClock()
+    signals = [dict(CLEAN, burn_firing=True)]
+    c = _controller(clock, signals, k_min=2, k_max=4, k_init=3)
+    for _ in range(10):
+        clock.advance(2.1)
+        assert 2 <= c.tick() <= 4
+    assert c.k == 2  # pinned at the floor, never below
+    signals[0] = dict(CLEAN)
+    for _ in range(20):
+        clock.advance(2.1)
+        assert 2 <= c.tick() <= 4
+    assert c.k == 4  # pinned at the ceiling, never above
+
+
+def test_k_init_clamped_and_band_asserted():
+    clock = FakeClock()
+    signals = [dict(CLEAN)]
+    c = _controller(clock, signals, k_min=2, k_max=4, k_init=9)
+    assert c.k == 4
+    with pytest.raises(AssertionError):
+        AdaptiveKController(5, 2, now_fn=clock,
+                            signals_fn=lambda: signals[0])
+
+
+def test_snapshot_carries_state_and_last_signals():
+    clock = FakeClock()
+    signals = [dict(CLEAN, acceptance=0.05)]
+    c = _controller(clock, signals)
+    clock.advance(2.1)
+    c.tick()
+    snap = c.snapshot()
+    assert snap["k"] == 3
+    assert snap["shrinks"] == 1
+    assert snap["last_signals"]["acceptance"] == 0.05
+    assert snap["k_min"] == 1 and snap["k_max"] == 6

@@ -1,0 +1,83 @@
+"""Rolling-window spec-decode accounting (worker/spec_decode/metrics.py)
+— the object that replaced the worker's unbounded lifetime counters.
+The controller steers on the WINDOW, so stale history must age out."""
+import pytest
+
+from intellillm_tpu.worker.spec_decode import metrics as spec_metrics
+from intellillm_tpu.worker.spec_decode.metrics import SpecStats
+
+
+def test_acceptance_rate_is_rolling_not_lifetime():
+    stats = SpecStats(window_passes=4)
+    # Four perfect passes...
+    for _ in range(4):
+        stats.record_pass(drafted=4, accepted=4, emitted=5, verified=5)
+    assert stats.acceptance_rate() == 1.0
+    # ...then four total-miss passes push them out of the window: the
+    # rolling rate collapses to 0 even though the lifetime rate is 0.5.
+    for _ in range(4):
+        stats.record_pass(drafted=4, accepted=0, emitted=1, verified=5)
+    assert stats.acceptance_rate() == 0.0
+    assert stats.total_accepted == 16 and stats.total_drafted == 32
+
+
+def test_cold_reads_are_safe():
+    stats = SpecStats()
+    assert stats.acceptance_rate() == 0.0
+    assert stats.verify_waste_ratio() is None
+    summary = stats.summary()
+    assert summary["enabled"] is False
+    assert summary["verify_waste_ratio"] is None
+
+
+def test_verify_waste_ratio():
+    stats = SpecStats()
+    stats.record_pass(drafted=4, accepted=1, emitted=2, verified=5)
+    # 5 verified positions, 2 emitted -> 60% of the verify work wasted.
+    assert stats.verify_waste_ratio() == pytest.approx(0.6)
+
+
+def test_per_request_accepted_pops_exactly_once():
+    stats = SpecStats()
+    stats.record_request_accepted("r1", 3)
+    stats.record_request_accepted("r1", 2)
+    stats.record_request_accepted("r2", 1)
+    assert stats.pop_request_accepted("r1") == 5
+    assert stats.pop_request_accepted("r1") is None
+    assert stats.pop_request_accepted("unknown") is None
+    assert stats.pop_request_accepted("r2") == 1
+
+
+def test_per_request_map_is_bounded():
+    stats = SpecStats()
+    cap = spec_metrics._MAX_REQUEST_ENTRIES
+    for i in range(cap + 10):
+        stats.record_request_accepted(f"r{i}", 1)
+    # Oldest evicted, newest retained.
+    assert stats.pop_request_accepted("r0") is None
+    assert stats.pop_request_accepted(f"r{cap + 9}") == 1
+
+
+def test_configure_resets_window_for_a_fresh_engine():
+    stats = SpecStats()
+    stats.configure(k_min=1, k_max=4, k_init=2)
+    stats.record_pass(drafted=4, accepted=0, emitted=1, verified=5)
+    stats.record_request_accepted("stale", 1)
+    # A rebuilt engine reconfigures the process-global singleton: the
+    # rolling window and per-request map must start clean.
+    stats.configure(k_min=1, k_max=4, k_init=3)
+    assert stats.total_passes == 0
+    assert stats.acceptance_rate() == 0.0
+    assert stats.pop_request_accepted("stale") is None
+    assert stats.current_k == 3
+
+
+def test_reset_for_testing_allows_reregistration():
+    # Unregisters the collector family; building fresh stats must not
+    # raise a duplicate-registration error.
+    spec_metrics.reset_for_testing()
+    s1 = spec_metrics.get_spec_stats()
+    spec_metrics.reset_for_testing()
+    s2 = spec_metrics.get_spec_stats()
+    assert s2 is not s1
+    spec_metrics.reset_for_testing()

@@ -111,8 +111,9 @@ def test_warm_up_full_covers_every_token_bucket(monkeypatch):
 
 def test_spec_worker_warmup_covers_teacher_and_draft(monkeypatch):
     """Speculative serving warm-up must compile the target's mixed pair,
-    the draft model's mixed pair, and the teacher-forced verification
-    program — and aggregate all five into warmup_stats."""
+    the draft model's mixed pair, and the K-ladder — for a fixed K
+    (k_min == k_max) that is one teacher program plus one draft fused
+    scan, six executables total in warmup_stats."""
     from transformers import LlamaConfig
 
     from intellillm_tpu.config import SpeculativeConfig
@@ -149,8 +150,54 @@ def test_spec_worker_warmup_covers_teacher_and_draft(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     n = worker.warm_up_model()
     assert n is not None, "spec warm-up fell back to lazy compilation"
-    # 2 target mixed variants + 2 draft mixed variants + 1 teacher;
-    # no fused/continuation programs in either pass.
-    assert n == 5
-    assert worker.warmup_stats["executables"] == 5
+    # 2 target mixed variants + 2 draft mixed variants + the K-ladder
+    # (1 teacher + 1 draft fused per K rung; fixed K = one rung).
+    assert n == 6
+    assert worker.warmup_stats["executables"] == 6
     assert worker.warmup_stats["seconds"] > 0.0
+
+
+def test_spec_worker_warmup_ladder_scales_with_band(monkeypatch):
+    """An adaptive band [k_min, k_max] warms every rung: 4 generic mixed
+    variants + 2 executables per K in the band, so no K transition can
+    hit a cold compile mid-serving."""
+    from transformers import LlamaConfig
+
+    from intellillm_tpu.config import SpeculativeConfig
+    from intellillm_tpu.worker.spec_decode.spec_worker import (
+        SpecDecodeWorker)
+
+    def mc(hidden, inter, layers):
+        hf = LlamaConfig(vocab_size=128, hidden_size=hidden,
+                         intermediate_size=inter, num_hidden_layers=layers,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=128,
+                         tie_word_embeddings=False)
+        return ModelConfig.from_hf_config(hf, dtype="float32",
+                                          max_model_len=128,
+                                          load_format="dummy")
+
+    cache_config = CacheConfig(block_size=16,
+                               num_device_blocks_override=64,
+                               swap_space_gib=0.01)
+    cache_config.num_device_blocks = 64
+    cache_config.num_cpu_blocks = 4
+    k_min, k_max = 2, 4
+    scheduler_config = SchedulerConfig(max_num_batched_tokens=2048,
+                                       max_num_seqs=8, max_model_len=128,
+                                       max_paddings=512,
+                                       num_decode_steps=k_max + 1)
+    spec = SpeculativeConfig(mc(32, 64, 1), k_max, k_min=k_min,
+                             k_max=k_max)
+    worker = SpecDecodeWorker(mc(64, 128, 2), ParallelConfig(),
+                              scheduler_config, cache_config,
+                              speculative_config=spec)
+    worker.init_model()
+    worker.load_model()
+    worker.init_cache_engine(cache_config)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    n = worker.warm_up_model()
+    assert n is not None
+    rungs = k_max - k_min + 1
+    assert n == 4 + 2 * rungs
+    assert worker.warmup_stats["executables"] == n
