@@ -27,8 +27,10 @@ def _make_worker(num_decode_steps, max_model_len=128,
                  max_num_batched_tokens=2048, enable_chunked_prefill=False):
     from transformers import LlamaConfig
 
-    hf = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
-                     num_hidden_layers=2, num_attention_heads=4,
+    # Smallest config that still exercises GQA: warm-up sweeps compile
+    # dozens of executables, so per-compile cost dominates test time.
+    hf = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=1, num_attention_heads=4,
                      num_key_value_heads=2,
                      max_position_embeddings=max_model_len,
                      tie_word_embeddings=False)
@@ -141,7 +143,7 @@ def test_spec_worker_warmup_covers_teacher_and_draft(monkeypatch):
                                        max_paddings=512,
                                        num_decode_steps=k_spec + 1)
     spec = SpeculativeConfig(mc(32, 64, 1), k_spec)
-    worker = SpecDecodeWorker(mc(64, 128, 2), ParallelConfig(),
+    worker = SpecDecodeWorker(mc(32, 64, 1), ParallelConfig(),
                               scheduler_config, cache_config,
                               speculative_config=spec)
     worker.init_model()
@@ -189,7 +191,7 @@ def test_spec_worker_warmup_ladder_scales_with_band(monkeypatch):
                                        num_decode_steps=k_max + 1)
     spec = SpeculativeConfig(mc(32, 64, 1), k_max, k_min=k_min,
                              k_max=k_max)
-    worker = SpecDecodeWorker(mc(64, 128, 2), ParallelConfig(),
+    worker = SpecDecodeWorker(mc(32, 64, 1), ParallelConfig(),
                               scheduler_config, cache_config,
                               speculative_config=spec)
     worker.init_model()
