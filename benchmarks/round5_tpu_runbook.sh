@@ -61,6 +61,14 @@ run serve_nopipeline env INTELLILLM_PIPELINE=0 \
     --kv-cache-dtype fp8_e5m2 --num-device-blocks 1600 \
     --max-num-seqs 96 --rates 8,16
 
+# 4b. Disaggregated prefill/decode A/B: 1 prefill + 2 decode replicas
+# vs 3 mixed, probe TTFT vs background P99 TPOT, plus what the
+# isolation costs in KV-transfer bytes/seconds (docs/routing.md).
+run serve_disagg python benchmarks/serve_bench.py --size 7b \
+    --scenario disagg --num-replicas 2 --quantization int8 \
+    --kv-cache-dtype fp8_e5m2 --num-device-blocks 1600 \
+    --max-num-seqs 96
+
 # 5. Real-checkpoint load validation (task 8).
 run real_checkpoint python benchmarks/real_checkpoint_tpu.py
 

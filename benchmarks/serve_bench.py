@@ -309,7 +309,8 @@ def wait_healthy(proc: subprocess.Popen, base: str, timeout: float,
 
 
 async def _ttft_under_load(api_url: str, model_name: str, background,
-                           probe, probe_delay: float):
+                           probe, probe_delay: float,
+                           protocol: str = "openai"):
     """Steady decode stream + one long-prompt probe injected mid-run.
 
     The background requests all start at once (short prompts, long
@@ -331,20 +332,20 @@ async def _ttft_under_load(api_url: str, model_name: str, background,
                                      timeout=timeout) as session:
         bg_tasks = [
             asyncio.create_task(send_request(
-                session, "openai", api_url, model_name, prompt,
+                session, protocol, api_url, model_name, prompt,
                 prompt_len, output_len, 1, bg_results))
             for prompt, prompt_len, output_len in background
         ]
         await asyncio.sleep(probe_delay)
         prompt, prompt_len, output_len = probe
-        await send_request(session, "openai", api_url, model_name, prompt,
+        await send_request(session, protocol, api_url, model_name, prompt,
                            prompt_len, output_len, 1, probe_results)
         await asyncio.gather(*bg_tasks)
     return time.perf_counter() - start, bg_results, probe_results
 
 
 def run_ttft_under_load(args, api_url: str, model_name: str, tokenizer,
-                        requests) -> dict:
+                        requests, protocol: str = "openai") -> dict:
     """The ttft-under-load scenario: report the probe's TTFT next to the
     background stream's P99 TPOT — the pair of numbers chunked prefill
     trades against each other."""
@@ -362,11 +363,12 @@ def run_ttft_under_load(args, api_url: str, model_name: str, tokenizer,
 
     # Warm the probe-shaped prefill program so the measured TTFT is
     # scheduling delay, not a first-compile stall.
-    asyncio.run(run_benchmark("openai", api_url, model_name, [probe],
+    asyncio.run(run_benchmark(protocol, api_url, model_name, [probe],
                               float("inf")))
 
     elapsed, bg_results, probe_results = asyncio.run(_ttft_under_load(
-        api_url, model_name, requests, probe, args.probe_delay))
+        api_url, model_name, requests, probe, args.probe_delay,
+        protocol=protocol))
     bg = compute_metrics(bg_results, elapsed)
     (pr,) = probe_results
     return {
@@ -386,9 +388,11 @@ def run_ttft_under_load(args, api_url: str, model_name: str, tokenizer,
 
 
 def launch_generate_replica(model_dir: str, args, port: int,
-                            log_path: str) -> subprocess.Popen:
+                            log_path: str,
+                            role: str = None) -> subprocess.Popen:
     """Launch one demo api_server replica (plain /generate protocol —
-    the surface the router fronts)."""
+    the surface the router fronts). `role` maps to --replica-role for
+    disaggregated fleets."""
     cmd = [
         sys.executable, "-m", "intellillm_tpu.entrypoints.api_server",
         "--model", model_dir,
@@ -408,6 +412,8 @@ def launch_generate_replica(model_dir: str, args, port: int,
         cmd += ["--quantization", args.quantization]
     if args.num_device_blocks:
         cmd += ["--num-device-blocks-override", str(args.num_device_blocks)]
+    if role and role != "mixed":
+        cmd += ["--replica-role", role]
     env = dict(os.environ)
     env.setdefault("HF_HUB_OFFLINE", "1")
     log = open(log_path, "wb")
@@ -515,6 +521,135 @@ def run_fleet(args, model_dir: str, tokenizer) -> dict:
             proc.send_signal(signal.SIGKILL)
             proc.wait()
 
+    print(json.dumps({"serve_bench_summary": summary}), flush=True)
+    return summary
+
+
+def _run_role_fleet(args, model_dir, tokenizer, roles, label,
+                    base_port) -> dict:
+    """Boot one replica per entry in `roles` (passed through as
+    --replica-role) behind the router (--replica-roles), run the
+    ttft-under-load probe through the router's /generate protocol, and
+    return the probe/background split plus the router's fleet
+    kv_transfer block and each replica's own transfer counters (bytes
+    move engine-side, so an HTTP fleet's byte counts live in the
+    replica processes, not the router's)."""
+    router_base = f"http://127.0.0.1:{args.port}"
+    api_url = router_base + "/generate"
+    replicas = []
+    router_proc = None
+    try:
+        for i, role in enumerate(roles):
+            port = base_port + i
+            log_path = f"{args.server_log}.{label}{i}"
+            proc = launch_generate_replica(model_dir, args, port, log_path,
+                                           role=role)
+            replicas.append((f"{label}-{i}-{role}",
+                             f"http://127.0.0.1:{port}", proc, log_path))
+        for name, base, proc, log_path in replicas:
+            wait_healthy(proc, base, args.init_timeout, log_path)
+
+        router_log = f"{args.server_log}.{label}.router"
+        router_cmd = [
+            sys.executable, "-m", "intellillm_tpu.router.server",
+            "--host", "127.0.0.1", "--port", str(args.port),
+            "--replica-urls", ",".join(b for _, b, _, _ in replicas),
+            "--replica-roles", ",".join(roles),
+            "--tokenizer", model_dir,
+            "--block-size", str(args.block_size),
+            "--health-interval", "1.0",
+        ]
+        env = dict(os.environ)
+        env.setdefault("HF_HUB_OFFLINE", "1")
+        log = open(router_log, "wb")
+        router_proc = subprocess.Popen(router_cmd, env=env, stdout=log,
+                                       stderr=subprocess.STDOUT)
+        wait_healthy(router_proc, router_base, 120.0, router_log)
+
+        requests = build_requests(args, tokenizer)
+        # Warm every replica's compile ladder through the router. On the
+        # disagg fleet this also seeds the KV registry: the repeat pass
+        # turns registry misses into fleet/local hits.
+        for _ in range(2):
+            asyncio.run(run_benchmark("generate", api_url, None, requests,
+                                      float("inf")))
+
+        m = run_ttft_under_load(args, api_url, None, tokenizer, requests,
+                                protocol="generate")
+        detail = snapshot_health_detail(router_base)
+        router_detail = (detail.get("router") or {}) if detail else {}
+        per_replica_kv = {}
+        kv_bytes = {"export": 0, "import": 0}
+        kv_seconds = {"export": 0.0, "import": 0.0}
+        for name, base, proc, log_path in replicas:
+            rd = snapshot_health_detail(base) or {}
+            kv = rd.get("kv_transfer")
+            per_replica_kv[name] = kv
+            if kv:
+                for d in ("export", "import"):
+                    kv_bytes[d] += (kv.get("bytes_total") or {}).get(d, 0)
+                    kv_seconds[d] += (kv.get("seconds_total")
+                                      or {}).get(d, 0.0)
+        return {
+            "label": label,
+            "roles": list(roles),
+            "probe_ttft_ms": m["probe_ttft_ms"],
+            "background_ttft_p99_ms": m["background_ttft_p99_ms"],
+            "background_tpot_p99_ms": m["background_tpot_p99_ms"],
+            "ttft_under_load": m,
+            "router_kv_transfer": router_detail.get("kv_transfer"),
+            "decisions": router_detail.get("decisions"),
+            "kv_bytes": kv_bytes,
+            "kv_seconds": {d: round(s, 6) for d, s in kv_seconds.items()},
+            "per_replica_kv": per_replica_kv,
+        }
+    finally:
+        if router_proc is not None:
+            router_proc.send_signal(signal.SIGKILL)
+            router_proc.wait()
+        for _, _, proc, _ in replicas:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+
+
+def run_disagg(args, model_dir, tokenizer) -> dict:
+    """The disagg scenario: A/B the SAME ttft-under-load workload on
+    (a) a disaggregated fleet — 1 prefill-role replica + --num-replicas
+    decode-role replicas — and (b) a mixed fleet of equal size
+    (--num-replicas + 1 mixed replicas), both behind the router. The
+    pair of numbers to watch is the probe's TTFT (prefill interference)
+    against the background stream's P99 TPOT (decode purity), next to
+    what the isolation costs: KV-transfer bytes/seconds and the fleet
+    prefix-cache hit counters (docs/routing.md)."""
+    n = args.num_replicas
+    disagg = _run_role_fleet(args, model_dir, tokenizer,
+                             ["prefill"] + ["decode"] * n, "disagg",
+                             args.replica_base_port)
+    mixed = _run_role_fleet(args, model_dir, tokenizer,
+                            ["mixed"] * (n + 1), "mixed",
+                            args.replica_base_port + n + 1)
+    comparison = {
+        "probe_ttft_ms": {"disagg": disagg["probe_ttft_ms"],
+                          "mixed": mixed["probe_ttft_ms"]},
+        "background_ttft_p99_ms": {
+            "disagg": disagg["background_ttft_p99_ms"],
+            "mixed": mixed["background_ttft_p99_ms"]},
+        "background_tpot_p99_ms": {
+            "disagg": disagg["background_tpot_p99_ms"],
+            "mixed": mixed["background_tpot_p99_ms"]},
+        "kv_bytes": disagg["kv_bytes"],
+        "kv_seconds": disagg["kv_seconds"],
+        "cache_hits": (disagg["router_kv_transfer"]
+                       or {}).get("cache_hits"),
+    }
+    summary = {"scenario": "disagg", "size": args.size,
+               "num_decode_replicas": n,
+               "input_len": args.input_len, "output_len": args.output_len,
+               "num_prompts": args.num_prompts,
+               "max_num_seqs": args.max_num_seqs,
+               "fleets": {"disagg": disagg, "mixed": mixed},
+               "comparison": comparison}
+    print(json.dumps({"serve_bench_disagg": comparison}), flush=True)
     print(json.dumps({"serve_bench_summary": summary}), flush=True)
     return summary
 
@@ -628,6 +763,9 @@ def main(args) -> dict:
 
     if args.scenario == "fleet":
         return run_fleet(args, model_dir, tokenizer)
+
+    if args.scenario == "disagg":
+        return run_disagg(args, model_dir, tokenizer)
 
     if args.compare_spec:
         if not args._spec_model_dir:
@@ -766,7 +904,8 @@ def make_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--server-log", type=str,
                    default="/tmp/serve_bench_server.log")
     p.add_argument("--scenario", type=str, default="rate-sweep",
-                   choices=["rate-sweep", "ttft-under-load", "fleet"],
+                   choices=["rate-sweep", "ttft-under-load", "fleet",
+                            "disagg"],
                    help="rate-sweep: Poisson sweep over --rates (the "
                         "default). ttft-under-load: start --num-prompts "
                         "short-prompt requests at once (steady decode "
@@ -777,11 +916,19 @@ def make_arg_parser() -> argparse.ArgumentParser:
                         "boot --num-replicas demo servers behind the "
                         "multi-replica router, sweep --rates through the "
                         "router, and report per-replica SLO splits plus "
-                        "the router's routing counters.")
+                        "the router's routing counters. disagg: A/B the "
+                        "ttft-under-load workload on a disaggregated "
+                        "fleet (1 prefill + --num-replicas decode "
+                        "replicas) vs an equal-size mixed fleet, and "
+                        "report the probe-TTFT/background-TPOT split "
+                        "plus KV-transfer bytes/seconds and fleet "
+                        "prefix-cache hit counts.")
     p.add_argument("--num-replicas", type=int, default=2,
-                   help="fleet scenario: engine replicas to launch")
+                   help="fleet scenario: engine replicas to launch; "
+                        "disagg scenario: decode replicas per fleet")
     p.add_argument("--replica-base-port", type=int, default=8300,
-                   help="fleet scenario: replica i listens on base+i")
+                   help="fleet/disagg scenarios: replica i listens on "
+                        "base+i")
     p.add_argument("--probe-input-len", type=int, default=None,
                    help="probe prompt length for ttft-under-load "
                         "(default: max-model-len - probe-output-len - 1)")
@@ -803,8 +950,10 @@ def make_arg_parser() -> argparse.ArgumentParser:
                         "(length-predictor checkpoint)")
     p.add_argument("--enable-chunked-prefill", action="store_true",
                    default=True,
-                   help="chunked prefill is the server default; flag "
-                        "kept for script compatibility (no-op)")
+                   help="DEPRECATED no-op, kept for script "
+                        "compatibility: chunked prefill is the server "
+                        "default; use --disable-chunked-prefill to turn "
+                        "it off")
     p.add_argument("--disable-chunked-prefill", action="store_true",
                    help="pass --disable-chunked-prefill to the server "
                         "(whole-prompt single-chunk admission)")

@@ -289,6 +289,7 @@ class SchedulerConfig:
         enable_chunked_prefill: bool = False,
         sjf_starvation_s: Optional[float] = None,
         predictor_path: Optional[str] = None,
+        replica_role: str = "mixed",
     ) -> None:
         self.enable_chunked_prefill = enable_chunked_prefill
         if max_num_batched_tokens is not None:
@@ -320,6 +321,13 @@ class SchedulerConfig:
         # reference's CUDA-graph + async-loop host-latency hiding. Beam
         # search and penalty-bearing batches fall back to 1.
         self.num_decode_steps = num_decode_steps
+        # Disaggregated serving role (docs/routing.md "Disaggregated
+        # roles"): "mixed" (default) runs the normal chunked prefill +
+        # decode loop; "prefill" finishes every request at
+        # prefill-complete (first sampled token) and pins the prompt
+        # prefix for KV export; "decode" expects imported prefixes and
+        # runs pure decode steps.
+        self.replica_role = replica_role
         self._verify_args()
 
     def _verify_args(self) -> None:
@@ -343,6 +351,10 @@ class SchedulerConfig:
             raise ValueError("num_decode_steps must be >= 1")
         if self.sjf_starvation_s is not None and self.sjf_starvation_s < 0:
             raise ValueError("sjf_starvation_s must be >= 0 (0 disables)")
+        if self.replica_role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"replica_role must be mixed | prefill | decode, got "
+                f"{self.replica_role!r}")
 
 
 @dataclass

@@ -151,6 +151,19 @@ class BlockSpaceManager:
         for seq in seq_group.get_seqs(status=SequenceStatus.WAITING):
             self.block_tables[seq.seq_id] = block_table.copy()
 
+    # --- prefix import (disaggregated KV transfer) ------------------------
+
+    def can_allocate_prefix_blocks(self, num_blocks: int) -> bool:
+        return (self.device_allocator.get_num_free_blocks() - num_blocks
+                >= self.watermark_blocks)
+
+    def allocate_prefix_blocks(self, num_blocks: int) -> BlockTable:
+        """Allocate device blocks for an imported (already-computed) prefix.
+        Each block carries ref_count=1 — the prefix-pool pin, mirroring
+        what `allocate()` does for the first group that computes a prefix
+        locally — so the blocks survive until the pool drops them."""
+        return [self.device_allocator.allocate() for _ in range(num_blocks)]
+
     # --- decode growth ---------------------------------------------------
 
     def can_append_slots(self, seq_group: SequenceGroup,

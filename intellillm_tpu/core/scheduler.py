@@ -158,6 +158,15 @@ class Scheduler:
         )
         self.prefix_pool = PrefixPool(cache_config.block_size)
 
+        # Disaggregated role (docs/routing.md "Disaggregated roles"): the
+        # behavioral split lives in the engine (prefill handoff) and the
+        # router (KV orchestration); here the role drives admission
+        # telemetry — a decode-role replica running a full local prefill
+        # means the router's KV handoff missed.
+        self.replica_role = getattr(scheduler_config, "replica_role",
+                                    "mixed")
+        self.prefill_recompute_count = 0
+
         self.waiting: Deque[SequenceGroup] = deque()
         self.running: Deque[SequenceGroup] = deque()
         self.swapped: Deque[SequenceGroup] = deque()
@@ -765,6 +774,18 @@ class Scheduler:
                 if prefix is not None and prefix.computed:
                     start = min(prefix.get_length(), num_prompt_tokens - 1)
                     seq.data.update_num_computed_tokens(start)
+                if (self.replica_role == "decode" and start == 0
+                        and num_prompt_tokens
+                        > self.cache_config.block_size):
+                    # Tail chunks (< one block past an imported prefix)
+                    # are expected on decode replicas; a whole multi-block
+                    # prompt with no computed prefix is not.
+                    self.prefill_recompute_count += 1
+                    logger.warning(
+                        "decode-role replica is running a full local "
+                        "prefill (%d tokens, no imported prefix) — the "
+                        "router's KV handoff missed for %s",
+                        num_prompt_tokens, seq_group.request_id)
                 remaining = num_prompt_tokens - start
                 size = min(remaining, slack, self._max_chunk_size)
                 final = size == remaining

@@ -7,11 +7,17 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import warnings
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
 from intellillm_tpu.config import (CacheConfig, LoRAConfig, ModelConfig,
                                    ParallelConfig, SchedulerConfig)
+
+# CLI sentinel for the deprecated --enable-chunked-prefill flag: the
+# store_true default is also True, so a plain bool cannot tell "user
+# typed the flag" (warn) from "default" (silent).
+_CHUNKED_CLI_SENTINEL = "__explicit_cli__"
 
 
 @dataclass
@@ -43,6 +49,7 @@ class EngineArgs:
     num_decode_steps: int = 8
     enable_chunked_prefill: bool = True
     disable_chunked_prefill: bool = False
+    replica_role: str = "mixed"
     # Model
     dtype: str = "auto"
     load_format: str = "auto"
@@ -80,6 +87,14 @@ class EngineArgs:
     def __post_init__(self) -> None:
         if self.tokenizer is None:
             self.tokenizer = self.model
+        if self.enable_chunked_prefill == _CHUNKED_CLI_SENTINEL:
+            warnings.warn(
+                "--enable-chunked-prefill is deprecated and a no-op: "
+                "chunked prefill has been the default since the mixed "
+                "token-budget dispatch landed. Drop the flag, or use "
+                "--disable-chunked-prefill to turn chunking off.",
+                DeprecationWarning, stacklevel=2)
+            self.enable_chunked_prefill = True
 
     @staticmethod
     def add_cli_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
@@ -131,9 +146,20 @@ class EngineArgs:
                             "heuristic; see docs/scheduling.md)")
         parser.add_argument("--num-decode-steps", type=int, default=8,
                             help="decode iterations fused per device call")
-        parser.add_argument("--enable-chunked-prefill", action="store_true",
-                            default=True,
-                            help="(default: on) split long prompts into "
+        parser.add_argument("--replica-role", type=str, default="mixed",
+                            choices=["mixed", "prefill", "decode"],
+                            help="disaggregated-serving role: 'prefill' "
+                            "finishes every request at prefill-complete "
+                            "(first token) and pins the prompt prefix for "
+                            "KV export; 'decode' imports prefilled KV and "
+                            "runs pure decode; 'mixed' (default) does both "
+                            "(see docs/routing.md)")
+        parser.add_argument("--enable-chunked-prefill", action="store_const",
+                            const=_CHUNKED_CLI_SENTINEL, default=True,
+                            help="DEPRECATED no-op (emits a "
+                            "DeprecationWarning): chunked prefill is on by "
+                            "default; use --disable-chunked-prefill to turn "
+                            "it off. (default: on) split long prompts into "
                             "token-budget-sized chunks and piggyback them "
                             "onto decode batches (mixed steps); running "
                             "decodes are admitted first, so a long prompt "
@@ -253,6 +279,7 @@ class EngineArgs:
                                     and not self.disable_chunked_prefill),
             sjf_starvation_s=self.sjf_starvation_s,
             predictor_path=self.predictor_path,
+            replica_role=self.replica_role,
         )
         lora_config = None
         if self.enable_lora:

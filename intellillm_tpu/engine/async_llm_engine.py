@@ -226,7 +226,18 @@ class AsyncLLMEngine:
         loop = asyncio.get_event_loop()
         step_fn = (self.engine.step_pipelined
                    if self.engine.pipeline_enabled else self.engine.step)
-        request_outputs = await loop.run_in_executor(None, step_fn)
+
+        def locked_step():
+            # Mutually exclusive with export_kv/import_kv (below), which
+            # also run on executor threads and re-bind the device cache.
+            # getattr: engine doubles in tests don't carry the lock.
+            lock = getattr(self.engine, "_kv_transfer_lock", None)
+            if lock is None:
+                return step_fn()
+            with lock:
+                return step_fn()
+
+        request_outputs = await loop.run_in_executor(None, locked_step)
 
         for request_output in request_outputs:
             self._request_tracker.process_request_output(
@@ -306,6 +317,20 @@ class AsyncLLMEngine:
         if not self.is_running:
             raise AsyncEngineDeadError("Background loop is not running.")
         return self._abort(request_id)
+
+    # --- disaggregated KV handoff (docs/routing.md) ----------------------
+
+    async def export_kv(self, prompt: str) -> bytes:
+        """Export the KV prefix pinned for `prompt` (prefill role)."""
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(
+            None, self.engine.export_kv_for_prompt, prompt)
+
+    async def import_kv(self, payload: bytes) -> dict:
+        """Install an exported KV payload as a computed prefix."""
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(None, self.engine.import_kv,
+                                          payload)
 
     def _abort(self, request_id: str) -> None:
         self._request_tracker.abort_request(request_id,

@@ -12,6 +12,7 @@ block counts → allocate pool → warm up).
 """
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple, Union
@@ -167,6 +168,12 @@ class LLMEngine:
         # log_stats off.
         self._tracer = get_step_tracer()
         self._flight = get_flight_recorder()
+        # Serializes KV export/import against device stepping: the async
+        # engine runs step() on an executor thread while /kv/* handlers
+        # call export_kv/import_kv from the event loop (also via executor)
+        # — both re-bind cache_engine.device_cache entries, so unguarded
+        # concurrency loses one side's writes.
+        self._kv_transfer_lock = threading.Lock()
         self._slo = get_slo_tracker()
         self.last_step_phases: dict = {}
         self.last_step_time: float = 0.0
@@ -249,6 +256,114 @@ class LLMEngine:
             "cpu": round(1.0 - free_cpu / num_total_cpu, 4)
             if num_total_cpu > 0 else 0.0,
         }
+
+    # --- disaggregated KV transfer (docs/routing.md "Disaggregated
+    # roles"): a prefill replica exports the paged KV blocks behind a
+    # computed prompt prefix; a decode replica imports them into its own
+    # pool as a pre-computed prefix, so requests carrying the matching
+    # prefix_pos decode with zero prefill recompute. ---------------------
+
+    def export_kv(self, token_ids: List[int], lora_int_id: int = 0) -> bytes:
+        """Serialize the computed KV prefix for `token_ids` (truncated to
+        a block multiple) into a content-addressed wire payload."""
+        from intellillm_tpu.affinity import affinity_key, truncate_to_block
+        from intellillm_tpu.obs.kv_transfer import get_kv_transfer_stats
+        from intellillm_tpu.worker.kv_transfer import (make_handle,
+                                                       serialize_handle)
+        ids = truncate_to_block(token_ids, self.cache_config.block_size)
+        if not ids:
+            raise ValueError(
+                "prompt is shorter than one KV block; nothing to export")
+        key = affinity_key(ids, lora_int_id)
+        prefix = self.scheduler.prefix_pool.prefixes.get(key)
+        if prefix is None or not prefix.computed or not prefix.allocated:
+            raise KeyError(
+                f"prefix {key:#018x} is not computed on this replica")
+        t0 = time.monotonic()
+        ce = self.worker.cache_engine
+        block_numbers = prefix.get_block_numbers()
+        with self._kv_transfer_lock:
+            layers = ce.export_blocks(block_numbers)
+        handle = make_handle(list(ids), lora_int_id,
+                             block_size=ce.block_size,
+                             num_layers=ce.num_layers,
+                             num_kv_heads=ce.num_kv_heads,
+                             head_size=ce.head_size,
+                             dtype=ce.dtype.name,
+                             num_blocks=len(block_numbers))
+        payload = serialize_handle(handle, layers)
+        get_kv_transfer_stats().record("export", len(block_numbers),
+                                       len(payload), time.monotonic() - t0)
+        self._flight.record(f"kv:{key:#018x}", "kv_export",
+                            detail=f"blocks={len(block_numbers)} "
+                            f"bytes={len(payload)}")
+        return payload
+
+    def export_kv_for_prompt(self, prompt: str, lora_int_id: int = 0) -> bytes:
+        """Export the KV prefix a prefill-role add_request() pinned for
+        `prompt`. Uses the same ``((len - 1) // block_size) * block_size``
+        alignment as the auto-pin: for prompts that are an exact block
+        multiple, the last block holds the boundary token's KV from the
+        handoff sample and is NOT part of the pinned prefix."""
+        ids = self.tokenizer.encode(prompt, "kv-export", None)
+        bs = self.cache_config.block_size
+        aligned = ((len(ids) - 1) // bs) * bs
+        if aligned <= 0:
+            raise ValueError(
+                "prompt is shorter than one KV block; nothing to export")
+        return self.export_kv(ids[:aligned], lora_int_id)
+
+    def import_kv(self, payload: bytes) -> dict:
+        """Install an exported KV payload as a computed prefix in this
+        replica's pool. Idempotent: re-importing a present prefix is a
+        no-op (reported as imported=False)."""
+        from intellillm_tpu.obs.kv_transfer import get_kv_transfer_stats
+        from intellillm_tpu.worker.kv_transfer import deserialize_handle
+        t0 = time.monotonic()
+        handle, layers = deserialize_handle(payload)
+        ce = self.worker.cache_engine
+        mine = dict(block_size=ce.block_size, num_layers=ce.num_layers,
+                    num_kv_heads=ce.num_kv_heads, head_size=ce.head_size,
+                    dtype=ce.dtype.name)
+        theirs = dict(block_size=handle.block_size,
+                      num_layers=handle.num_layers,
+                      num_kv_heads=handle.num_kv_heads,
+                      head_size=handle.head_size, dtype=handle.dtype)
+        if mine != theirs:
+            raise ValueError(
+                f"KV payload geometry {theirs} does not match this "
+                f"replica's cache {mine}")
+        prefix = self.scheduler.prefix_pool.add_or_get_prefix(
+            handle.token_ids, handle.lora_int_id)
+        assert prefix is not None and prefix.hash == handle.key
+        if prefix.computed or prefix.allocated:
+            # Already present (computed) or a local group is mid-prefill
+            # on it (allocated): scattering imported blocks on top would
+            # race the local prefill — skip, the KV is/will be there.
+            return {"key": handle.key, "imported": False,
+                    "num_blocks": prefix.get_num_blocks(),
+                    "prefix_pos": len(handle.token_ids)}
+        bm = self.scheduler.block_manager
+        if not bm.can_allocate_prefix_blocks(handle.num_blocks):
+            raise RuntimeError(
+                f"cannot import prefix {handle.key:#018x}: "
+                f"{handle.num_blocks} blocks would breach the allocation "
+                "watermark")
+        blocks = bm.allocate_prefix_blocks(handle.num_blocks)
+        with self._kv_transfer_lock:
+            ce.import_blocks(layers, [b.block_number for b in blocks])
+        prefix.set_block_table(blocks)
+        prefix.computed = True
+        get_kv_transfer_stats().record("import", handle.num_blocks,
+                                       len(payload), time.monotonic() - t0)
+        self._flight.record(f"kv:{handle.key:#018x}", "kv_import",
+                            detail=f"blocks={handle.num_blocks} "
+                            f"bytes={len(payload)}")
+        # prefix_pos is what a /generate request must carry to decode on
+        # top of this prefix (replica token space, block-aligned).
+        return {"key": handle.key, "imported": True,
+                "num_blocks": handle.num_blocks,
+                "prefix_pos": len(handle.token_ids)}
 
     # --- init ------------------------------------------------------------
 
@@ -354,6 +469,20 @@ class LLMEngine:
                                                          lora_request)
 
         block_size = self.cache_config.block_size
+        if self.scheduler_config.replica_role == "prefill":
+            # Prefill role: pin the block-aligned prompt prefix so its
+            # blocks survive past request completion for export. The
+            # router ends the prefill leg at the first token by sending
+            # max_tokens=1 — not enforced here, because on decode-replica
+            # failover the router replays the FULL request on a prefill-
+            # capable replica and needs the complete output.
+            if (prefix_pos is None
+                    and sampling_params.prompt_logprobs is None
+                    and self.model_config.get_sliding_window() is None):
+                aligned = ((len(prompt_token_ids) - 1) // block_size
+                           ) * block_size
+                if aligned > 0:
+                    prefix_pos = aligned
         seq_id = next(self.seq_counter)
         seq = Sequence(seq_id, prompt, prompt_token_ids, block_size,
                        lora_request)

@@ -97,10 +97,56 @@ async def generate(request: web.Request) -> web.StreamResponse:
                                  headers={"X-Request-Id": request_id})
 
 
+async def kv_export(request: web.Request) -> web.Response:
+    """Export the KV prefix prefilled for a prompt (disaggregated
+    serving; docs/routing.md "Disaggregated roles").
+
+    Body: {"prompt": str} → opaque octet-stream payload
+    (worker/kv_transfer.py wire format)."""
+    body = await request.json()
+    prompt = body.get("prompt")
+    if not isinstance(prompt, str):
+        return web.json_response({"error": "missing prompt"}, status=400)
+    try:
+        payload = await engine.export_kv(prompt)
+    except KeyError as e:
+        # Prefix not computed on this replica (yet): the router treats
+        # this as a soft miss and falls back to local prefill.
+        return web.json_response({"error": str(e)}, status=404)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.Response(body=payload,
+                        content_type="application/octet-stream")
+
+
+async def kv_import(request: web.Request) -> web.Response:
+    """Install an exported KV payload as a computed prefix.
+
+    Body: raw octet-stream payload → {"key", "imported", "num_blocks",
+    "prefix_pos"}."""
+    payload = await request.read()
+    try:
+        result = await engine.import_kv(payload)
+    except ValueError as e:
+        # Bad magic / geometry mismatch / key-token mismatch.
+        return web.json_response({"error": str(e)}, status=400)
+    except RuntimeError as e:
+        # Would breach the allocation watermark — back-pressure, not a
+        # client error.
+        return web.json_response({"error": str(e)}, status=409)
+    # JSON cannot carry the 64-bit key losslessly in all clients;
+    # stringify it (the router treats it as opaque).
+    result = dict(result)
+    result["key"] = f"{result['key']:#018x}"
+    return web.json_response(result)
+
+
 def build_app(enable_profiling: bool = False) -> web.Application:
-    app = web.Application()
+    app = web.Application(client_max_size=1024**3)
     app.router.add_get("/health", health)
     app.router.add_post("/generate", generate)
+    app.router.add_post("/kv/export", kv_export)
+    app.router.add_post("/kv/import", kv_import)
     # This server has no auth middleware, so the profiler admin routes
     # (which degrade serving and write traces to a caller-chosen dir)
     # stay off unless explicitly opted in.

@@ -235,6 +235,12 @@ def add_debug_routes(app: web.Application,
             body["status"] = "initializing"
             return web.json_response(body, status=503)
         scheduler = engine.scheduler
+        # Disaggregated serving surface: the router's health poller
+        # reads the role, serve_bench reads the transfer summary.
+        body["role"] = getattr(getattr(engine, "scheduler_config", None),
+                               "replica_role", "mixed")
+        from intellillm_tpu.obs.kv_transfer import get_kv_transfer_stats
+        body["kv_transfer"] = get_kv_transfer_stats().summary()
         body["queue_depths"] = {
             "waiting": len(scheduler.waiting),
             "running": len(scheduler.running),

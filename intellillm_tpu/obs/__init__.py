@@ -16,6 +16,8 @@ from intellillm_tpu.obs.efficiency import (EfficiencyTracker,
 from intellillm_tpu.obs.flight_recorder import (EVENTS, FlightRecorder,
                                                 get_flight_recorder)
 from intellillm_tpu.obs.history import MetricsHistory, get_metrics_history
+from intellillm_tpu.obs.kv_transfer import (KVTransferStats,
+                                            get_kv_transfer_stats)
 from intellillm_tpu.obs.slo import (SLOTracker, derive_request_metrics,
                                     get_slo_tracker)
 from intellillm_tpu.obs.trace_export import (TraceSink, flush_black_box,
@@ -36,6 +38,7 @@ __all__ = [
     "EfficiencyTracker",
     "EngineWatchdog",
     "FlightRecorder",
+    "KVTransferStats",
     "MetricsHistory",
     "PHASES",
     "SLOTracker",
@@ -50,6 +53,7 @@ __all__ = [
     "get_device_telemetry",
     "get_efficiency_tracker",
     "get_flight_recorder",
+    "get_kv_transfer_stats",
     "get_metrics_history",
     "get_slo_tracker",
     "get_step_tracer",

@@ -24,6 +24,10 @@ states, Google SRE Workbook style for the burn rate):
                            window (router process only — the series is
                            absent on replicas, so the rule stays
                            inactive there)
+    kv_transfer_stall warn a disaggregated KV export/import has been in
+                           flight longer than `INTELLILLM_KV_STALL_S`
+                           (wedged handoff; inactive until the first
+                           transfer)
 
 State machine per rule: inactive -> pending (condition held, waiting
 out `for_s`) -> firing -> resolved (condition cleared; kept visible for
@@ -290,6 +294,34 @@ class CompileStormRule(AlertRule):
             f"(threshold > {self.max_compiles:g})")
 
 
+class KVTransferStallRule(AlertRule):
+    """Disaggregated serving: a KV export/import has been in flight
+    longer than `INTELLILLM_KV_STALL_S` (default 30 s). Reads the
+    process-global transfer stats directly (like WatchdogStallRule) —
+    an in-flight transfer produces no history samples to window over."""
+
+    def __init__(self, stall_after_s: Optional[float] = None) -> None:
+        self.stall_after_s = (stall_after_s if stall_after_s is not None
+                              else _env_f("INTELLILLM_KV_STALL_S", 30.0))
+        super().__init__(
+            "kv_transfer_stall", severity="warn",
+            description="a disaggregated KV transfer has been in flight "
+            f"longer than {self.stall_after_s:g}s (wedged handoff)")
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        from intellillm_tpu.obs.kv_transfer import get_kv_transfer_stats
+        stats = get_kv_transfer_stats()
+        age = stats.oldest_inflight_age_s()
+        if age is None:
+            if stats.transfers_total == 0:
+                return None, None, "no KV transfers yet"
+            return False, 0.0, "no transfer in flight"
+        return age > self.stall_after_s, round(age, 3), (
+            f"oldest in-flight transfer is {age:.1f}s old "
+            f"(threshold {self.stall_after_s:g}s)")
+
+
 class RouterFailoverRule(AlertRule):
 
     def __init__(self, window_s: Optional[float] = None) -> None:
@@ -313,7 +345,8 @@ class RouterFailoverRule(AlertRule):
 
 def built_in_rules() -> List[AlertRule]:
     return [SLOBurnRateRule(), WatchdogStallRule(), HBMHeadroomRule(),
-            MFUCollapseRule(), CompileStormRule(), RouterFailoverRule()]
+            MFUCollapseRule(), CompileStormRule(), RouterFailoverRule(),
+            KVTransferStallRule()]
 
 
 class _RuleState:

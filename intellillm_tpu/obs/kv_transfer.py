@@ -1,0 +1,188 @@
+"""Fleet KV-transfer telemetry (disaggregated prefill/decode serving).
+
+Tracks the content-addressed KV handoff path between prefill-role and
+decode-role replicas (docs/routing.md "Disaggregated roles"): payload
+bytes and paged blocks moved in each direction, wall seconds spent
+serializing/deserializing + shipping, and the fleet prefix-cache
+outcome per routed request. Exported (when `prometheus_client` is
+installed — python-side totals keep the test surface working without
+it):
+
+    intellillm_kv_transfer_bytes_total{direction}    counter
+    intellillm_kv_transfer_blocks_total{direction}   counter
+    intellillm_kv_transfer_seconds_total{direction}  counter
+    intellillm_kv_transfer_cache_hits_total{kind}    counter
+    intellillm_kv_transfer_inflight                  gauge
+
+`direction` is `export` (prefill replica → wire) or `import` (wire →
+decode replica pool). `kind` records what the router's fleet KV
+registry decided: `miss` (prefix never prefilled — a prefill-role pass
+runs), `fleet_hit` (prefilled once already; the payload is reused and
+only shipped to a new decode replica), `local_hit` (the chosen decode
+replica already imported this prefix — no transfer at all).
+
+Being `intellillm_*` gauges/counters the family is auto-sampled by the
+in-process metrics history; the `kv_transfer_stall` alert rule
+(obs/alerts.py) reads this module's in-flight table directly, firing
+when the oldest open transfer exceeds `INTELLILLM_KV_STALL_S`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter, Gauge
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+DIRECTIONS = ("export", "import")
+CACHE_KINDS = ("miss", "fleet_hit", "local_hit")
+
+
+class _KVTransferMetrics:
+    """Prometheus collectors (process-global, built once — same
+    singleton pattern as router/metrics.py)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.counter_bytes = Counter(
+            "intellillm_kv_transfer_bytes_total",
+            "KV handoff payload bytes (direction = export | import).",
+            ["direction"])
+        self.counter_blocks = Counter(
+            "intellillm_kv_transfer_blocks_total",
+            "Paged KV blocks moved (direction = export | import).",
+            ["direction"])
+        self.counter_seconds = Counter(
+            "intellillm_kv_transfer_seconds_total",
+            "Wall seconds spent on KV handoffs "
+            "(direction = export | import).", ["direction"])
+        self.counter_cache = Counter(
+            "intellillm_kv_transfer_cache_hits_total",
+            "Fleet prefix-cache outcomes per routed request "
+            "(kind = miss | fleet_hit | local_hit).", ["kind"])
+        self.gauge_inflight = Gauge(
+            "intellillm_kv_transfer_inflight",
+            "KV transfers currently in flight (router view).")
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+class KVTransferStats:
+    """Python-side rolling totals + the in-flight transfer table the
+    stall alert rule reads. Thread-safe; works without prometheus."""
+
+    def __init__(self, now_fn=time.monotonic) -> None:
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self.bytes_total: Dict[str, int] = {d: 0 for d in DIRECTIONS}
+        self.blocks_total: Dict[str, int] = {d: 0 for d in DIRECTIONS}
+        self.seconds_total: Dict[str, float] = {d: 0.0 for d in DIRECTIONS}
+        self.cache_hits: Dict[str, int] = {k: 0 for k in CACHE_KINDS}
+        self.transfers_total = 0
+        self._inflight: Dict[int, float] = {}   # token -> start ts
+        self._next_token = 0
+        self._metrics = _KVTransferMetrics() if _PROMETHEUS else None
+
+    # --- recording --------------------------------------------------------
+
+    def record(self, direction: str, blocks: int, num_bytes: int,
+               seconds: float) -> None:
+        assert direction in DIRECTIONS, direction
+        with self._lock:
+            self.bytes_total[direction] += int(num_bytes)
+            self.blocks_total[direction] += int(blocks)
+            self.seconds_total[direction] += float(seconds)
+        if self._metrics is not None:
+            self._metrics.counter_bytes.labels(direction).inc(num_bytes)
+            self._metrics.counter_blocks.labels(direction).inc(blocks)
+            self._metrics.counter_seconds.labels(direction).inc(seconds)
+
+    def record_cache(self, kind: str) -> None:
+        assert kind in CACHE_KINDS, kind
+        with self._lock:
+            self.cache_hits[kind] += 1
+        if self._metrics is not None:
+            self._metrics.counter_cache.labels(kind).inc()
+
+    def transfer_started(self) -> int:
+        """Open an in-flight transfer; returns a token for _finished."""
+        with self._lock:
+            self._next_token += 1
+            token = self._next_token
+            self._inflight[token] = self._now()
+            inflight = len(self._inflight)
+        if self._metrics is not None:
+            self._metrics.gauge_inflight.set(inflight)
+        return token
+
+    def transfer_finished(self, token: int) -> None:
+        with self._lock:
+            self._inflight.pop(token, None)
+            self.transfers_total += 1
+            inflight = len(self._inflight)
+        if self._metrics is not None:
+            self._metrics.gauge_inflight.set(inflight)
+
+    # --- read side --------------------------------------------------------
+
+    def oldest_inflight_age_s(self) -> Optional[float]:
+        with self._lock:
+            if not self._inflight:
+                return None
+            return self._now() - min(self._inflight.values())
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "bytes_total": dict(self.bytes_total),
+                "blocks_total": dict(self.blocks_total),
+                "seconds_total": {d: round(s, 6)
+                                  for d, s in self.seconds_total.items()},
+                "cache_hits": dict(self.cache_hits),
+                "transfers_total": self.transfers_total,
+                "inflight": len(self._inflight),
+            }
+
+
+_STATS: Optional[KVTransferStats] = None
+_STATS_LOCK = threading.Lock()
+
+
+def get_kv_transfer_stats() -> KVTransferStats:
+    global _STATS
+    if _STATS is None:
+        with _STATS_LOCK:
+            if _STATS is None:
+                _STATS = KVTransferStats()
+    return _STATS
+
+
+def reset_for_testing() -> None:
+    global _STATS
+    _KVTransferMetrics.reset_for_testing()
+    _STATS = None

@@ -29,7 +29,8 @@ try:
 except ImportError:  # pragma: no cover
     _PROMETHEUS = False
 
-DECISIONS = ("affinity_hit", "affinity_new", "load_balanced", "failover")
+DECISIONS = ("affinity_hit", "affinity_new", "load_balanced", "failover",
+             "disagg_prefill")
 
 
 class _RouterMetrics:

@@ -41,8 +41,12 @@ class ReplicaFailure(Exception):
 class Replica:
     """Base replica: identity, health state, and load accounting."""
 
-    def __init__(self, replica_id: str) -> None:
+    def __init__(self, replica_id: str, role: str = "mixed") -> None:
         self.replica_id = replica_id
+        # Disaggregated serving role (docs/routing.md "Disaggregated
+        # roles"): "mixed" serves everything; "prefill" runs chunked
+        # prefill and exports KV; "decode" imports KV and decodes.
+        self.role = role
         self.healthy = False
         self.last_health: Optional[dict] = None
         self.last_health_ts: Optional[float] = None
@@ -77,6 +81,17 @@ class Replica:
         """(status_code, body) of the replica's /health/detail."""
         raise NotImplementedError
 
+    async def export_kv(self, prompt: str) -> bytes:
+        """Export the KV prefix this replica prefilled for `prompt`
+        (content-addressed wire payload, worker/kv_transfer.py)."""
+        raise NotImplementedError
+
+    async def import_kv(self, payload: bytes) -> dict:
+        """Install an exported KV payload; returns {key, imported,
+        num_blocks, prefix_pos} (prefix_pos in the replica's own token
+        space)."""
+        raise NotImplementedError
+
     async def fetch_trace(self, request_id: str) -> Optional[list]:
         """This replica's flight-recorder events for `request_id`, or
         None when unknown/unreachable — the stitching side of
@@ -93,8 +108,9 @@ class InProcessReplica(Replica):
     fleets). `kill()` simulates a replica crash: in-flight streams raise
     `ReplicaFailure` at the next chunk and the replica goes unhealthy."""
 
-    def __init__(self, replica_id: str, engine) -> None:
-        super().__init__(replica_id)
+    def __init__(self, replica_id: str, engine,
+                 role: str = "mixed") -> None:
+        super().__init__(replica_id, role=role)
         self.engine = engine
         self._killed = False
 
@@ -151,6 +167,8 @@ class InProcessReplica(Replica):
         scheduler = llm_engine.scheduler
         body = {
             "status": "ok",
+            "role": getattr(llm_engine.scheduler_config, "replica_role",
+                            "mixed"),
             "queue_depths": {
                 "waiting": len(scheduler.waiting),
                 "running": len(scheduler.running),
@@ -174,6 +192,24 @@ class InProcessReplica(Replica):
         from intellillm_tpu.obs import get_flight_recorder
         return get_flight_recorder().get_trace(request_id)
 
+    async def export_kv(self, prompt: str) -> bytes:
+        if self._killed:
+            raise ReplicaFailure(f"replica {self.replica_id} is down")
+        try:
+            return await self.engine.export_kv(prompt)
+        except (KeyError, ValueError, RuntimeError) as e:
+            raise ReplicaFailure(
+                f"replica {self.replica_id}: kv export failed: {e}") from e
+
+    async def import_kv(self, payload: bytes) -> dict:
+        if self._killed:
+            raise ReplicaFailure(f"replica {self.replica_id} is down")
+        try:
+            return await self.engine.import_kv(payload)
+        except (ValueError, RuntimeError) as e:
+            raise ReplicaFailure(
+                f"replica {self.replica_id}: kv import failed: {e}") from e
+
 
 class HTTPReplica(Replica):
     """Fronts an engine server over HTTP (demo api_server protocol).
@@ -184,8 +220,9 @@ class HTTPReplica(Replica):
 
     def __init__(self, replica_id: str, base_url: str,
                  proc: Optional[subprocess.Popen] = None,
-                 request_timeout_s: float = 600.0) -> None:
-        super().__init__(replica_id)
+                 request_timeout_s: float = 600.0,
+                 role: str = "mixed") -> None:
+        super().__init__(replica_id, role=role)
         self.base_url = base_url.rstrip("/")
         self.proc = proc
         self.request_timeout_s = request_timeout_s
@@ -257,6 +294,41 @@ class HTTPReplica(Replica):
             # attempt with events=None instead of failing the fetch.
             return None
 
+    async def export_kv(self, prompt: str) -> bytes:
+        import aiohttp
+        try:
+            async with self._get_session().post(
+                    f"{self.base_url}/kv/export",
+                    json={"prompt": prompt}) as resp:
+                if resp.status != 200:
+                    raise ReplicaFailure(
+                        f"replica {self.replica_id}: /kv/export -> "
+                        f"{resp.status}")
+                return await resp.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                ConnectionError) as e:
+            raise ReplicaFailure(
+                f"replica {self.replica_id}: {type(e).__name__}: {e}"
+            ) from e
+
+    async def import_kv(self, payload: bytes) -> dict:
+        import aiohttp
+        try:
+            async with self._get_session().post(
+                    f"{self.base_url}/kv/import", data=payload,
+                    headers={"Content-Type": "application/octet-stream"}
+            ) as resp:
+                if resp.status != 200:
+                    raise ReplicaFailure(
+                        f"replica {self.replica_id}: /kv/import -> "
+                        f"{resp.status}")
+                return await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                ConnectionError, json.JSONDecodeError) as e:
+            raise ReplicaFailure(
+                f"replica {self.replica_id}: {type(e).__name__}: {e}"
+            ) from e
+
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
@@ -273,16 +345,20 @@ class HTTPReplica(Replica):
 
 def launch_http_replica(replica_id: str, port: int,
                         engine_argv: List[str],
-                        host: str = "127.0.0.1") -> HTTPReplica:
+                        host: str = "127.0.0.1",
+                        role: str = "mixed") -> HTTPReplica:
     """Launch a demo api_server subprocess as a replica (inherits this
     process's environment, so INTELLILLM_JAX_PLATFORM etc. apply)."""
     cmd = [
         sys.executable, "-m", "intellillm_tpu.entrypoints.api_server",
         "--host", host, "--port", str(port),
     ] + list(engine_argv)
+    if role != "mixed" and "--replica-role" not in engine_argv:
+        cmd += ["--replica-role", role]
     logger.info("launching replica %s: %s", replica_id, " ".join(cmd))
     proc = subprocess.Popen(cmd)
-    return HTTPReplica(replica_id, f"http://{host}:{port}", proc=proc)
+    return HTTPReplica(replica_id, f"http://{host}:{port}", proc=proc,
+                       role=role)
 
 
 class ReplicaManager:
@@ -310,18 +386,26 @@ class ReplicaManager:
     def get(self, replica_id: str) -> Replica:
         return self.replicas[replica_id]
 
-    def healthy_loads(self, exclude: Optional[set] = None
-                      ) -> Dict[str, float]:
+    def healthy_loads(self, exclude: Optional[set] = None,
+                      role: Optional[str] = None) -> Dict[str, float]:
         """Routing candidates: healthy replicas (minus `exclude`) →
         outstanding predicted decode tokens. Unhealthy replicas are
         simply absent — in-flight work keeps draining, new work skips
-        them (drain-on-unhealthy)."""
+        them (drain-on-unhealthy). `role` narrows candidates to one
+        disaggregated role; None means any role."""
         exclude = exclude or set()
         return {
             rid: r.predicted_load
             for rid, r in self.replicas.items()
             if r.healthy and rid not in exclude
+            and (role is None or r.role == role)
         }
+
+    def disagg_active(self) -> bool:
+        """Whether the fleet can run a disaggregated handoff right now:
+        at least one healthy prefill AND one healthy decode replica."""
+        roles = {r.role for r in self.replicas.values() if r.healthy}
+        return "prefill" in roles and "decode" in roles
 
     # --- load accounting --------------------------------------------------
 
@@ -365,6 +449,12 @@ class ReplicaManager:
                 continue
             r.last_health = body
             r.last_health_ts = time.monotonic()
+            # Replicas self-report their role on /health/detail; trust it
+            # over static config so a fleet assembled from bare URLs
+            # still disaggregates correctly.
+            reported_role = body.get("role")
+            if reported_role in ("mixed", "prefill", "decode"):
+                r.role = reported_role
             # A 503 "initializing" body is a live-but-not-ready replica;
             # "stalled" (watchdog) is unhealthy like a probe failure.
             # "degraded" (page-severity alert firing) stays HEALTHY:
@@ -431,6 +521,7 @@ class ReplicaManager:
         for rid, r in self.replicas.items():
             out[rid] = {
                 "healthy": r.healthy,
+                "role": r.role,
                 "predicted_load_tokens": r.predicted_load,
                 "inflight": r.inflight,
                 "consecutive_failures": r.consecutive_failures,

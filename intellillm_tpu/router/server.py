@@ -44,8 +44,8 @@ import argparse
 import asyncio
 import json
 import time
-from collections import deque
-from typing import AsyncIterator, Dict, List, Optional
+from collections import OrderedDict, deque
+from typing import AsyncIterator, Dict, List, Optional, Tuple
 
 from aiohttp import web
 
@@ -69,6 +69,57 @@ logger = init_logger(__name__)
 
 TIMEOUT_KEEP_ALIVE = 5
 
+REPLICA_ROLES = ("mixed", "prefill", "decode")
+
+
+class _KVStore:
+    """Router-side fleet KV registry for disaggregated serving: maps the
+    router's content-addressed affinity key to the exported payload plus
+    which replicas already hold the prefix. Small LRU — entries are
+    whole KV slabs for shared prompt prefixes (system prompts), not a
+    general response cache."""
+
+    def __init__(self, max_entries: int = 32) -> None:
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[int, dict]" = OrderedDict()
+        self.evictions = 0
+
+    def get(self, key: int) -> Optional[dict]:
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+        return entry
+
+    def put(self, key: int, payload: bytes, source: str) -> dict:
+        entry = {
+            "payload": payload,
+            "source": source,
+            # Replica-token-space prefix position, learned from the
+            # first successful import (the router may be tokenizer-less
+            # and cannot compute it itself).
+            "prefix_pos": None,
+            "imported": {source},
+        }
+        self._entries[key] = entry
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+        return entry
+
+    def drop_replica(self, replica_id: str) -> None:
+        """A dead replica's imported prefixes died with it."""
+        for entry in self._entries.values():
+            entry["imported"].discard(replica_id)
+
+    def summary(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "payload_bytes": sum(len(e["payload"])
+                                 for e in self._entries.values()),
+            "evictions": self.evictions,
+        }
+
 
 class Router:
     """Ties the policy, the replica fleet, and the length predictor into
@@ -89,6 +140,10 @@ class Router:
         # the same trace id don't collide with the router's spans.
         self.recorder = FlightRecorder(hop="router")
         self.tracebook = TraceBook()
+        # Disaggregated prefill/decode: the fleet KV registry (engages
+        # only while the fleet has both roles healthy — see
+        # ReplicaManager.disagg_active).
+        self.kv_store = _KVStore()
         # Rolling router-side hop timings for the /health/detail trace
         # summary (seconds; small fixed window).
         self._hop_window: deque = deque(maxlen=256)
@@ -146,13 +201,37 @@ class Router:
         last_error: Optional[Exception] = None
         first_chunk_seen = False
         for attempt in range(attempts):
-            loads = self.manager.healthy_loads(exclude=excluded)
+            # Disaggregated path (first attempt only, prompt longer than
+            # one block): route the decode leg among decode-role
+            # replicas, after a prefill-role replica prefilled the
+            # prefix and its KV moved over. Failover attempts replay the
+            # FULL request on any healthy replica regardless of role —
+            # prefill-role engines do not cap generation, so a replay
+            # that lands on one still produces complete output.
+            disagg = (attempt == 0 and "prefix_pos" not in payload
+                      and len(token_ids) > self.config.block_size
+                      and self.manager.disagg_active())
+            loads = self.manager.healthy_loads(
+                exclude=excluded, role="decode" if disagg else None)
+            if disagg and not loads:
+                disagg = False
+                loads = self.manager.healthy_loads(exclude=excluded)
             try:
                 replica_id, decision = self.policy.choose(key, loads)
             except NoReplicaAvailable:
                 self.recorder.record(trace_id, "aborted",
                                      detail="no_replica_available")
                 raise
+            prefix_pos: Optional[int] = None
+            if disagg:
+                # The handoff (prefill leg + KV transfer) runs BEFORE
+                # this attempt's route_decision span so decision→routed
+                # pairs zip in order during hop attribution; a soft
+                # failure returns None and the decode replica recomputes
+                # the prefill locally (correctness unaffected).
+                prefix_pos = await self._kv_handoff(
+                    trace_id, key, prompt, replica_id, excluded,
+                    predicted_len)
             if attempt > 0:
                 decision = "failover"
             self._count_decision(decision)
@@ -174,9 +253,12 @@ class Router:
                 trace_id, "routed",
                 detail=f"attempt={attempt} replica={replica_id} "
                        f"request_id={request_id}")
+            out_payload = payload
+            if prefix_pos is not None:
+                out_payload = {**payload, "prefix_pos": prefix_pos}
             try:
                 async for chunk in replica.generate(
-                        payload, predicted_len=scaled_len,
+                        out_payload, predicted_len=scaled_len,
                         request_id=request_id):
                     if not first_chunk_seen:
                         first_chunk_seen = True
@@ -198,8 +280,10 @@ class Router:
                 self.manager.on_complete(replica_id, scaled_len)
                 self.manager.mark_failed(replica_id)
                 # Its cached prefixes are gone with it: let its keys
-                # re-seed instead of pinning to a corpse.
+                # re-seed instead of pinning to a corpse. Same for its
+                # imported KV — the registry forgets it held anything.
                 self.policy.affinity.drop_replica(replica_id)
+                self.kv_store.drop_replica(replica_id)
                 m = get_router_metrics()
                 if m is not None:
                     m.counter_failovers.labels(replica=replica_id).inc()
@@ -250,6 +334,137 @@ class Router:
             "hops": hops,
         }
         get_trace_sink().maybe_export(trace_id, events, rec, hop="router")
+
+    # --- disaggregated KV handoff ----------------------------------------
+
+    async def _kv_handoff(self, trace_id: str, key: int, prompt: str,
+                          decode_rid: str, excluded: set,
+                          predicted_len: int) -> Optional[int]:
+        """Ensure `decode_rid` holds the KV prefix for `prompt` before
+        the decode leg routes to it. Registry outcomes:
+
+        - local_hit: the decode replica already imported this prefix —
+          no transfer, no prefill leg.
+        - fleet_hit: another replica prefilled it earlier — import the
+          registered payload (one kv_transfer span).
+        - miss: run the prefill leg (max_tokens=1) on the least-loaded
+          prefill-role replica, export (one kv_transfer span), register,
+          then import (a second span).
+
+        Returns the replica-token-space prefix_pos for the decode
+        request, or None when the handoff soft-failed — the decode
+        replica then recomputes the prefill locally, which its scheduler
+        warns about and counts (prefill_recompute_count)."""
+        from intellillm_tpu.obs.kv_transfer import get_kv_transfer_stats
+        stats = get_kv_transfer_stats()
+        entry = self.kv_store.get(key)
+        if (entry is not None and decode_rid in entry["imported"]
+                and entry["prefix_pos"] is not None):
+            stats.record_cache("local_hit")
+            return entry["prefix_pos"]
+        if entry is None:
+            stats.record_cache("miss")
+            exported = await self._prefill_and_export(trace_id, key,
+                                                      prompt,
+                                                      excluded,
+                                                      predicted_len)
+            if exported is None:
+                return None
+            payload, source_rid = exported
+            entry = self.kv_store.put(key, payload, source_rid)
+        else:
+            stats.record_cache("fleet_hit")
+
+        token = stats.transfer_started()
+        self.recorder.record(
+            trace_id, "kv_transfer_start",
+            detail=f"import key={key:#018x} -> {decode_rid} "
+                   f"bytes={len(entry['payload'])}")
+        result = None
+        try:
+            result = await self.manager.get(decode_rid).import_kv(
+                entry["payload"])
+            detail = (f"imported={result['imported']} "
+                      f"blocks={result['num_blocks']}")
+        except ReplicaFailure as e:
+            logger.warning("kv import into %s failed: %s", decode_rid, e)
+            detail = f"import failed: {e}"[:200]
+        finally:
+            stats.transfer_finished(token)
+            self.recorder.record(trace_id, "kv_transfer_done",
+                                 detail=detail)
+        if result is None:
+            return None
+        entry["imported"].add(decode_rid)
+        prefix_pos = result.get("prefix_pos")
+        if prefix_pos:
+            entry["prefix_pos"] = int(prefix_pos)
+        return entry["prefix_pos"]
+
+    async def _prefill_and_export(
+            self, trace_id: str, key: int, prompt: str, excluded: set,
+            predicted_len: int) -> Optional[Tuple[bytes, str]]:
+        """The prefill leg of a registry miss: run `prompt` with
+        max_tokens=1 on the least-loaded healthy prefill-role replica
+        (under the sub-request id `{trace_id}#p0` so it gets its own
+        sealed replica trace), then export the prefix KV. Returns
+        (payload, replica_id) or None on soft failure."""
+        from intellillm_tpu.obs.kv_transfer import get_kv_transfer_stats
+        stats = get_kv_transfer_stats()
+        loads = self.manager.healthy_loads(exclude=excluded,
+                                           role="prefill")
+        if not loads:
+            return None
+        prefill_rid = min(loads, key=loads.get)
+        replica = self.manager.get(prefill_rid)
+        sub_id = f"{trace_id}#p0"
+        # The load charge is the prompt length scaled like any other
+        # route: prefill cost tracks prompt tokens, and the charge is
+        # released as soon as the leg completes.
+        charge = max(int(round(predicted_len *
+                               replica.calibration_factor)), 1)
+        self._count_decision("disagg_prefill")
+        self.recorder.record(trace_id, "route_decision",
+                             detail=f"disagg_prefill->{prefill_rid}")
+        self.manager.on_route(prefill_rid, charge)
+        self.tracebook.note_attempt(trace_id, 0, prefill_rid, sub_id,
+                                    "disagg_prefill")
+        self.recorder.record(
+            trace_id, "routed",
+            detail=f"attempt=prefill replica={prefill_rid} "
+                   f"request_id={sub_id}")
+        try:
+            async for _ in replica.generate(
+                    {"prompt": prompt, "max_tokens": 1},
+                    predicted_len=charge, request_id=sub_id):
+                pass
+        except ReplicaFailure as e:
+            # Soft failure: the decode replica will recompute locally.
+            # The health poller decides whether the replica is dead.
+            logger.warning("disagg prefill leg failed on %s: %s",
+                           prefill_rid, e)
+            return None
+        finally:
+            self.manager.on_complete(prefill_rid, charge)
+
+        token = stats.transfer_started()
+        self.recorder.record(
+            trace_id, "kv_transfer_start",
+            detail=f"export key={key:#018x} from={prefill_rid}")
+        payload = None
+        try:
+            payload = await replica.export_kv(prompt)
+            detail = f"export bytes={len(payload)}"
+        except ReplicaFailure as e:
+            logger.warning("kv export from %s failed: %s", prefill_rid, e)
+            detail = f"export failed: {e}"[:200]
+        finally:
+            stats.transfer_finished(token)
+            self.recorder.record(trace_id, "kv_transfer_done",
+                                 detail=detail)
+        if payload is None:
+            return None
+        return payload, prefill_rid
 
     # --- observability ----------------------------------------------------
 
@@ -337,6 +552,7 @@ class Router:
     def snapshot(self) -> dict:
         healthy = [rid for rid, r in self.manager.replicas.items()
                    if r.healthy]
+        from intellillm_tpu.obs.kv_transfer import get_kv_transfer_stats
         return {
             "replicas": self.manager.snapshot(),
             "healthy_replicas": sorted(healthy),
@@ -344,6 +560,11 @@ class Router:
             "affinity_entries": len(self.policy.affinity),
             "tracing": self._trace_summary(),
             "alerts": self.fleet_alerts(),
+            "kv_transfer": {
+                "disagg_active": self.manager.disagg_active(),
+                "registry": self.kv_store.summary(),
+                **get_kv_transfer_stats().summary(),
+            },
             "config": {
                 "block_size": self.config.block_size,
                 "affinity_blocks": self.config.affinity_blocks,
@@ -507,6 +728,12 @@ def make_arg_parser() -> argparse.ArgumentParser:
                         help="replica /health/detail poll period, seconds")
     parser.add_argument("--max-retries", type=int, default=1,
                         help="re-routes after a replica failure")
+    parser.add_argument("--replica-roles", type=str, default=None,
+                        help="comma-separated disaggregated roles "
+                        "(mixed|prefill|decode), aligned with "
+                        "--replica-urls order then launched replicas; "
+                        "launched replicas get --replica-role appended "
+                        "to their engine args (docs/routing.md)")
     return parser
 
 
@@ -530,14 +757,27 @@ def build_router_from_args(args, engine_argv: List[str]) -> Router:
     router = Router(config, manager, predictor=predictor,
                     tokenizer=tokenizer)
 
+    roles = [r.strip()
+             for r in (getattr(args, "replica_roles", None) or "").split(",")
+             if r.strip()]
+    for role in roles:
+        if role not in REPLICA_ROLES:
+            raise SystemExit(f"--replica-roles: unknown role {role!r} "
+                             f"(choose from {', '.join(REPLICA_ROLES)})")
+
+    def role_for(index: int) -> str:
+        return roles[index] if index < len(roles) else "mixed"
+
     urls = [u.strip() for u in (args.replica_urls or "").split(",")
             if u.strip()]
     for i, url in enumerate(urls):
         from intellillm_tpu.router.replica import HTTPReplica
-        router.add_replica(HTTPReplica(f"replica-{i}", url))
+        router.add_replica(HTTPReplica(f"replica-{i}", url,
+                                       role=role_for(i)))
     for i in range(args.launch_replicas):
         replica = launch_http_replica(
-            f"launched-{i}", args.replica_base_port + i, engine_argv)
+            f"launched-{i}", args.replica_base_port + i, engine_argv,
+            role=role_for(len(urls) + i))
         router.add_replica(replica)
     if not router.manager.replicas:
         raise SystemExit(

@@ -29,6 +29,8 @@ per-hop latency attribution that partitions the router-observed e2e:
 
     router_queue   received -> first route_decision
     routing        route_decision -> routed, summed over attempts
+    kv_transfer    kv_transfer_start -> kv_transfer_done, summed over
+                   handoffs (disaggregated prefill/decode only)
     replica_queue  scheduled - queued, summed over attempts
     prefill        first_token - scheduled, summed over attempts
     decode         terminal - first_token, summed over attempts
@@ -44,9 +46,10 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 ROUTER_EVENTS = ("received", "route_decision", "routed", "first_chunk",
-                 "replica_failed", "finished", "aborted")
+                 "replica_failed", "finished", "aborted",
+                 "kv_transfer_start", "kv_transfer_done")
 
-ROUTER_HOPS = ("router_queue", "routing", "network")
+ROUTER_HOPS = ("router_queue", "routing", "kv_transfer", "network")
 REPLICA_HOPS = ("replica_queue", "prefill", "decode")
 
 
@@ -117,8 +120,8 @@ def attribute_hops(router_events: List[Dict[str, Any]],
         return {"e2e_s": None, "hops_s": {}}
     e2e = max(terminal - received, 0.0)
 
-    hops = {h: 0.0 for h in ("router_queue", "routing", "replica_queue",
-                             "prefill", "decode")}
+    hops = {h: 0.0 for h in ("router_queue", "routing", "kv_transfer",
+                             "replica_queue", "prefill", "decode")}
     decision_ts = [ev["ts"] for ev in router_events
                    if ev["event"] == "route_decision"]
     routed_ts = [ev["ts"] for ev in router_events
@@ -127,6 +130,15 @@ def attribute_hops(router_events: List[Dict[str, Any]],
         hops["router_queue"] = max(decision_ts[0] - received, 0.0)
     for d, r in zip(decision_ts, routed_ts):
         hops["routing"] += max(r - d, 0.0)
+    # Disaggregated KV handoff: export-from-prefill + import-into-decode
+    # time the router spent between legs. The residual clamp below keeps
+    # the decomposition a partition.
+    kv_start_ts = [ev["ts"] for ev in router_events
+                   if ev["event"] == "kv_transfer_start"]
+    kv_done_ts = [ev["ts"] for ev in router_events
+                  if ev["event"] == "kv_transfer_done"]
+    for s, d in zip(kv_start_ts, kv_done_ts):
+        hops["kv_transfer"] += max(d - s, 0.0)
 
     for att in attempts:
         events = att.get("events")

@@ -130,6 +130,49 @@ class CacheEngine:
         self._telemetry.record_swap("out", len(src_to_dst),
                                     self.logical_block_bytes)
 
+    # --- KV export/import (disaggregated serving) ------------------------
+
+    def export_blocks(
+            self,
+            block_numbers: List[int]) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Read device blocks into transient host arrays for a KV handoff.
+
+        Same device→host path as swap_out, but into a payload-sized
+        staging array (mapping device block i → staging slot j) instead
+        of the fixed swap pool, so exports never contend with scheduler
+        swap plans for CPU block numbers.
+        """
+        src_to_dst = {int(b): j for j, b in enumerate(block_numbers)}
+        shape = self._block_shape(len(block_numbers))
+        np_dtype = self.cpu_cache[0][0].dtype if self.cpu_cache else \
+            np.dtype(self.dtype.name)
+        layers: List[Tuple[np.ndarray, np.ndarray]] = []
+        for k_dev, v_dev in self.device_cache:
+            k_out = np.zeros(shape, dtype=np_dtype)
+            v_out = np.zeros(shape, dtype=np_dtype)
+            swap_blocks(k_dev, k_out, src_to_dst, direction="out")
+            swap_blocks(v_dev, v_out, src_to_dst, direction="out")
+            layers.append((k_out, v_out))
+        self._telemetry.record_swap("out", len(block_numbers),
+                                    self.logical_block_bytes)
+        return layers
+
+    def import_blocks(self, layers: List[Tuple[np.ndarray, np.ndarray]],
+                      block_numbers: List[int]) -> None:
+        """Scatter a KV handoff payload into device blocks (inverse of
+        export_blocks; staging slot j → device block j's target)."""
+        if len(layers) != self.num_layers:
+            raise ValueError(f"payload has {len(layers)} layers, cache has "
+                             f"{self.num_layers}")
+        src_to_dst = {j: int(b) for j, b in enumerate(block_numbers)}
+        for i, (k_host, v_host) in enumerate(layers):
+            k_dev, v_dev = self.device_cache[i]
+            k_dev = swap_blocks(k_host, k_dev, src_to_dst, direction="in")
+            v_dev = swap_blocks(v_host, v_dev, src_to_dst, direction="in")
+            self.device_cache[i] = (k_dev, v_dev)
+        self._telemetry.record_swap("in", len(block_numbers),
+                                    self.logical_block_bytes)
+
     def copy(self, src_to_dsts: Dict[int, List[int]]) -> None:
         self.device_cache = copy_blocks(self.device_cache, src_to_dsts)
         self._telemetry.record_swap(

@@ -1,0 +1,144 @@
+"""Content-addressed KV handoff payloads (disaggregated serving).
+
+A prefill-role replica exports the paged KV blocks backing a pinned
+prompt prefix; a decode-role replica imports them into its own pool and
+decodes with zero prefill recompute. The handle's identity is the same
+stable 64-bit prompt key the router already routes on
+(`affinity.affinity_key`), which makes the fleet KV registry
+content-addressed: the handle carries the prefix token ids, so every
+process recomputes the key locally instead of trusting the wire.
+
+Wire format (ships in-process or over HTTP as one opaque body):
+
+    MAGIC "IKV1" | u32 header_len | JSON header | raw block bytes
+
+The JSON header records the cache geometry (block_size, num_layers,
+num_kv_heads, head_size, dtype, num_blocks) plus the prefix token ids;
+the raw tail is, per layer, the K blocks then the V blocks, each block
+an unpadded ``[num_kv_heads, block_size, head_size]`` slab in the
+header's dtype. Import validates geometry — a decode replica with a
+different model/dtype/block_size rejects the payload instead of
+scattering garbage into its pool.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import asdict, dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from intellillm_tpu.affinity import affinity_key
+
+MAGIC = b"IKV1"
+_LEN = struct.Struct("<I")
+
+# numpy has no native bfloat16/fp8 — ml_dtypes (a jax dependency)
+# provides the dtype objects the CPU swap pool already uses.
+try:
+    import ml_dtypes
+    _EXTRA_DTYPES = {
+        "bfloat16": np.dtype(ml_dtypes.bfloat16),
+        "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+        "float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+    }
+except ImportError:  # pragma: no cover
+    _EXTRA_DTYPES = {}
+
+
+def resolve_dtype(name: str) -> np.dtype:
+    if name in _EXTRA_DTYPES:
+        return _EXTRA_DTYPES[name]
+    return np.dtype(name)
+
+
+@dataclass
+class KVHandle:
+    """Identity + geometry of one exported prefix. `key` is
+    affinity_key(token_ids, lora_int_id) — recomputed on import."""
+    key: int
+    token_ids: List[int]
+    lora_int_id: int
+    block_size: int
+    num_layers: int
+    num_kv_heads: int
+    head_size: int
+    dtype: str
+    num_blocks: int
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.token_ids)
+
+    def block_bytes(self) -> int:
+        return (self.num_kv_heads * self.block_size * self.head_size *
+                resolve_dtype(self.dtype).itemsize)
+
+    def payload_bytes(self) -> int:
+        """Raw KV bytes (k+v, all layers), excluding the header."""
+        return 2 * self.num_layers * self.num_blocks * self.block_bytes()
+
+
+def make_handle(token_ids: List[int], lora_int_id: int, *, block_size: int,
+                num_layers: int, num_kv_heads: int, head_size: int,
+                dtype: str, num_blocks: int) -> KVHandle:
+    token_ids = [int(t) for t in token_ids]
+    return KVHandle(key=affinity_key(token_ids, lora_int_id),
+                    token_ids=token_ids, lora_int_id=int(lora_int_id),
+                    block_size=block_size, num_layers=num_layers,
+                    num_kv_heads=num_kv_heads, head_size=head_size,
+                    dtype=dtype, num_blocks=num_blocks)
+
+
+def serialize_handle(handle: KVHandle,
+                     layers: List[Tuple[np.ndarray, np.ndarray]]) -> bytes:
+    """Pack a handle + its per-layer (k_blocks, v_blocks) arrays, each
+    shaped [num_blocks, num_kv_heads, block_size, head_size]."""
+    if len(layers) != handle.num_layers:
+        raise ValueError(f"handle says {handle.num_layers} layers, "
+                         f"got {len(layers)}")
+    expect = (handle.num_blocks, handle.num_kv_heads, handle.block_size,
+              handle.head_size)
+    header = json.dumps(asdict(handle), separators=(",", ":")).encode()
+    parts = [MAGIC, _LEN.pack(len(header)), header]
+    for i, (k, v) in enumerate(layers):
+        for name, arr in (("k", k), ("v", v)):
+            if tuple(arr.shape) != expect:
+                raise ValueError(f"layer {i} {name} shape {arr.shape} != "
+                                 f"expected {expect}")
+            parts.append(np.ascontiguousarray(arr).tobytes())
+    return b"".join(parts)
+
+
+def deserialize_handle(
+        payload: bytes) -> Tuple[KVHandle, List[Tuple[np.ndarray,
+                                                      np.ndarray]]]:
+    """Inverse of serialize_handle; validates magic, geometry, and the
+    content address (key must match the carried token ids)."""
+    if payload[:4] != MAGIC:
+        raise ValueError("bad KV payload magic")
+    (header_len, ) = _LEN.unpack_from(payload, 4)
+    header_end = 8 + header_len
+    handle = KVHandle(**json.loads(payload[8:header_end]))
+    if handle.key != affinity_key(handle.token_ids, handle.lora_int_id):
+        raise ValueError("KV handle key does not match its token ids")
+    dtype = resolve_dtype(handle.dtype)
+    shape = (handle.num_blocks, handle.num_kv_heads, handle.block_size,
+             handle.head_size)
+    block_bytes = handle.num_blocks * handle.block_bytes()
+    expected = header_end + 2 * handle.num_layers * block_bytes
+    if len(payload) != expected:
+        raise ValueError(f"KV payload is {len(payload)} bytes, geometry "
+                         f"implies {expected}")
+    layers = []
+    off = header_end
+    for _ in range(handle.num_layers):
+        k = np.frombuffer(payload, dtype, count=int(np.prod(shape)),
+                          offset=off).reshape(shape)
+        off += block_bytes
+        v = np.frombuffer(payload, dtype, count=int(np.prod(shape)),
+                          offset=off).reshape(shape)
+        off += block_bytes
+        layers.append((k, v))
+    return handle, layers

@@ -1,0 +1,57 @@
+"""CLI argument plumbing (engine/arg_utils.py): the deprecated no-op
+--enable-chunked-prefill warns and still resolves to chunking ON, the
+--replica-role flag round-trips into SchedulerConfig, and the silent
+default path stays silent."""
+import argparse
+import warnings
+
+import pytest
+
+from intellillm_tpu.engine.arg_utils import EngineArgs
+
+
+def _parse(argv):
+    parser = EngineArgs.add_cli_args(argparse.ArgumentParser())
+    return parser.parse_args(argv)
+
+
+def test_enable_chunked_prefill_flag_warns_and_stays_on():
+    args = _parse(["--model", "m", "--enable-chunked-prefill"])
+    with pytest.warns(DeprecationWarning, match="no-op"):
+        engine_args = EngineArgs.from_cli_args(args)
+    # The sentinel never leaks: the flag resolves back to the default.
+    assert engine_args.enable_chunked_prefill is True
+    assert engine_args.disable_chunked_prefill is False
+
+
+def test_no_warning_without_the_flag():
+    args = _parse(["--model", "m"])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DeprecationWarning)
+        engine_args = EngineArgs.from_cli_args(args)
+    assert engine_args.enable_chunked_prefill is True
+
+
+def test_disable_chunked_prefill_still_works():
+    args = _parse(["--model", "m", "--disable-chunked-prefill"])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DeprecationWarning)
+        engine_args = EngineArgs.from_cli_args(args)
+    assert engine_args.disable_chunked_prefill is True
+
+
+@pytest.mark.parametrize("role", ["mixed", "prefill", "decode"])
+def test_replica_role_round_trips(role):
+    args = _parse(["--model", "m", "--replica-role", role])
+    engine_args = EngineArgs.from_cli_args(args)
+    assert engine_args.replica_role == role
+
+
+def test_replica_role_rejects_unknown():
+    with pytest.raises(SystemExit):
+        _parse(["--model", "m", "--replica-role", "draft"])
+    from intellillm_tpu.config import SchedulerConfig
+    with pytest.raises(ValueError, match="replica_role"):
+        SchedulerConfig(max_num_batched_tokens=512, max_num_seqs=4,
+                        max_model_len=128, max_paddings=512,
+                        replica_role="draft")

@@ -220,9 +220,39 @@ def test_built_in_catalogue_names_and_severities():
     rules = {r.name: r for r in built_in_rules()}
     assert set(rules) == {"slo_burn_rate", "watchdog_stall",
                           "hbm_headroom", "mfu_collapse",
-                          "compile_storm", "router_failover"}
+                          "compile_storm", "router_failover",
+                          "kv_transfer_stall"}
     pages = {n for n, r in rules.items() if r.severity == "page"}
     assert pages == {"slo_burn_rate", "watchdog_stall", "hbm_headroom"}
+
+
+def test_kv_transfer_stall_rule_fires_on_wedged_transfer():
+    from intellillm_tpu.obs import kv_transfer
+    from intellillm_tpu.obs.alerts import KVTransferStallRule
+
+    kv_transfer.reset_for_testing()
+    try:
+        rule = KVTransferStallRule(stall_after_s=5.0)
+        stats = kv_transfer.get_kv_transfer_stats()
+        clock = _Clock(t=100.0)
+        stats._now = clock
+
+        # Never transferred anything: no data, not a clean pass.
+        fired, _, detail = rule.evaluate(None, clock())
+        assert fired is None and "no KV transfers" in detail
+
+        # A transfer in flight past the threshold fires; finishing it
+        # clears the rule.
+        token = stats.transfer_started()
+        clock.t += 6.0
+        fired, value, detail = rule.evaluate(None, clock())
+        assert fired is True
+        assert value == pytest.approx(6.0)
+        stats.transfer_finished(token)
+        fired, value, _ = rule.evaluate(None, clock())
+        assert fired is False and value == 0.0
+    finally:
+        kv_transfer.reset_for_testing()
 
 
 def test_summary_is_compact():

@@ -1,0 +1,251 @@
+"""E2E acceptance for disaggregated prefill/decode serving (tiny OPT,
+CPU): a prefill-role replica runs the prompt once and exports its paged
+KV over the content-addressed handoff; decode-role replicas import it
+and produce BIT-IDENTICAL greedy output vs a single mixed replica. The
+fleet registry means a shared prefix is prefilled once per fleet — a
+second decode replica gets a fleet_hit import, a repeat request on the
+same replica a local_hit with no transfer. Also covers the satellite:
+a decode replica dying mid-stream after the import fails over to the
+prefill-capable replica, which replays the FULL request cleanly."""
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from intellillm_tpu import SamplingParams
+from intellillm_tpu.engine.arg_utils import AsyncEngineArgs
+from intellillm_tpu.engine.async_llm_engine import AsyncLLMEngine
+from intellillm_tpu.obs import get_flight_recorder
+from intellillm_tpu.obs.kv_transfer import (get_kv_transfer_stats,
+                                            reset_for_testing as
+                                            reset_kv_for_testing)
+from intellillm_tpu.research.predictor import PromptLengthHeuristic
+from intellillm_tpu.router.metrics import _RouterMetrics
+from intellillm_tpu.router.policy import RouterConfig
+from intellillm_tpu.router.replica import InProcessReplica, ReplicaManager
+from intellillm_tpu.router.server import Router, build_router_app
+
+# 12 tokens (incl bos) under the word tokenizer: one exportable 8-token
+# block at block_size=8 (the last, boundary-holding block stays local).
+PROMPT = "the president of the united states is the capital of france"
+GEN = {"max_tokens": 16, "temperature": 0.0, "ignore_eos": True}
+
+
+def _build_engine(tiny_opt_dir, role="mixed"):
+    args = AsyncEngineArgs(model=tiny_opt_dir, dtype="float32",
+                           max_model_len=128, block_size=8,
+                           num_device_blocks_override=128,
+                           max_num_seqs=4, max_paddings=512,
+                           swap_space=0.01, disable_log_stats=True,
+                           disable_log_requests=True, replica_role=role)
+    return AsyncLLMEngine.from_engine_args(args)
+
+
+def _reset_all():
+    _RouterMetrics.reset_for_testing()
+    get_flight_recorder().reset_for_testing()
+    reset_kv_for_testing()
+
+
+def _router():
+    config = RouterConfig(block_size=8, affinity_blocks=2,
+                          load_balance_slack=0.0, max_retries=1,
+                          health_interval_s=0.2)
+    return Router(config, ReplicaManager(health_interval_s=0.2),
+                  predictor=PromptLengthHeuristic(scale=4.0),
+                  tokenizer=None)
+
+
+@pytest.fixture(scope="module")
+def baseline_text(tiny_opt_dir):
+    """Cumulative (prompt + completion) greedy text from a single mixed
+    replica — the bit-identity reference for every disagg fleet."""
+    async def run():
+        engine = _build_engine(tiny_opt_dir)
+        final = None
+        async for out in engine.generate(PROMPT, SamplingParams(**GEN),
+                                         "disagg-baseline"):
+            final = out
+        return final.prompt + final.outputs[0].text
+    try:
+        return asyncio.run(run())
+    finally:
+        get_flight_recorder().reset_for_testing()
+
+
+async def _stream(client, trace_id, kill_after_first_chunk=None):
+    """POST /generate and drain the stream; returns the cumulative text
+    of the last chunk."""
+    resp = await client.post(
+        "/generate",
+        json={"prompt": PROMPT, "stream": True, **GEN},
+        headers={"X-Request-Id": trace_id})
+    assert resp.status == 200
+    chunks = []
+    async for line in resp.content:
+        line = line.strip()
+        if not line:
+            continue
+        chunks.append(json.loads(line))
+        if kill_after_first_chunk is not None:
+            kill_after_first_chunk.kill()
+            kill_after_first_chunk = None
+    assert chunks
+    return chunks[-1]["text"][0]
+
+
+def test_disagg_bit_identical_and_prefilled_once_per_fleet(
+        tiny_opt_dir, baseline_text):
+    _reset_all()
+
+    async def run():
+        router = _router()
+        p0 = InProcessReplica("p0", _build_engine(tiny_opt_dir, "prefill"),
+                              role="prefill")
+        d0 = InProcessReplica("d0", _build_engine(tiny_opt_dir, "decode"),
+                              role="decode")
+        d1 = InProcessReplica("d1", _build_engine(tiny_opt_dir, "decode"),
+                              role="decode")
+        for r in (p0, d0, d1):
+            router.add_replica(r, healthy=True)
+        assert router.manager.disagg_active()
+
+        client = TestClient(TestServer(build_router_app(router)))
+        await client.start_server()
+        try:
+            # --- request 1: registry miss — prefill leg + export +
+            # import, decode output bit-identical to the mixed replica.
+            text1 = await _stream(client, "disagg-t1")
+            assert text1 == baseline_text
+
+            st = await (await client.get("/debug/trace/disagg-t1")).json()
+            assert [a["request_id"] for a in st["attempts"]] == [
+                "disagg-t1#p0", "disagg-t1"]
+            assert st["attempts"][0]["decision"] == "disagg_prefill"
+            assert st["attempts"][0]["replica_id"] == "p0"
+            first_decode = st["attempts"][1]["replica_id"]
+            assert first_decode in ("d0", "d1")
+            assert all(a["has_events"] for a in st["attempts"])
+
+            # kv_transfer is a real hop in the stitched attribution and
+            # the partition still sums exactly to e2e.
+            hops_s = st["attribution"]["hops_s"]
+            assert hops_s["kv_transfer"] > 0.0
+            assert all(v >= 0.0 for v in hops_s.values())
+            assert sum(hops_s.values()) == pytest.approx(
+                st["attribution"]["e2e_s"], abs=1e-4)
+            router_evs = [ev["event"] for ev in st["timeline"]
+                          if ev["hop"] == "router"]
+            # export span + import span, strictly between the prefill
+            # leg's routed and the decode leg's route_decision.
+            assert router_evs.count("kv_transfer_start") == 2
+            assert router_evs.count("kv_transfer_done") == 2
+            assert router_evs.count("route_decision") == 2
+
+            assert router.decisions["disagg_prefill"] == 1
+            stats = get_kv_transfer_stats().summary()
+            assert stats["cache_hits"] == {"miss": 1, "fleet_hit": 0,
+                                           "local_hit": 0}
+            assert stats["blocks_total"] == {"export": 1, "import": 1}
+            assert stats["bytes_total"]["export"] > 0
+            assert stats["bytes_total"]["import"] == \
+                stats["bytes_total"]["export"]
+            assert stats["inflight"] == 0
+            # The decode replica never recomputed the prefill locally.
+            served = router.manager.get(first_decode)
+            assert served.engine.engine.scheduler.prefill_recompute_count \
+                == 0
+
+            # --- request 2: kill the serving decode replica; the same
+            # prefix on the OTHER decode replica is a fleet_hit import —
+            # prefilled once per fleet, not once per replica.
+            served.kill()
+            text2 = await _stream(client, "disagg-t2")
+            assert text2 == baseline_text
+            assert router.decisions["disagg_prefill"] == 1  # still once
+            stats = get_kv_transfer_stats().summary()
+            assert stats["cache_hits"]["miss"] == 1
+            assert stats["cache_hits"]["fleet_hit"] == 1
+            assert stats["blocks_total"] == {"export": 1, "import": 2}
+            other = d0 if served is d1 else d1
+            assert other.engine.engine.scheduler.prefill_recompute_count \
+                == 0
+
+            # --- request 3: same replica again — local_hit, no
+            # transfer at all.
+            transfers_before = stats["transfers_total"]
+            text3 = await _stream(client, "disagg-t3")
+            assert text3 == baseline_text
+            stats = get_kv_transfer_stats().summary()
+            assert stats["cache_hits"]["local_hit"] == 1
+            assert stats["transfers_total"] == transfers_before
+
+            # --- the router snapshot carries the fleet KV block -------
+            detail = await (await client.get("/health/detail")).json()
+            kv = detail["router"]["kv_transfer"]
+            assert kv["disagg_active"] is True
+            assert kv["registry"]["entries"] == 1
+            assert kv["registry"]["payload_bytes"] > 0
+            assert kv["bytes_total"]["import"] > 0
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(run())
+    finally:
+        _reset_all()
+
+
+def test_decode_death_after_import_fails_over_with_full_replay(
+        tiny_opt_dir, baseline_text):
+    """Satellite: a decode replica dies mid-stream AFTER importing the
+    KV prefix. The router fails over to the only healthy replica — the
+    prefill-role one — which replays the FULL request (prefill roles do
+    not cap generation) and the client still sees complete output."""
+    _reset_all()
+
+    async def run():
+        router = _router()
+        p0 = InProcessReplica("p0", _build_engine(tiny_opt_dir, "prefill"),
+                              role="prefill")
+        d0 = InProcessReplica("d0", _build_engine(tiny_opt_dir, "decode"),
+                              role="decode")
+        router.add_replica(p0, healthy=True)
+        router.add_replica(d0, healthy=True)
+
+        client = TestClient(TestServer(build_router_app(router)))
+        await client.start_server()
+        try:
+            text = await _stream(client, "disagg-fo",
+                                 kill_after_first_chunk=d0)
+            assert text == baseline_text
+            assert router.decisions["disagg_prefill"] == 1
+            assert router.decisions["failover"] == 1
+
+            st = await (await client.get("/debug/trace/disagg-fo")).json()
+            assert [a["request_id"] for a in st["attempts"]] == [
+                "disagg-fo#p0", "disagg-fo", "disagg-fo#f1"]
+            assert st["attempts"][1]["replica_id"] == "d0"
+            assert st["attempts"][2]["replica_id"] == "p0"
+            assert st["attempts"][2]["decision"] == "failover"
+            hops_s = st["attribution"]["hops_s"]
+            assert hops_s["kv_transfer"] > 0.0
+            assert sum(hops_s.values()) == pytest.approx(
+                st["attribution"]["e2e_s"], abs=1e-4)
+
+            # The dead replica's imported prefixes died with it: the
+            # registry forgets d0 held anything (the payload survives
+            # for the next decode replica to import).
+            assert all("d0" not in e["imported"]
+                       for e in router.kv_store._entries.values())
+            assert router.kv_store.summary()["entries"] == 1
+            # With the only decode replica gone, disagg disengages.
+            assert router.manager.disagg_active() is False
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(run())
+    finally:
+        _reset_all()

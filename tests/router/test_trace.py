@@ -104,6 +104,24 @@ class TestAttribution:
         assert hops["replica_queue"] == pytest.approx(0.02 + 0.04)
         assert sum(hops.values()) == pytest.approx(out["e2e_s"])
 
+    def test_kv_transfer_hop_is_part_of_the_partition(self):
+        # Disaggregated handoff: a prefill-leg decision/routed pair,
+        # export + import kv_transfer spans, then the decode leg.
+        router_events = [
+            _ev(0.0, "received"),
+            _ev(0.01, "route_decision"), _ev(0.02, "routed"),
+            _ev(0.30, "kv_transfer_start"), _ev(0.35, "kv_transfer_done"),
+            _ev(0.36, "kv_transfer_start"), _ev(0.40, "kv_transfer_done"),
+            _ev(0.41, "route_decision"), _ev(0.42, "routed"),
+            _ev(1.0, "finished"),
+        ]
+        out = attribute_hops(router_events, [])
+        hops = out["hops_s"]
+        assert hops["kv_transfer"] == pytest.approx(0.05 + 0.04)
+        assert hops["routing"] == pytest.approx(0.02)
+        assert sum(hops.values()) == pytest.approx(out["e2e_s"])
+        assert hops["network"] >= 0.0
+
     def test_network_clamped_nonnegative(self):
         # Replica clock runs AHEAD of the router's: evidence exceeds
         # e2e; the clamp keeps the partition sane.

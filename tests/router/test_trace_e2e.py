@@ -130,10 +130,12 @@ def test_stitched_trace_across_failover(tiny_opt_dir, monkeypatch,
             attribution = st["attribution"]
             hops_s = attribution["hops_s"]
             assert set(hops_s) == {"router_queue", "routing",
-                                   "replica_queue", "prefill", "decode",
-                                   "network"}
+                                   "kv_transfer", "replica_queue",
+                                   "prefill", "decode", "network"}
             assert all(v >= 0.0 for v in hops_s.values())
             assert hops_s["decode"] > 0.0
+            # No disaggregated handoff on a mixed fleet.
+            assert hops_s["kv_transfer"] == 0.0
             assert sum(hops_s.values()) == pytest.approx(
                 attribution["e2e_s"], abs=1e-4)
 

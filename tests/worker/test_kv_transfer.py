@@ -1,0 +1,83 @@
+"""Unit tests for the content-addressed KV handoff wire format
+(worker/kv_transfer.py): handle identity, serialize/deserialize
+roundtrip, and the validation that keeps a decode replica from
+scattering a mismatched payload into its pool."""
+import numpy as np
+import pytest
+
+from intellillm_tpu.affinity import affinity_key
+from intellillm_tpu.worker.kv_transfer import (KVHandle, deserialize_handle,
+                                               make_handle, resolve_dtype,
+                                               serialize_handle)
+
+GEOM = dict(block_size=8, num_layers=2, num_kv_heads=4, head_size=16,
+            dtype="float32", num_blocks=3)
+
+
+def _layers(handle, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (handle.num_blocks, handle.num_kv_heads, handle.block_size,
+             handle.head_size)
+    dtype = resolve_dtype(handle.dtype)
+    return [(rng.standard_normal(shape).astype(dtype),
+             rng.standard_normal(shape).astype(dtype))
+            for _ in range(handle.num_layers)]
+
+
+def test_make_handle_is_content_addressed():
+    ids = list(range(24))
+    handle = make_handle(ids, 0, **GEOM)
+    assert handle.key == affinity_key(ids, 0)
+    assert handle.num_tokens == 24
+    # Same tokens under a different LoRA are a different prefix.
+    assert make_handle(ids, 7, **GEOM).key != handle.key
+
+
+def test_roundtrip_bit_exact():
+    handle = make_handle(list(range(24)), 0, **GEOM)
+    layers = _layers(handle)
+    payload = serialize_handle(handle, layers)
+    assert len(payload) > handle.payload_bytes()  # header + magic
+    out_handle, out_layers = deserialize_handle(payload)
+    assert out_handle == handle
+    for (k, v), (ok, ov) in zip(layers, out_layers):
+        np.testing.assert_array_equal(k, ok)
+        np.testing.assert_array_equal(v, ov)
+
+
+def test_roundtrip_bfloat16():
+    handle = make_handle(list(range(16)), 0, **{**GEOM, "dtype": "bfloat16"})
+    layers = _layers(handle)
+    payload = serialize_handle(handle, layers)
+    _, out_layers = deserialize_handle(payload)
+    for (k, _), (ok, _) in zip(layers, out_layers):
+        assert ok.dtype == resolve_dtype("bfloat16")
+        np.testing.assert_array_equal(k.view(np.uint16),
+                                      ok.view(np.uint16))
+
+
+def test_serialize_rejects_wrong_shapes():
+    handle = make_handle(list(range(24)), 0, **GEOM)
+    layers = _layers(handle)
+    with pytest.raises(ValueError, match="layers"):
+        serialize_handle(handle, layers[:-1])
+    bad = [(k[:, :1], v) for k, v in layers]
+    with pytest.raises(ValueError, match="shape"):
+        serialize_handle(handle, bad)
+
+
+def test_deserialize_rejects_corruption():
+    handle = make_handle(list(range(24)), 0, **GEOM)
+    payload = serialize_handle(handle, _layers(handle))
+    with pytest.raises(ValueError, match="magic"):
+        deserialize_handle(b"XXXX" + payload[4:])
+    with pytest.raises(ValueError, match="bytes"):
+        deserialize_handle(payload[:-8])
+
+    # A tampered key no longer matches the carried token ids: the
+    # content address is recomputed, never trusted from the wire.
+    tampered = KVHandle(key=handle.key ^ 1, token_ids=handle.token_ids,
+                        lora_int_id=0, **GEOM)
+    bad = serialize_handle(tampered, _layers(handle))
+    with pytest.raises(ValueError, match="key"):
+        deserialize_handle(bad)
