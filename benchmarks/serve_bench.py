@@ -389,10 +389,12 @@ def run_ttft_under_load(args, api_url: str, model_name: str, tokenizer,
 
 def launch_generate_replica(model_dir: str, args, port: int,
                             log_path: str,
-                            role: str = None) -> subprocess.Popen:
+                            role: str = None,
+                            extra_args=None) -> subprocess.Popen:
     """Launch one demo api_server replica (plain /generate protocol —
     the surface the router fronts). `role` maps to --replica-role for
-    disaggregated fleets."""
+    disaggregated fleets; `extra_args` appends raw CLI flags (the
+    multi-tenant scenario passes the LoRA/fairness knobs through it)."""
     cmd = [
         sys.executable, "-m", "intellillm_tpu.entrypoints.api_server",
         "--model", model_dir,
@@ -414,6 +416,8 @@ def launch_generate_replica(model_dir: str, args, port: int,
         cmd += ["--num-device-blocks-override", str(args.num_device_blocks)]
     if role and role != "mixed":
         cmd += ["--replica-role", role]
+    if extra_args:
+        cmd += list(extra_args)
     env = dict(os.environ)
     env.setdefault("HF_HUB_OFFLINE", "1")
     log = open(log_path, "wb")
@@ -654,6 +658,264 @@ def run_disagg(args, model_dir, tokenizer) -> dict:
     return summary
 
 
+def _make_bench_adapter(model_dir: str, out_dir: str, seed: int,
+                        rank: int = 8) -> str:
+    """Synthesize a tiny HF-PEFT-style LoRA checkpoint (q/v targets)
+    against `model_dir`'s config — the multi-tenant scenario needs N
+    distinct adapters, not N distinct base models."""
+    import numpy as np
+    import safetensors.numpy
+    with open(os.path.join(model_dir, "config.json")) as f:
+        cfg = json.load(f)
+    hidden = cfg["hidden_size"]
+    heads = cfg["num_attention_heads"]
+    kv_heads = cfg.get("num_key_value_heads") or heads
+    head_dim = hidden // heads
+    dims = {"q_proj": (hidden, hidden),
+            "v_proj": (hidden, kv_heads * head_dim)}
+    rng = np.random.RandomState(seed)
+    tensors = {}
+    for li in range(cfg["num_hidden_layers"]):
+        for t, (din, dout) in dims.items():
+            base = f"base_model.model.model.layers.{li}.self_attn.{t}"
+            tensors[f"{base}.lora_A.weight"] = rng.randn(
+                rank, din).astype(np.float32) * 0.01
+            tensors[f"{base}.lora_B.weight"] = rng.randn(
+                dout, rank).astype(np.float32) * 0.01
+    os.makedirs(out_dir, exist_ok=True)
+    safetensors.numpy.save_file(
+        tensors, os.path.join(out_dir, "adapter_model.safetensors"))
+    with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
+        json.dump({"r": rank, "lora_alpha": float(rank),
+                   "target_modules": list(dims)}, f)
+    return out_dir
+
+
+def _post_json(base: str, path: str, body: dict, timeout: float = 30.0):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode(errors="replace"))
+
+
+async def _tenant_request(session, base: str, tenant: str, prompt: str,
+                          max_tokens: int, results: list) -> None:
+    """One streamed /generate request measuring client-side TTFT and
+    TPOT. `ignore_eos` + a fixed `max_tokens` make the token count
+    known, so TPOT = (stream tail time) / (max_tokens - 1) regardless
+    of how the server batches chunks."""
+    payload = {"prompt": prompt, "tenant": tenant, "stream": True,
+               "max_tokens": max_tokens, "ignore_eos": True,
+               "temperature": 0.0}
+    t0 = time.perf_counter()
+    ttft = None
+    async with session.post(base + "/generate", json=payload) as resp:
+        resp.raise_for_status()
+        async for line in resp.content:
+            if line.strip() and ttft is None:
+                ttft = time.perf_counter() - t0
+    latency = time.perf_counter() - t0
+    results.append({
+        "tenant": tenant,
+        "ttft_s": ttft,
+        "latency_s": latency,
+        "tpot_s": ((latency - ttft) / max(max_tokens - 1, 1)
+                   if ttft is not None else None),
+    })
+
+
+async def _mt_phase(base: str, victim_tenants, victim_requests: int,
+                    victim_output_len: int, hog_tenant=None,
+                    hog_concurrency: int = 0,
+                    hog_output_len: int = 0,
+                    hog_start_delay: float = 1.5) -> list:
+    """One load phase: each victim tenant streams `victim_requests`
+    sequential requests (concurrency 1 per tenant — the latency probe)
+    while the hog tenant, if any, floods `hog_concurrency` concurrent
+    long-output requests. The flood starts `hog_start_delay` seconds
+    after the victims — this is the noisy-NEIGHBOR scenario: the
+    victims are established tenants when the hog arrives, so the
+    fairness pass sees >= 2 present tenants and caps the hog at
+    admission. (A hog that floods an EMPTY machine legitimately takes
+    every seat — work-conserving fairness never evicts running work;
+    see docs/multitenancy.md.) Hog tasks are cancelled once every
+    victim finishes (the hog exists to create contention, not to be
+    measured); the server aborts the dropped streams."""
+    import aiohttp
+    results: list = []
+    hog_results: list = []
+    conn = aiohttp.TCPConnector(limit=0)
+    timeout = aiohttp.ClientTimeout(total=6 * 3600)
+    async with aiohttp.ClientSession(connector=conn,
+                                     timeout=timeout) as session:
+
+        async def hog_request(i):
+            await asyncio.sleep(hog_start_delay)
+            await _tenant_request(session, base, hog_tenant,
+                                  "busy " * 24 + str(i), hog_output_len,
+                                  hog_results)
+
+        hog_tasks = [
+            asyncio.create_task(hog_request(i))
+            for i in range(hog_concurrency)
+        ] if hog_tenant else []
+
+        async def victim_stream(tenant, salt):
+            for i in range(victim_requests):
+                await _tenant_request(
+                    session, base, tenant,
+                    f"measure {salt} {i} " + "ping " * 12,
+                    victim_output_len, results)
+
+        await asyncio.gather(*(victim_stream(t, si)
+                               for si, t in enumerate(victim_tenants)))
+        for task in hog_tasks:
+            task.cancel()
+        await asyncio.gather(*hog_tasks, return_exceptions=True)
+    return results
+
+
+def _mt_percentiles(rows, field: str) -> dict:
+    vals = sorted(r[field] * 1e3 for r in rows
+                  if r.get(field) is not None)
+    if not vals:
+        return {}
+    def pick(q):
+        return round(vals[min(len(vals) - 1,
+                              max(0, int(q * len(vals) + 0.5) - 1))], 2)
+    return {"p50": pick(0.50), "p99": pick(0.99), "n": len(vals)}
+
+
+def run_multi_tenant(args, model_dir, tokenizer) -> dict:
+    """The multi-tenant scenario (docs/multitenancy.md): N LoRA tenants
+    on ONE replica — two victim tenants streaming latency-probe
+    requests, one hot tenant flooding, plus background tenants so the
+    registered adapter count exceeds --max-loras (device-slot churn).
+    Phases: (1) victims solo, (2) victims + hog with fairness caps on,
+    (3) same contention with --disable-tenant-fairness. The isolation
+    verdict is victim TPOT p99 per phase: caps-on should hold within
+    ~2x of solo while caps-off degrades unboundedly with hog size."""
+    base = f"http://127.0.0.1:{args.port}"
+    n = max(3, args.num_tenants)
+    adapters = [
+        _make_bench_adapter(model_dir,
+                            os.path.join(model_dir, f"bench-adapter-{i}"),
+                            seed=100 + i)
+        for i in range(1, n + 1)
+    ]
+    tenant_ids = [f"tenant-{i}" for i in range(1, n + 1)]
+    hog, victims = tenant_ids[0], tenant_ids[1:3]
+    max_loras = max(2, n - 1)   # fewer slots than adapters → churn
+    lora_flags = ["--enable-lora", "--max-loras", str(max_loras),
+                  "--max-lora-rank", "8",
+                  "--max-cpu-loras", str(n + 1)]
+
+    def boot(extra, log_suffix):
+        log_path = args.server_log + log_suffix
+        proc = launch_generate_replica(model_dir, args, args.port,
+                                       log_path,
+                                       extra_args=lora_flags + extra)
+        wait_healthy(proc, base, args.init_timeout, log_path)
+        for i, (tid, path) in enumerate(zip(tenant_ids, adapters)):
+            body = {"lora_name": tid, "lora_int_id": i + 1,
+                    "lora_local_path": path}
+            if tid == hog and args.tenant_hog_share_cap:
+                body["token_share_cap"] = args.tenant_hog_share_cap
+            _post_json(base, f"/tenants/{tid}/adapter", body)
+        # Touch every tenant once: warms the compile ladder and pulls
+        # each adapter through the loader before measurement.
+        asyncio.run(_mt_phase(base, tenant_ids, 1,
+                              args.victim_output_len))
+        return proc
+
+    summary = {"scenario": "multi-tenant", "size": args.size,
+               "num_tenants": n, "max_loras": max_loras,
+               "hog": hog, "victims": victims,
+               "hog_concurrency": args.hog_concurrency,
+               "hog_output_len": args.hog_output_len,
+               "victim_requests": args.victim_requests,
+               "victim_output_len": args.victim_output_len,
+               "tenant_hog_share_cap": args.tenant_hog_share_cap,
+               "max_num_seqs": args.max_num_seqs}
+    phases = {}
+    proc = boot([], ".mt-fair")
+    try:
+        solo = asyncio.run(_mt_phase(
+            base, victims, args.victim_requests, args.victim_output_len))
+        phases["victim_solo"] = solo
+        caps_on = asyncio.run(_mt_phase(
+            base, victims, args.victim_requests, args.victim_output_len,
+            hog_tenant=hog, hog_concurrency=args.hog_concurrency,
+            hog_output_len=args.hog_output_len,
+            hog_start_delay=args.hog_start_delay))
+        phases["contention_caps_on"] = caps_on
+        detail = snapshot_health_detail(base)
+        summary["tenants_caps_on"] = detail.get("tenants")
+        summary["alerts_caps_on"] = distill_alerts(snapshot_alerts(base))
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+    proc = boot(["--disable-tenant-fairness"], ".mt-unfair")
+    try:
+        caps_off = asyncio.run(_mt_phase(
+            base, victims, args.victim_requests, args.victim_output_len,
+            hog_tenant=hog, hog_concurrency=args.hog_concurrency,
+            hog_output_len=args.hog_output_len,
+            hog_start_delay=args.hog_start_delay))
+        phases["contention_caps_off"] = caps_off
+        detail = snapshot_health_detail(base)
+        summary["tenants_caps_off"] = detail.get("tenants")
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+    per_phase = {}
+    for phase, rows in phases.items():
+        per_phase[phase] = {
+            "tpot_ms": _mt_percentiles(rows, "tpot_s"),
+            "ttft_ms": _mt_percentiles(rows, "ttft_s"),
+            "per_tenant_tpot_ms": {
+                t: _mt_percentiles([r for r in rows if r["tenant"] == t],
+                                   "tpot_s")
+                for t in sorted({r["tenant"] for r in rows})},
+        }
+    summary["victim_latency"] = per_phase
+
+    def ratio(a, b):
+        return (round(a / b, 3)
+                if a is not None and b else None)
+    solo_p99 = (per_phase.get("victim_solo", {})
+                .get("tpot_ms", {}).get("p99"))
+    on_p99 = (per_phase.get("contention_caps_on", {})
+              .get("tpot_ms", {}).get("p99"))
+    off_p99 = (per_phase.get("contention_caps_off", {})
+               .get("tpot_ms", {}).get("p99"))
+    stats_on = ((summary.get("tenants_caps_on") or {}).get("stats")
+                or {})
+    churn = {t: {"loads": (stats_on.get(t) or {}).get("adapter_loads"),
+                 "evictions": (stats_on.get(t)
+                               or {}).get("adapter_evictions"),
+                 "deferred_tokens": (stats_on.get(t)
+                                     or {}).get("deferred_tokens")}
+             for t in tenant_ids}
+    isolation = {
+        "victim_tpot_p99_ms": {"solo": solo_p99, "caps_on": on_p99,
+                               "caps_off": off_p99},
+        "caps_on_vs_solo": ratio(on_p99, solo_p99),
+        "caps_off_vs_solo": ratio(off_p99, solo_p99),
+        "caps_off_vs_caps_on": ratio(off_p99, on_p99),
+        "isolation_holds_2x": (on_p99 is not None and solo_p99
+                               and on_p99 <= 2.0 * solo_p99),
+        "adapter_churn": churn,
+    }
+    summary["isolation"] = isolation
+    print(json.dumps({"serve_bench_multitenant": isolation}), flush=True)
+    print(json.dumps({"serve_bench_summary": summary}), flush=True)
+    return summary
+
+
 def _compare_policies(args, model_dir, tokenizer, policies) -> dict:
     """Run the ttft-under-load scenario once per scheduling policy (one
     server lifecycle each) and print an SLO comparison block — the
@@ -766,6 +1028,9 @@ def main(args) -> dict:
 
     if args.scenario == "disagg":
         return run_disagg(args, model_dir, tokenizer)
+
+    if args.scenario == "multi-tenant":
+        return run_multi_tenant(args, model_dir, tokenizer)
 
     if args.compare_spec:
         if not args._spec_model_dir:
@@ -905,7 +1170,7 @@ def make_arg_parser() -> argparse.ArgumentParser:
                    default="/tmp/serve_bench_server.log")
     p.add_argument("--scenario", type=str, default="rate-sweep",
                    choices=["rate-sweep", "ttft-under-load", "fleet",
-                            "disagg"],
+                            "disagg", "multi-tenant"],
                    help="rate-sweep: Poisson sweep over --rates (the "
                         "default). ttft-under-load: start --num-prompts "
                         "short-prompt requests at once (steady decode "
@@ -922,7 +1187,12 @@ def make_arg_parser() -> argparse.ArgumentParser:
                         "replicas) vs an equal-size mixed fleet, and "
                         "report the probe-TTFT/background-TPOT split "
                         "plus KV-transfer bytes/seconds and fleet "
-                        "prefix-cache hit counts.")
+                        "prefix-cache hit counts. multi-tenant: "
+                        "--num-tenants LoRA tenants on one replica with "
+                        "one hot tenant flooding; reports victim-tenant "
+                        "TPOT p99 solo vs contention with fairness caps "
+                        "on and off, per-tenant SLO splits, and adapter "
+                        "churn counters (docs/multitenancy.md).")
     p.add_argument("--num-replicas", type=int, default=2,
                    help="fleet scenario: engine replicas to launch; "
                         "disagg scenario: decode replicas per fleet")
@@ -978,6 +1248,31 @@ def make_arg_parser() -> argparse.ArgumentParser:
                    help="with --speculative-size: run the rate sweep "
                         "twice (spec off, then on) and print a "
                         "serve_bench_spec_comparison block")
+    p.add_argument("--num-tenants", type=int, default=4,
+                   help="multi-tenant scenario: LoRA tenants to "
+                        "register (adapters synthesized per tenant; "
+                        "--max-loras is set to num-tenants - 1 so slot "
+                        "churn is exercised)")
+    p.add_argument("--hog-concurrency", type=int, default=40,
+                   help="multi-tenant scenario: concurrent long-output "
+                        "requests the hot tenant keeps in flight")
+    p.add_argument("--hog-output-len", type=int, default=160,
+                   help="multi-tenant scenario: output tokens per hog "
+                        "request")
+    p.add_argument("--hog-start-delay", type=float, default=1.5,
+                   help="multi-tenant scenario: seconds after the "
+                        "victim probes start before the hog floods "
+                        "(victims must be resident for admission "
+                        "fairness to see two tenants)")
+    p.add_argument("--victim-requests", type=int, default=5,
+                   help="multi-tenant scenario: sequential probe "
+                        "requests per victim tenant per phase")
+    p.add_argument("--victim-output-len", type=int, default=32,
+                   help="multi-tenant scenario: output tokens per "
+                        "victim probe request")
+    p.add_argument("--tenant-hog-share-cap", type=float, default=0.2,
+                   help="multi-tenant scenario: token_share_cap "
+                        "registered for the hot tenant (0 disables)")
     return p
 
 

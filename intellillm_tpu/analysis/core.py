@@ -164,6 +164,7 @@ DEFAULT_METRICS_MODULES: Tuple[str, ...] = (
     "intellillm_tpu/router/metrics.py",
     "intellillm_tpu/prediction/metrics.py",
     "intellillm_tpu/worker/spec_decode/metrics.py",
+    "intellillm_tpu/tenancy/metrics.py",
 )
 
 # Per-request server paths where an append to a module-level container

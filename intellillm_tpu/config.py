@@ -290,6 +290,7 @@ class SchedulerConfig:
         sjf_starvation_s: Optional[float] = None,
         predictor_path: Optional[str] = None,
         replica_role: str = "mixed",
+        tenant_fairness: bool = True,
     ) -> None:
         self.enable_chunked_prefill = enable_chunked_prefill
         if max_num_batched_tokens is not None:
@@ -328,6 +329,12 @@ class SchedulerConfig:
         # prefix for KV export; "decode" expects imported prefixes and
         # runs pure decode steps.
         self.replica_role = replica_role
+        # Per-tenant weighted admission caps (docs/multitenancy.md):
+        # when >= 2 tenants are present, each tenant's RUNNING seats and
+        # per-step prefill-chunk tokens are capped at its weighted share
+        # so a noisy neighbor cannot starve other tenants' decodes.
+        # --disable-tenant-fairness turns the caps off (A/B knob).
+        self.tenant_fairness = tenant_fairness
         self._verify_args()
 
     def _verify_args(self) -> None:

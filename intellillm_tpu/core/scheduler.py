@@ -38,6 +38,7 @@ from intellillm_tpu.prediction import get_prediction_service
 from intellillm_tpu.prefix import PrefixPool
 from intellillm_tpu.sequence import (Sequence, SequenceData, SequenceGroup,
                                      SequenceGroupMetadata, SequenceStatus)
+from intellillm_tpu.tenancy import get_tenant_registry, get_tenant_stats
 from intellillm_tpu.utils import default_len_buckets, pad_to_bucket
 from intellillm_tpu.worker.spec_decode.eligibility import (
     seq_group_spec_eligible)
@@ -101,6 +102,114 @@ class SchedulerOutputs:
     def is_empty(self) -> bool:
         return (not self.scheduled_seq_groups and not self.blocks_to_swap_in
                 and not self.blocks_to_swap_out and not self.blocks_to_copy)
+
+
+class _TenantFairnessPass:
+    """Per-scheduling-pass tenant fairness caps (docs/multitenancy.md).
+
+    Weighted share: each present tenant is entitled to
+    `weight / sum(present weights)` of the machine, optionally tightened
+    by its `token_share_cap`. That share caps (a) the tenant's RUNNING
+    seats — gating prompt admission and swap-in, never evicting already
+    running work — and (b) in chunked mode, the tenant's prefill-chunk
+    tokens per step, so a hog's prompt stream cannot monopolize the
+    token budget while other tenants' decodes are resident.
+
+    Work-conserving: inactive (every check a no-op) when fairness is
+    disabled or fewer than two tenants are present, so a lone tenant
+    may use the whole machine. Every tenant always keeps at least one
+    seat / one chunk token, so caps never deadlock admission.
+    """
+
+    def __init__(self, scheduler: "Scheduler",
+                 chunk_budget: Optional[int] = None) -> None:
+        self.active = False
+        cfg = scheduler.scheduler_config
+        if not getattr(cfg, "tenant_fairness", True):
+            return
+        registry = get_tenant_registry()
+        self._registry = registry
+        present: Dict[str, float] = {}
+        for queue in (scheduler.running, scheduler.swapped,
+                      scheduler.waiting):
+            for sg in queue:
+                tenant = registry.tenant_for_adapter(sg.lora_int_id)
+                if tenant not in present:
+                    present[tenant] = registry.weight_for(tenant)
+        if len(present) < 2:
+            return
+        self.active = True
+        total_weight = sum(present.values())
+        self.seat_limits: Dict[str, int] = {}
+        self.chunk_limits: Optional[Dict[str, int]] = (
+            {} if chunk_budget is not None else None)
+        for tenant, weight in present.items():
+            share = weight / total_weight
+            cap = registry.share_cap_for(tenant)
+            if cap is not None:
+                share = min(share, cap)
+            self.seat_limits[tenant] = max(
+                1, int(cfg.max_num_seqs * share))
+            if self.chunk_limits is not None:
+                self.chunk_limits[tenant] = max(1, int(chunk_budget * share))
+        self.seats: Dict[str, int] = {}
+        for sg in scheduler.running:
+            tenant = registry.tenant_for_adapter(sg.lora_int_id)
+            self.seats[tenant] = (self.seats.get(tenant, 0)
+                                  + sg.get_max_num_running_seqs())
+        self.chunk_used: Dict[str, int] = {}
+
+    def defer_admission(self, seq_group: SequenceGroup, pending_tokens: int,
+                        check_chunk: bool = False) -> bool:
+        """True when admitting would push the group's tenant past its
+        seat cap this pass (or, for new prompts with `check_chunk`, its
+        per-step chunk-token share is already spent) — the caller
+        defers the group and `pending_tokens` is recorded as
+        admission-deferred."""
+        if not self.active:
+            return False
+        tenant = self._registry.tenant_for_adapter(seq_group.lora_int_id)
+        seat_limit = self.seat_limits.get(tenant)
+        if seat_limit is None:
+            # Tenant appeared after this pass's caps were computed (e.g.
+            # registered mid-step): no cap this pass, fair next pass.
+            return False
+        over_seats = (self.seats.get(tenant, 0)
+                      + seq_group.get_max_num_running_seqs() > seat_limit)
+        chunk_limit = ((self.chunk_limits or {}).get(tenant)
+                       if check_chunk else None)
+        chunk_spent = (chunk_limit is not None
+                       and self.chunk_used.get(tenant, 0) >= chunk_limit)
+        if not over_seats and not chunk_spent:
+            return False
+        get_tenant_stats().record_deferred(tenant,
+                                           max(int(pending_tokens), 0))
+        return True
+
+    def note_admit(self, seq_group: SequenceGroup) -> None:
+        if not self.active:
+            return
+        tenant = self._registry.tenant_for_adapter(seq_group.lora_int_id)
+        self.seats[tenant] = (self.seats.get(tenant, 0)
+                              + seq_group.get_max_num_running_seqs())
+
+    def allowed_chunk(self, seq_group: SequenceGroup, want: int) -> int:
+        """Clamp a prefill chunk to the tenant's remaining per-step
+        token share; the granted amount is charged and the shortfall
+        recorded as admission-deferred tokens."""
+        if not self.active or self.chunk_limits is None or want <= 0:
+            return want
+        tenant = self._registry.tenant_for_adapter(seq_group.lora_int_id)
+        limit = self.chunk_limits.get(tenant)
+        if limit is None:
+            return want
+        used = self.chunk_used.get(tenant, 0)
+        granted = max(0, min(want, limit - used))
+        if granted:
+            self.chunk_used[tenant] = used + granted
+        if granted < want:
+            get_tenant_stats().record_deferred(tenant, want - granted)
+        return granted
 
 
 class Scheduler:
@@ -320,6 +429,8 @@ class Scheduler:
             num_batched_tokens = 0
             curr_loras = self._running_loras()
             lora_deferred: List[SequenceGroup] = []
+            fairness = _TenantFairnessPass(self)
+            tenant_deferred: List[SequenceGroup] = []
 
             # SJF makes admission order policy-driven too: sort the waiting
             # queue by policy priority (FCFS degenerates to arrival order).
@@ -365,6 +476,13 @@ class Scheduler:
                     self.waiting.popleft()
                     lora_deferred.append(seq_group)
                     continue
+                if fairness.defer_admission(
+                        seq_group,
+                        waiting_seqs[0].data.get_num_uncomputed_tokens(),
+                        check_chunk=True):
+                    self.waiting.popleft()
+                    tenant_deferred.append(seq_group)
+                    continue
 
                 # Computed prefix-cache tokens are skipped: their KV is
                 # already resident, so the chunk starts past them.
@@ -407,6 +525,7 @@ class Scheduler:
                 num_curr_seqs += num_new_seqs
                 if curr_loras is not None and lora_id > 0:
                     curr_loras.add(lora_id)
+                fairness.note_admit(seq_group)
                 scheduled.append(seq_group)
                 if seq_group.first_scheduled_time is None:
                     seq_group.first_scheduled_time = now
@@ -416,6 +535,8 @@ class Scheduler:
 
             # Deferred-for-LoRA groups go back to the front (in order).
             for sg in reversed(lora_deferred):
+                self.waiting.appendleft(sg)
+            for sg in reversed(tenant_deferred):
                 self.waiting.appendleft(sg)
 
             if scheduled or ignored_seq_groups:
@@ -507,6 +628,8 @@ class Scheduler:
                                 for sg in self.running)
             curr_loras = self._running_loras()
             lora_deferred_swap: List[SequenceGroup] = []
+            fairness = _TenantFairnessPass(self)
+            tenant_deferred_swap: List[SequenceGroup] = []
             while self.swapped:
                 seq_group = self.swapped[0]
                 steps = self._row_steps(seq_group, num_steps, spec_requests)
@@ -517,6 +640,11 @@ class Scheduler:
                 if self._lora_cap_exceeded(curr_loras, lora_id):
                     self.swapped.popleft()
                     lora_deferred_swap.append(seq_group)
+                    continue
+                if fairness.defer_admission(
+                        seq_group, seq_group.get_max_num_running_seqs()):
+                    self.swapped.popleft()
+                    tenant_deferred_swap.append(seq_group)
                     continue
                 num_new_seqs = seq_group.get_max_num_running_seqs()
                 if (num_curr_seqs + num_new_seqs
@@ -530,8 +658,11 @@ class Scheduler:
                 num_curr_seqs += num_new_seqs
                 if curr_loras is not None and lora_id > 0:
                     curr_loras.add(lora_id)
+                fairness.note_admit(seq_group)
                 self.running.append(seq_group)
             for sg in reversed(lora_deferred_swap):
+                self.swapped.appendleft(sg)
+            for sg in reversed(tenant_deferred_swap):
                 self.swapped.appendleft(sg)
 
         num_batched_tokens = sum(
@@ -623,6 +754,11 @@ class Scheduler:
         prefilling_groups = [sg for sg in prefilling_groups
                              if sg in self.running]
 
+        # Per-tenant fairness caps for this step (seat caps gate the
+        # swap-in/admission passes below; chunk-token caps split the
+        # prefill slack). Inactive unless >= 2 tenants are present.
+        fairness = _TenantFairnessPass(self, chunk_budget=budget)
+
         # Pass 2: swap-in (decode-ready groups join the batch, mid-prefill
         # groups resume chunking where their KV left off).
         self.swapped = deque(self.policy.sort_by_priority(now, self.swapped))
@@ -631,6 +767,7 @@ class Scheduler:
                                 for sg in self.running)
             curr_loras = self._running_loras()
             lora_deferred_swap: List[SequenceGroup] = []
+            tenant_deferred_swap: List[SequenceGroup] = []
             while self.swapped:
                 seq_group = self.swapped[0]
                 steps = self._row_steps(seq_group, 1, spec_requests)
@@ -641,6 +778,11 @@ class Scheduler:
                 if self._lora_cap_exceeded(curr_loras, lora_id):
                     self.swapped.popleft()
                     lora_deferred_swap.append(seq_group)
+                    continue
+                if fairness.defer_admission(
+                        seq_group, seq_group.get_max_num_running_seqs()):
+                    self.swapped.popleft()
+                    tenant_deferred_swap.append(seq_group)
                     continue
                 num_new_seqs = seq_group.get_max_num_running_seqs()
                 if (num_curr_seqs + num_new_seqs
@@ -663,8 +805,11 @@ class Scheduler:
                 num_curr_seqs += num_new_seqs
                 if curr_loras is not None and lora_id > 0:
                     curr_loras.add(lora_id)
+                fairness.note_admit(seq_group)
                 self.running.append(seq_group)
             for sg in reversed(lora_deferred_swap):
+                self.swapped.appendleft(sg)
+            for sg in reversed(tenant_deferred_swap):
                 self.swapped.appendleft(sg)
 
         # Pass 3: spend the slack on prefill chunks — in-flight first.
@@ -702,7 +847,12 @@ class Scheduler:
                 break
             seq = seq_group.get_seqs(status=SequenceStatus.RUNNING)[0]
             remaining = seq.data.get_num_uncomputed_tokens()
-            size = min(remaining, slack, self._max_chunk_size)
+            size = fairness.allowed_chunk(
+                seq_group, min(remaining, slack, self._max_chunk_size))
+            if size <= 0:
+                # Tenant's chunk share for this step is spent; the group
+                # stays resident and resumes next step.
+                continue
             start = seq.data.get_num_computed_tokens()
             final = size == remaining
             seq.data.update_num_computed_tokens(size)
@@ -722,6 +872,7 @@ class Scheduler:
                                 for sg in self.running)
             curr_loras = self._running_loras()
             lora_deferred: List[SequenceGroup] = []
+            tenant_deferred: List[SequenceGroup] = []
             if self.scheduler_config.policy != "fcfs":
                 self.waiting = deque(
                     self.policy.sort_by_priority(now, self.waiting))
@@ -760,6 +911,13 @@ class Scheduler:
                     self.waiting.popleft()
                     lora_deferred.append(seq_group)
                     continue
+                if fairness.defer_admission(
+                        seq_group,
+                        waiting_seqs[0].data.get_num_uncomputed_tokens(),
+                        check_chunk=True):
+                    self.waiting.popleft()
+                    tenant_deferred.append(seq_group)
+                    continue
                 num_new_seqs = seq_group.get_max_num_running_seqs()
                 if (num_curr_seqs + num_new_seqs
                         > self.scheduler_config.max_num_seqs):
@@ -787,7 +945,8 @@ class Scheduler:
                         "router's KV handoff missed for %s",
                         num_prompt_tokens, seq_group.request_id)
                 remaining = num_prompt_tokens - start
-                size = min(remaining, slack, self._max_chunk_size)
+                size = fairness.allowed_chunk(
+                    seq_group, min(remaining, slack, self._max_chunk_size))
                 final = size == remaining
                 seq.data.update_num_computed_tokens(size)
                 if final:
@@ -799,6 +958,7 @@ class Scheduler:
                 num_curr_seqs += num_new_seqs
                 if curr_loras is not None and lora_id > 0:
                     curr_loras.add(lora_id)
+                fairness.note_admit(seq_group)
                 if seq_group.first_scheduled_time is None:
                     seq_group.first_scheduled_time = now
                     self._flight.record(seq_group.request_id, "scheduled")
@@ -806,6 +966,8 @@ class Scheduler:
                     seq_group.request_id, "prefill_start",
                     detail=f"tokens={num_prompt_tokens},chunked=1")
             for sg in reversed(lora_deferred):
+                self.waiting.appendleft(sg)
+            for sg in reversed(tenant_deferred):
                 self.waiting.appendleft(sg)
 
         num_prefill_tokens = sum(size for _, size, _ in chunks.values())

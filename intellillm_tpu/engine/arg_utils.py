@@ -50,6 +50,7 @@ class EngineArgs:
     enable_chunked_prefill: bool = True
     disable_chunked_prefill: bool = False
     replica_role: str = "mixed"
+    disable_tenant_fairness: bool = False
     # Model
     dtype: str = "auto"
     load_format: str = "auto"
@@ -174,6 +175,13 @@ class EngineArgs:
                             "--max-num-batched-tokens whole). Execution "
                             "still uses the mixed dispatch — the legacy "
                             "homogeneous prefill path is gone")
+        parser.add_argument("--disable-tenant-fairness", action="store_true",
+                            help="turn off the per-tenant weighted "
+                            "admission caps (seat + prefill-chunk-token "
+                            "shares) that stop a noisy-neighbor tenant "
+                            "from starving other tenants' decodes; with "
+                            "one tenant the caps are inactive anyway "
+                            "(see docs/multitenancy.md)")
         parser.add_argument("--dtype", type=str, default="auto",
                             choices=["auto", "bfloat16", "float32", "float16"])
         parser.add_argument("--load-format", type=str, default="auto",
@@ -280,6 +288,7 @@ class EngineArgs:
             sjf_starvation_s=self.sjf_starvation_s,
             predictor_path=self.predictor_path,
             replica_role=self.replica_role,
+            tenant_fairness=not self.disable_tenant_fairness,
         )
         lora_config = None
         if self.enable_lora:

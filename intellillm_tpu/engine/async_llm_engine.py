@@ -332,6 +332,25 @@ class AsyncLLMEngine:
         return await loop.run_in_executor(None, self.engine.import_kv,
                                           payload)
 
+    # --- multi-tenant adapter lifecycle (docs/multitenancy.md) -----------
+
+    async def load_lora_adapter(self, tenant_id: str, lora_name: str,
+                                lora_int_id: int, lora_local_path: str,
+                                weight: float = 1.0,
+                                token_share_cap=None) -> dict:
+        """Register a tenant and hot-load its adapter (POST /tenants)."""
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(
+            None, lambda: self.engine.load_lora_adapter(
+                tenant_id, lora_name, lora_int_id, lora_local_path,
+                weight=weight, token_share_cap=token_share_cap))
+
+    async def unload_lora_adapter(self, tenant_id: str) -> dict:
+        """Unregister a tenant and drop its adapter."""
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(
+            None, self.engine.unload_lora_adapter, tenant_id)
+
     def _abort(self, request_id: str) -> None:
         self._request_tracker.abort_request(request_id,
                                             verbose=self.log_requests)

@@ -365,6 +365,78 @@ class LLMEngine:
                 "num_blocks": handle.num_blocks,
                 "prefix_pos": len(handle.token_ids)}
 
+    # --- multi-tenant adapter lifecycle (docs/multitenancy.md): the API
+    # servers' POST /tenants/{id}/adapter lands here. Error conventions
+    # mirror the KV transfer handlers: ValueError -> 400, KeyError -> 404,
+    # RuntimeError -> 409. ------------------------------------------------
+
+    def load_lora_adapter(
+        self,
+        tenant_id: str,
+        lora_name: str,
+        lora_int_id: int,
+        lora_local_path: str,
+        weight: float = 1.0,
+        token_share_cap: Optional[float] = None,
+    ) -> dict:
+        """Register `tenant_id` and hot-load its adapter: validate the
+        checkpoint and warm the worker's host LRU so the tenant's first
+        request doesn't pay the disk read mid-batch. Device slot
+        activation stays per-step (set_active_loras). Re-posting the same
+        tenant updates its fairness knobs in place."""
+        from intellillm_tpu.lora.request import LoRARequest
+        from intellillm_tpu.tenancy import TenantSpec, get_tenant_registry
+        req = None
+        if lora_int_id:
+            if self.worker.lora_manager is None:
+                raise RuntimeError(
+                    "LoRA is not enabled on this engine (start with "
+                    "--enable-lora)")
+            req = LoRARequest(lora_name=lora_name, lora_int_id=lora_int_id,
+                              lora_local_path=lora_local_path)
+        spec = TenantSpec(tenant_id, lora_request=req, weight=weight,
+                          token_share_cap=token_share_cap)
+        # Register FIRST so the load/evict churn counters the hot-load
+        # emits attribute to the tenant, not the adapter-<id> fallback.
+        registry = get_tenant_registry()
+        old = registry.get(tenant_id)
+        registry.register(spec)
+        info = {"lora_int_id": 0, "active": False}
+        if req is not None:
+            try:
+                with self._kv_transfer_lock:
+                    info = self.worker.lora_manager.load_adapter(req)
+            except Exception:
+                # Roll the registration back (or restore the previous
+                # spec) so a bad checkpoint doesn't leave a
+                # half-registered tenant.
+                if old is not None:
+                    registry.register(old)
+                else:
+                    registry.unregister(tenant_id)
+                raise
+        return {"tenant": tenant_id, "weight": weight,
+                "token_share_cap": token_share_cap, **info}
+
+    def unload_lora_adapter(self, tenant_id: str) -> dict:
+        """Unregister `tenant_id` and drop its adapter from the device
+        slot table and host cache. In-flight requests already holding the
+        adapter's stacked weights finish on whatever slot data is
+        resident; new requests naming the adapter re-load from disk."""
+        from intellillm_tpu.tenancy import get_tenant_registry
+        registry = get_tenant_registry()
+        spec = registry.get(tenant_id)
+        if spec is None:
+            raise KeyError(f"unknown tenant {tenant_id!r}")
+        # Unload BEFORE unregistering: the eviction event the unload
+        # emits resolves through the registry for tenant attribution.
+        if spec.lora_int_id and self.worker.lora_manager is not None:
+            with self._kv_transfer_lock:
+                self.worker.lora_manager.unload_adapter(spec.lora_int_id)
+        registry.unregister(tenant_id)
+        return {"tenant": tenant_id, "lora_int_id": spec.lora_int_id,
+                "unloaded": True}
+
     # --- init ------------------------------------------------------------
 
     def _init_tokenizer(self, **kwargs) -> None:
@@ -947,6 +1019,17 @@ class LLMEngine:
                                      for s in seq_group.get_seqs())
                     self._slo.record_finish(seq_group.request_id,
                                             actual_len)
+                    # Per-tenant SLO attribution rides the same
+                    # exactly-once seal (docs/multitenancy.md). Lazy
+                    # import: tenancy singletons shouldn't initialise
+                    # for engines that never finish a request (tests
+                    # poking step() internals).
+                    from intellillm_tpu.tenancy import (get_tenant_registry,
+                                                        get_tenant_stats)
+                    tenant = get_tenant_registry().tenant_for_adapter(
+                        seq_group.lora_int_id)
+                    get_tenant_stats().record_finish(
+                        tenant, seq_group.request_id, actual_len)
                     # Same exactly-once seal feeds the online length
                     # calibrator; it may restamp in-flight predictions.
                     self._prediction.observe_finish(

@@ -38,6 +38,33 @@ async def health(request: web.Request) -> web.Response:
     return web.Response(status=200)
 
 
+def _resolve_lora(tenant, lora_int_id):
+    """Map a /generate body's tenant / adapter naming to the registered
+    LoRARequest (docs/multitenancy.md). Returns (lora_request, error):
+    naming an unknown tenant or unregistered adapter is a client error —
+    silently serving such traffic from the base model would misattribute
+    it to the default tenant's fairness share and SLO metrics."""
+    if tenant is None and not lora_int_id:
+        return None, None
+    from intellillm_tpu.tenancy import get_tenant_registry
+    registry = get_tenant_registry()
+    if tenant is not None:
+        spec = registry.get(tenant)
+        if spec is None:
+            return None, f"unknown tenant {tenant!r}"
+        if lora_int_id and spec.lora_int_id != int(lora_int_id):
+            return None, (f"lora_int_id {lora_int_id} does not match "
+                          f"tenant {tenant!r}'s adapter "
+                          f"({spec.lora_int_id})")
+        return spec.lora_request, None
+    lora_int_id = int(lora_int_id)
+    owner = registry.get(registry.tenant_for_adapter(lora_int_id))
+    if owner is None or owner.lora_int_id != lora_int_id:
+        return None, (f"adapter id {lora_int_id} is not registered "
+                      "(POST /tenants/{id}/adapter first)")
+    return owner.lora_request, None
+
+
 async def generate(request: web.Request) -> web.StreamResponse:
     """Generate completion for the request.
 
@@ -47,6 +74,11 @@ async def generate(request: web.Request) -> web.StreamResponse:
     prompt = request_dict.pop("prompt")
     prefix_pos = request_dict.pop("prefix_pos", None)
     stream = request_dict.pop("stream", False)
+    tenant = request_dict.pop("tenant", None)
+    lora_int_id = request_dict.pop("lora_int_id", None)
+    lora_request, lora_err = _resolve_lora(tenant, lora_int_id)
+    if lora_err is not None:
+        return web.json_response({"error": lora_err}, status=400)
     sampling_params = SamplingParams(**request_dict)
     # Honor a validated client X-Request-Id (this is how the router
     # propagates the distributed trace id — every flight-recorder event
@@ -62,6 +94,7 @@ async def generate(request: web.Request) -> web.StreamResponse:
     with request_context(request_id):
         results_generator = engine.generate(prompt, sampling_params,
                                             request_id,
+                                            lora_request=lora_request,
                                             prefix_pos=prefix_pos)
 
         if stream:
@@ -141,12 +174,49 @@ async def kv_import(request: web.Request) -> web.Response:
     return web.json_response(result)
 
 
+async def tenant_adapter(request: web.Request) -> web.Response:
+    """Tenant registration + adapter hot load/unload
+    (docs/multitenancy.md).
+
+    Body: {"lora_name", "lora_int_id", "lora_local_path",
+           "weight"?, "token_share_cap"?}  — register/load
+          {"unload": true}                 — unregister/unload"""
+    tenant_id = request.match_info["tenant_id"]
+    body = await request.json()
+    try:
+        if body.get("unload"):
+            result = await engine.unload_lora_adapter(tenant_id)
+        else:
+            cap = body.get("token_share_cap")
+            result = await engine.load_lora_adapter(
+                tenant_id,
+                lora_name=body.get("lora_name") or tenant_id,
+                lora_int_id=int(body.get("lora_int_id") or 0),
+                lora_local_path=body.get("lora_local_path") or "",
+                weight=float(body.get("weight", 1.0)),
+                token_share_cap=None if cap is None else float(cap))
+    except (ValueError, TypeError) as e:
+        return web.json_response({"error": str(e)}, status=400)
+    except KeyError as e:
+        return web.json_response({"error": str(e)}, status=404)
+    except RuntimeError as e:
+        return web.json_response({"error": str(e)}, status=409)
+    return web.json_response(result)
+
+
+async def tenants_list(request: web.Request) -> web.Response:
+    from intellillm_tpu.tenancy import get_tenant_registry
+    return web.json_response(get_tenant_registry().snapshot())
+
+
 def build_app(enable_profiling: bool = False) -> web.Application:
     app = web.Application(client_max_size=1024**3)
     app.router.add_get("/health", health)
     app.router.add_post("/generate", generate)
     app.router.add_post("/kv/export", kv_export)
     app.router.add_post("/kv/import", kv_import)
+    app.router.add_get("/tenants", tenants_list)
+    app.router.add_post("/tenants/{tenant_id}/adapter", tenant_adapter)
     # This server has no auth middleware, so the profiler admin routes
     # (which degrade serving and write traces to a caller-chosen dir)
     # stay off unless explicitly opted in.

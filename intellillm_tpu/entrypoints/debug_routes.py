@@ -250,6 +250,24 @@ def add_debug_routes(app: web.Application,
             body["kv_cache_usage"] = engine.kv_cache_usage()
         except Exception:
             body["kv_cache_usage"] = None
+        # Multi-tenant surface (docs/multitenancy.md): registrations,
+        # per-tenant SLO/goodput splits, and the device-resident adapter
+        # set. The router's adapter-affinity override and intellillm-top's
+        # TENANTS panel both read this block; emitted only when tenancy
+        # is in play (registered tenants or a LoRA-enabled worker) so
+        # single-tenant fleets pay nothing.
+        from intellillm_tpu.tenancy import (get_tenant_registry,
+                                            get_tenant_stats)
+        registry = get_tenant_registry()
+        lora_manager = getattr(getattr(engine, "worker", None),
+                               "lora_manager", None)
+        if registry.tenant_ids() or lora_manager is not None:
+            tenants_block = registry.snapshot()
+            tenants_block["stats"] = get_tenant_stats().summary()
+            tenants_block["active_adapters"] = (
+                sorted(lora_manager.list_loras())
+                if lora_manager is not None else [])
+            body["tenants"] = tenants_block
         stalled = watchdog.state == "stalled"
         if stalled:
             body["status"] = "stalled"

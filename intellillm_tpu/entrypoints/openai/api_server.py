@@ -118,6 +118,41 @@ async def auth_middleware(request: web.Request, handler):
     return await handler(request)
 
 
+async def tenant_adapter(request: web.Request) -> web.Response:
+    """Tenant registration + adapter hot load/unload
+    (docs/multitenancy.md) — admin surface; sits behind --api-key like
+    every non-health route. Same body contract as the demo server."""
+    if openai_serving_completion is None:
+        return web.json_response({"error": "engine not ready"}, status=503)
+    engine = openai_serving_completion.engine
+    tenant_id = request.match_info["tenant_id"]
+    body = await request.json()
+    try:
+        if body.get("unload"):
+            result = await engine.unload_lora_adapter(tenant_id)
+        else:
+            cap = body.get("token_share_cap")
+            result = await engine.load_lora_adapter(
+                tenant_id,
+                lora_name=body.get("lora_name") or tenant_id,
+                lora_int_id=int(body.get("lora_int_id") or 0),
+                lora_local_path=body.get("lora_local_path") or "",
+                weight=float(body.get("weight", 1.0)),
+                token_share_cap=None if cap is None else float(cap))
+    except (ValueError, TypeError) as e:
+        return web.json_response({"error": str(e)}, status=400)
+    except KeyError as e:
+        return web.json_response({"error": str(e)}, status=404)
+    except RuntimeError as e:
+        return web.json_response({"error": str(e)}, status=409)
+    return web.json_response(result)
+
+
+async def tenants_list(request: web.Request) -> web.Response:
+    from intellillm_tpu.tenancy import get_tenant_registry
+    return web.json_response(get_tenant_registry().snapshot())
+
+
 async def start_profile(request: web.Request) -> web.Response:
     """Begin a jax.profiler trace of the serving loop (view in
     TensorBoard/xprof) — admin endpoint; protect with --api-key."""
@@ -149,6 +184,8 @@ def build_app(api_key: Optional[str] = None,
     app.router.add_get("/v1/models", show_available_models)
     app.router.add_post("/v1/chat/completions", create_chat_completion)
     app.router.add_post("/v1/completions", create_completion)
+    app.router.add_get("/tenants", tenants_list)
+    app.router.add_post("/tenants/{tenant_id}/adapter", tenant_adapter)
     if enable_profiling:
         # Admin endpoints: explicit opt-in (profiling degrades serving and
         # writes trace files to a caller-chosen directory).

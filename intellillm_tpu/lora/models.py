@@ -269,6 +269,9 @@ class LoRAModelManager:
         self._use_clock = 0
         self._last_used: Dict[int, int] = {}
         self._batch_clock = 0
+        # Called with the evicted lora_id on LRU slot eviction (the
+        # worker manager wires per-tenant churn counters through this).
+        self.evict_hook = None
 
     def begin_batch(self) -> None:
         """Mark the start of a batch: adapters touched after this point are
@@ -321,6 +324,8 @@ class LoRAModelManager:
             self._last_used.pop(victim, None)
             logger.info("Evicting LoRA id=%d from slot %d (LRU)", victim,
                         slot)
+            if self.evict_hook is not None:
+                self.evict_hook(victim)
 
         r = self.max_rank
         for t, (din, dout) in self.target_dims.items():

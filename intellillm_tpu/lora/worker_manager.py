@@ -46,6 +46,20 @@ class WorkerLoRAManager:
             hidden_size=getattr(model, "hidden_size", 0),
             extra_vocab_size=lora_config.lora_extra_vocab_size,
         )
+        # Per-tenant adapter churn telemetry (docs/multitenancy.md).
+        self.device_manager.evict_hook = (
+            lambda lora_id: self._record_adapter_event(lora_id, "evict"))
+
+    def _record_adapter_event(self, lora_int_id: int, event: str) -> None:
+        # Lazy import: the lora package must stay importable without
+        # initialising the tenancy singletons (and vice versa).
+        from intellillm_tpu.tenancy import (get_tenant_registry,
+                                            get_tenant_stats)
+        tenant = get_tenant_registry().tenant_for_adapter(lora_int_id)
+        if event == "load":
+            get_tenant_stats().record_adapter_load(tenant)
+        else:
+            get_tenant_stats().record_adapter_evict(tenant)
 
     def _get_lora(self, req: LoRARequest) -> LoRAModel:
         lora = self._host_cache.get(req.lora_int_id)
@@ -55,11 +69,13 @@ class WorkerLoRAManager:
             lora = LoRAModel.from_local_checkpoint(req.lora_local_path,
                                                    self.num_layers)
             self._host_cache[req.lora_int_id] = lora
+            self._record_adapter_event(req.lora_int_id, "load")
             while len(self._host_cache) > self.lora_config.max_cpu_loras:
                 # Host eviction drops only the host copy: an adapter already
                 # activated on device is self-sufficient (deactivating here
                 # could free a slot another row of the SAME batch recorded).
-                self._host_cache.popitem(last=False)
+                evicted_id, _ = self._host_cache.popitem(last=False)
+                self._record_adapter_event(evicted_id, "evict")
         self._host_cache.move_to_end(req.lora_int_id)
         return lora
 
@@ -113,12 +129,16 @@ class WorkerLoRAManager:
         self,
         row_requests: List[Optional[LoRARequest]],
         padded_len: int,
-    ) -> Optional[Dict]:
-        """Ensure every adapter named by the batch is resident on device;
-        return the `lora` pytree for the jitted step (None if the batch
-        uses no adapters)."""
-        if not any(r is not None for r in row_requests):
-            return None
+    ) -> Dict:
+        """Ensure every adapter named by the batch is resident on device
+        and return the `lora` pytree for the jitted step.
+
+        Compile stability: ALWAYS returns the pytree, with adapter-free
+        rows pointing at the reserved all-zero slot 0. The runner's jit
+        bucket keys include `lora_state is not None`, so a LoRA-enabled
+        engine must present a structurally identical pytree every step
+        — adapter traffic then only changes data (`.at[:, slot].set`),
+        never the compiled program (no per-adapter recompiles)."""
         self.device_manager.begin_batch()
         row_slots = np.zeros(padded_len, np.int32)
         for i, req in enumerate(row_requests):
@@ -131,6 +151,32 @@ class WorkerLoRAManager:
                 row_slots[i] = dm.activate(req.lora_int_id,
                                            self._get_lora(req))
         return self.device_manager.batch_state(row_slots)
+
+    # --- hot load/unload (POST /tenants/{id}/adapter) ---------------------
+
+    def load_adapter(self, req: LoRARequest) -> Dict:
+        """Hot-load: validate the checkpoint and warm the host cache so
+        the adapter's first request doesn't pay the disk read. Device
+        slot activation stays per-batch (set_active_loras)."""
+        self.validate_request(req)
+        lora = self._get_lora(req)
+        return {
+            "lora_int_id": req.lora_int_id,
+            "rank": lora.rank,
+            "targets": lora.targets,
+            "active": self.device_manager.is_active(req.lora_int_id),
+        }
+
+    def unload_adapter(self, lora_int_id: int) -> None:
+        """Hot-unload: free the device slot and drop the host copy +
+        validation cache. A later request naming this adapter re-loads
+        and re-validates from disk."""
+        was_active = self.device_manager.is_active(lora_int_id)
+        self.device_manager.deactivate(lora_int_id)
+        in_host = self._host_cache.pop(lora_int_id, None) is not None
+        self._validated_ids.discard(lora_int_id)
+        if was_active or in_host:
+            self._record_adapter_event(lora_int_id, "evict")
 
     def list_loras(self) -> List[int]:
         return list(self.device_manager._slot_by_id)

@@ -343,10 +343,48 @@ class RouterFailoverRule(AlertRule):
             f"{delta:g} failovers in the last {self.window_s:g}s")
 
 
+class TenantNoisyNeighborRule(AlertRule):
+    """Multi-tenant isolation (docs/multitenancy.md): one tenant is
+    consuming more than `INTELLILLM_TENANT_HOG_SHARE` (default 0.6) of
+    the recent token throughput WHILE at least one other active tenant's
+    windowed TPOT p99 is over its SLO. Both legs are required — a lone
+    hot tenant on idle capacity is fine (work-conserving fairness admits
+    it on purpose), and victim SLO misses without a hog are a capacity
+    problem, not an isolation problem. Reads the process-global tenant
+    stats directly (like KVTransferStallRule) — the signal is a joint
+    condition over per-tenant windows that history series can't
+    express."""
+
+    def __init__(self, hog_share: Optional[float] = None) -> None:
+        self.hog_share = (hog_share if hog_share is not None
+                          else _env_f("INTELLILLM_TENANT_HOG_SHARE", 0.6))
+        super().__init__(
+            "tenant_noisy_neighbor", severity="warn",
+            description="one tenant dominates recent throughput "
+            f"(share > {self.hog_share:g}) while another active "
+            "tenant's TPOT p99 breaches SLO (isolation failure)")
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        from intellillm_tpu.obs.slo import get_slo_tracker
+        from intellillm_tpu.tenancy import get_tenant_stats
+        signal = get_tenant_stats().noisy_neighbor_signal(
+            get_slo_tracker().slo_tpot_ms)
+        if signal is None:
+            return None, None, "fewer than two active tenants"
+        hogging = signal["hog_share"] > self.hog_share
+        victims = signal["victims_over_slo"]
+        return hogging and bool(victims), round(signal["hog_share"], 4), (
+            f"tenant {signal['hog']!r} holds "
+            f"{signal['hog_share']:.0%} of recent tokens; "
+            f"victims over TPOT SLO: {victims or 'none'} "
+            f"({signal['active_tenants']} active tenants)")
+
+
 def built_in_rules() -> List[AlertRule]:
     return [SLOBurnRateRule(), WatchdogStallRule(), HBMHeadroomRule(),
             MFUCollapseRule(), CompileStormRule(), RouterFailoverRule(),
-            KVTransferStallRule()]
+            KVTransferStallRule(), TenantNoisyNeighborRule()]
 
 
 class _RuleState:

@@ -29,8 +29,8 @@ try:
 except ImportError:  # pragma: no cover
     _PROMETHEUS = False
 
-DECISIONS = ("affinity_hit", "affinity_new", "load_balanced", "failover",
-             "disagg_prefill")
+DECISIONS = ("affinity_hit", "affinity_new", "adapter_affinity",
+             "load_balanced", "failover", "disagg_prefill")
 
 
 class _RouterMetrics:

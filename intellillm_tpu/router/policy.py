@@ -142,9 +142,20 @@ class RoutingPolicy:
         self.affinity.drop_replica(replica_id)
 
     def choose(self, affinity_key: Optional[int],
-               loads: Dict[str, float]) -> Tuple[str, str]:
+               loads: Dict[str, float],
+               warm_replicas: Optional[set] = None) -> Tuple[str, str]:
         """Pick a replica from `loads` (healthy candidates → predicted
-        outstanding tokens). Returns (replica_id, decision)."""
+        outstanding tokens). Returns (replica_id, decision).
+
+        `warm_replicas` is the adapter-locality override
+        (docs/multitenancy.md): the subset of candidates that already
+        hold the request's LoRA adapter in a device slot. On an
+        affinity-map MISS, a warm replica within slack beats the ring
+        seed — landing on a cold replica costs an adapter activation
+        (potentially an LRU eviction churning another tenant). A map
+        HIT still wins over warmth: the mapped replica holds the
+        prompt's prefix KV *under this adapter*, which warmth alone
+        doesn't buy."""
         if not loads:
             raise NoReplicaAvailable("no healthy replica available")
         # Deterministic tie-break on id keeps tests and reasoning simple.
@@ -152,6 +163,13 @@ class RoutingPolicy:
         slack = self.config.load_balance_slack
 
         if affinity_key is None:
+            if warm_replicas:
+                warm = {r: l for r, l in loads.items()
+                        if r in warm_replicas}
+                if warm:
+                    wleast = min(warm, key=lambda r: (warm[r], r))
+                    if loads[wleast] <= loads[least] + slack:
+                        return wleast, "adapter_affinity"
             return least, "load_balanced"
 
         mapped = self.affinity.get(affinity_key)
@@ -160,6 +178,14 @@ class RoutingPolicy:
                 return mapped, "affinity_hit"
             self.affinity.put(affinity_key, least)
             return least, "load_balanced"
+
+        if warm_replicas:
+            warm = {r: l for r, l in loads.items() if r in warm_replicas}
+            if warm:
+                wleast = min(warm, key=lambda r: (warm[r], r))
+                if loads[wleast] <= loads[least] + slack:
+                    self.affinity.put(affinity_key, wleast)
+                    return wleast, "adapter_affinity"
 
         seeded = self.ring.lookup(affinity_key, loads)
         if seeded is not None and loads[seeded] <= loads[least] + slack:

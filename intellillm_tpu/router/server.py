@@ -178,6 +178,49 @@ class Router:
         if m is not None:
             m.counter_decisions.labels(decision=decision).inc()
 
+    def _payload_lora_int_id(self, payload: dict) -> int:
+        """The request's adapter id for affinity keying
+        (docs/multitenancy.md): direct `lora_int_id`, or `tenant`
+        resolved through the freshest polled replica /health/detail
+        tenants block (the registry lives engine-side; the router only
+        mirrors it). Unresolvable naming keys as the base model (id 0)
+        — the replica rejects it with a 400 on arrival."""
+        lora = payload.get("lora_int_id")
+        if lora:
+            try:
+                return int(lora)
+            except (TypeError, ValueError):
+                return 0
+        tenant = payload.get("tenant")
+        if not tenant:
+            return 0
+        for replica in self.manager.replicas.values():
+            block = (replica.last_health or {}).get("tenants") or {}
+            for spec in block.get("tenants") or []:
+                if spec.get("tenant_id") == tenant:
+                    return int(spec.get("lora_int_id") or 0)
+        return 0
+
+    def _warm_replicas(self, lora_int_id: int,
+                       loads: Dict[str, float]) -> Optional[set]:
+        """Candidates whose last (non-stale) health poll reported the
+        adapter resident in a device slot — the adapter-locality
+        override RoutingPolicy.choose applies on affinity-map misses."""
+        if not lora_int_id:
+            return None
+        stale_after_s = 3.0 * self.manager.health_interval_s
+        now = time.monotonic()
+        warm: set = set()
+        for rid in loads:
+            replica = self.manager.get(rid)
+            if (replica is None or replica.last_health_ts is None
+                    or now - replica.last_health_ts > stale_after_s):
+                continue
+            block = (replica.last_health or {}).get("tenants") or {}
+            if lora_int_id in (block.get("active_adapters") or []):
+                warm.add(rid)
+        return warm or None
+
     async def stream_request(self, payload: dict,
                              trace_id: Optional[str] = None
                              ) -> AsyncIterator[dict]:
@@ -189,8 +232,15 @@ class Router:
         id `{trace_id}#f{k}`."""
         prompt = payload.get("prompt", "")
         token_ids = self._token_ids(prompt)
+        # Adapter id is part of the affinity key — same (tokens, adapter)
+        # keying as PrefixPool / the KV-export affinity_key, so "same
+        # key" still means "same reusable prefix KV" under multi-LoRA
+        # (a prefix computed under adapter A must not attract adapter
+        # B's traffic).
+        lora_int_id = self._payload_lora_int_id(payload)
         key = prompt_affinity_key(token_ids, self.config.block_size,
-                                  self.config.affinity_blocks)
+                                  self.config.affinity_blocks,
+                                  lora_int_id=lora_int_id)
         predicted_len = self._predict_len(prompt, token_ids)
         trace_id = trace_id or random_uuid()
         self.recorder.record(trace_id, "received",
@@ -217,7 +267,9 @@ class Router:
                 disagg = False
                 loads = self.manager.healthy_loads(exclude=excluded)
             try:
-                replica_id, decision = self.policy.choose(key, loads)
+                replica_id, decision = self.policy.choose(
+                    key, loads,
+                    warm_replicas=self._warm_replicas(lora_int_id, loads))
             except NoReplicaAvailable:
                 self.recorder.record(trace_id, "aborted",
                                      detail="no_replica_available")

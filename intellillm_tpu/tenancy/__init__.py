@@ -1,0 +1,32 @@
+"""Multi-tenant multi-LoRA serving (docs/multitenancy.md).
+
+Makes `(model, adapter)` a first-class serving dimension: the registry
+maps adapters to named tenants with fairness weights, the metrics
+module exports the per-tenant `intellillm_tenant_*` SLO/goodput family,
+the scheduler's admission caps read the registry's weights, and the
+router keys prefix affinity on `(prompt, adapter)`.
+"""
+from intellillm_tpu.tenancy.metrics import TenantStats, get_tenant_stats
+from intellillm_tpu.tenancy.registry import (DEFAULT_TENANT, TenantRegistry,
+                                             TenantSpec,
+                                             adapter_fallback_tenant,
+                                             get_tenant_registry)
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "TenantRegistry",
+    "TenantSpec",
+    "TenantStats",
+    "adapter_fallback_tenant",
+    "get_tenant_registry",
+    "get_tenant_stats",
+    "reset_for_testing",
+]
+
+
+def reset_for_testing() -> None:
+    """Reset both the registry and stats singletons (test hook)."""
+    from intellillm_tpu.tenancy import metrics as _metrics
+    from intellillm_tpu.tenancy import registry as _registry
+    _metrics.reset_for_testing()
+    _registry.reset_for_testing()

@@ -1,0 +1,336 @@
+"""Per-tenant SLO/goodput telemetry for multi-LoRA serving.
+
+Every family carries a `tenant` label resolved through the tenant
+registry (unknown adapters attribute as `adapter-<id>`, base-model
+traffic as `default`), exported when `prometheus_client` is installed
+— python-side rolling state keeps the test surface and /health/detail
+working without it:
+
+    intellillm_tenant_generation_tokens_total{tenant}   counter
+    intellillm_tenant_deferred_tokens_total{tenant}     counter
+    intellillm_tenant_adapter_loads_total{tenant}       counter
+    intellillm_tenant_adapter_evictions_total{tenant}   counter
+    intellillm_tenant_tokens_per_second{tenant}         gauge
+    intellillm_tenant_goodput_ratio{tenant}             gauge
+    intellillm_tenant_ttft_ms{tenant,quantile}          gauge (p50|p99)
+    intellillm_tenant_tpot_ms{tenant,quantile}          gauge (p50|p99)
+
+`deferred_tokens` counts prompt tokens whose admission the scheduler's
+fairness caps pushed to a later step (docs/multitenancy.md); adapter
+load/evict counters come from the worker's host-LRU manager. Being
+`intellillm_*` families they are auto-sampled by the in-process metrics
+history; the `tenant_noisy_neighbor` alert rule (obs/alerts.py) reads
+this module's rolling windows directly via `noisy_neighbor_signal`.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter, Gauge
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+# Finished-request records kept per tenant for percentile windows.
+_RECORD_WINDOW = 256
+# Token-rate / noisy-neighbor lookback.
+_RATE_WINDOW_S = 60.0
+_QUANTILES = ("p50", "p99")
+
+
+class _TenantMetrics:
+    """Prometheus collectors (process-global, built once — same
+    singleton pattern as obs/kv_transfer.py)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.counter_tokens = Counter(
+            "intellillm_tenant_generation_tokens_total",
+            "Generation tokens finished per tenant.", ["tenant"])
+        self.counter_deferred = Counter(
+            "intellillm_tenant_deferred_tokens_total",
+            "Prompt tokens whose admission the scheduler's per-tenant "
+            "fairness caps deferred to a later step.", ["tenant"])
+        self.counter_adapter_loads = Counter(
+            "intellillm_tenant_adapter_loads_total",
+            "LoRA adapter loads into the worker host cache per tenant.",
+            ["tenant"])
+        self.counter_adapter_evictions = Counter(
+            "intellillm_tenant_adapter_evictions_total",
+            "LoRA adapter evictions (device slot or host cache) per "
+            "tenant.", ["tenant"])
+        self.gauge_tps = Gauge(
+            "intellillm_tenant_tokens_per_second",
+            "Generation tokens/s per tenant over the rate window.",
+            ["tenant"])
+        self.gauge_goodput = Gauge(
+            "intellillm_tenant_goodput_ratio",
+            "Fraction of the tenant's windowed finishes meeting both "
+            "TTFT and TPOT SLO targets.", ["tenant"])
+        self.gauge_ttft = Gauge(
+            "intellillm_tenant_ttft_ms",
+            "Windowed TTFT per tenant (quantile = p50 | p99).",
+            ["tenant", "quantile"])
+        self.gauge_tpot = Gauge(
+            "intellillm_tenant_tpot_ms",
+            "Windowed per-output-token latency per tenant "
+            "(quantile = p50 | p99).", ["tenant", "quantile"])
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+def _percentile(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (same math
+    as obs/slo.py)."""
+    idx = max(int(math.ceil(p / 100.0 * len(sorted_vals))) - 1, 0)
+    return sorted_vals[min(idx, len(sorted_vals) - 1)]
+
+
+class _TenantWindow:
+    """Rolling per-tenant state (caller holds the TenantStats lock)."""
+
+    def __init__(self) -> None:
+        # (ttft_ms | None, tpot_ms | None, good) per finished request.
+        self.records: Deque[Tuple[Optional[float], Optional[float], bool]] = \
+            deque(maxlen=_RECORD_WINDOW)
+        # (ts, generation_tokens) finish events for tok/s + hog share.
+        self.token_events: Deque[Tuple[float, int]] = deque()
+        self.generation_tokens_total = 0
+        self.deferred_tokens_total = 0
+        self.adapter_loads_total = 0
+        self.adapter_evictions_total = 0
+        self.finished_total = 0
+
+
+class TenantStats:
+    """Python-side per-tenant rolling windows + lifetime counters.
+
+    Thread-safe: finishes land from the engine step loop while the
+    scheduler records deferrals and HTTP handlers read summaries."""
+
+    def __init__(self, now_fn=time.monotonic,
+                 rate_window_s: float = _RATE_WINDOW_S) -> None:
+        self._now = now_fn
+        self._rate_window_s = rate_window_s
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _TenantWindow] = {}
+        self._metrics = _TenantMetrics() if _PROMETHEUS else None
+
+    # --- recording --------------------------------------------------------
+
+    def record_finish(self, tenant: str, request_id: str,
+                      num_generation_tokens: int) -> None:
+        """Attribute one finished request to `tenant` by replaying its
+        flight-recorder trace (same derivation as the global SLO
+        tracker, so per-tenant and fleet percentiles agree)."""
+        from intellillm_tpu.obs import get_flight_recorder, get_slo_tracker
+        from intellillm_tpu.obs.slo import derive_request_metrics
+        events = get_flight_recorder().get_trace(request_id)
+        if events is None:
+            return
+        rec = derive_request_metrics(events, num_generation_tokens)
+        if rec is None:
+            return
+        slo = get_slo_tracker()
+        self.observe(tenant, rec, slo_ttft_ms=slo.slo_ttft_ms,
+                     slo_tpot_ms=slo.slo_tpot_ms)
+
+    def observe(self, tenant: str, rec: Dict[str, Any], *,
+                slo_ttft_ms: float, slo_tpot_ms: float) -> None:
+        """Record one derived request record (see
+        obs/slo.derive_request_metrics for the shape)."""
+        ttft_ms = (rec["ttft_s"] * 1000.0
+                   if rec.get("ttft_s") is not None else None)
+        tpot_ms = (rec["tpot_s"] * 1000.0
+                   if rec.get("tpot_s") is not None else None)
+        tokens = int(rec.get("generation_tokens") or 0)
+        # Aborts/reroutes never produced a first token — they are not
+        # SLO-eligible, mirroring the global tracker's goodput rule.
+        eligible = rec.get("reason") not in ("abort", "rerouted") and \
+            ttft_ms is not None
+        good = bool(eligible and ttft_ms <= slo_ttft_ms
+                    and (tpot_ms is None or tpot_ms <= slo_tpot_ms))
+        now = self._now()
+        with self._lock:
+            win = self._tenants.setdefault(tenant, _TenantWindow())
+            if eligible:
+                win.records.append((ttft_ms, tpot_ms, good))
+            win.finished_total += 1
+            win.generation_tokens_total += tokens
+            win.token_events.append((now, tokens))
+            self._prune(win, now)
+            gauges = self._gauge_values(win, now) if self._metrics else None
+        if self._metrics is not None:
+            self._metrics.counter_tokens.labels(tenant).inc(tokens)
+            self._export_gauges(tenant, gauges)
+
+    def record_deferred(self, tenant: str, num_tokens: int) -> None:
+        if num_tokens <= 0:
+            return
+        with self._lock:
+            win = self._tenants.setdefault(tenant, _TenantWindow())
+            win.deferred_tokens_total += int(num_tokens)
+        if self._metrics is not None:
+            self._metrics.counter_deferred.labels(tenant).inc(num_tokens)
+
+    def record_adapter_load(self, tenant: str) -> None:
+        with self._lock:
+            win = self._tenants.setdefault(tenant, _TenantWindow())
+            win.adapter_loads_total += 1
+        if self._metrics is not None:
+            self._metrics.counter_adapter_loads.labels(tenant).inc()
+
+    def record_adapter_evict(self, tenant: str) -> None:
+        with self._lock:
+            win = self._tenants.setdefault(tenant, _TenantWindow())
+            win.adapter_evictions_total += 1
+        if self._metrics is not None:
+            self._metrics.counter_adapter_evictions.labels(tenant).inc()
+
+    # --- read side --------------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        """Per-tenant block for /health/detail and serve_bench."""
+        now = self._now()
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for tenant, win in sorted(self._tenants.items()):
+                self._prune(win, now)
+                vals = self._gauge_values(win, now)
+                out[tenant] = {
+                    "finished": win.finished_total,
+                    "generation_tokens": win.generation_tokens_total,
+                    "deferred_tokens": win.deferred_tokens_total,
+                    "adapter_loads": win.adapter_loads_total,
+                    "adapter_evictions": win.adapter_evictions_total,
+                    "tokens_per_second": round(vals["tps"], 3),
+                    "goodput_ratio": (round(vals["goodput"], 4)
+                                      if vals["goodput"] is not None
+                                      else None),
+                    "ttft_ms": vals["ttft"],
+                    "tpot_ms": vals["tpot"],
+                }
+        return out
+
+    def noisy_neighbor_signal(self, slo_tpot_ms: float
+                              ) -> Optional[Dict[str, Any]]:
+        """Hog detection over the rate window: which tenant ate the
+        largest generation-token share, and which other active tenants
+        are currently blowing their TPOT SLO. None until at least two
+        tenants produced tokens in the window (a lone tenant cannot be
+        a noisy neighbor)."""
+        now = self._now()
+        shares: Dict[str, int] = {}
+        victims: List[str] = []
+        with self._lock:
+            for tenant, win in self._tenants.items():
+                self._prune(win, now)
+                recent = sum(n for _, n in win.token_events)
+                if recent > 0:
+                    shares[tenant] = recent
+            if len(shares) < 2:
+                return None
+            total = sum(shares.values())
+            hog = max(shares, key=lambda t: (shares[t], t))
+            for tenant in shares:
+                if tenant == hog:
+                    continue
+                tpots = sorted(
+                    r[1] for r in self._tenants[tenant].records
+                    if r[1] is not None)
+                if tpots and _percentile(tpots, 99.0) > slo_tpot_ms:
+                    victims.append(tenant)
+        return {
+            "hog": hog,
+            "hog_share": shares[hog] / total,
+            "active_tenants": len(shares),
+            "victims_over_slo": sorted(victims),
+        }
+
+    # --- internals (lock held) --------------------------------------------
+
+    def _prune(self, win: _TenantWindow, now: float) -> None:
+        cutoff = now - self._rate_window_s
+        while win.token_events and win.token_events[0][0] < cutoff:
+            win.token_events.popleft()
+
+    def _gauge_values(self, win: _TenantWindow, now: float
+                      ) -> Dict[str, Any]:
+        recent_tokens = sum(n for _, n in win.token_events)
+        if win.token_events:
+            span = max(now - win.token_events[0][0], 1e-3)
+            tps = recent_tokens / span
+        else:
+            tps = 0.0
+        ttfts = sorted(r[0] for r in win.records if r[0] is not None)
+        tpots = sorted(r[1] for r in win.records if r[1] is not None)
+        goods = [r[2] for r in win.records]
+        return {
+            "tps": tps,
+            "goodput": (sum(goods) / len(goods)) if goods else None,
+            "ttft": {q: round(_percentile(ttfts, p), 3)
+                     for q, p in (("p50", 50.0), ("p99", 99.0))
+                     } if ttfts else None,
+            "tpot": {q: round(_percentile(tpots, p), 3)
+                     for q, p in (("p50", 50.0), ("p99", 99.0))
+                     } if tpots else None,
+        }
+
+    def _export_gauges(self, tenant: str,
+                       vals: Optional[Dict[str, Any]]) -> None:
+        if vals is None or self._metrics is None:
+            return
+        m = self._metrics
+        m.gauge_tps.labels(tenant).set(vals["tps"])
+        if vals["goodput"] is not None:
+            m.gauge_goodput.labels(tenant).set(vals["goodput"])
+        for q in _QUANTILES:
+            if vals["ttft"] is not None:
+                m.gauge_ttft.labels(tenant, q).set(vals["ttft"][q])
+            if vals["tpot"] is not None:
+                m.gauge_tpot.labels(tenant, q).set(vals["tpot"][q])
+
+
+_STATS: Optional[TenantStats] = None
+_STATS_LOCK = threading.Lock()
+
+
+def get_tenant_stats() -> TenantStats:
+    global _STATS
+    if _STATS is None:
+        with _STATS_LOCK:
+            if _STATS is None:
+                _STATS = TenantStats()
+    return _STATS
+
+
+def reset_for_testing() -> None:
+    global _STATS
+    _TenantMetrics.reset_for_testing()
+    _STATS = None

@@ -1,0 +1,168 @@
+"""Tenant registry — the process-global (model, adapter) → tenant map.
+
+A *tenant* is the unit of isolation for multi-LoRA serving
+(docs/multitenancy.md): it owns at most one LoRA adapter, a fairness
+`weight` used by the scheduler's admission caps, and an optional
+`token_share_cap` tightening its share further. Registration happens
+over `POST /tenants/{id}/adapter` on the API servers (which also
+hot-loads the adapter into the worker's host LRU); the scheduler,
+engine finish hook, and router all resolve requests back to a tenant
+through this registry.
+
+Requests that never registered still get attributed: adapter id 0 (the
+reserved all-zero slot) maps to the `default` tenant and unknown
+nonzero adapters to `adapter-<id>`, so per-tenant metrics and fairness
+never lose traffic on the floor.
+
+Thread-safe: HTTP handlers register/unregister from executor threads
+while the engine step loop resolves tenants per batch.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+DEFAULT_TENANT = "default"
+
+
+def adapter_fallback_tenant(lora_int_id: int) -> str:
+    """Tenant name for an adapter nobody registered."""
+    return DEFAULT_TENANT if not lora_int_id else f"adapter-{lora_int_id}"
+
+
+@dataclass
+class TenantSpec:
+    """One tenant's registration: adapter identity + fairness knobs.
+
+    `lora_request` is the `lora.request.LoRARequest` attached to every
+    generation the tenant submits (None for a base-model tenant).
+    `weight` is the relative share used by the scheduler's weighted
+    seat caps; `token_share_cap` (0, 1] optionally caps the tenant's
+    seat/chunk share below its weighted entitlement.
+    """
+
+    tenant_id: str
+    lora_request: Optional[Any] = None
+    weight: float = 1.0
+    token_share_cap: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if not self.tenant_id or not isinstance(self.tenant_id, str):
+            raise ValueError("tenant_id must be a non-empty string")
+        if not (self.weight > 0):
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: weight must be > 0, "
+                f"got {self.weight}")
+        if self.token_share_cap is not None and not (
+                0 < self.token_share_cap <= 1):
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: token_share_cap must be in "
+                f"(0, 1], got {self.token_share_cap}")
+
+    @property
+    def lora_int_id(self) -> int:
+        return (self.lora_request.lora_int_id
+                if self.lora_request is not None else 0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "tenant_id": self.tenant_id,
+            "lora_int_id": self.lora_int_id,
+            "lora_name": (self.lora_request.lora_name
+                          if self.lora_request is not None else None),
+            "weight": self.weight,
+            "token_share_cap": self.token_share_cap,
+        }
+
+
+class TenantRegistry:
+    """Thread-safe tenant table + adapter-id reverse index."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, TenantSpec] = {}
+        self._by_adapter: Dict[int, str] = {}
+
+    def register(self, spec: TenantSpec) -> None:
+        """Insert or replace a tenant. One adapter id belongs to at most
+        one tenant (ValueError otherwise) — affinity keys and slot
+        attribution would be ambiguous."""
+        with self._lock:
+            owner = self._by_adapter.get(spec.lora_int_id)
+            if (spec.lora_int_id and owner is not None
+                    and owner != spec.tenant_id):
+                raise ValueError(
+                    f"adapter id {spec.lora_int_id} is already registered "
+                    f"to tenant {owner!r}")
+            old = self._tenants.get(spec.tenant_id)
+            if old is not None and old.lora_int_id:
+                self._by_adapter.pop(old.lora_int_id, None)
+            self._tenants[spec.tenant_id] = spec
+            if spec.lora_int_id:
+                self._by_adapter[spec.lora_int_id] = spec.tenant_id
+        logger.info("Registered tenant %s (adapter=%d weight=%.2f cap=%s).",
+                    spec.tenant_id, spec.lora_int_id, spec.weight,
+                    spec.token_share_cap)
+
+    def unregister(self, tenant_id: str) -> TenantSpec:
+        """Remove a tenant; KeyError when unknown (HTTP 404)."""
+        with self._lock:
+            spec = self._tenants.pop(tenant_id, None)
+            if spec is None:
+                raise KeyError(f"unknown tenant {tenant_id!r}")
+            if spec.lora_int_id:
+                self._by_adapter.pop(spec.lora_int_id, None)
+        logger.info("Unregistered tenant %s.", tenant_id)
+        return spec
+
+    def get(self, tenant_id: str) -> Optional[TenantSpec]:
+        with self._lock:
+            return self._tenants.get(tenant_id)
+
+    def tenant_for_adapter(self, lora_int_id: int) -> str:
+        """Resolve an adapter id to its tenant name, falling back to
+        `default` (id 0) / `adapter-<id>` so attribution never fails."""
+        with self._lock:
+            tenant = self._by_adapter.get(lora_int_id)
+        return tenant if tenant is not None else adapter_fallback_tenant(
+            lora_int_id)
+
+    def weight_for(self, tenant_id: str) -> float:
+        spec = self.get(tenant_id)
+        return spec.weight if spec is not None else 1.0
+
+    def share_cap_for(self, tenant_id: str) -> Optional[float]:
+        spec = self.get(tenant_id)
+        return spec.token_share_cap if spec is not None else None
+
+    def tenant_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            specs = [s.snapshot() for _, s in sorted(self._tenants.items())]
+        return {"tenants": specs}
+
+
+_REGISTRY: Optional[TenantRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def get_tenant_registry() -> TenantRegistry:
+    global _REGISTRY
+    if _REGISTRY is None:
+        with _REGISTRY_LOCK:
+            if _REGISTRY is None:
+                _REGISTRY = TenantRegistry()
+    return _REGISTRY
+
+
+def reset_for_testing() -> None:
+    global _REGISTRY
+    _REGISTRY = None

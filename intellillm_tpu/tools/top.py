@@ -278,6 +278,8 @@ def render_frame(health: Optional[Dict[str, Any]],
 
     lines.extend(_spec_lines(health.get("spec")))
 
+    lines.extend(_tenant_lines(health.get("tenants")))
+
     lines.extend(_alerts_lines(alerts))
 
     lines.extend(_slowest_lines(slo.get("slowest") or []))
@@ -332,6 +334,41 @@ def _spec_lines(spec: Optional[Dict[str, Any]]) -> List[str]:
         f"emitted {totals.get('emitted_tokens', 0)}",
     ]
     return ["", "Spec decode: " + "  ".join(parts)]
+
+
+def _tenant_lines(tenants: Optional[Dict[str, Any]]) -> List[str]:
+    """TENANTS panel from /health/detail's tenants block
+    (docs/multitenancy.md). Absent key = single-tenant serving (no
+    registrations, no LoRA manager). One row per tenant with traffic,
+    plus the device-resident adapter count."""
+    if not tenants:
+        return []
+    stats = tenants.get("stats") or {}
+    active = tenants.get("active_adapters") or []
+    registered = tenants.get("tenants") or []
+    if not stats and not registered:
+        return []
+    lines = ["", f"Tenants ({len(registered)} registered, "
+             f"{len(active)} adapter{'s' if len(active) != 1 else ''} "
+             "on device):"]
+    if not stats:
+        lines.append("  (no finished requests yet)")
+        return lines
+    width = max(len(t) for t in stats)
+    for tenant in sorted(stats):
+        row = stats[tenant] or {}
+        tpot = row.get("tpot_ms") or {}
+        tpot_s = (f"{tpot.get('p99'):.0f}" if isinstance(
+            tpot.get("p99"), (int, float)) else "n/a")
+        lines.append(
+            f"  {tenant.ljust(width)}  "
+            f"tok/s {row.get('tokens_per_second', 0):>7.1f}  "
+            f"goodput {_pct(row.get('goodput_ratio'))}  "
+            f"TPOT-p99 {tpot_s}ms  "
+            f"deferred {row.get('deferred_tokens', 0)}  "
+            f"churn {row.get('adapter_loads', 0)}/"
+            f"{row.get('adapter_evictions', 0)}")
+    return lines
 
 
 def _efficiency_lines(eff: Dict[str, Any]) -> List[str]:

@@ -655,9 +655,14 @@ class ModelRunner:
         sentinel (the sentinel would otherwise scatter into a REAL
         extra-token column)."""
         lora_state = None
-        if self.lora_manager is not None and row_loras is not None:
-            lora_state = self.lora_manager.set_active_loras(row_loras,
-                                                            padded_n)
+        if self.lora_manager is not None:
+            # Compile stability: a LoRA-enabled engine passes the pytree
+            # on EVERY step (row_loras None means "no adapter rows" —
+            # all rows ride the reserved all-zero slot 0), so the jit
+            # bucket key's `lora_state is not None` toggle never flips
+            # and adapter traffic can't mint new executables.
+            lora_state = self.lora_manager.set_active_loras(
+                row_loras if row_loras is not None else [], padded_n)
         eff_vocab = self.vocab_size
         if lora_state is not None and "vocab" in lora_state:
             eff_vocab += lora_state["vocab"]["extra_embed"].shape[1]

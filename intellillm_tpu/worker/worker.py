@@ -332,6 +332,13 @@ class Worker:
             widths = buckets[:2] if full else buckets[:1]
             for b in batch_sizes:
                 zeros_i = place(np.zeros((b, 1), np.int32))
+                # A LoRA-enabled engine passes the lora pytree on EVERY
+                # step (slot-0 zero adapter when no rows carry one), so
+                # warm-up must too — otherwise the warmed executables
+                # (lora=None structure) never match serving and the
+                # first real step recompiles mid-serving.
+                lora = (runner.lora_manager.set_active_loras([], b)
+                        if runner.lora_manager is not None else None)
                 for w in widths:
                     args = (place(np.zeros((b, 1), np.int32)), zeros_i,
                             place(np.zeros((b, w), np.int32)),
@@ -343,7 +350,8 @@ class Worker:
                             place(np.zeros(b, np.uint32)),
                             place(np.zeros(b, np.float32)),
                             place(np.zeros(b, np.float32)),
-                            place(np.ones(b, np.float32)), None, None)
+                            place(np.ones(b, np.float32)), None, None,
+                            lora)
                     for flags in flag_variants:
                         packed, caches = runner._jit_decode_single(
                             self.params, self.cache_engine.device_cache,
@@ -358,8 +366,8 @@ class Worker:
                             # request doesn't trigger a full XLA compile
                             # mid-serving.
                             m = pad_to_bucket(1, buckets)
-                            fargs = args + (None,
-                                            place(np.zeros(m, np.int32)))
+                            fargs = args + (
+                                place(np.zeros(m, np.int32)), )
                             packed, _fetched, caches = \
                                 runner._jit_decode_single(
                                     self.params,

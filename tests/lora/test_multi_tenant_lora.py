@@ -1,0 +1,226 @@
+"""Multi-tenant multi-LoRA serving tests (docs/multitenancy.md).
+
+Covers the tenancy-facing contracts of the LoRA subsystem: slot-0 rows
+through a LoRA-ENABLED engine are bit-identical to a LoRA-off engine
+(the reserved all-zero slot adds exact 0.0), the worker manager's LRU
+churn under slot pressure never unpins adapters referenced by the
+current batch, device/host evictions attribute to the owning tenant's
+churn counters, and adapter traffic compiles no new executables beyond
+the shape buckets the base engine already owns.
+"""
+import pytest
+
+from intellillm_tpu import tenancy
+from intellillm_tpu.config import LoRAConfig
+from intellillm_tpu.lora.request import LoRARequest
+from intellillm_tpu.lora.worker_manager import WorkerLoRAManager
+from intellillm_tpu.sampling_params import SamplingParams
+from intellillm_tpu.tenancy import TenantSpec, get_tenant_registry
+
+from tests.lora.test_lora import _NUM_LAYERS, _greedy_tokens, make_adapter
+
+
+@pytest.fixture(autouse=True)
+def clean_tenancy():
+    tenancy.reset_for_testing()
+    yield
+    tenancy.reset_for_testing()
+
+
+@pytest.fixture(scope="module")
+def mt_setup(tmp_path_factory):
+    """Tiny base llama + three small adapters (q/v only, rank 4)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    root = tmp_path_factory.mktemp("mt-lora")
+    base = str(root / "base")
+    from tests.conftest import _build_word_tokenizer
+    _, vocab_size = _build_word_tokenizer(base)
+    torch.manual_seed(0)
+    config = LlamaConfig(
+        vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6, pad_token_id=0,
+        eos_token_id=1, bos_token_id=1, tie_word_embeddings=False,
+        torch_dtype=torch.float32)
+    LlamaForCausalLM(config).eval().save_pretrained(
+        base, safe_serialization=True)
+    ads = {
+        i: make_adapter(str(root / f"ad{i}"), seed=30 + i, rank=4,
+                        alpha=8.0, targets=("q_proj", "v_proj"))
+        for i in (1, 2, 3)
+    }
+    return dict(base=base, adapters=ads)
+
+
+# --- satellite: slot-0 bit-equality --------------------------------------
+
+
+def test_slot0_rows_bit_identical_to_lora_off_engine(mt_setup,
+                                                     example_prompts):
+    """Enabling LoRA must be free for base-model tenants: the same
+    prompts through a LoRA-enabled engine WITHOUT an adapter emit
+    token-for-token the outputs of a plain engine (slot 0's zero
+    einsum adds exact 0.0 — greedy argmax cannot flip)."""
+    prompts = example_prompts[:3]
+    golden = _greedy_tokens(mt_setup["base"], prompts)
+    via_lora_engine = _greedy_tokens(mt_setup["base"], prompts,
+                                     enable_lora=True, max_loras=2,
+                                     max_lora_rank=8)
+    assert via_lora_engine == golden
+
+
+def test_nonzero_adapter_changes_greedy_output(mt_setup, example_prompts):
+    """Sanity leg of the bit-equality golden: the adapter path is live —
+    a real (random, scaled) adapter flips at least one greedy token."""
+    from intellillm_tpu.entrypoints.llm import LLM
+
+    prompts = example_prompts[:3]
+    golden = _greedy_tokens(mt_setup["base"], prompts)
+    llm = LLM(model=mt_setup["base"], max_model_len=64,
+              num_device_blocks_override=64, enable_lora=True, max_loras=2,
+              max_lora_rank=8)
+    outs = llm.generate(
+        prompts, SamplingParams(temperature=0.0, max_tokens=8),
+        lora_request=LoRARequest("ad1", 1, mt_setup["adapters"][1]))
+    adapted = [o.outputs[0].token_ids for o in outs]
+    assert adapted != golden
+
+
+# --- satellite: worker-manager LRU churn under slot pressure --------------
+
+
+class _FakeLoRAModel:
+    supports_lora = True
+    num_layers = _NUM_LAYERS
+    hidden_size = 64
+
+    class config:
+        vocab_size = 0
+
+    def lora_target_dims(self):
+        return {"q": (64, 64), "v": (64, 32)}
+
+
+def _manager(max_loras=2, max_cpu_loras=None):
+    cfg = LoRAConfig(max_lora_rank=8, max_loras=max_loras,
+                     max_cpu_loras=max_cpu_loras, lora_dtype="float32",
+                     lora_extra_vocab_size=0)
+    return WorkerLoRAManager(_FakeLoRAModel(), cfg)
+
+
+def _req(mt_setup, i):
+    return LoRARequest(f"ad{i}", i, mt_setup["adapters"][i])
+
+
+def test_lru_churn_keeps_in_flight_slots_pinned(mt_setup):
+    """Evict/reload under slot pressure: the batch-spanning adapter
+    keeps its slot across the churn; only the LRU non-batch adapter is
+    evicted; a batch needing more adapters than slots fails loudly
+    instead of silently corrupting a pinned row."""
+    mgr = _manager(max_loras=2, max_cpu_loras=4)
+    r1, r2, r3 = (_req(mt_setup, i) for i in (1, 2, 3))
+
+    state = mgr.set_active_loras([r1, r2, None, None], 4)
+    slots1 = [int(x) for x in state["row_slots"]]
+    assert sorted(slots1[:2]) == [1, 2] and slots1[2:] == [0, 0]
+
+    # Next batch touches ad1 first then needs a slot for ad3: ad2 (LRU,
+    # not in this batch) is evicted; ad1's slot must not move.
+    state = mgr.set_active_loras([r1, r3], 2)
+    slots2 = [int(x) for x in state["row_slots"]]
+    assert slots2[0] == slots1[0], "in-flight adapter's slot moved"
+    assert slots2[1] == slots1[1], "freed slot should be reused for ad3"
+    dm = mgr.device_manager
+    assert dm.is_active(1) and dm.is_active(3) and not dm.is_active(2)
+
+    # Three distinct adapters in ONE batch with two slots: every
+    # resident adapter is pinned by this batch → loud failure.
+    with pytest.raises(RuntimeError, match="pinned by the current batch"):
+        mgr.set_active_loras([r1, r2, r3], 3)
+
+
+def test_reload_after_eviction_round_trips(mt_setup):
+    """An evicted adapter reactivates correctly from the host cache
+    (same weights land in whatever slot it gets)."""
+    import numpy as np
+    mgr = _manager(max_loras=1, max_cpu_loras=4)
+    r1, r2 = _req(mt_setup, 1), _req(mt_setup, 2)
+    mgr.set_active_loras([r1], 1)
+    a_before = np.asarray(mgr.device_manager.a_stacks["q"][:, 1]).copy()
+    mgr.set_active_loras([r2], 1)           # evicts ad1
+    mgr.set_active_loras([r1], 1)           # reloads ad1
+    a_after = np.asarray(mgr.device_manager.a_stacks["q"][:, 1])
+    np.testing.assert_array_equal(a_before, a_after)
+
+
+def test_adapter_churn_attributes_to_tenant(mt_setup):
+    """Device-slot and host-cache evictions count against the OWNING
+    tenant (registry-resolved), not the tenant that triggered them."""
+    for i, tid in ((1, "acme"), (2, "globex")):
+        get_tenant_registry().register(
+            TenantSpec(tid, lora_request=_req(mt_setup, i)))
+    mgr = _manager(max_loras=1, max_cpu_loras=2)
+    r1, r2, r3 = (_req(mt_setup, i) for i in (1, 2, 3))
+
+    mgr.set_active_loras([r1], 1)
+    mgr.set_active_loras([r2], 1)   # device-evicts ad1 (acme)
+    summary = tenancy.get_tenant_stats().summary()
+    assert summary["acme"]["adapter_loads"] == 1
+    assert summary["acme"]["adapter_evictions"] == 1
+    assert summary["globex"]["adapter_loads"] == 1
+    assert summary["globex"]["adapter_evictions"] == 0
+
+    # ad3 is nobody's adapter: its host load attributes to the fallback
+    # tenant, and the host-cache eviction it forces (max_cpu_loras=2,
+    # LRU is ad1) lands on acme again.
+    mgr.set_active_loras([r3], 1)
+    summary = tenancy.get_tenant_stats().summary()
+    assert summary["adapter-3"]["adapter_loads"] == 1
+    assert summary["acme"]["adapter_evictions"] == 2
+    assert summary["globex"]["adapter_evictions"] == 1  # device evict
+
+
+def test_hot_unload_frees_slot_and_counts_eviction(mt_setup):
+    get_tenant_registry().register(
+        TenantSpec("acme", lora_request=_req(mt_setup, 1)))
+    mgr = _manager(max_loras=2, max_cpu_loras=4)
+    mgr.set_active_loras([_req(mt_setup, 1)], 1)
+    mgr.unload_adapter(1)
+    assert not mgr.device_manager.is_active(1)
+    assert mgr.list_loras() == []
+    summary = tenancy.get_tenant_stats().summary()
+    assert summary["acme"]["adapter_evictions"] == 1
+    # Unloading an absent adapter is a no-op, not a double count.
+    mgr.unload_adapter(1)
+    assert tenancy.get_tenant_stats().summary()[
+        "acme"]["adapter_evictions"] == 1
+
+
+# --- compile stability: no per-adapter executables ------------------------
+
+
+def test_no_new_executables_for_adapter_traffic(mt_setup, example_prompts):
+    """The recompile-hazard contract (worker/model_runner.py): once the
+    LoRA-enabled engine has compiled a shape bucket, serving ANY
+    adapter through that bucket is a cache hit — `row_slots` and the
+    stacked A/B tensors are data, never part of the jit key."""
+    from intellillm_tpu.entrypoints.llm import LLM
+    from intellillm_tpu.obs import get_compile_tracker
+
+    prompt = example_prompts[0]
+    params = SamplingParams(temperature=0.0, max_tokens=8)
+    llm = LLM(model=mt_setup["base"], max_model_len=64,
+              num_device_blocks_override=64, enable_lora=True, max_loras=2,
+              max_lora_rank=8)
+    # Warm the shape buckets once with base-model (slot 0) traffic.
+    llm.generate([prompt], params)
+    baseline = get_compile_tracker().snapshot()["compiles"]
+
+    for i in (1, 2, 1):
+        llm.generate([prompt], params,
+                     lora_request=_req(mt_setup, i))
+    after = get_compile_tracker().snapshot()["compiles"]
+    assert after == baseline, (
+        f"adapter traffic minted new executables: {baseline} -> {after}")

@@ -221,7 +221,7 @@ def test_built_in_catalogue_names_and_severities():
     assert set(rules) == {"slo_burn_rate", "watchdog_stall",
                           "hbm_headroom", "mfu_collapse",
                           "compile_storm", "router_failover",
-                          "kv_transfer_stall"}
+                          "kv_transfer_stall", "tenant_noisy_neighbor"}
     pages = {n for n, r in rules.items() if r.severity == "page"}
     assert pages == {"slo_burn_rate", "watchdog_stall", "hbm_headroom"}
 
@@ -265,3 +265,59 @@ def test_summary_is_compact():
     assert s["page_firing"] is True
     assert s["counts"]["firing"] == 1
     assert "rules" not in s
+
+
+def test_tenant_noisy_neighbor_rule_joint_condition():
+    """tenant_noisy_neighbor (docs/multitenancy.md) fires only on the
+    JOINT condition: one tenant over the share threshold AND another
+    active tenant over its TPOT SLO. Either leg alone stays quiet."""
+    from intellillm_tpu import tenancy
+    from intellillm_tpu.obs.alerts import TenantNoisyNeighborRule
+    from intellillm_tpu.obs.slo import get_slo_tracker
+    from intellillm_tpu.tenancy import metrics as tmetrics
+
+    tenancy.reset_for_testing()
+    try:
+        clock = _Clock(t=100.0)
+        stats = tmetrics.TenantStats(now_fn=clock)
+        tmetrics._STATS = stats
+        rule = TenantNoisyNeighborRule(hog_share=0.6)
+        slo_tpot_ms = get_slo_tracker().slo_tpot_ms
+        slo = dict(slo_ttft_ms=1e9, slo_tpot_ms=1e9)
+
+        # Single tenant: no data, not a clean pass.
+        fired, value, detail = rule.evaluate(None, clock())
+        assert fired is None and "fewer than two" in detail
+
+        # Hog dominates but the victim is healthy: no isolation failure.
+        def rec(tpot_ms, tokens):
+            return {"ttft_s": 0.01, "tpot_s": tpot_ms / 1e3,
+                    "generation_tokens": tokens}
+        stats.observe("hog", rec(1.0, 900), **slo)
+        stats.observe("victim", rec(slo_tpot_ms * 0.5, 100), **slo)
+        fired, value, _ = rule.evaluate(None, clock())
+        assert fired is False
+        assert value == pytest.approx(0.9)
+
+        # Victim's TPOT p99 breaches SLO while the hog holds the share:
+        # fires, valued at the hog's token share.
+        stats.observe("victim", rec(slo_tpot_ms * 10, 100), **slo)
+        fired, value, detail = rule.evaluate(None, clock())
+        assert fired is True
+        assert "victim" in detail and "hog" in detail
+
+        # Victim over SLO but throughput balanced (no hog): capacity
+        # problem, not an isolation problem.
+        balanced = tmetrics.TenantStats(now_fn=clock)
+        tmetrics._STATS = balanced
+        balanced.observe("a", rec(1.0, 500), **slo)
+        balanced.observe("b", rec(slo_tpot_ms * 10, 500), **slo)
+        fired, _, _ = rule.evaluate(None, clock())
+        assert fired is False
+    finally:
+        tenancy.reset_for_testing()
+
+
+def test_tenant_rule_in_built_ins():
+    names = [r.name for r in built_in_rules()]
+    assert "tenant_noisy_neighbor" in names

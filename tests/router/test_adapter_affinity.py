@@ -1,0 +1,109 @@
+"""Adapter-aware routing tests (docs/multitenancy.md): the affinity
+key includes `lora_int_id` (a prefix computed under adapter X is not
+the same cache entry as under adapter Y), and the policy's
+adapter-locality override prefers replicas that already hold the
+request's adapter in a device slot."""
+import pytest
+
+from intellillm_tpu.affinity import affinity_key, prompt_affinity_key
+from intellillm_tpu.router.metrics import _RouterMetrics
+from intellillm_tpu.router.policy import RouterConfig, RoutingPolicy
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    _RouterMetrics.reset_for_testing()
+    yield
+    _RouterMetrics.reset_for_testing()
+
+
+def _policy(replicas=("r0", "r1", "r2"), slack=256.0):
+    policy = RoutingPolicy(RouterConfig(load_balance_slack=slack))
+    for r in replicas:
+        policy.add_replica(r)
+    return policy
+
+
+# --- satellite: lora_int_id is part of the routing key --------------------
+
+
+def test_affinity_key_includes_adapter_id():
+    """Regression: the same prompt under different adapters must map to
+    DIFFERENT keys — their prefix KV is not interchangeable — while the
+    same (prompt, adapter) pair stays stable."""
+    tokens = list(range(64))
+    base = prompt_affinity_key(tokens, block_size=16, max_blocks=4)
+    ad1 = prompt_affinity_key(tokens, block_size=16, max_blocks=4,
+                              lora_int_id=1)
+    ad2 = prompt_affinity_key(tokens, block_size=16, max_blocks=4,
+                              lora_int_id=2)
+    assert len({base, ad1, ad2}) == 3
+    assert ad1 == prompt_affinity_key(tokens, block_size=16, max_blocks=4,
+                                      lora_int_id=1)
+    # Default matches the explicit no-adapter id (old callers unchanged).
+    assert base == prompt_affinity_key(tokens, block_size=16, max_blocks=4,
+                                       lora_int_id=0)
+    assert affinity_key(tokens, 7) != affinity_key(tokens, 8)
+
+
+def test_adapter_keys_route_independently():
+    """Two tenants sharing a prompt template concentrate on (possibly)
+    different replicas, and each key's placement is sticky."""
+    policy = _policy()
+    tokens = list(range(32))
+    loads = {"r0": 0.0, "r1": 0.0, "r2": 0.0}
+    key1 = prompt_affinity_key(tokens, lora_int_id=1)
+    key2 = prompt_affinity_key(tokens, lora_int_id=2)
+    r_ad1, d1 = policy.choose(key1, dict(loads))
+    r_ad2, d2 = policy.choose(key2, dict(loads))
+    assert d1 == d2 == "affinity_new"
+    assert policy.choose(key1, dict(loads)) == (r_ad1, "affinity_hit")
+    assert policy.choose(key2, dict(loads)) == (r_ad2, "affinity_hit")
+
+
+# --- adapter-locality override in the policy ------------------------------
+
+
+def test_keyless_request_prefers_warm_replica():
+    policy = _policy(slack=10.0)
+    loads = {"r0": 0.0, "r1": 5.0, "r2": 20.0}
+    # No warmth info: plain least-loaded.
+    assert policy.choose(None, loads) == ("r0", "load_balanced")
+    # r1 already holds the adapter and is within slack of r0: warmth
+    # wins (activation on r0 would churn a slot).
+    assert policy.choose(None, loads, warm_replicas={"r1"}) == (
+        "r1", "adapter_affinity")
+    # A warm replica beyond the slack loses to load balancing.
+    assert policy.choose(None, loads, warm_replicas={"r2"}) == (
+        "r0", "load_balanced")
+
+
+def test_map_miss_seeds_to_warm_replica_and_sticks():
+    policy = _policy(slack=10.0)
+    loads = {"r0": 0.0, "r1": 5.0, "r2": 6.0}
+    key = prompt_affinity_key(list(range(32)), lora_int_id=3)
+    picked, decision = policy.choose(key, loads, warm_replicas={"r1"})
+    assert (picked, decision) == ("r1", "adapter_affinity")
+    # The override wrote the affinity map: the next request with this
+    # key is a plain hit even with no warmth info (e.g. adapter since
+    # evicted — the prefix KV is still there).
+    assert policy.choose(key, loads) == ("r1", "affinity_hit")
+
+
+def test_map_hit_beats_warmth():
+    """A mapped replica holds the prompt's prefix KV *under this
+    adapter* — warmth elsewhere must not steal the request."""
+    policy = _policy(slack=10.0)
+    loads = {"r0": 0.0, "r1": 0.0, "r2": 0.0}
+    key = prompt_affinity_key(list(range(32)), lora_int_id=1)
+    mapped, _ = policy.choose(key, loads)
+    others = {r for r in loads if r != mapped}
+    assert policy.choose(key, loads, warm_replicas=others) == (
+        mapped, "affinity_hit")
+
+
+def test_adapter_affinity_is_a_counted_decision():
+    """The decision taxonomy in router metrics includes the new label
+    (observability docs list it; the counter family is pre-registered)."""
+    from intellillm_tpu.router.metrics import DECISIONS
+    assert "adapter_affinity" in DECISIONS

@@ -1,0 +1,13 @@
+"""Tenancy tests share the process-global registry/stats singletons —
+reset them around every test so registrations never leak across tests
+(or into the rest of the suite)."""
+import pytest
+
+from intellillm_tpu import tenancy
+
+
+@pytest.fixture(autouse=True)
+def clean_tenancy():
+    tenancy.reset_for_testing()
+    yield
+    tenancy.reset_for_testing()

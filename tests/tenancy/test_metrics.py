@@ -1,0 +1,110 @@
+"""TenantStats unit tests on a fake clock: rolling windows, summary
+shape, deferred/churn counters, and the noisy-neighbor signal the
+`tenant_noisy_neighbor` alert rule consumes."""
+import pytest
+
+from intellillm_tpu.tenancy.metrics import TenantStats
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _rec(ttft_s=0.05, tpot_s=0.01, tokens=10, reason=None):
+    return {"ttft_s": ttft_s, "tpot_s": tpot_s,
+            "generation_tokens": tokens, "reason": reason}
+
+
+SLO = dict(slo_ttft_ms=100.0, slo_tpot_ms=50.0)
+
+
+def test_summary_counters_and_rates():
+    clock = _Clock()
+    stats = TenantStats(now_fn=clock, rate_window_s=60.0)
+    stats.observe("a", _rec(tokens=10), **SLO)
+    clock.t = 10.0
+    stats.observe("a", _rec(tokens=20), **SLO)
+    s = stats.summary()["a"]
+    assert s["finished"] == 2
+    assert s["generation_tokens"] == 30
+    # 30 tokens over the 10s span between first event and now.
+    assert s["tokens_per_second"] == pytest.approx(3.0)
+    assert s["goodput_ratio"] == 1.0
+    assert s["ttft_ms"]["p50"] == pytest.approx(50.0)
+    assert s["tpot_ms"]["p99"] == pytest.approx(10.0)
+
+
+def test_goodput_counts_slo_misses():
+    stats = TenantStats(now_fn=_Clock())
+    stats.observe("a", _rec(ttft_s=0.05, tpot_s=0.01), **SLO)
+    stats.observe("a", _rec(ttft_s=0.5, tpot_s=0.01), **SLO)   # TTFT miss
+    stats.observe("a", _rec(ttft_s=0.05, tpot_s=0.2), **SLO)   # TPOT miss
+    # summary() rounds to 4 decimals.
+    assert stats.summary()["a"]["goodput_ratio"] == pytest.approx(
+        1 / 3, abs=1e-3)
+
+
+def test_aborts_are_not_slo_eligible():
+    stats = TenantStats(now_fn=_Clock())
+    stats.observe("a", _rec(ttft_s=None, tpot_s=None, tokens=0,
+                            reason="abort"), **SLO)
+    s = stats.summary()["a"]
+    assert s["finished"] == 1
+    assert s["goodput_ratio"] is None
+    assert s["ttft_ms"] is None
+
+
+def test_rate_window_prunes_but_totals_persist():
+    clock = _Clock()
+    stats = TenantStats(now_fn=clock, rate_window_s=60.0)
+    stats.observe("a", _rec(tokens=100), **SLO)
+    clock.t = 120.0
+    s = stats.summary()["a"]
+    assert s["tokens_per_second"] == 0.0
+    assert s["generation_tokens"] == 100
+
+
+def test_deferred_and_adapter_churn_counters():
+    stats = TenantStats(now_fn=_Clock())
+    stats.record_deferred("a", 32)
+    stats.record_deferred("a", 0)      # no-op
+    stats.record_deferred("a", -5)     # no-op
+    stats.record_adapter_load("a")
+    stats.record_adapter_load("a")
+    stats.record_adapter_evict("a")
+    s = stats.summary()["a"]
+    assert s["deferred_tokens"] == 32
+    assert s["adapter_loads"] == 2
+    assert s["adapter_evictions"] == 1
+
+
+def test_noisy_neighbor_needs_two_active_tenants():
+    clock = _Clock()
+    stats = TenantStats(now_fn=clock, rate_window_s=60.0)
+    assert stats.noisy_neighbor_signal(50.0) is None
+    stats.observe("solo", _rec(tokens=1000), **SLO)
+    assert stats.noisy_neighbor_signal(50.0) is None
+    # A tenant whose traffic aged out of the window is not "active".
+    clock.t = 120.0
+    stats.observe("other", _rec(tokens=10), **SLO)
+    assert stats.noisy_neighbor_signal(50.0) is None
+
+
+def test_noisy_neighbor_identifies_hog_and_victims():
+    stats = TenantStats(now_fn=_Clock(), rate_window_s=60.0)
+    stats.observe("hog", _rec(tpot_s=0.001, tokens=900), **SLO)
+    stats.observe("victim", _rec(tpot_s=0.2, tokens=100), **SLO)
+    sig = stats.noisy_neighbor_signal(slo_tpot_ms=50.0)
+    assert sig["hog"] == "hog"
+    assert sig["hog_share"] == pytest.approx(0.9)
+    assert sig["active_tenants"] == 2
+    assert sig["victims_over_slo"] == ["victim"]
+    # Same split but the victim is healthy: no victims reported.
+    healthy = TenantStats(now_fn=_Clock(), rate_window_s=60.0)
+    healthy.observe("hog", _rec(tpot_s=0.001, tokens=900), **SLO)
+    healthy.observe("victim", _rec(tpot_s=0.001, tokens=100), **SLO)
+    assert healthy.noisy_neighbor_signal(50.0)["victims_over_slo"] == []

@@ -1,0 +1,94 @@
+"""Tenant registry unit tests: registration/ownership rules, fallback
+attribution, and the snapshot shape served over /tenants and
+/health/detail."""
+import pytest
+
+from intellillm_tpu.lora.request import LoRARequest
+from intellillm_tpu.tenancy import (DEFAULT_TENANT, TenantSpec,
+                                    adapter_fallback_tenant,
+                                    get_tenant_registry)
+
+
+def _spec(tenant_id, lora_id=0, **kwargs):
+    req = (LoRARequest(f"{tenant_id}-adapter", lora_id, f"/tmp/{tenant_id}")
+           if lora_id else None)
+    return TenantSpec(tenant_id, lora_request=req, **kwargs)
+
+
+def test_register_and_resolve_adapter():
+    reg = get_tenant_registry()
+    reg.register(_spec("acme", lora_id=7, weight=2.0, token_share_cap=0.5))
+    assert reg.tenant_for_adapter(7) == "acme"
+    assert reg.weight_for("acme") == 2.0
+    assert reg.share_cap_for("acme") == 0.5
+    assert reg.tenant_ids() == ["acme"]
+    spec = reg.get("acme")
+    assert spec.lora_int_id == 7
+
+
+def test_fallback_attribution_never_fails():
+    reg = get_tenant_registry()
+    assert reg.tenant_for_adapter(0) == DEFAULT_TENANT
+    assert reg.tenant_for_adapter(42) == "adapter-42"
+    assert adapter_fallback_tenant(0) == DEFAULT_TENANT
+    assert adapter_fallback_tenant(3) == "adapter-3"
+    # Unregistered tenants read neutral fairness defaults.
+    assert reg.weight_for("ghost") == 1.0
+    assert reg.share_cap_for("ghost") is None
+
+
+def test_adapter_owned_by_one_tenant():
+    reg = get_tenant_registry()
+    reg.register(_spec("a", lora_id=1))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register(_spec("b", lora_id=1))
+    # Re-registering the SAME tenant (e.g. adapter swap) is allowed and
+    # releases its previous adapter id.
+    reg.register(_spec("a", lora_id=2))
+    assert reg.tenant_for_adapter(2) == "a"
+    assert reg.tenant_for_adapter(1) == "adapter-1"
+    reg.register(_spec("b", lora_id=1))
+    assert reg.tenant_for_adapter(1) == "b"
+
+
+def test_unregister_releases_adapter():
+    reg = get_tenant_registry()
+    reg.register(_spec("a", lora_id=5))
+    spec = reg.unregister("a")
+    assert spec.lora_int_id == 5
+    assert reg.get("a") is None
+    assert reg.tenant_for_adapter(5) == "adapter-5"
+    with pytest.raises(KeyError, match="unknown tenant"):
+        reg.unregister("a")
+
+
+def test_base_model_tenant_has_no_adapter():
+    reg = get_tenant_registry()
+    reg.register(_spec("base-co", weight=3.0))
+    assert reg.get("base-co").lora_int_id == 0
+    # Adapter id 0 still resolves to `default`, not the base tenant —
+    # id 0 is the reserved no-adapter slot, never owned.
+    assert reg.tenant_for_adapter(0) == DEFAULT_TENANT
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="weight"):
+        TenantSpec("t", weight=0.0)
+    with pytest.raises(ValueError, match="token_share_cap"):
+        TenantSpec("t", token_share_cap=1.5)
+    with pytest.raises(ValueError, match="token_share_cap"):
+        TenantSpec("t", token_share_cap=0.0)
+    with pytest.raises(ValueError, match="tenant_id"):
+        TenantSpec("")
+
+
+def test_snapshot_shape():
+    reg = get_tenant_registry()
+    reg.register(_spec("b", lora_id=2))
+    reg.register(_spec("a", lora_id=1, weight=2.0, token_share_cap=0.25))
+    snap = reg.snapshot()
+    assert [s["tenant_id"] for s in snap["tenants"]] == ["a", "b"]
+    assert snap["tenants"][0] == {
+        "tenant_id": "a", "lora_int_id": 1, "lora_name": "a-adapter",
+        "weight": 2.0, "token_share_cap": 0.25,
+    }

@@ -1,0 +1,56 @@
+"""intellillm-top TENANTS panel unit tests: rendering of the
+/health/detail tenants block (no HTTP, no engine)."""
+from intellillm_tpu.tools.top import _tenant_lines
+
+
+def _block():
+    return {
+        "tenants": [
+            {"tenant_id": "acme", "lora_int_id": 1, "lora_name": "acme",
+             "weight": 2.0, "token_share_cap": 0.5},
+            {"tenant_id": "globex", "lora_int_id": 2, "lora_name": "g",
+             "weight": 1.0, "token_share_cap": None},
+        ],
+        "active_adapters": [1, 2],
+        "stats": {
+            "acme": {"finished": 10, "generation_tokens": 800,
+                     "deferred_tokens": 64, "adapter_loads": 3,
+                     "adapter_evictions": 2,
+                     "tokens_per_second": 123.4, "goodput_ratio": 0.95,
+                     "ttft_ms": {"p50": 10.0, "p99": 40.0},
+                     "tpot_ms": {"p50": 5.0, "p99": 12.0}},
+            "globex": {"finished": 1, "generation_tokens": 8,
+                       "deferred_tokens": 0, "adapter_loads": 1,
+                       "adapter_evictions": 0,
+                       "tokens_per_second": 2.0, "goodput_ratio": None,
+                       "ttft_ms": None, "tpot_ms": None},
+        },
+    }
+
+
+def test_panel_renders_per_tenant_rows():
+    lines = _tenant_lines(_block())
+    text = "\n".join(lines)
+    assert "Tenants (2 registered, 2 adapters on device):" in text
+    acme = next(ln for ln in lines if "acme" in ln)
+    assert "tok/s   123.4" in acme
+    assert "TPOT-p99 12ms" in acme
+    assert "deferred 64" in acme
+    assert "churn 3/2" in acme
+    # Missing percentiles render as n/a, not a crash.
+    globex = next(ln for ln in lines if "globex" in ln)
+    assert "TPOT-p99 n/ams" in globex or "n/a" in globex
+
+
+def test_panel_absent_for_single_tenant_serving():
+    assert _tenant_lines(None) == []
+    assert _tenant_lines({}) == []
+    assert _tenant_lines({"tenants": [], "active_adapters": [],
+                          "stats": {}}) == []
+
+
+def test_panel_before_first_finish():
+    lines = _tenant_lines({"tenants": [{"tenant_id": "a"}],
+                           "active_adapters": [], "stats": {}})
+    assert any("no finished requests yet" in ln for ln in lines)
+    assert any("1 registered, 0 adapters" in ln for ln in lines)

@@ -144,3 +144,65 @@ def test_spec_bench_modes_build():
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     line = json.loads(r.stdout.strip().splitlines()[-1])
     assert line["value"] > 0
+
+
+def test_serve_bench_multi_tenant_args_parse():
+    """The multi-tenant scenario's CLI surface stays wired."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from benchmarks.serve_bench import make_arg_parser
+    args = make_arg_parser().parse_args(
+        ["--scenario", "multi-tenant", "--num-tenants", "4",
+         "--hog-concurrency", "12", "--tenant-hog-share-cap", "0.3",
+         "--hog-start-delay", "0.5"])
+    assert args.scenario == "multi-tenant"
+    assert args.num_tenants == 4
+    assert args.hog_concurrency == 12
+    assert args.tenant_hog_share_cap == 0.3
+    assert args.hog_start_delay == 0.5
+
+
+@pytest.mark.slow
+def test_serve_bench_multi_tenant_smoke():
+    """Multi-tenant scenario end to end (docs/multitenancy.md): 3 LoRA
+    tenants on one tiny replica, hot-loaded adapters, a small hog, and
+    the per-tenant SLO split + isolation block in the summary. Tiny
+    sizes — this smoke proves the wiring, not the 2x isolation bound
+    (that's the full CPU acceptance run's job)."""
+    import json
+    r = _run(["benchmarks/serve_bench.py", "--size", "tiny",
+              "--scenario", "multi-tenant", "--num-tenants", "3",
+              "--hog-concurrency", "4", "--hog-output-len", "24",
+              "--hog-start-delay", "0.2",
+              "--victim-requests", "2", "--victim-output-len", "8",
+              "--input-len", "8", "--max-model-len", "64",
+              "--max-num-seqs", "4", "--num-decode-steps", "4",
+              "--num-device-blocks", "128", "--port", "8737",
+              "--init-timeout", "240",
+              "--server-log", "/tmp/serve_bench_mt.log"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-3000:]
+    summary = None
+    for line in r.stdout.splitlines():
+        if line.startswith('{"serve_bench_summary"'):
+            summary = json.loads(line)["serve_bench_summary"]
+    assert summary is not None, r.stdout[-2000:]
+    assert summary["scenario"] == "multi-tenant"
+    assert summary["num_tenants"] == 3
+    assert summary["hog"] == "tenant-1"
+    phases = summary["victim_latency"]
+    assert set(phases) == {"victim_solo", "contention_caps_on",
+                           "contention_caps_off"}
+    for phase in phases.values():
+        assert phase["tpot_ms"]["n"] > 0
+        assert phase["tpot_ms"]["p99"] is not None
+        # Per-tenant SLO split: both victim tenants measured.
+        assert set(phase["per_tenant_tpot_ms"]) == {"tenant-2", "tenant-3"}
+    iso = summary["isolation"]
+    assert set(iso["victim_tpot_p99_ms"]) == {"solo", "caps_on", "caps_off"}
+    assert all(v is not None for v in iso["victim_tpot_p99_ms"].values())
+    # Adapter churn counters from the caps-on run's /health/detail.
+    churn = iso["adapter_churn"]
+    assert set(churn) == {"tenant-1", "tenant-2", "tenant-3"}
+    assert sum(c["loads"] or 0 for c in churn.values()) >= 3
+    # Per-tenant stats block made it into the snapshot.
+    stats = (summary["tenants_caps_on"] or {}).get("stats") or {}
+    assert any(t.startswith("tenant-") for t in stats)
