@@ -488,6 +488,14 @@ def main():
         "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 3),
     }
     rec["regression"] = _regression_vs_prior(tok_s)
+    # Per-kernel cost ledger (obs/kernels.py): static cost_analysis
+    # FLOPs/bytes per executable plus the before/after delta against the
+    # best prior round — ROADMAP item 2's "per-kernel before/after in
+    # the efficiency ledger" exit artifact.
+    kernels = _kernel_snapshot()
+    if kernels is not None:
+        rec["kernels"] = kernels
+        rec["kernel_regression"] = _kernel_regression_vs_prior(kernels)
     warmup = _PROGRESS.get("engine_warmup")
     if warmup is not None:
         rec["warmup_compile"] = {
@@ -528,6 +536,85 @@ def _regression_vs_prior(tok_s: float):
         "baseline_tok_s": best_value,
         "delta_pct": round(delta_pct, 1),
         "regressed": delta_pct < -5.0,
+    }
+
+
+def _kernel_snapshot():
+    """Compact kernel-ledger snapshot for the round record. None (key
+    omitted) when the obs stack is unavailable — never a bench failure."""
+    try:
+        from intellillm_tpu.obs import get_kernel_ledger
+        return get_kernel_ledger().snapshot(top=8)
+    except Exception:
+        return None
+
+
+def _best_prior_kernel_programs():
+    """Per-program kernel aggregates from the best successful prior
+    round's BENCH_r0*.json, or (None, None) when no prior record carries
+    a kernels block (rounds before the ledger existed, or dark rounds)."""
+    best_programs, best_round, best_value = None, None, 0.0
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        for path in sorted(glob.glob(os.path.join(here, "BENCH_r0*.json"))):
+            try:
+                with open(path) as f:
+                    prior = json.load(f)
+            except Exception:
+                continue
+            parsed = prior.get("parsed") or {}
+            value = parsed.get("value")
+            programs = (parsed.get("kernels") or {}).get("programs")
+            if (parsed.get("unit") == "tok/s/chip" and programs
+                    and isinstance(value, (int, float)) and value > 0
+                    and value > best_value):
+                best_value = value
+                best_round = prior.get("n")
+                best_programs = programs
+    except Exception:
+        return None, None
+    return best_programs, best_round
+
+
+def _kernel_regression_vs_prior(kernels: dict):
+    """Per-kernel before/after deltas vs the best prior round: per
+    program, the % change in cost_analysis FLOPs, bytes accessed, and
+    total compile seconds. Flags any program whose bytes-accessed grew
+    > 10% without a FLOPs increase — more HBM traffic for the same math
+    is a pad/layout regression smell, invisible in tok/s alone when the
+    chip is latency-bound. None when no prior record has a kernels
+    block to compare against."""
+    current = (kernels or {}).get("programs") or {}
+    prior, prior_round = _best_prior_kernel_programs()
+    if not current or not prior:
+        return None
+    deltas, flagged = {}, []
+    for program in sorted(current):
+        agg, prev = current[program], prior.get(program)
+        if not isinstance(prev, dict):
+            continue
+        row = {}
+        for field in ("flops_max", "bytes_accessed_max",
+                      "compile_seconds_total"):
+            cur_v, prev_v = agg.get(field), prev.get(field)
+            if (isinstance(cur_v, (int, float))
+                    and isinstance(prev_v, (int, float)) and prev_v > 0):
+                row[field + "_delta_pct"] = round(
+                    (cur_v - prev_v) / prev_v * 100.0, 1)
+            else:
+                row[field + "_delta_pct"] = None
+        bytes_d = row["bytes_accessed_max_delta_pct"]
+        flops_d = row["flops_max_delta_pct"]
+        row["bytes_grew_without_flops"] = bool(
+            bytes_d is not None and bytes_d > 10.0
+            and (flops_d is None or flops_d <= 0.0))
+        if row["bytes_grew_without_flops"]:
+            flagged.append(program)
+        deltas[program] = row
+    return {
+        "baseline_round": prior_round,
+        "deltas": deltas,
+        "flagged": flagged,
     }
 
 

@@ -208,6 +208,20 @@ def snapshot_efficiency(base: str) -> dict:
         return {"error": f"efficiency scrape failed: {e}"}
 
 
+def snapshot_kernels(base: str, top: int = 8) -> dict:
+    """Scrape the per-kernel cost ledger (/debug/kernels): per-(program,
+    bucket) cost_analysis FLOPs / bytes / peak HBM, the cost-model-vs-
+    analytic MFU cross-check, and any merged profiler capture — the
+    before/after artifact a Pallas kernel pass is judged against
+    (ROADMAP item 2)."""
+    try:
+        with urllib.request.urlopen(f"{base}/debug/kernels?top={top}",
+                                    timeout=5) as r:
+            return json.loads(r.read().decode(errors="replace"))
+    except Exception as e:
+        return {"error": f"kernels scrape failed: {e}"}
+
+
 def snapshot_alerts(base: str) -> dict:
     """Scrape /debug/alerts. On a router this includes the fleet block
     (every replica's alert summary aggregated), so a fleet run can
@@ -1134,6 +1148,7 @@ def run_single(args, model_dir, tokenizer, scheduling_policy=None) -> dict:
         summary["spec"] = detail.get("spec")
         summary["device_telemetry"] = distill_device_telemetry(detail)
         summary["efficiency"] = snapshot_efficiency(base)
+        summary["kernels"] = snapshot_kernels(base)
         summary["alerts"] = distill_alerts(snapshot_alerts(base))
     finally:
         proc.send_signal(signal.SIGKILL)

@@ -12,6 +12,7 @@ block counts → allocate pool → warm up).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -159,6 +160,11 @@ class LLMEngine:
         except Exception:
             logger.warning("Efficiency telemetry unavailable.",
                            exc_info=True)
+        # Per-kernel cost ledger (obs/kernels.py): the runner's dispatch
+        # hook feeds it; the engine only marks step boundaries for the
+        # cost-model MFU window.
+        from intellillm_tpu.obs import get_kernel_ledger
+        self._kernel_ledger = get_kernel_ledger()
 
         self._init_cache()
 
@@ -652,7 +658,15 @@ class LLMEngine:
                       ) -> Optional[str]:
         """Begin a jax.profiler trace covering subsequent engine steps.
         View with TensorBoard or xprof. Returns the trace directory, or
-        None if a trace is already running (jax allows only one)."""
+        None if a trace is already running (jax allows only one) or the
+        profiler refuses to start — never raises into the caller (the
+        admin endpoint maps None to a 409, not a 500 that could take
+        the engine thread down with it).
+
+        Every trace carries a mandatory max-duration watchdog: a trace
+        left running degrades serving and grows without bound on disk,
+        so after INTELLILLM_PROFILER_MAX_S (default 120s) it is stopped
+        automatically, as if stop_profile had been called."""
         import jax
         import threading
         if not hasattr(self, "_profile_lock"):
@@ -661,10 +675,41 @@ class LLMEngine:
             if getattr(self, "_profiling", False):
                 logger.warning("Profiling already running; ignoring start.")
                 return None
-            jax.profiler.start_trace(trace_dir)
+            try:
+                jax.profiler.start_trace(trace_dir)
+            except Exception:
+                # e.g. a trace started outside the engine's bookkeeping,
+                # or an unwritable dir — a busy/bad-request condition,
+                # not an engine fault.
+                logger.warning("jax.profiler.start_trace(%s) failed; "
+                               "refusing the profile request.", trace_dir,
+                               exc_info=True)
+                return None
             self._profiling = True
-        logger.info("Profiling started; trace dir: %s", trace_dir)
+            max_s = self._profiler_max_s()
+            timer = threading.Timer(max_s, self._profile_expired, (max_s,))
+            timer.daemon = True
+            timer.start()
+            self._profile_timer = timer
+        logger.info("Profiling started; trace dir: %s (auto-stop after "
+                    "%.0fs)", trace_dir, max_s)
         return trace_dir
+
+    @staticmethod
+    def _profiler_max_s() -> float:
+        raw = os.environ.get("INTELLILLM_PROFILER_MAX_S")
+        try:
+            value = float(raw) if raw else 120.0
+        except ValueError:
+            logger.warning("Ignoring invalid INTELLILLM_PROFILER_MAX_S=%r "
+                           "(want seconds).", raw)
+            value = 120.0
+        return value if value > 0 else 120.0
+
+    def _profile_expired(self, max_s: float) -> None:
+        logger.warning("Profiling exceeded INTELLILLM_PROFILER_MAX_S "
+                       "(%.0fs); stopping the trace automatically.", max_s)
+        self.stop_profile()
 
     def stop_profile(self) -> None:
         import jax
@@ -672,12 +717,22 @@ class LLMEngine:
         if not hasattr(self, "_profile_lock"):
             self._profile_lock = threading.Lock()
         # Serialize start/stop: stop_trace runs for seconds (it writes the
-        # whole trace) and may be called from an executor thread.
+        # whole trace) and may be called from an executor thread, the
+        # watchdog timer thread, or both racing — the _profiling flag
+        # under the lock makes the stop exactly-once.
         with self._profile_lock:
             if not getattr(self, "_profiling", False):
                 return
             self._profiling = False
-            jax.profiler.stop_trace()
+            timer = getattr(self, "_profile_timer", None)
+            if timer is not None:
+                timer.cancel()
+                self._profile_timer = None
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                logger.warning("jax.profiler.stop_trace() failed.",
+                               exc_info=True)
         logger.info("Profiling stopped.")
 
     def get_num_unfinished_requests(self) -> int:
@@ -1065,6 +1120,9 @@ class LLMEngine:
             # Fold this step's wall time into the rolling MFU (works
             # with stats logging off — benches read the gauge/ledger).
             self._efficiency.record_step(step_time)
+            # Cost-model MFU cross-check + the capture endpoint's step
+            # counter (obs/kernels.py).
+            self._kernel_ledger.record_step(step_time)
 
         if self.stat_logger is not None:
             stats = self._get_stats(scheduler_outputs)

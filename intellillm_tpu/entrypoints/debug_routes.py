@@ -34,6 +34,14 @@
                                         ratio, lifetime token totals
                                         (404 when the engine runs
                                         without a draft model)
+    GET  /debug/kernels                 per-(program, bucket) kernel
+                                        cost ledger: cost_analysis
+                                        FLOPs / bytes / peak HBM per
+                                        executable, cost-model-vs-
+                                        analytic MFU cross-check, and
+                                        the latest measured per-op
+                                        wall-time capture (?top=N
+                                        trims the tables)
     GET  /health/detail                 structured liveness: last-step
                                         age, watchdog state, queue
                                         depths, KV usage, SLO summary,
@@ -44,7 +52,17 @@
                                         "degraded" (still 200) while a
                                         page-severity alert is firing
     POST /debug/profiler/start?dir=...  begin a jax.profiler device trace
+                                        (auto-stopped after
+                                        INTELLILLM_PROFILER_MAX_S; 409
+                                        while one is running)
     POST /debug/profiler/stop           end it (writes the trace to disk)
+    POST /debug/profiler/capture?steps=N&top=K
+                                        bounded capture-and-parse: trace
+                                        N engine steps into a temp dir,
+                                        fold the trace events into
+                                        per-op wall time, merge the
+                                        top-K ops into the kernel
+                                        ledger, delete the temp dir
 
 See docs/observability.md. The profiler endpoints drive
 LLMEngine.start_profile/stop_profile and are admin-only: profiling
@@ -65,8 +83,9 @@ from aiohttp import web
 from intellillm_tpu.obs import (get_alert_manager, get_boot_timeline,
                                 get_compile_tracker, get_device_telemetry,
                                 get_efficiency_tracker,
-                                get_flight_recorder, get_metrics_history,
-                                get_slo_tracker, get_watchdog)
+                                get_flight_recorder, get_kernel_ledger,
+                                get_metrics_history, get_slo_tracker,
+                                get_watchdog)
 from intellillm_tpu.prediction import get_prediction_service
 from intellillm_tpu.worker.spec_decode.metrics import get_spec_stats
 
@@ -193,6 +212,14 @@ def add_debug_routes(app: web.Application,
         return web.json_response(
             get_efficiency_tracker().snapshot(top_n=top_n))
 
+    async def debug_kernels(request: web.Request) -> web.Response:
+        try:
+            top = int(request.query.get("top", "8"))
+        except ValueError:
+            return web.json_response({"error": "top must be an integer"},
+                                     status=400)
+        return web.json_response(get_kernel_ledger().snapshot(top=top))
+
     async def health_detail(request: web.Request) -> web.Response:
         """Deep liveness, as opposed to the LB-cheap bare-200 /health:
         503 while the watchdog has declared a stall (or before engine
@@ -216,6 +243,8 @@ def add_debug_routes(app: web.Application,
             # /debug/efficiency.
             "efficiency": get_efficiency_tracker().snapshot(
                 top_n=4, include_buckets=False),
+            # Compact: the per-executable table lives at /debug/kernels.
+            "kernels": get_kernel_ledger().health_block(),
             "live_requests": len(get_flight_recorder().live_request_ids()),
             "alerts": alerts.summary(),
             "boot": get_boot_timeline().snapshot(),
@@ -300,6 +329,52 @@ def add_debug_routes(app: web.Application,
         await loop.run_in_executor(None, engine.stop_profile)
         return web.json_response({"ok": True})
 
+    async def profiler_capture(request: web.Request) -> web.Response:
+        """Bounded capture-and-parse (obs/kernels.py): profile N engine
+        steps into a temp dir, fold the trace into per-op wall-time
+        totals, merge the top-K ops into the kernel ledger, and delete
+        the trace — no caller-chosen paths, no unbounded trace left
+        running (the step wait is capped by
+        INTELLILLM_PROFILER_CAPTURE_TIMEOUT_S on idle engines, and the
+        engine's INTELLILLM_PROFILER_MAX_S watchdog backstops both)."""
+        engine = get_engine()
+        if engine is None:
+            return web.json_response({"error": "engine not ready"},
+                                     status=503)
+        from intellillm_tpu.obs.kernels import (capture_max_steps,
+                                                parse_trace_dir,
+                                                wait_for_steps)
+        try:
+            steps = int(request.query.get("steps", "8"))
+            top = int(request.query.get("top", "16"))
+        except ValueError:
+            return web.json_response(
+                {"error": "steps and top must be integers"}, status=400)
+        steps = max(1, min(steps, capture_max_steps()))
+        ledger = get_kernel_ledger()
+        import shutil
+        import tempfile
+        tmpdir = tempfile.mkdtemp(prefix="intellillm-kernel-capture-")
+        started = engine.start_profile(tmpdir)
+        if started is None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+            return web.json_response(
+                {"error": "a trace is already running"}, status=409)
+        loop = asyncio.get_event_loop()
+        try:
+            observed = await loop.run_in_executor(
+                None, wait_for_steps, ledger, steps)
+            await loop.run_in_executor(None, engine.stop_profile)
+            ops = await loop.run_in_executor(None, parse_trace_dir, tmpdir)
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+        block = ledger.merge_profile(ops, steps=observed, top=top)
+        return web.json_response({
+            "steps_requested": steps,
+            "steps_observed": observed,
+            "profile": block,
+        })
+
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/trace", debug_trace)
     app.router.add_get("/debug/stall", debug_stall)
@@ -308,7 +383,9 @@ def add_debug_routes(app: web.Application,
     app.router.add_get("/debug/alerts", debug_alerts)
     app.router.add_get("/debug/predictor", debug_predictor)
     app.router.add_get("/debug/spec", debug_spec)
+    app.router.add_get("/debug/kernels", debug_kernels)
     app.router.add_get("/health/detail", health_detail)
     if enable_profiling:
         app.router.add_post("/debug/profiler/start", profiler_start)
         app.router.add_post("/debug/profiler/stop", profiler_stop)
+        app.router.add_post("/debug/profiler/capture", profiler_capture)

@@ -1,8 +1,8 @@
 """Observability subsystem: step-phase tracing, XLA compile tracking,
 the per-request flight recorder, request SLO telemetry, the engine
 stall watchdog, device/HBM telemetry, the compute-efficiency ledger,
-the in-process metrics history, and the alert rule engine. See
-docs/observability.md."""
+the per-kernel cost ledger, the in-process metrics history, and the
+alert rule engine. See docs/observability.md."""
 from intellillm_tpu.obs.alerts import (AlertManager, AlertRule,
                                        built_in_rules, get_alert_manager)
 from intellillm_tpu.obs.boot import BootTimeline, get_boot_timeline
@@ -16,6 +16,8 @@ from intellillm_tpu.obs.efficiency import (EfficiencyTracker,
 from intellillm_tpu.obs.flight_recorder import (EVENTS, FlightRecorder,
                                                 get_flight_recorder)
 from intellillm_tpu.obs.history import MetricsHistory, get_metrics_history
+from intellillm_tpu.obs.kernels import (KernelLedger, get_kernel_ledger,
+                                        parse_trace_dir)
 from intellillm_tpu.obs.kv_transfer import (KVTransferStats,
                                             get_kv_transfer_stats)
 from intellillm_tpu.obs.slo import (SLOTracker, derive_request_metrics,
@@ -39,6 +41,7 @@ __all__ = [
     "EngineWatchdog",
     "FlightRecorder",
     "KVTransferStats",
+    "KernelLedger",
     "MetricsHistory",
     "PHASES",
     "SLOTracker",
@@ -53,6 +56,7 @@ __all__ = [
     "get_device_telemetry",
     "get_efficiency_tracker",
     "get_flight_recorder",
+    "get_kernel_ledger",
     "get_kv_transfer_stats",
     "get_metrics_history",
     "get_slo_tracker",
@@ -60,6 +64,7 @@ __all__ = [
     "get_trace_sink",
     "get_watchdog",
     "install_black_box_handlers",
+    "parse_trace_dir",
     "record_kernel_dispatch",
     "request_context",
     "sanitize_request_id",

@@ -10,7 +10,9 @@ ledger, swap traffic, queue depths, KV-cache usage, goodput/SLO
 percentiles with a goodput history sparkline, the ALERTS panel
 (pending/firing rules, fleet aggregation when pointed at a router), and
 the compute-efficiency panel (MFU, pad%, per-axis bucket fill,
-top-waste bucket). Curses-free: each frame clears the screen with
+top-waste bucket), and the KERNELS panel (per-program executables,
+dispatches, cost-model FLOPs/bytes/HBM, and the cost-model-vs-analytic
+MFU cross-check). Curses-free: each frame clears the screen with
 ANSI escapes, so it works over any dumb tty / kubectl exec. `--once`
 prints a single frame and exits (scriptable health check).
 
@@ -286,6 +288,8 @@ def render_frame(health: Optional[Dict[str, Any]],
 
     lines.extend(_efficiency_lines(health.get("efficiency") or {}))
 
+    lines.extend(_kernel_lines(health.get("kernels")))
+
     tok_parts = []
     for kind in ("prompt", "generation"):
         series = metrics.get(f"intellillm_{kind}_tokens_total")
@@ -408,6 +412,52 @@ def _efficiency_lines(eff: Dict[str, Any]) -> List[str]:
             f"({worst.get('pad_tokens', 0)} pad tokens over "
             f"{worst.get('dispatches', 0)} dispatches)")
     return lines
+
+
+def _kernel_lines(kernels: Optional[Dict[str, Any]]) -> List[str]:
+    """KERNELS panel from /health/detail's kernels block
+    (obs/kernels.py; the per-executable table lives at /debug/kernels).
+    Per-program FLOPs/bytes are null (shown n/a, never 0) on backends
+    where executable introspection is skipped — the CPU contract."""
+    if not kernels or not kernels.get("enabled"):
+        return []
+    programs = kernels.get("programs") or {}
+    if not programs:
+        return []
+    lines = ["", f"Kernels ({kernels.get('executables_total', 0)} "
+             f"executables, introspection="
+             f"{kernels.get('introspection', 'auto')}):"]
+    mfu_cm = kernels.get("mfu_costmodel")
+    mfu_an = kernels.get("mfu_analytic")
+    lines.append(f"  MFU cost-model {_pct(mfu_cm)} vs analytic "
+                 f"{_pct(mfu_an)}")
+    width = max(len(p) for p in programs)
+    for program in sorted(programs):
+        agg = programs[program] or {}
+        lines.append(
+            f"  {program.ljust(width)}  "
+            f"exec {agg.get('executables', 0)}  "
+            f"disp {agg.get('dispatches', 0)}  "
+            f"flops {_eng(agg.get('flops_max'))}  "
+            f"bytes {_eng(agg.get('bytes_accessed_max'))}  "
+            f"hbm-peak {_eng(agg.get('hbm_peak_bytes_max'))}  "
+            f"compile {agg.get('compile_seconds_total', 0):.2f}s")
+    steps = kernels.get("profiled_steps")
+    if steps:
+        lines.append(f"  measured: last capture covered {steps} steps "
+                     "(ops at /debug/kernels)")
+    return lines
+
+
+def _eng(x: Optional[float]) -> str:
+    """Engineering notation for FLOPs/bytes columns; n/a for null."""
+    if not isinstance(x, (int, float)):
+        return "n/a"
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                          (1e3, "K")):
+        if abs(x) >= scale:
+            return f"{x / scale:.1f}{suffix}"
+    return f"{x:.0f}"
 
 
 def _pct(x: Optional[float]) -> str:

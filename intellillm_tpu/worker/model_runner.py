@@ -49,7 +49,8 @@ from intellillm_tpu.layers.sampler import (LOGPROB_K_BUCKETS,
 from intellillm_tpu.logger import init_logger
 from intellillm_tpu.native import build_decode_batch
 from intellillm_tpu.obs import (get_compile_tracker,
-                                get_efficiency_tracker, get_step_tracer)
+                                get_efficiency_tracker, get_kernel_ledger,
+                                get_step_tracer)
 from intellillm_tpu.sampling_params import SamplingParams, SamplingType
 from intellillm_tpu.sequence import (SamplerOutput, SequenceGroupMetadata,
                                      SequenceGroupOutput, SequenceOutput)
@@ -182,6 +183,7 @@ class ModelRunner:
         self._tracer = get_step_tracer()
         self._compile_tracker = get_compile_tracker()
         self._efficiency = get_efficiency_tracker()
+        self._kernel_ledger = get_kernel_ledger()
 
         self.block_size = cache_config.block_size
         self.sliding_window = model_config.get_sliding_window()
@@ -247,13 +249,28 @@ class ModelRunner:
 
     def _guarded_call(self, program, key, fn, /, *args, **kwargs):
         """Every jitted dispatch goes through here: compile tracking
-        (obs/compile_tracker.py) plus the watchdog dispatch guard — a
-        dispatch blocked past INTELLILLM_WATCHDOG_DISPATCH_S fires the
-        stall report (obs/watchdog.py)."""
+        (obs/compile_tracker.py), the kernel cost ledger
+        (obs/kernels.py — a new bucket's executable is introspected via
+        cost_analysis()/memory_analysis() after its first successful
+        dispatch; the abstract signature is captured BEFORE the call
+        because kv_caches are donated), plus the watchdog dispatch
+        guard — a dispatch blocked past INTELLILLM_WATCHDOG_DISPATCH_S
+        fires the stall report (obs/watchdog.py)."""
+        import time as _time
         from intellillm_tpu.obs import get_watchdog
+        pending = self._kernel_ledger.prepare(program, key, fn, args,
+                                              kwargs)
+        t0 = _time.monotonic() if pending is not None else 0.0
         with get_watchdog().dispatch(program):
-            return self._compile_tracker.call(program, key, fn,
-                                              *args, **kwargs)
+            try:
+                out = self._compile_tracker.call(program, key, fn,
+                                                 *args, **kwargs)
+            except BaseException:
+                self._kernel_ledger.abandon(pending)
+                raise
+        if pending is not None:
+            self._kernel_ledger.commit(pending, _time.monotonic() - t0)
+        return out
 
     # --- packing helpers --------------------------------------------------
 

@@ -1,0 +1,102 @@
+"""E2e acceptance for the per-kernel cost ledger (obs/kernels.py): a
+real CPU-backend engine run must create a ledger entry for every jit
+bucket the runner dispatched — with the `mixed` program present after
+one generate — and `GET /debug/kernels` must serve it on BOTH servers.
+On CPU the degradation contract holds end to end: analysis fields are
+null (not zero) because `auto` introspection skips the second compile
+on the tier-1 backend."""
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from intellillm_tpu import LLM, SamplingParams
+from intellillm_tpu.obs import get_kernel_ledger
+
+
+@pytest.fixture
+def fresh_kernels(monkeypatch):
+    monkeypatch.delenv("INTELLILLM_KERNEL_INTROSPECT", raising=False)
+    monkeypatch.delenv("INTELLILLM_KERNEL_LEDGER", raising=False)
+    ledger = get_kernel_ledger()
+    ledger.reset_for_testing()
+    yield ledger
+    ledger.reset_for_testing()
+
+
+def _serve_and_fetch(build_app, path="/debug/kernels?top=16"):
+    result = {}
+
+    async def go():
+        client = TestClient(TestServer(build_app()))
+        await client.start_server()
+        try:
+            resp = await client.get(path)
+            result["status"] = resp.status
+            result["data"] = await resp.json()
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+    return result
+
+
+def test_engine_run_populates_kernel_ledger_and_both_servers(
+        tiny_opt_dir, example_prompts, fresh_kernels):
+    llm = LLM(model=tiny_opt_dir, dtype="float32", max_model_len=128,
+              max_num_seqs=8, max_paddings=512)
+    params = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    for i, prompt in enumerate(example_prompts):
+        llm.llm_engine.add_request(str(i), prompt, params)
+    llm._run_engine(use_tqdm=False)
+
+    ledger = fresh_kernels
+    snap = ledger.snapshot(top=32)
+
+    # Every dispatched jit bucket is a ledger entry; the run prefills
+    # and decodes, so the mixed program must be there.
+    assert snap["executables_total"] > 0
+    programs = snap["programs"]
+    assert "mixed" in programs, programs
+    assert programs["mixed"]["executables"] >= 1
+    assert programs["mixed"]["dispatches"] >= 1
+
+    # CPU degradation contract: `auto` introspection skips the second
+    # compile on the CPU backend, so every analysis field is null —
+    # None, never 0 — while bookkeeping fields stay real.
+    assert snap["backend"] == "cpu"
+    assert snap["introspection"] == "auto"
+    for entry in snap["executables"]:
+        assert entry["analysis"] == "skipped"
+        assert entry["flops"] is None
+        assert entry["bytes_accessed"] is None
+        assert entry["hbm_peak_bytes"] is None
+        assert entry["dispatches"] >= 1
+        assert entry["compile_seconds"] is not None
+    assert programs["mixed"]["flops_max"] is None
+
+    # The engine marked step boundaries; with no per-executable FLOPs
+    # the cost-model MFU reads null (the analytic one rides along for
+    # the cross-check).
+    assert snap["steps"] > 0
+    assert snap["mfu_costmodel"] is None
+    assert "mfu_analytic" in snap
+
+    # Both servers serve the same process-global ledger.
+    from intellillm_tpu.entrypoints import api_server as demo_server
+    from intellillm_tpu.entrypoints.openai import api_server as \
+        openai_server
+    for build_app in (demo_server.build_app, openai_server.build_app):
+        served = _serve_and_fetch(build_app)
+        assert served["status"] == 200
+        data = served["data"]
+        assert data["executables_total"] == snap["executables_total"]
+        assert data["programs"]["mixed"]["dispatches"] == \
+            programs["mixed"]["dispatches"]
+        assert data["executables"][0]["flops"] is None
+
+    # /health/detail carries the compact block (no per-executable list).
+    served = _serve_and_fetch(demo_server.build_app, "/health/detail")
+    kernels = served["data"]["kernels"]
+    assert kernels["executables_total"] == snap["executables_total"]
+    assert "executables" not in kernels

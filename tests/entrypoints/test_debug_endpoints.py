@@ -167,16 +167,153 @@ def test_profiler_routes_registered_only_with_opt_in():
         assert resp.status == 503
         resp = await client.post("/debug/profiler/stop")
         assert resp.status == 503
+        resp = await client.post("/debug/profiler/capture")
+        assert resp.status == 503
 
     async def absent(client):
         resp = await client.post("/debug/profiler/start")
         assert resp.status == 404
         resp = await client.post("/debug/profiler/stop")
         assert resp.status == 404
+        resp = await client.post("/debug/profiler/capture")
+        assert resp.status == 404
 
     _run(openai_server.build_app(enable_profiling=True), gated)
     _run(demo_server.build_app(enable_profiling=True), gated)
     _run(demo_server.build_app(), absent)
+
+
+def test_kernels_endpoint_and_health_block_on_both_servers():
+    """/debug/kernels is always registered (read-only) on both servers
+    and serves the process-global ledger; /health/detail carries the
+    compact kernels block. Entries introspected elsewhere in the
+    process (here: faked) are visible through every surface."""
+    from types import SimpleNamespace
+
+    from intellillm_tpu.obs import get_kernel_ledger
+
+    ledger = get_kernel_ledger()
+    ledger.reset_for_testing()
+    ledger.introspect_mode = "on"
+    mem = SimpleNamespace(argument_size_in_bytes=100,
+                          output_size_in_bytes=20,
+                          temp_size_in_bytes=30,
+                          generated_code_size_in_bytes=1)
+    compiled = SimpleNamespace(
+        cost_analysis=lambda: [{"flops": 64.0, "bytes accessed": 32.0}],
+        memory_analysis=lambda: mem)
+    fn = SimpleNamespace(
+        lower=lambda *a, **k: SimpleNamespace(compile=lambda: compiled))
+    pending = ledger.prepare("mixed", (8, 128), fn, (), {})
+    ledger.commit(pending, 0.25)
+    try:
+        async def scenario(client):
+            resp = await client.get("/debug/kernels", params={"top": "4"})
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["enabled"] is True
+            assert data["executables_total"] == 1
+            entry = data["executables"][0]
+            assert entry["program"] == "mixed"
+            assert entry["flops"] == 64.0
+            assert entry["hbm_peak_bytes"] == 151
+            assert data["programs"]["mixed"]["dispatches"] == 1
+            # Cross-check fields ride along even when both are null.
+            assert "mfu_costmodel" in data and "mfu_analytic" in data
+
+            resp = await client.get("/debug/kernels",
+                                    params={"top": "bogus"})
+            assert resp.status == 400
+
+            # Compact block on deep health (503: no engine behind the
+            # test app, body rides along like the other obs blocks).
+            resp = await client.get("/health/detail")
+            data = await resp.json()
+            kernels = data["kernels"]
+            assert kernels["executables_total"] == 1
+            assert kernels["programs"]["mixed"]["flops_max"] == 64.0
+            assert "executables" not in kernels
+
+        _run(demo_server.build_app(), scenario)
+        _run(openai_server.build_app(), scenario)
+    finally:
+        ledger.reset_for_testing()
+
+
+def test_profiler_capture_runs_against_a_fake_engine(monkeypatch, tmp_path):
+    """Full capture-and-parse flow without a device: a fake engine
+    "profiles" by dropping a pre-baked trace file into the capture's
+    temp dir; the endpoint bounds the step wait, parses the trace,
+    merges the op table into the ledger, and 409s while a trace is
+    already running."""
+    import gzip
+    import json as jsonlib
+
+    from aiohttp import web
+
+    from intellillm_tpu.entrypoints.debug_routes import add_debug_routes
+    from intellillm_tpu.obs import get_kernel_ledger
+
+    monkeypatch.setenv("INTELLILLM_PROFILER_CAPTURE_TIMEOUT_S", "0.2")
+    ledger = get_kernel_ledger()
+    ledger.reset_for_testing()
+
+    class _FakeEngine:
+        def __init__(self):
+            self.profiling = False
+
+        def start_profile(self, trace_dir):
+            if self.profiling:
+                return None
+            self.profiling = True
+            doc = {"traceEvents": [
+                {"ph": "M", "pid": 9, "name": "process_name",
+                 "args": {"name": "/device:TPU:0"}},
+                {"ph": "X", "pid": 9, "tid": 1, "ts": 0, "dur": 300.0,
+                 "name": "fusion.7"},
+                {"ph": "X", "pid": 9, "tid": 1, "ts": 400, "dur": 100.0,
+                 "name": "copy.1"},
+            ]}
+            with gzip.open(f"{trace_dir}/host.trace.json.gz", "wt") as f:
+                jsonlib.dump(doc, f)
+            return trace_dir
+
+        def stop_profile(self):
+            self.profiling = False
+
+    engine = _FakeEngine()
+    app = web.Application()
+    add_debug_routes(app, lambda: engine, enable_profiling=True)
+    try:
+        async def scenario(client):
+            resp = await client.post("/debug/profiler/capture",
+                                     params={"steps": "2", "top": "1"})
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["steps_requested"] == 2
+            assert data["steps_observed"] == 0  # idle fake engine
+            profile = data["profile"]
+            assert profile["ops_total"] == 2
+            assert [op["name"] for op in profile["ops"]] == ["fusion.7"]
+            assert profile["ops"][0]["share"] == pytest.approx(0.75)
+            # Merged into the ledger: /debug/kernels now carries it.
+            resp = await client.get("/debug/kernels")
+            assert (await resp.json())["profile"]["ops_total"] == 2
+
+            # Concurrent capture while a trace runs: 409, engine state
+            # untouched.
+            engine.profiling = True
+            resp = await client.post("/debug/profiler/capture")
+            assert resp.status == 409
+            engine.profiling = False
+
+            resp = await client.post("/debug/profiler/capture",
+                                     params={"steps": "bogus"})
+            assert resp.status == 400
+
+        _run(app, scenario)
+    finally:
+        ledger.reset_for_testing()
 
 
 @pytest.mark.skipif(not _PROMETHEUS, reason="needs prometheus_client")

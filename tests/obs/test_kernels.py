@@ -1,0 +1,326 @@
+"""Unit suite for the per-kernel cost ledger (obs/kernels.py): bucket
+keying, introspection parsing (faked cost/memory analysis objects),
+top-K ordering, the CPU/no-TPU degradation contract (nulls, never an
+exception on the dispatch path), the cost-model MFU window, and the
+profiler-trace parser against a faked trace-event file.
+
+The module also prints its own wall-clock on teardown: the ledger tests
+run inside the tier-1 870s budget, so the suite self-reports what it
+costs (see docs/observability.md "Testing hooks")."""
+import gzip
+import json
+import math
+import sys
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from intellillm_tpu.obs.kernels import (KernelLedger, _parse_cost_analysis,
+                                        get_kernel_ledger, parse_trace_dir)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _module_wallclock():
+    t0 = time.perf_counter()
+    yield
+    # sys.__stderr__ bypasses pytest's capture: the tier-1 log always
+    # shows what the ledger suite cost against the 870s budget.
+    sys.__stderr__.write(
+        f"\n[tier-1 budget] tests/obs/test_kernels.py wall-clock: "
+        f"{time.perf_counter() - t0:.1f}s\n")
+
+
+@pytest.fixture
+def ledger():
+    led = get_kernel_ledger()
+    led.reset_for_testing()
+    yield led
+    led.reset_for_testing()
+
+
+def _fake_fn(cost, mem, lower_raises=None):
+    """A stand-in for a jitted function: .lower(...).compile() returns
+    an object with cost_analysis()/memory_analysis()."""
+    compiled = SimpleNamespace(cost_analysis=lambda: cost,
+                               memory_analysis=lambda: mem)
+    lowered = SimpleNamespace(compile=lambda: compiled)
+
+    def lower(*args, **kwargs):
+        if lower_raises is not None:
+            raise lower_raises
+        return lowered
+
+    return SimpleNamespace(lower=lower)
+
+
+_MEM = SimpleNamespace(argument_size_in_bytes=1000,
+                       output_size_in_bytes=200,
+                       temp_size_in_bytes=300,
+                       generated_code_size_in_bytes=8)
+
+
+def _dispatch_new(ledger, program, key, fn, elapsed=0.1):
+    """Drive the prepare/commit pair the runner's _guarded_call uses."""
+    pending = ledger.prepare(program, key, fn,
+                             (np.ones((4,), np.float32),), {})
+    assert pending is not None
+    ledger.commit(pending, elapsed)
+
+
+def test_keying_new_vs_seen_bucket(ledger):
+    ledger.introspect_mode = "on"
+    fn = _fake_fn([{"flops": 100.0, "bytes accessed": 50.0}], _MEM)
+    _dispatch_new(ledger, "mixed", (8, 128), fn)
+    # Same (program, key) again: counted, not re-introspected.
+    assert ledger.prepare("mixed", (8, 128), fn, (), {}) is None
+    # Same key under another program is a distinct executable.
+    assert ledger.prepare("decode_fused", (8, 128), fn, (), {}) is not None
+
+    snap = ledger.snapshot(top=8)
+    entry = snap["executables"][0]
+    assert entry["program"] == "mixed"
+    assert entry["bucket"] == repr((8, 128))
+    assert entry["flops"] == 100.0
+    assert entry["bytes_accessed"] == 50.0
+    assert entry["intensity_flops_per_byte"] == 2.0
+    assert entry["hbm_peak_bytes"] == 1000 + 200 + 300 + 8
+    assert entry["hbm_temp_bytes"] == 300
+    assert entry["compile_seconds"] == pytest.approx(0.1)
+    assert entry["dispatches"] == 2
+    assert entry["analysis"] == "ok"
+
+
+def test_cost_analysis_accepts_dict_and_list_forms():
+    # jax returns a plain dict on some versions, [dict] on others.
+    for raw in ({"flops": 7.0, "bytes accessed": 3.0},
+                [{"flops": 7.0, "bytes accessed": 3.0}]):
+        parsed = _parse_cost_analysis(raw)
+        assert parsed["flops"] == 7.0
+        assert parsed["bytes_accessed"] == 3.0
+    # XLA's -1 means "unknown": normalized to null, never kept as a
+    # negative that would poison sums.
+    parsed = _parse_cost_analysis({"flops": -1, "bytes accessed": 4.0})
+    assert parsed["flops"] is None
+    # Empty / non-dict shapes: every value null, nothing raises.
+    assert all(v is None for v in _parse_cost_analysis([]).values())
+    assert _parse_cost_analysis(None) == {}
+    assert _parse_cost_analysis("garbage") == {}
+
+
+def test_top_k_ordering_analyzed_first_then_hottest(ledger):
+    ledger.introspect_mode = "on"
+    fn_small = _fake_fn([{"flops": 10.0, "bytes accessed": 5.0}], _MEM)
+    fn_big = _fake_fn([{"flops": 900.0, "bytes accessed": 5.0}], _MEM)
+    _dispatch_new(ledger, "mixed", ("small",), fn_small)
+    _dispatch_new(ledger, "mixed", ("big",), fn_big)
+    ledger.introspect_mode = "off"
+    fn_null = _fake_fn(None, None)
+    _dispatch_new(ledger, "decode_fused", ("null",), fn_null)
+    for _ in range(3):
+        assert ledger.prepare("decode_fused", ("null",), fn_null,
+                              (), {}) is None
+
+    snap = ledger.snapshot(top=2)
+    assert snap["executables_total"] == 3
+    assert [e["bucket"] for e in snap["executables"]] == [
+        repr(("big",)), repr(("small",))]
+    # Null entries sort after analyzed ones but are never dropped from
+    # the aggregates.
+    assert snap["programs"]["decode_fused"]["dispatches"] == 4
+    assert snap["programs"]["decode_fused"]["flops_max"] is None
+
+
+def test_failed_first_dispatch_forgets_the_key(ledger):
+    ledger.introspect_mode = "on"
+    fn = _fake_fn([{"flops": 1.0}], _MEM)
+    pending = ledger.prepare("mixed", ("oom",), fn, (), {})
+    assert pending is not None
+    ledger.abandon(pending)  # dispatch raised
+    # Retry is introspected fresh, not treated as a cache hit.
+    assert ledger.prepare("mixed", ("oom",), fn, (), {}) is not None
+    assert ledger.snapshot(top=1)["executables_total"] == 0
+
+
+def test_introspection_failure_degrades_to_null_entry(ledger):
+    """Satellite regression test: cost_analysis()/memory_analysis()
+    raising or returning empty must produce a null entry — NaN-not-0 on
+    gauges, None in JSON — and NEVER an exception on the dispatch
+    path."""
+    ledger.introspect_mode = "on"
+    # lower() raises outright.
+    fn_raise = _fake_fn(None, None, lower_raises=RuntimeError("no aot"))
+    _dispatch_new(ledger, "mixed", ("raise",), fn_raise)  # must not throw
+    # cost_analysis returns empty, memory_analysis raises.
+    def _mem_raises():
+        raise NotImplementedError("cpu")
+    compiled = SimpleNamespace(cost_analysis=lambda: [],
+                               memory_analysis=_mem_raises)
+    fn_empty = SimpleNamespace(
+        lower=lambda *a, **k: SimpleNamespace(compile=lambda: compiled))
+    _dispatch_new(ledger, "mixed", ("empty",), fn_empty)
+
+    snap = ledger.snapshot(top=8)
+    by_bucket = {e["bucket"]: e for e in snap["executables"]}
+    for bucket, status in ((repr(("raise",)), "error"),
+                           (repr(("empty",)), "empty")):
+        entry = by_bucket[bucket]
+        assert entry["analysis"] == status
+        for field in ("flops", "bytes_accessed", "hbm_peak_bytes",
+                      "hbm_temp_bytes", "intensity_flops_per_byte"):
+            assert entry[field] is None, (bucket, field)
+    # The gauges read NaN (never 0) while no executable is analyzed.
+    if ledger._metrics is not None:
+        value = ledger._metrics.gauge_flops.labels("mixed")._value.get()
+        assert math.isnan(value)
+    # The JSON stays serializable with the nulls in place.
+    json.dumps(snap)
+
+
+def test_cpu_auto_mode_creates_null_entries(ledger, monkeypatch):
+    """Default `auto` on the CPU backend: entries exist for every
+    bucket, analysis fields are null — introspection's second compile
+    is not paid on the tier-1 backend."""
+    monkeypatch.delenv("INTELLILLM_KERNEL_INTROSPECT", raising=False)
+    ledger.reset_for_testing()
+    assert ledger.introspect_mode == "auto"
+    import jax
+    fn = jax.jit(lambda x: x + 1)
+    x = np.ones((4,), np.float32)
+    pending = ledger.prepare("mixed", ("cpu",), fn, (x,), {})
+    fn(x)
+    ledger.commit(pending, 0.05)
+    entry = ledger.snapshot(top=1)["executables"][0]
+    assert entry["analysis"] == "skipped"
+    assert entry["flops"] is None and entry["bytes_accessed"] is None
+    assert entry["compile_seconds"] == pytest.approx(0.05)
+
+
+def test_mfu_costmodel_window_and_unknown_poisoning(ledger, monkeypatch):
+    monkeypatch.setenv("INTELLILLM_PEAK_FLOPS", "1e6")
+    ledger.reset_for_testing()
+    ledger.introspect_mode = "on"
+    fn = _fake_fn([{"flops": 5e3, "bytes accessed": 1.0}], _MEM)
+    _dispatch_new(ledger, "mixed", ("a",), fn)
+    # 5e3 FLOPs in 0.01s against a 1e6 FLOP/s peak: MFU = 0.5.
+    assert ledger.record_step(0.01) == pytest.approx(0.5)
+    assert ledger.snapshot(top=0)["mfu_costmodel"] == pytest.approx(0.5)
+    if ledger._metrics is not None:
+        assert ledger._metrics.gauge_mfu_costmodel._value.get() == \
+            pytest.approx(0.5)
+
+    # A dispatch with unknown FLOPs poisons the step: a partial sum
+    # would silently understate MFU, so the window reads null instead.
+    ledger.introspect_mode = "off"
+    _dispatch_new(ledger, "mixed", ("null",), _fake_fn(None, None))
+    assert ledger.record_step(0.01) is None
+    assert ledger.snapshot(top=0)["mfu_costmodel"] is None
+    if ledger._metrics is not None:
+        assert math.isnan(
+            ledger._metrics.gauge_mfu_costmodel._value.get())
+    # Known steps rebuild the window afterwards.
+    assert ledger.prepare("mixed", ("a",), fn, (), {}) is None
+    assert ledger.record_step(0.01) == pytest.approx(0.5)
+
+
+def test_merge_profile_top_k_and_shares(ledger):
+    ops = [{"name": "fusion.1", "total_us": 600.0, "count": 3},
+           {"name": "fusion.2", "total_us": 300.0, "count": 2},
+           {"name": "copy.3", "total_us": 100.0, "count": 9}]
+    block = ledger.merge_profile(ops, steps=4, top=2)
+    assert block["steps"] == 4
+    assert block["ops_total"] == 3
+    assert block["total_us"] == pytest.approx(1000.0)
+    assert [op["name"] for op in block["ops"]] == ["fusion.1", "fusion.2"]
+    assert block["ops"][0]["share"] == pytest.approx(0.6)
+    snap = ledger.snapshot(top=0)
+    assert snap["profile"]["ops"][1]["share"] == pytest.approx(0.3)
+    json.dumps(snap)
+
+
+def _write_trace(path, events):
+    doc = {"displayTimeUnit": "ns", "metadata": {}, "traceEvents": events}
+    with gzip.open(path, "wt", encoding="utf-8") as f:
+        json.dump(doc, f)
+
+
+def test_parse_trace_dir_prefers_device_lanes(tmp_path):
+    plugin_dir = tmp_path / "plugins" / "profile" / "2026_08_08"
+    plugin_dir.mkdir(parents=True)
+    _write_trace(plugin_dir / "host.trace.json.gz", [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        # Host python frames: excluded once a device lane exists.
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 9999.0,
+         "name": "$pjit.py:330 cache_miss"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 5000.0,
+         "name": "PjitFunction(step)"},
+        # Device ops: summed by name across events.
+        {"ph": "X", "pid": 9, "tid": 2, "ts": 0, "dur": 120.5,
+         "name": "fusion.1"},
+        {"ph": "X", "pid": 9, "tid": 2, "ts": 200, "dur": 79.5,
+         "name": "fusion.1"},
+        {"ph": "X", "pid": 9, "tid": 3, "ts": 0, "dur": 50.0,
+         "name": "copy.2"},
+        # Malformed events are skipped, not fatal.
+        {"ph": "X", "pid": 9, "tid": 3, "ts": 0, "name": "no-dur"},
+        {"ph": "C", "pid": 9, "name": "counter", "dur": 1.0},
+    ])
+    ops = parse_trace_dir(str(tmp_path))
+    assert [op["name"] for op in ops] == ["fusion.1", "copy.2"]
+    assert ops[0]["total_us"] == pytest.approx(200.0)
+    assert ops[0]["count"] == 2
+
+
+def test_parse_trace_dir_cpu_single_lane_filters_python_frames(tmp_path):
+    _write_trace(tmp_path / "vm.trace.json.gz", [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 9999.0,
+         "name": "$profiler.py:91 start_trace"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 42.0,
+         "name": "PjitFunction(decode)"},
+    ])
+    ops = parse_trace_dir(str(tmp_path))
+    assert [op["name"] for op in ops] == ["PjitFunction(decode)"]
+
+
+def test_parse_trace_dir_corrupt_or_missing_is_empty(tmp_path):
+    assert parse_trace_dir(str(tmp_path / "nowhere")) == []
+    bad = tmp_path / "x.trace.json.gz"
+    bad.write_bytes(b"not gzip at all")
+    assert parse_trace_dir(str(tmp_path)) == []
+
+
+def test_reset_for_testing_clears_everything(ledger):
+    ledger.introspect_mode = "on"
+    _dispatch_new(ledger, "mixed", ("k",),
+                  _fake_fn([{"flops": 1.0}], _MEM))
+    ledger.merge_profile([{"name": "f", "total_us": 1.0, "count": 1}],
+                         steps=1)
+    ledger.record_step(0.01)
+    ledger.reset_for_testing()
+    snap = ledger.snapshot(top=4)
+    assert snap["executables_total"] == 0
+    assert snap["steps"] == 0
+    assert snap["profile"] is None
+    # The key space is forgotten too: the same bucket is "new" again.
+    assert ledger.prepare("mixed", ("k",), _fake_fn(None, None),
+                          (), {}) is not None
+
+
+def test_disabled_ledger_is_a_noop(monkeypatch):
+    monkeypatch.setenv("INTELLILLM_KERNEL_LEDGER", "0")
+    led = get_kernel_ledger()
+    led.reset_for_testing()
+    try:
+        assert led.prepare("mixed", ("k",), _fake_fn(None, None),
+                           (), {}) is None
+        assert led.record_step(0.01) is None
+        assert led.snapshot(top=4)["enabled"] is False
+    finally:
+        monkeypatch.delenv("INTELLILLM_KERNEL_LEDGER")
+        led.reset_for_testing()

@@ -50,6 +50,12 @@ def test_constructors_are_found():
     assert "intellillm_spec_emitted_tokens_total" in names
     assert "intellillm_spec_current_k" in names
     assert "intellillm_spec_verify_waste_ratio" in names
+    # Per-kernel cost-ledger families (PR 16).
+    assert "intellillm_kernel_flops" in names
+    assert "intellillm_kernel_bytes_accessed" in names
+    assert "intellillm_kernel_hbm_peak_bytes" in names
+    assert "intellillm_kernel_executables" in names
+    assert "intellillm_kernel_mfu_costmodel" in names
 
 
 def test_every_metric_name_is_prefixed():
