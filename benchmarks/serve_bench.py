@@ -254,6 +254,31 @@ def distill_alerts(alerts: dict) -> dict:
     }
 
 
+def distill_contention(detail: dict) -> dict:
+    """Compact the scheduler's contention ledger (the `contention` block
+    of /health/detail, backed by intellillm_sched_deferred_seconds_total
+    / intellillm_sched_decisions_total) into the A/B-comparable block:
+    deferred-seconds-by-cause plus the preemption/requeue counts — the
+    *why* next to every scenario's queue-wait numbers."""
+    block = (detail or {}).get("contention")
+    if not block:
+        return {"error": (detail or {}).get(
+            "error", "no contention block in /health/detail")}
+    decisions = block.get("decisions") or {}
+    return {
+        "deferred_seconds_by_cause":
+            block.get("deferred_seconds_by_cause") or {},
+        "decisions": decisions,
+        "preemptions": decisions.get("preempt_victim", 0),
+        "requeues": decisions.get("requeue", 0),
+    }
+
+
+def snapshot_contention(base: str) -> dict:
+    """distill_contention over a fresh /health/detail scrape."""
+    return distill_contention(snapshot_health_detail(base))
+
+
 def snapshot_fleet_traces(router_base: str, limit: int = 3) -> dict:
     """Sample stitched fleet traces from the router: recent trace ids
     from /debug/trace, each fetched via /debug/trace/{id} — the per-hop
@@ -523,8 +548,11 @@ def run_fleet(args, model_dir: str, tokenizer) -> dict:
                 "hops_ms": slo.get("hops_ms"),
                 "queue_depths": detail.get("queue_depths"),
                 "kv_cache_usage": detail.get("kv_cache_usage"),
+                "contention": distill_contention(detail),
             }
         summary["per_replica_slo"] = per_replica
+        summary["contention"] = {
+            name: pr["contention"] for name, pr in per_replica.items()}
         print(json.dumps({"serve_bench_fleet": {
             "per_replica_slo": per_replica,
             "router": summary["router"],
@@ -597,12 +625,14 @@ def _run_role_fleet(args, model_dir, tokenizer, roles, label,
         detail = snapshot_health_detail(router_base)
         router_detail = (detail.get("router") or {}) if detail else {}
         per_replica_kv = {}
+        per_replica_contention = {}
         kv_bytes = {"export": 0, "import": 0}
         kv_seconds = {"export": 0.0, "import": 0.0}
         for name, base, proc, log_path in replicas:
             rd = snapshot_health_detail(base) or {}
             kv = rd.get("kv_transfer")
             per_replica_kv[name] = kv
+            per_replica_contention[name] = distill_contention(rd)
             if kv:
                 for d in ("export", "import"):
                     kv_bytes[d] += (kv.get("bytes_total") or {}).get(d, 0)
@@ -620,6 +650,7 @@ def _run_role_fleet(args, model_dir, tokenizer, roles, label,
             "kv_bytes": kv_bytes,
             "kv_seconds": {d: round(s, 6) for d, s in kv_seconds.items()},
             "per_replica_kv": per_replica_kv,
+            "contention": per_replica_contention,
         }
     finally:
         if router_proc is not None:
@@ -666,6 +697,8 @@ def run_disagg(args, model_dir, tokenizer) -> dict:
                "num_prompts": args.num_prompts,
                "max_num_seqs": args.max_num_seqs,
                "fleets": {"disagg": disagg, "mixed": mixed},
+               "contention": {"disagg": disagg.get("contention"),
+                              "mixed": mixed.get("contention")},
                "comparison": comparison}
     print(json.dumps({"serve_bench_disagg": comparison}), flush=True)
     print(json.dumps({"serve_bench_summary": summary}), flush=True)
@@ -866,6 +899,7 @@ def run_multi_tenant(args, model_dir, tokenizer) -> dict:
         phases["contention_caps_on"] = caps_on
         detail = snapshot_health_detail(base)
         summary["tenants_caps_on"] = detail.get("tenants")
+        contention = {"caps_on": distill_contention(detail)}
         summary["alerts_caps_on"] = distill_alerts(snapshot_alerts(base))
     finally:
         proc.send_signal(signal.SIGKILL)
@@ -881,6 +915,7 @@ def run_multi_tenant(args, model_dir, tokenizer) -> dict:
         phases["contention_caps_off"] = caps_off
         detail = snapshot_health_detail(base)
         summary["tenants_caps_off"] = detail.get("tenants")
+        contention["caps_off"] = distill_contention(detail)
     finally:
         proc.send_signal(signal.SIGKILL)
         proc.wait()
@@ -896,6 +931,7 @@ def run_multi_tenant(args, model_dir, tokenizer) -> dict:
                 for t in sorted({r["tenant"] for r in rows})},
         }
     summary["victim_latency"] = per_phase
+    summary["contention"] = contention
 
     def ratio(a, b):
         return (round(a / b, 3)
@@ -1149,6 +1185,7 @@ def run_single(args, model_dir, tokenizer, scheduling_policy=None) -> dict:
         summary["device_telemetry"] = distill_device_telemetry(detail)
         summary["efficiency"] = snapshot_efficiency(base)
         summary["kernels"] = snapshot_kernels(base)
+        summary["contention"] = distill_contention(detail)
         summary["alerts"] = distill_alerts(snapshot_alerts(base))
     finally:
         proc.send_signal(signal.SIGKILL)

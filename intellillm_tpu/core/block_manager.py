@@ -349,3 +349,10 @@ class BlockSpaceManager:
 
     def get_num_free_cpu_blocks(self) -> int:
         return self.cpu_allocator.get_num_free_blocks()
+
+    def kv_pressure_detail(self) -> str:
+        """Compact free-vs-watermark snapshot for scheduler decision
+        events: what the watermark check saw when it said LATER."""
+        return (f"free={self.device_allocator.get_num_free_blocks()}"
+                f"/{self.num_total_device_blocks}"
+                f",watermark={self.watermark_blocks}")

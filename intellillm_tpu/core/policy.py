@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from typing import Deque, List, Optional
 
+from intellillm_tpu.obs.decisions import get_decision_log
 from intellillm_tpu.sequence import SequenceGroup
 
 
@@ -46,6 +47,9 @@ class Policy:
         age = now - seq_group.arrival_time
         if age < self.starvation_s:
             return None
+        # Decision-log verdict (deduped there — sort_by_priority
+        # re-derives promotion for every group on every pass).
+        get_decision_log().promoted(seq_group.request_id, age)
         return self._PROMOTED + age
 
     def get_priority(self, now: float, seq_group: SequenceGroup) -> float:

@@ -32,8 +32,8 @@ from intellillm_tpu.config import CacheConfig, LoRAConfig, SchedulerConfig
 from intellillm_tpu.core.block_manager import AllocStatus, BlockSpaceManager
 from intellillm_tpu.core.policy import Policy, PolicyFactory
 from intellillm_tpu.logger import init_logger
-from intellillm_tpu.obs import (get_flight_recorder, get_slo_tracker,
-                                get_step_tracer)
+from intellillm_tpu.obs import (get_decision_log, get_flight_recorder,
+                                get_slo_tracker, get_step_tracer)
 from intellillm_tpu.prediction import get_prediction_service
 from intellillm_tpu.prefix import PrefixPool
 from intellillm_tpu.sequence import (Sequence, SequenceData, SequenceGroup,
@@ -296,6 +296,7 @@ class Scheduler:
 
         self._tracer = get_step_tracer()
         self._flight = get_flight_recorder()
+        self._decisions = get_decision_log()
 
     @property
     def lora_enabled(self) -> bool:
@@ -322,6 +323,7 @@ class Scheduler:
         # entry, before tokenization) so SLO queue-wait = scheduled -
         # queued measures scheduler wait only.
         self._flight.record(seq_group.request_id, "queued")
+        self._decisions.note_queued(seq_group.request_id)
         self.waiting.append(seq_group)
 
     def abort_seq_group(self, request_id: Union[str, Iterable[str]]) -> None:
@@ -365,11 +367,14 @@ class Scheduler:
         yield from self.running
         yield from self.swapped
 
-    def _pop_preemption_victim(self) -> SequenceGroup:
+    def _pop_preemption_victim(
+            self, trigger: Optional[str] = None) -> SequenceGroup:
         """Remove and return the running group with the most predicted
         remaining work (p90 when available — evicting the priciest tail
         frees the most future block demand per preemption). Groups
-        without any prediction fall back to the priority-order tail."""
+        without any prediction fall back to the priority-order tail.
+        `trigger` is the request that needed the blocks (decision-log
+        attribution only)."""
         best_i = -1
         best_remaining = -1.0
         for i, sg in enumerate(self.running):
@@ -384,9 +389,14 @@ class Scheduler:
             if remaining > best_remaining:
                 best_i, best_remaining = i, remaining
         if best_i < 0:
-            return self.running.pop()  # lowest priority
+            victim = self.running.pop()  # lowest priority
+            self._decisions.preempt_victim(
+                victim.request_id, None, trigger, "priority_tail")
+            return victim
         victim = self.running[best_i]
         del self.running[best_i]
+        self._decisions.preempt_victim(
+            victim.request_id, best_remaining, trigger, "p90_priced")
         return victim
 
     # --- the scheduling pass --------------------------------------------
@@ -421,6 +431,8 @@ class Scheduler:
         # only the mixed program family ever runs.
         # Admit while nothing is swapped out (swapped groups have
         # priority — they were already admitted once).
+        if self.swapped and self.waiting:
+            self._decisions.pass_blocked("swap_backlog")
         if not self.swapped:
             scheduled: List[SequenceGroup] = []
             chunks: Dict[str, Tuple[int, int, bool]] = {}
@@ -458,6 +470,9 @@ class Scheduler:
 
                 can_allocate = self.block_manager.can_allocate(seq_group)
                 if can_allocate == AllocStatus.LATER:
+                    self._decisions.pass_blocked(
+                        "kv_watermark",
+                        self.block_manager.kv_pressure_detail())
                     break
                 if can_allocate == AllocStatus.NEVER:
                     logger.warning(
@@ -473,6 +488,7 @@ class Scheduler:
                 if self._lora_cap_exceeded(curr_loras, lora_id):
                     # Defer: admitting would exceed the concurrent-adapter
                     # slots; later groups may still fit.
+                    self._decisions.defer(seq_group.request_id, "lora_cap")
                     self.waiting.popleft()
                     lora_deferred.append(seq_group)
                     continue
@@ -480,6 +496,8 @@ class Scheduler:
                         seq_group,
                         waiting_seqs[0].data.get_num_uncomputed_tokens(),
                         check_chunk=True):
+                    self._decisions.defer(seq_group.request_id,
+                                          "tenant_fairness")
                     self.waiting.popleft()
                     tenant_deferred.append(seq_group)
                     continue
@@ -494,17 +512,21 @@ class Scheduler:
                 if new_tokens > self._max_chunk_size:
                     # Sliding-window cap: this prompt needs real chunking —
                     # leave it for a serial chunked pass.
+                    self._decisions.pass_blocked("token_budget",
+                                                 "needs_chunking")
                     break
 
                 # Flat token accounting: the runner flattens prompt rows
                 # into one (token_budget,)-bucketed batch, so the budget
                 # caps the SUM of chunk tokens, not batch x max-len.
                 if num_batched_tokens + new_tokens > self._prefill_token_budget:
+                    self._decisions.pass_blocked("token_budget")
                     break
 
                 num_new_seqs = seq_group.get_max_num_running_seqs()
                 if (num_curr_seqs + num_new_seqs
                         > self.scheduler_config.max_num_seqs):
+                    self._decisions.pass_blocked("max_seqs")
                     break
 
                 # Padding waste counted against the *bucketed* flat shape
@@ -515,6 +537,7 @@ class Scheduler:
                 num_paddings = (
                     pad_to_bucket(total, self._mixed_token_buckets) - total)
                 if scheduled and num_paddings > self.scheduler_config.max_paddings:
+                    self._decisions.pass_blocked("padding")
                     break
                 num_batched_tokens = total
 
@@ -527,6 +550,7 @@ class Scheduler:
                     curr_loras.add(lora_id)
                 fairness.note_admit(seq_group)
                 scheduled.append(seq_group)
+                self._decisions.scheduled(seq_group.request_id)
                 if seq_group.first_scheduled_time is None:
                     seq_group.first_scheduled_time = now
                     self._flight.record(seq_group.request_id, "scheduled")
@@ -607,7 +631,8 @@ class Scheduler:
             while not self.block_manager.can_append_slots(
                     seq_group, self._clamped_steps(seq_group, steps)):
                 if self.running:
-                    victim = self._pop_preemption_victim()
+                    victim = self._pop_preemption_victim(
+                        trigger=seq_group.request_id)
                     self._preempt(victim, blocks_to_swap_out)
                     preempted.append(victim)
                 else:
@@ -635,20 +660,27 @@ class Scheduler:
                 steps = self._row_steps(seq_group, num_steps, spec_requests)
                 if not self.block_manager.can_swap_in(
                         seq_group, self._clamped_steps(seq_group, steps)):
+                    self._decisions.pass_blocked(
+                        "kv_watermark",
+                        self.block_manager.kv_pressure_detail())
                     break
                 lora_id = seq_group.lora_int_id
                 if self._lora_cap_exceeded(curr_loras, lora_id):
+                    self._decisions.defer(seq_group.request_id, "lora_cap")
                     self.swapped.popleft()
                     lora_deferred_swap.append(seq_group)
                     continue
                 if fairness.defer_admission(
                         seq_group, seq_group.get_max_num_running_seqs()):
+                    self._decisions.defer(seq_group.request_id,
+                                          "tenant_fairness")
                     self.swapped.popleft()
                     tenant_deferred_swap.append(seq_group)
                     continue
                 num_new_seqs = seq_group.get_max_num_running_seqs()
                 if (num_curr_seqs + num_new_seqs
                         > self.scheduler_config.max_num_seqs):
+                    self._decisions.pass_blocked("max_seqs")
                     break
                 self.swapped.popleft()
                 self._swap_in(seq_group, blocks_to_swap_in)
@@ -731,7 +763,8 @@ class Scheduler:
             while not self.block_manager.can_append_slots(
                     seq_group, self._clamped_steps(seq_group, steps)):
                 if self.running:
-                    victim = self._pop_preemption_victim()
+                    victim = self._pop_preemption_victim(
+                        trigger=seq_group.request_id)
                     self._preempt(victim, blocks_to_swap_out)
                     preempted.append(victim)
                 else:
@@ -773,20 +806,27 @@ class Scheduler:
                 steps = self._row_steps(seq_group, 1, spec_requests)
                 if not self.block_manager.can_swap_in(
                         seq_group, self._clamped_steps(seq_group, steps)):
+                    self._decisions.pass_blocked(
+                        "kv_watermark",
+                        self.block_manager.kv_pressure_detail())
                     break
                 lora_id = seq_group.lora_int_id
                 if self._lora_cap_exceeded(curr_loras, lora_id):
+                    self._decisions.defer(seq_group.request_id, "lora_cap")
                     self.swapped.popleft()
                     lora_deferred_swap.append(seq_group)
                     continue
                 if fairness.defer_admission(
                         seq_group, seq_group.get_max_num_running_seqs()):
+                    self._decisions.defer(seq_group.request_id,
+                                          "tenant_fairness")
                     self.swapped.popleft()
                     tenant_deferred_swap.append(seq_group)
                     continue
                 num_new_seqs = seq_group.get_max_num_running_seqs()
                 if (num_curr_seqs + num_new_seqs
                         > self.scheduler_config.max_num_seqs):
+                    self._decisions.pass_blocked("max_seqs")
                     break
                 self.swapped.popleft()
                 self._swap_in(seq_group, blocks_to_swap_in)
@@ -830,6 +870,9 @@ class Scheduler:
                                    self._mixed_token_buckets) - mixed_rows)
             if slack <= 0 and decode_groups:
                 deferred = decode_groups.pop()
+                self._decisions.defer(
+                    deferred.request_id, "token_budget",
+                    "decode_deferred_one_step_for_prefill")
                 n = deferred.num_seqs(status=SequenceStatus.RUNNING)
                 decode_rows -= n
                 if (spec_requests is not None
@@ -847,14 +890,22 @@ class Scheduler:
                 break
             seq = seq_group.get_seqs(status=SequenceStatus.RUNNING)[0]
             remaining = seq.data.get_num_uncomputed_tokens()
-            size = fairness.allowed_chunk(
-                seq_group, min(remaining, slack, self._max_chunk_size))
+            want = min(remaining, slack, self._max_chunk_size)
+            size = fairness.allowed_chunk(seq_group, want)
             if size <= 0:
                 # Tenant's chunk share for this step is spent; the group
                 # stays resident and resumes next step.
+                self._decisions.chunk_split(
+                    seq_group.request_id,
+                    seq.data.get_num_computed_tokens(), 0, remaining,
+                    "tenant_fairness")
                 continue
             start = seq.data.get_num_computed_tokens()
             final = size == remaining
+            if not final:
+                self._decisions.chunk_split(
+                    seq_group.request_id, start, size, remaining - size,
+                    "tenant_fairness" if size < want else "token_budget")
             seq.data.update_num_computed_tokens(size)
             if final:
                 seq.data.mark_prefill_complete()
@@ -867,6 +918,9 @@ class Scheduler:
         # mixed dispatch's multi-sample rows, prompt_logprobs accumulate
         # across chunks, prefix hits start past the computed tokens).
         # Swapped groups keep priority; a preempting step admits nothing.
+        if self.waiting and (preempted or self.swapped):
+            self._decisions.pass_blocked(
+                "preempted" if preempted else "swap_backlog")
         if not preempted and not self.swapped:
             num_curr_seqs = sum(sg.get_max_num_running_seqs()
                                 for sg in self.running)
@@ -895,6 +949,9 @@ class Scheduler:
                     continue
                 can_allocate = self.block_manager.can_allocate(seq_group)
                 if can_allocate == AllocStatus.LATER:
+                    self._decisions.pass_blocked(
+                        "kv_watermark",
+                        self.block_manager.kv_pressure_detail())
                     break
                 if can_allocate == AllocStatus.NEVER:
                     logger.warning(
@@ -915,12 +972,15 @@ class Scheduler:
                         seq_group,
                         waiting_seqs[0].data.get_num_uncomputed_tokens(),
                         check_chunk=True):
+                    self._decisions.defer(seq_group.request_id,
+                                          "tenant_fairness")
                     self.waiting.popleft()
                     tenant_deferred.append(seq_group)
                     continue
                 num_new_seqs = seq_group.get_max_num_running_seqs()
                 if (num_curr_seqs + num_new_seqs
                         > self.scheduler_config.max_num_seqs):
+                    self._decisions.pass_blocked("max_seqs")
                     break
                 self.waiting.popleft()
                 self._allocate(seq_group, mark_prefilled=False)
@@ -945,9 +1005,13 @@ class Scheduler:
                         "router's KV handoff missed for %s",
                         num_prompt_tokens, seq_group.request_id)
                 remaining = num_prompt_tokens - start
-                size = fairness.allowed_chunk(
-                    seq_group, min(remaining, slack, self._max_chunk_size))
+                want = min(remaining, slack, self._max_chunk_size)
+                size = fairness.allowed_chunk(seq_group, want)
                 final = size == remaining
+                if not final:
+                    self._decisions.chunk_split(
+                        seq_group.request_id, start, size, remaining - size,
+                        "tenant_fairness" if size < want else "token_budget")
                 seq.data.update_num_computed_tokens(size)
                 if final:
                     seq.data.mark_prefill_complete()
@@ -959,12 +1023,17 @@ class Scheduler:
                 if curr_loras is not None and lora_id > 0:
                     curr_loras.add(lora_id)
                 fairness.note_admit(seq_group)
+                self._decisions.scheduled(seq_group.request_id)
                 if seq_group.first_scheduled_time is None:
                     seq_group.first_scheduled_time = now
                     self._flight.record(seq_group.request_id, "scheduled")
                 self._flight.record(
                     seq_group.request_id, "prefill_start",
                     detail=f"tokens={num_prompt_tokens},chunked=1")
+            if self.waiting and slack <= 0:
+                # Loop exited with prompts still waiting: the step's
+                # token budget is spent.
+                self._decisions.pass_blocked("token_budget")
             for sg in reversed(lora_deferred):
                 self.waiting.appendleft(sg)
             for sg in reversed(tenant_deferred):
@@ -991,7 +1060,15 @@ class Scheduler:
         self, prefill_only: bool = False,
     ) -> Tuple[List[SequenceGroupMetadata], SchedulerOutputs]:
         with self._tracer.span("schedule"):
+            # Decision-log pass bracket: verdict sites inside _schedule
+            # report what blocked admission; end_pass charges every
+            # still-waiting request the elapsed wall time to the cause
+            # observed this pass (see obs/decisions.py).
+            self._decisions.begin_pass()
             scheduler_outputs = self._schedule(prefill_only=prefill_only)
+            self._decisions.end_pass(
+                [sg.request_id for sg in self.waiting],
+                [sg.request_id for sg in self.swapped])
 
             seq_group_metadata_list: List[SequenceGroupMetadata] = []
             for seq_group in scheduler_outputs.scheduled_seq_groups:
@@ -1080,9 +1157,11 @@ class Scheduler:
         before the next scheduling pass) and 1 for everyone else."""
         if spec_requests is None:
             return num_steps
-        if seq_group_spec_eligible(seq_group):
-            return self.scheduler_config.num_decode_steps
-        return 1
+        k = self.scheduler_config.num_decode_steps
+        eligible = seq_group_spec_eligible(seq_group)
+        # Decision-log verdict (recorded on eligibility change only).
+        self._decisions.spec_plan(seq_group.request_id, eligible, k)
+        return k if eligible else 1
 
     def _clamped_steps(self, seq_group: SequenceGroup,
                        num_steps: int) -> int:
@@ -1130,6 +1209,8 @@ class Scheduler:
                 preemption_mode = PreemptionMode.SWAP
         self._flight.record(seq_group.request_id, "preempted",
                             detail=preemption_mode.name.lower())
+        self._decisions.requeued(seq_group.request_id,
+                                 preemption_mode.name.lower())
         if preemption_mode == PreemptionMode.RECOMPUTE:
             self._preempt_by_recompute(seq_group)
         else:
@@ -1171,6 +1252,7 @@ class Scheduler:
         blocks_to_swap_in.update(mapping)
         self._flight.record(seq_group.request_id, "swapped_in",
                             detail=f"blocks={len(mapping)}")
+        self._decisions.swap(seq_group.request_id, "in", len(mapping))
         for seq in seq_group.get_seqs(status=SequenceStatus.SWAPPED):
             seq.status = SequenceStatus.RUNNING
 
@@ -1187,5 +1269,6 @@ class Scheduler:
         blocks_to_swap_out.update(mapping)
         self._flight.record(seq_group.request_id, "swapped_out",
                             detail=f"blocks={len(mapping)}")
+        self._decisions.swap(seq_group.request_id, "out", len(mapping))
         for seq in seq_group.get_seqs(status=SequenceStatus.RUNNING):
             seq.status = SequenceStatus.SWAPPED

@@ -6,7 +6,18 @@
     GET  /debug/trace?request_id=<id>   flight-recorder events for one
                                         request (404 if unknown/evicted)
     GET  /debug/trace                   live request ids + recently
-                                        finished traces (?limit=N)
+                                        finished traces (?limit=N,
+                                        ?event=<name> keeps only traces
+                                        containing that event) + per-
+                                        terminal-event counts over the
+                                        finished ring
+    GET  /debug/explain/{request_id}    per-request root-cause explain:
+                                        scheduler decision events, the
+                                        queue-wait / stall decomposition
+                                        by cause, the measured SLO
+                                        timings, and a top-line verdict
+                                        (obs/decisions.py; 404 if the
+                                        request was never seen)
     GET  /debug/stall                   watchdog state + ring of stall
                                         reports (thread stacks, queue
                                         depths, compile snapshot)
@@ -80,8 +91,9 @@ from typing import Callable, Optional
 
 from aiohttp import web
 
-from intellillm_tpu.obs import (get_alert_manager, get_boot_timeline,
-                                get_compile_tracker, get_device_telemetry,
+from intellillm_tpu.obs import (EVENTS, explain_request, get_alert_manager,
+                                get_boot_timeline, get_compile_tracker,
+                                get_decision_log, get_device_telemetry,
                                 get_efficiency_tracker,
                                 get_flight_recorder, get_kernel_ledger,
                                 get_metrics_history, get_slo_tracker,
@@ -191,10 +203,28 @@ def add_debug_routes(app: web.Application,
         except ValueError:
             return web.json_response({"error": "limit must be an integer"},
                                      status=400)
+        event = request.query.get("event")
+        if event is not None and event not in EVENTS:
+            return web.json_response(
+                {"error": f"unknown event {event!r} "
+                 f"(one of: {', '.join(EVENTS)})"}, status=400)
         return web.json_response({
             "live_request_ids": recorder.live_request_ids(),
-            "recent_finished": recorder.recent_finished(limit),
+            "finished_counts": recorder.finished_counts(),
+            "recent_finished": recorder.recent_finished(limit, event=event),
         })
+
+    async def debug_explain(request: web.Request) -> web.Response:
+        """Root-cause explain for one request on this hop (the router's
+        /debug/explain/{trace_id} stitches these across hops)."""
+        request_id = request.match_info["request_id"]
+        payload = explain_request(request_id)
+        if not payload["found"]:
+            return web.json_response(
+                {"error": f"no trace or scheduler decisions for "
+                 f"request_id={request_id} (never seen, or evicted)"},
+                status=404)
+        return web.json_response(payload)
 
     async def debug_stall(request: web.Request) -> web.Response:
         watchdog = get_watchdog()
@@ -246,6 +276,11 @@ def add_debug_routes(app: web.Application,
             # Compact: the per-executable table lives at /debug/kernels.
             "kernels": get_kernel_ledger().health_block(),
             "live_requests": len(get_flight_recorder().live_request_ids()),
+            # Fleet contention ledger: deferred seconds by cause +
+            # decision counts (per-request decomposition at
+            # /debug/explain/{id}; intellillm-top renders this as the
+            # CONTENTION panel).
+            "contention": get_decision_log().summary(),
             "alerts": alerts.summary(),
             "boot": get_boot_timeline().snapshot(),
             # Compact: the per-bucket table lives at /debug/predictor.
@@ -377,6 +412,7 @@ def add_debug_routes(app: web.Application,
 
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/trace", debug_trace)
+    app.router.add_get("/debug/explain/{request_id}", debug_explain)
     app.router.add_get("/debug/stall", debug_stall)
     app.router.add_get("/debug/efficiency", debug_efficiency)
     app.router.add_get("/debug/history", debug_history)

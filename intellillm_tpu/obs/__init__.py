@@ -9,6 +9,8 @@ from intellillm_tpu.obs.boot import BootTimeline, get_boot_timeline
 from intellillm_tpu.obs.compile_tracker import (CompileTracker,
                                                 get_compile_tracker,
                                                 record_kernel_dispatch)
+from intellillm_tpu.obs.decisions import (CAUSES, DECISIONS, DecisionLog,
+                                          explain_request, get_decision_log)
 from intellillm_tpu.obs.device_telemetry import (DeviceTelemetry,
                                                  get_device_telemetry)
 from intellillm_tpu.obs.efficiency import (EfficiencyTracker,
@@ -34,7 +36,10 @@ __all__ = [
     "AlertManager",
     "AlertRule",
     "BootTimeline",
+    "CAUSES",
     "CompileTracker",
+    "DECISIONS",
+    "DecisionLog",
     "DeviceTelemetry",
     "EVENTS",
     "EfficiencyTracker",
@@ -49,10 +54,12 @@ __all__ = [
     "TraceSink",
     "built_in_rules",
     "derive_request_metrics",
+    "explain_request",
     "flush_black_box",
     "get_alert_manager",
     "get_boot_timeline",
     "get_compile_tracker",
+    "get_decision_log",
     "get_device_telemetry",
     "get_efficiency_tracker",
     "get_flight_recorder",

@@ -1,0 +1,581 @@
+"""Scheduler decision log: why the scheduler did what it did, per request.
+
+The flight recorder (obs/flight_recorder.py) records what *happened* to
+a request — `queued → scheduled → first_token`. It cannot say why a
+request sat queued for 4 seconds: blocked on the token budget? a
+tenant-fairness cap? the KV watermark? repeatedly preempted as the
+p90-priced victim? This module records the scheduler's *verdicts* and
+keeps a per-request wait ledger that attributes every queued / stalled
+second to a cause, so `GET /debug/explain/{request_id}` can decompose
+queue-wait exactly (the per-cause seconds sum to the SLO tracker's
+measured queue-wait) and emit a top-line verdict.
+
+Wiring contract (core/scheduler.py drives this):
+
+- `note_queued(rid)` opens the wait clock when the request enters the
+  WAITING queue (same site as the flight recorder's `queued`).
+- Each scheduling pass is bracketed by `begin_pass()` / `end_pass()`.
+  Inside the pass, verdict sites report what blocked admission:
+  `defer(rid, cause)` for per-request verdicts (tenant_fairness,
+  lora_cap) and `pass_blocked(cause)` for budget-style breaks that stop
+  the whole admission loop (token_budget, kv_watermark, max_seqs,
+  padding) — every request still waiting behind the break inherits the
+  pass's blocking cause. `end_pass()` charges each still-waiting
+  request the wall time since its last charge to the cause observed
+  THIS pass; `scheduled(rid)` closes the clock, charging the final
+  interval to the last observed cause. Intervals with no observed
+  cause (e.g. the sub-millisecond wait before an immediate admission)
+  are charged to `unattributed`, which keeps the decomposition summing
+  exactly but is never exported to the Prometheus `{cause}` series.
+- Preemption re-opens the clock in the `stall` phase (`requeued`), so
+  queue-wait (before first schedule — the SLO definition) and
+  post-preemption stall time decompose separately.
+- Point verdicts (`preempt_victim`, `promoted`, `chunk_split`,
+  `spec_plan`, `swap_in`/`swap_out`) append to the request's bounded
+  decision-event deque and bump `intellillm_sched_decisions_total`.
+
+Memory is bounded like the flight recorder: a capped live table
+(`INTELLILLM_DECISION_MAX_REQUESTS`, oldest evicted), a finished ring
+(256 — sealed by the SLO finish hook so explains outlive the request),
+and capped per-request event deques (`INTELLILLM_DECISION_MAX_EVENTS`).
+`INTELLILLM_DECISION_LOG=0` disables everything (every hook returns
+immediately).
+
+Exported series (auto-sampled by the metrics history + alert engine):
+
+    intellillm_sched_deferred_seconds_total{cause}           counter
+    intellillm_sched_decisions_total{decision,cause}         counter
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, Iterable, List, Optional
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+# Why a request could not make progress this pass. `preempted` covers
+# stall time after eviction (until re-admission / swap-in);
+# `swap_backlog` marks admission passes skipped because swapped-out
+# groups hold priority; `unattributed` absorbs intervals no verdict
+# site observed (kept out of the Prometheus series).
+CAUSES = ("token_budget", "tenant_fairness", "kv_watermark", "max_seqs",
+          "lora_cap", "padding", "preempted", "swap_backlog",
+          "unattributed")
+
+# Point-verdict vocabulary for the decision event stream.
+DECISIONS = ("defer", "scheduled", "promote", "preempt_victim",
+             "chunk_split", "spec_plan", "swap_in", "swap_out", "requeue")
+
+_PHASES = ("queue", "stall")
+
+
+class _SchedDecisionMetrics:
+    """Prometheus collectors (process-global, built once — same
+    singleton pattern as obs/kv_transfer.py)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.counter_deferred_s = Counter(
+            "intellillm_sched_deferred_seconds_total",
+            "Wall seconds requests spent blocked in the scheduler, by "
+            "blocking cause (token_budget | tenant_fairness | "
+            "kv_watermark | max_seqs | lora_cap | padding | preempted | "
+            "swap_backlog).", ["cause"])
+        self.counter_decisions = Counter(
+            "intellillm_sched_decisions_total",
+            "Scheduler verdicts by decision type and cause (defer | "
+            "scheduled | promote | preempt_victim | chunk_split | "
+            "spec_plan | swap_in | swap_out | requeue).",
+            ["decision", "cause"])
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+class _Entry:
+    """Per-request wait ledger + bounded decision-event stream."""
+
+    __slots__ = ("phase", "mark", "cause", "ledger", "events",
+                 "preemptions", "promoted_once", "last_defer_cause",
+                 "spec_state", "queued_wall")
+
+    def __init__(self, max_events: int, queued_wall: float) -> None:
+        self.phase: Optional[str] = None        # "queue" | "stall" | None
+        self.mark: float = 0.0                  # monotonic ts of last charge
+        self.cause: Optional[str] = None        # last observed blocking cause
+        self.ledger: Dict[str, Dict[str, float]] = {}  # phase -> cause -> s
+        self.events: deque = deque(maxlen=max_events)
+        self.preemptions = 0
+        self.promoted_once = False
+        self.last_defer_cause: Optional[str] = None
+        self.spec_state: Optional[str] = None
+        self.queued_wall = queued_wall
+
+
+class DecisionLog:
+    """Thread-safe bounded store of scheduler verdicts and per-request
+    cause-attributed wait time."""
+
+    def __init__(self, enabled: bool = True,
+                 max_events_per_request: int = 64,
+                 max_live_requests: int = 2048,
+                 max_finished_requests: int = 256,
+                 now_fn=time.monotonic) -> None:
+        self.enabled = enabled
+        self.max_events_per_request = max_events_per_request
+        self.max_live_requests = max_live_requests
+        self.max_finished_requests = max_finished_requests
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self._live: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._finished: "OrderedDict[str, _Entry]" = OrderedDict()
+        # Per-pass verdict scratchpad (single scheduler thread writes it;
+        # the lock still guards readers on the server thread).
+        self._pass_cause: Optional[str] = None
+        self._pass_detail: Optional[str] = None
+        self._pass_deferred: Dict[str, str] = {}
+        # Python-side totals (work without prometheus; /health/detail +
+        # intellillm-top read these).
+        self.deferred_seconds: Dict[str, float] = {}
+        self.decision_counts: Dict[str, int] = {}
+        self._metrics = _SchedDecisionMetrics() if _PROMETHEUS else None
+
+    # --- internals --------------------------------------------------------
+
+    def _entry(self, request_id: str,
+               create: bool = False) -> Optional[_Entry]:
+        ent = self._live.get(request_id)
+        if ent is None and create:
+            ent = _Entry(self.max_events_per_request, time.time())
+            self._live[request_id] = ent
+            while len(self._live) > self.max_live_requests:
+                self._live.popitem(last=False)
+        return ent
+
+    def _charge(self, ent: _Entry, cause: str, now: float) -> None:
+        """Attribute [ent.mark, now] to `cause` in the open phase."""
+        if ent.phase is None:
+            return
+        elapsed = max(now - ent.mark, 0.0)
+        ent.mark = now
+        if elapsed <= 0.0:
+            return
+        bucket = ent.ledger.setdefault(ent.phase, {})
+        bucket[cause] = bucket.get(cause, 0.0) + elapsed
+        if cause != "unattributed":
+            self.deferred_seconds[cause] = (
+                self.deferred_seconds.get(cause, 0.0) + elapsed)
+            if self._metrics is not None:
+                self._metrics.counter_deferred_s.labels(cause).inc(elapsed)
+
+    def _event(self, ent: _Entry, decision: str, cause: Optional[str],
+               detail: Optional[str]) -> None:
+        ent.events.append((time.time(), decision, cause, detail))
+        self.decision_counts[decision] = (
+            self.decision_counts.get(decision, 0) + 1)
+        if self._metrics is not None:
+            self._metrics.counter_decisions.labels(
+                decision, cause or "none").inc()
+
+    # --- wait-clock hooks (scheduler pass protocol) -----------------------
+
+    def note_queued(self, request_id: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self._entry(request_id, create=True)
+            ent.phase = "queue"
+            ent.mark = self._now()
+
+    def begin_pass(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._pass_cause = None
+            self._pass_detail = None
+            self._pass_deferred = {}
+
+    def pass_blocked(self, cause: str, detail: Optional[str] = None) -> None:
+        """The admission / swap-in loop stopped for everyone behind this
+        point; first blocking cause of the pass wins."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._pass_cause is None:
+                self._pass_cause = cause
+                self._pass_detail = detail
+
+    def defer(self, request_id: str, cause: str,
+              detail: Optional[str] = None) -> None:
+        """Per-request verdict: this specific group was skipped this pass.
+        The decision event is recorded once per cause change (not every
+        pass), the charge-cause every pass."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._pass_deferred[request_id] = cause
+            ent = self._entry(request_id, create=True)
+            if ent.last_defer_cause != cause:
+                ent.last_defer_cause = cause
+                self._event(ent, "defer", cause, detail)
+
+    def end_pass(self, waiting_ids: Iterable[str],
+                 swapped_ids: Iterable[str] = ()) -> None:
+        """Charge every still-blocked request the interval since its last
+        charge, to the cause observed this pass."""
+        if not self.enabled:
+            return
+        now = self._now()
+        with self._lock:
+            for rid in list(waiting_ids) + list(swapped_ids):
+                ent = self._live.get(rid)
+                if ent is None or ent.phase is None:
+                    continue
+                cause = self._pass_deferred.get(rid)
+                if cause is None and ent.phase == "stall" and ent.cause:
+                    # Stalled victims keep `preempted` until a verdict
+                    # site names a more specific re-admission blocker.
+                    cause = ent.cause
+                if cause is None:
+                    cause = self._pass_cause
+                if cause is None:
+                    cause = ent.cause or "unattributed"
+                self._charge(ent, cause, now)
+                ent.cause = cause
+                # Requests blocked behind a pass-level break get a defer
+                # event too (once per cause change, not per pass).
+                if (cause != "unattributed"
+                        and ent.last_defer_cause != cause):
+                    ent.last_defer_cause = cause
+                    self._event(ent, "defer", cause,
+                                self._pass_detail
+                                if cause == self._pass_cause else None)
+            self._pass_cause = None
+            self._pass_detail = None
+            self._pass_deferred = {}
+
+    def scheduled(self, request_id: str,
+                  detail: Optional[str] = None) -> None:
+        """The request made it into the batch: close the open wait phase,
+        charging the final interval to the last observed cause."""
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self._live.get(request_id)
+            if ent is None or ent.phase is None:
+                return
+            cause = (self._pass_deferred.pop(request_id, None)
+                     or ent.cause or "unattributed")
+            now = self._now()
+            self._charge(ent, cause, now)
+            waited = sum(ent.ledger.get(ent.phase, {}).values())
+            self._event(ent, "scheduled", None,
+                        detail or f"{ent.phase}_wait={waited:.3f}s")
+            ent.phase = None
+            ent.cause = None
+            ent.last_defer_cause = None
+
+    def requeued(self, request_id: str, mode: str,
+                 detail: Optional[str] = None) -> None:
+        """The request lost its seat (preempt-by-recompute re-queues it,
+        preempt-by-swap moves it to SWAPPED): open the stall clock."""
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self._entry(request_id, create=True)
+            ent.phase = "stall"
+            ent.mark = self._now()
+            ent.cause = "preempted"
+            ent.preemptions += 1
+            self._event(ent, "requeue", "preempted",
+                        detail or f"mode={mode}")
+
+    # --- point verdicts ---------------------------------------------------
+
+    def preempt_victim(self, request_id: str, price: Optional[float],
+                       trigger: Optional[str], mode: str) -> None:
+        """`request_id` was chosen as the eviction victim (most predicted
+        remaining work at p90) to make room for `trigger`."""
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self._entry(request_id, create=True)
+            parts = [f"mode={mode}"]
+            if price is not None:
+                parts.append(f"p90_remaining={price:.0f}")
+            if trigger:
+                parts.append(f"for={trigger}")
+            self._event(ent, "preempt_victim", "preempted",
+                        ",".join(parts))
+
+    def promoted(self, request_id: str, age_s: float) -> None:
+        """Starvation aging promoted this group above SJF order (recorded
+        once per request — sort_by_priority re-derives it every pass)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self._entry(request_id, create=True)
+            if ent.promoted_once:
+                return
+            ent.promoted_once = True
+            self._event(ent, "promote", "starvation",
+                        f"waited={age_s:.3f}s")
+
+    def chunk_split(self, request_id: str, start: int, size: int,
+                    remaining: int, cause: str) -> None:
+        """A prefill chunk was clamped below the remaining prompt (the
+        request needs more steps); `cause` names the clamp."""
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self._entry(request_id, create=True)
+            self._event(ent, "chunk_split", cause,
+                        f"start={start},size={size},remaining={remaining}")
+
+    def spec_plan(self, request_id: str, eligible: bool, k: int) -> None:
+        """Speculation verdict for this round (recorded on change only —
+        it is re-derived per row per pass)."""
+        if not self.enabled:
+            return
+        state = f"eligible,k={k}" if eligible else "ineligible"
+        with self._lock:
+            ent = self._entry(request_id, create=True)
+            if ent.spec_state == state:
+                return
+            ent.spec_state = state
+            self._event(ent, "spec_plan", None, state)
+
+    def swap(self, request_id: str, direction: str, blocks: int) -> None:
+        """KV blocks moved device<->host for this group. Swap-in also
+        closes an open stall clock (the request is resident again)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self._entry(request_id, create=True)
+            decision = "swap_in" if direction == "in" else "swap_out"
+            self._event(ent, decision, None, f"blocks={blocks}")
+            if direction == "in" and ent.phase == "stall":
+                self._charge(ent, ent.cause or "preempted", self._now())
+                ent.phase = None
+                ent.cause = None
+
+    def seal(self, request_id: str) -> None:
+        """Request finished/aborted: close any open clock and move the
+        entry to the finished ring so the explain outlives the request."""
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self._live.pop(request_id, None)
+            if ent is None:
+                return
+            if ent.phase is not None:
+                self._charge(ent, ent.cause or "unattributed", self._now())
+                ent.phase = None
+            self._finished[request_id] = ent
+            while len(self._finished) > self.max_finished_requests:
+                self._finished.popitem(last=False)
+
+    # --- read side --------------------------------------------------------
+
+    def explain(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """Cause decomposition + decision events for one request, or None
+        if never seen (or evicted)."""
+        with self._lock:
+            ent = (self._live.get(request_id)
+                   or self._finished.get(request_id))
+            if ent is None:
+                return None
+            ledger = {ph: dict(cs) for ph, cs in ent.ledger.items()}
+            events = list(ent.events)
+            preemptions = ent.preemptions
+            promoted = ent.promoted_once
+            phase = ent.phase
+            live = request_id in self._live
+        queue = ledger.get("queue", {})
+        stall = ledger.get("stall", {})
+        return {
+            "request_id": request_id,
+            "state": phase or ("running" if live else "finished"),
+            "queue_wait": {"by_cause": {c: round(s, 6)
+                                        for c, s in queue.items()},
+                           "total_s": round(sum(queue.values()), 6)},
+            "stall": {"by_cause": {c: round(s, 6)
+                                   for c, s in stall.items()},
+                      "total_s": round(sum(stall.values()), 6)},
+            "preemptions": preemptions,
+            "promoted": promoted,
+            "verdict": _verdict(queue, stall, preemptions, promoted,
+                                events),
+            "decisions": [
+                {"ts": ts, "decision": d,
+                 **({"cause": c} if c else {}),
+                 **({"detail": det} if det else {})}
+                for ts, d, c, det in events],
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        """Fleet-level contention ledger for /health/detail and top."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "deferred_seconds_by_cause": {
+                    c: round(s, 6)
+                    for c, s in sorted(self.deferred_seconds.items())},
+                "decisions": dict(sorted(self.decision_counts.items())),
+                "live_requests": len(self._live),
+                "finished_requests": len(self._finished),
+            }
+
+    def decision_events(self, request_id: str) -> List[Dict[str, Any]]:
+        """Raw decision events (trace-sink export payload)."""
+        with self._lock:
+            ent = (self._live.get(request_id)
+                   or self._finished.get(request_id))
+            items = list(ent.events) if ent is not None else []
+        return [{"ts": ts, "decision": d,
+                 **({"cause": c} if c else {}),
+                 **({"detail": det} if det else {})}
+                for ts, d, c, det in items]
+
+    def reset_for_testing(self) -> None:
+        with self._lock:
+            self._live = OrderedDict()
+            self._finished = OrderedDict()
+            self._pass_cause = None
+            self._pass_deferred = {}
+            self.deferred_seconds = {}
+            self.decision_counts = {}
+
+
+def _verdict(queue: Dict[str, float], stall: Dict[str, float],
+             preemptions: int, promoted: bool, events: list) -> str:
+    """One-line root-cause summary, worst contributors first."""
+    parts: List[str] = []
+    named = {c: s for c, s in queue.items() if c != "unattributed"}
+    if named:
+        top = sorted(named.items(), key=lambda kv: -kv[1])
+        parts.append("deferred " + ", ".join(
+            f"{s:.2f}s by {c}" for c, s in top[:2]))
+    if preemptions:
+        trig = next((det for _, d, _, det in reversed(events)
+                     if d == "preempt_victim" and det), None)
+        stall_s = sum(stall.values())
+        msg = f"preempted {preemptions}x"
+        if trig:
+            msg += f" ({trig})"
+        if stall_s:
+            msg += f", stalled {stall_s:.2f}s"
+        parts.append(msg)
+    if promoted:
+        parts.append("promoted by starvation aging")
+    if not parts:
+        total = sum(queue.values())
+        return (f"no contention observed (queue wait {total:.3f}s "
+                "unattributed)" if total else "no contention observed")
+    return "; ".join(parts)
+
+
+def explain_request(request_id: str) -> Dict[str, Any]:
+    """Assemble the full /debug/explain payload for one request on THIS
+    hop: decision decomposition + flight-recorder trace + derived SLO
+    metrics, with a cross-check of attributed vs measured queue-wait.
+    Shared by both API servers' debug routes and the router's per-hop
+    fetch. Local imports avoid an obs-module import cycle (slo.py calls
+    back into this module to seal entries)."""
+    from intellillm_tpu.obs.flight_recorder import get_flight_recorder
+    from intellillm_tpu.obs.slo import derive_request_metrics
+
+    recorder = get_flight_recorder()
+    trace = recorder.get_trace(request_id)
+    explain = get_decision_log().explain(request_id)
+    payload: Dict[str, Any] = {
+        "request_id": request_id,
+        "hop": recorder.hop,
+        "found": trace is not None or explain is not None,
+    }
+    if trace is not None:
+        payload["trace"] = trace
+        # Generation-token count is unknown from the trace alone; drop
+        # the fields it parameterizes rather than report wrong values.
+        derived = derive_request_metrics(trace, 0)
+        if derived:
+            derived.pop("tpot_s", None)
+            derived.pop("generation_tokens", None)
+            payload["measured"] = derived
+    if explain is not None:
+        payload.update({k: v for k, v in explain.items()
+                        if k != "request_id"})
+        measured_qw = (payload.get("measured") or {}).get("queue_wait_s")
+        if measured_qw is not None:
+            attributed = explain["queue_wait"]["total_s"]
+            payload["queue_wait"]["measured_s"] = measured_qw
+            payload["queue_wait"]["unexplained_s"] = round(
+                max(measured_qw - attributed, 0.0), 6)
+    else:
+        payload["verdict"] = ("no scheduler decisions recorded "
+                              "(decision log disabled, or entry evicted)")
+    return payload
+
+
+def _enabled_from_env() -> bool:
+    from intellillm_tpu.utils import parse_env_flag
+    flag = parse_env_flag(os.environ.get("INTELLILLM_DECISION_LOG"))
+    return True if flag is None else flag
+
+
+def _int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+_DECISION_LOG: Optional[DecisionLog] = None
+_LOG_LOCK = threading.Lock()
+
+
+def get_decision_log() -> DecisionLog:
+    global _DECISION_LOG
+    if _DECISION_LOG is None:
+        with _LOG_LOCK:
+            if _DECISION_LOG is None:
+                _DECISION_LOG = DecisionLog(
+                    enabled=_enabled_from_env(),
+                    max_events_per_request=_int_env(
+                        "INTELLILLM_DECISION_MAX_EVENTS", 64),
+                    max_live_requests=_int_env(
+                        "INTELLILLM_DECISION_MAX_REQUESTS", 2048))
+    return _DECISION_LOG
+
+
+def reset_for_testing() -> None:
+    global _DECISION_LOG
+    _SchedDecisionMetrics.reset_for_testing()
+    _DECISION_LOG = None

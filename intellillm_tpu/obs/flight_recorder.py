@@ -112,14 +112,22 @@ class FlightRecorder:
                  **({"detail": d} if d is not None else {})}
                 for ts, ev, d in items]
 
-    def recent_finished(self, limit: int = 32) -> List[Dict[str, Any]]:
+    def recent_finished(self, limit: int = 32,
+                        event: Optional[str] = None) -> List[Dict[str, Any]]:
         """Most-recently finished requests (newest first), each with its
-        full event list — the /debug/trace dump when no id is given."""
+        full event list — the /debug/trace dump when no id is given.
+        `event` keeps only traces containing that event (operators
+        hunting preempted/rerouted requests filter instead of dumping
+        the whole ring)."""
         with self._lock:
             items = [(rid, list(buf))
                      for rid, buf in reversed(self._finished.items())]
         out = []
-        for rid, events in items[:limit]:
+        for rid, events in items:
+            if len(out) >= limit:
+                break
+            if event is not None and all(ev != event for _, ev, _ in events):
+                continue
             out.append({
                 "request_id": rid,
                 "hop": self.hop,
@@ -128,6 +136,17 @@ class FlightRecorder:
                            for ts, ev, d in events],
             })
         return out
+
+    def finished_counts(self) -> Dict[str, int]:
+        """Terminal-event counts across the finished ring (how the last
+        max_finished_requests requests ended, without dumping traces)."""
+        counts: Dict[str, int] = {}
+        with self._lock:
+            for buf in self._finished.values():
+                if buf:
+                    last = buf[-1][1]
+                    counts[last] = counts.get(last, 0) + 1
+        return counts
 
     def live_request_ids(self) -> List[str]:
         with self._lock:

@@ -289,9 +289,17 @@ class SLOTracker:
         if rec is not None:
             rec["request_id"] = request_id
             self.observe(rec)
+            # Seal the scheduler decision log (moves the entry to its
+            # finished ring so /debug/explain outlives the request) and
+            # ride its verdicts on the tail-sampled export, so black-box
+            # dumps carry the WHY alongside the lifecycle events.
+            from intellillm_tpu.obs.decisions import get_decision_log
+            dlog = get_decision_log()
+            dlog.seal(request_id)
             from intellillm_tpu.obs.trace_export import get_trace_sink
-            get_trace_sink().maybe_export(request_id, events, rec,
-                                          hop=recorder.hop)
+            get_trace_sink().maybe_export(
+                request_id, events, rec, hop=recorder.hop,
+                decisions=dlog.decision_events(request_id) or None)
 
     def observe(self, rec: Dict[str, Any]) -> None:
         """Record one derived request record (see derive_request_metrics

@@ -202,10 +202,14 @@ class TraceSink:
     def maybe_export(self, trace_id: str,
                      events: List[Dict[str, Any]],
                      rec: Optional[Dict[str, Any]] = None,
-                     hop: Optional[str] = None) -> Optional[str]:
+                     hop: Optional[str] = None,
+                     decisions: Optional[List[Dict[str, Any]]] = None
+                     ) -> Optional[str]:
         """Export one finished trace if the tail-sampling policy keeps
         it. Returns the decision ('kept_slo' | 'kept_sampled') or None
-        when dropped/disabled."""
+        when dropped/disabled. `decisions` carries the scheduler
+        decision-log verdicts (obs/decisions.py) for the request, so
+        exported dumps explain the waits they record."""
         if not self.enabled:
             return None
         decision = self._decide(trace_id, rec)
@@ -221,6 +225,7 @@ class TraceSink:
             "decision": decision,
             "slo": rec,
             "events": events,
+            **({"sched_decisions": decisions} if decisions else {}),
         }, separators=(",", ":"))
         try:
             with self._lock:

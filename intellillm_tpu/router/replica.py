@@ -99,6 +99,13 @@ class Replica:
         the stitched view matters most."""
         return None
 
+    async def fetch_explain(self, request_id: str) -> Optional[dict]:
+        """This replica's /debug/explain payload (scheduler decision
+        decomposition, obs/decisions.py) for `request_id`, or None when
+        unknown/unreachable. Same must-not-raise contract as
+        fetch_trace."""
+        return None
+
     async def close(self) -> None:
         pass
 
@@ -191,6 +198,11 @@ class InProcessReplica(Replica):
         # the `rerouted` terminal must be visible in the stitched view).
         from intellillm_tpu.obs import get_flight_recorder
         return get_flight_recorder().get_trace(request_id)
+
+    async def fetch_explain(self, request_id: str) -> Optional[dict]:
+        from intellillm_tpu.obs import explain_request
+        payload = explain_request(request_id)
+        return payload if payload.get("found") else None
 
     async def export_kv(self, prompt: str) -> bytes:
         if self._killed:
@@ -292,6 +304,20 @@ class HTTPReplica(Replica):
         except Exception:
             # Unreachable replica: the stitched trace reports the
             # attempt with events=None instead of failing the fetch.
+            return None
+
+    async def fetch_explain(self, request_id: str) -> Optional[dict]:
+        import aiohttp
+        try:
+            async with self._get_session().get(
+                    f"{self.base_url}/debug/explain/{request_id}",
+                    timeout=aiohttp.ClientTimeout(total=5.0)) as resp:
+                if resp.status != 200:
+                    return None
+                return await resp.json()
+        except Exception:
+            # Same contract as fetch_trace: a dead replica yields
+            # explain=None for the attempt, never a failed stitch.
             return None
 
     async def export_kv(self, prompt: str) -> bytes:

@@ -25,6 +25,11 @@ streams through to a chosen replica on the back side:
                              flight-recorder events into one causally-
                              ordered timeline with per-hop latency
                              attribution (router/trace.py)
+    GET  /debug/explain/{id} the STITCHED root-cause explain: every
+                             attempted replica's /debug/explain payload
+                             (scheduler decision decomposition,
+                             obs/decisions.py) under the router's
+                             per-hop attribution, with a fleet verdict
 
 Failover: a `ReplicaFailure` mid-request marks the replica unhealthy,
 drops its affinity placements, and re-routes the request once to another
@@ -62,7 +67,7 @@ from intellillm_tpu.router.replica import (Replica, ReplicaFailure,
                                            ReplicaManager,
                                            launch_http_replica)
 from intellillm_tpu.router.trace import (TraceBook, attempt_request_id,
-                                         stitch_trace)
+                                         attribute_hops, stitch_trace)
 from intellillm_tpu.utils import random_uuid
 
 logger = init_logger(__name__)
@@ -532,6 +537,43 @@ class Router:
                              if replica is not None else None)
         return stitch_trace(trace_id, router_events, attempts)
 
+    async def stitched_explain(self, trace_id: str) -> Optional[dict]:
+        """Fleet root-cause explain: each attempted replica's
+        /debug/explain payload (scheduler decision decomposition,
+        obs/decisions.py) stitched under the router's hop attribution,
+        with a fleet-level verdict. None when the router never saw the
+        trace."""
+        router_events = self.recorder.get_trace(trace_id)
+        attempts = self.tracebook.attempts(trace_id) or []
+        if not router_events:
+            return None
+        hops = []
+        verdicts = []
+        for att in attempts:
+            replica = self.manager.replicas.get(att["replica_id"])
+            explain = (await replica.fetch_explain(att["request_id"])
+                       if replica is not None else None)
+            hops.append({
+                "attempt": att.get("attempt"),
+                "replica_id": att["replica_id"],
+                "request_id": att["request_id"],
+                "explain": explain,
+            })
+            if explain and explain.get("verdict"):
+                verdicts.append(
+                    f"{att['replica_id']}: {explain['verdict']}")
+            att["events"] = (explain or {}).get("trace")
+        failovers = max(len(attempts) - 1, 0)
+        if failovers:
+            verdicts.insert(0, f"rerouted {failovers}x by the router")
+        return {
+            "trace_id": trace_id,
+            "attribution": attribute_hops(router_events, attempts),
+            "attempts": hops,
+            "verdict": ("; ".join(verdicts) if verdicts
+                        else "no contention observed on any hop"),
+        }
+
     def _trace_summary(self) -> dict:
         """Router-side hop timings + trace bookkeeping for
         /health/detail."""
@@ -715,6 +757,16 @@ def build_router_app(router: Router) -> web.Application:
                 status=404)
         return web.json_response(stitched)
 
+    async def debug_explain_stitched(request: web.Request) -> web.Response:
+        trace_id = request.match_info["trace_id"]
+        explained = await router.stitched_explain(trace_id)
+        if explained is None:
+            return web.json_response(
+                {"error": f"no trace for trace_id={trace_id} "
+                 "(never routed here, or evicted from the ring)"},
+                status=404)
+        return web.json_response(explained)
+
     app = web.Application()
     app.router.add_get("/health", health)
     app.router.add_post("/generate", generate)
@@ -722,6 +774,7 @@ def build_router_app(router: Router) -> web.Application:
     app.router.add_get("/health/detail", health_detail)
     app.router.add_get("/debug/trace", debug_trace_list)
     app.router.add_get("/debug/trace/{trace_id}", debug_trace_stitched)
+    app.router.add_get("/debug/explain/{trace_id}", debug_explain_stitched)
     app.router.add_get("/debug/history", debug_history)
     app.router.add_get("/debug/alerts", debug_alerts)
 

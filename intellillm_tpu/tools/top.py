@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import re
 import sys
 import time
@@ -282,6 +283,8 @@ def render_frame(health: Optional[Dict[str, Any]],
 
     lines.extend(_tenant_lines(health.get("tenants")))
 
+    lines.extend(_contention_lines(health.get("contention")))
+
     lines.extend(_alerts_lines(alerts))
 
     lines.extend(_slowest_lines(slo.get("slowest") or []))
@@ -373,6 +376,48 @@ def _tenant_lines(tenants: Optional[Dict[str, Any]]) -> List[str]:
             f"churn {row.get('adapter_loads', 0)}/"
             f"{row.get('adapter_evictions', 0)}")
     return lines
+
+
+def _contention_lines(contention: Optional[Dict[str, Any]]) -> List[str]:
+    """CONTENTION panel from /health/detail's contention block
+    (obs/decisions.py scheduler decision log): cumulative deferred
+    seconds by blocking cause plus preemption/promotion verdict counts.
+    Hidden while no contention has been observed — an idle or
+    uncontended engine renders no panel rather than a row of zeros
+    (per-request decomposition at /debug/explain/{id})."""
+    if not contention or not contention.get("enabled"):
+        return []
+    causes = contention.get("deferred_seconds_by_cause") or {}
+    decisions = contention.get("decisions") or {}
+    if not causes and not decisions:
+        return []
+    lines = ["", "Contention (deferred seconds by cause):"]
+    if causes:
+        width = max(len(c) for c in causes)
+        for cause, seconds in sorted(causes.items(),
+                                     key=lambda kv: -_num(kv[1])):
+            lines.append(f"  {cause.ljust(width)}  {_num(seconds):>9.3f}s")
+    else:
+        lines.append("  (no deferrals yet)")
+    verdict_parts = []
+    for decision in ("preempt_victim", "requeue", "promote", "defer",
+                     "chunk_split", "swap_out", "swap_in"):
+        count = decisions.get(decision)
+        if count:
+            verdict_parts.append(f"{decision}={count}")
+    if verdict_parts:
+        lines.append("  verdicts: " + "  ".join(verdict_parts))
+    return lines
+
+
+def _num(x: Any) -> float:
+    """Defensive float: NaN/None/garbage from a half-up replica renders
+    as 0 instead of crashing the panel sort/format."""
+    try:
+        value = float(x)
+    except (TypeError, ValueError):
+        return 0.0
+    return value if math.isfinite(value) else 0.0
 
 
 def _efficiency_lines(eff: Dict[str, Any]) -> List[str]:

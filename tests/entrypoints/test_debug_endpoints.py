@@ -512,6 +512,111 @@ def test_spec_endpoint_and_health_block_on_both_servers():
         spec_metrics.reset_for_testing()
 
 
+def test_trace_event_filter_and_finished_counts():
+    """/debug/trace grows `?event=` (only traces containing that event;
+    unknown names 400 with the valid set) and `finished_counts` — how
+    the last ring of requests terminated."""
+    recorder = _seed_recorder()
+    recorder.record("smoke-2", "arrived")
+    recorder.record("smoke-2", "preempted", detail="mode=swap")
+    recorder.record("smoke-2", "aborted")
+    try:
+        async def scenario(client):
+            resp = await client.get("/debug/trace")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["finished_counts"] == {"finished": 1, "aborted": 1}
+
+            resp = await client.get("/debug/trace",
+                                    params={"event": "preempted"})
+            assert resp.status == 200
+            data = await resp.json()
+            assert [x["request_id"] for x in data["recent_finished"]] == [
+                "smoke-2"]
+
+            resp = await client.get("/debug/trace",
+                                    params={"event": "finished"})
+            data = await resp.json()
+            assert [x["request_id"] for x in data["recent_finished"]] == [
+                "smoke-1"]
+
+            resp = await client.get("/debug/trace",
+                                    params={"event": "exploded"})
+            assert resp.status == 400
+            assert "preempted" in (await resp.json())["error"]
+
+        _run(demo_server.build_app(), scenario)
+        _run(openai_server.build_app(), scenario)
+    finally:
+        get_flight_recorder().reset_for_testing()
+
+
+def test_explain_endpoint_and_contention_block_on_both_servers():
+    """/debug/explain/{id} decomposes the wait by cause on both servers;
+    /health/detail carries the fleet-level `contention` block (served
+    even while the app has no engine behind it)."""
+    import time as time_mod
+
+    from intellillm_tpu.obs import decisions as decisions_mod
+
+    decisions_mod.reset_for_testing()
+    recorder = _seed_recorder()
+    dlog = decisions_mod.get_decision_log()
+    dlog.note_queued("smoke-1")
+    dlog.begin_pass()
+    dlog.pass_blocked("token_budget")
+    time_mod.sleep(0.02)
+    dlog.end_pass(["smoke-1"])
+    dlog.begin_pass()
+    dlog.defer("smoke-1", "tenant_fairness")
+    time_mod.sleep(0.01)
+    dlog.end_pass(["smoke-1"])
+    dlog.begin_pass()
+    dlog.scheduled("smoke-1")
+    dlog.end_pass([])
+    dlog.seal("smoke-1")
+    try:
+        async def scenario(client):
+            resp = await client.get("/debug/explain/smoke-1")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["found"] is True
+            assert data["state"] == "finished"
+            by_cause = data["queue_wait"]["by_cause"]
+            assert by_cause["token_budget"] > 0
+            assert by_cause["tenant_fairness"] > 0
+            assert data["queue_wait"]["total_s"] == pytest.approx(
+                sum(by_cause.values()))
+            assert "token_budget" in data["verdict"]
+            # The flight-recorder timeline and measured SLO cross-check
+            # ride along (smoke-1 has a full seeded trace).
+            assert [e["event"] for e in data["trace"]][-1] == "finished"
+            assert "measured_s" in data["queue_wait"]
+            assert "unexplained_s" in data["queue_wait"]
+            kinds = [d["decision"] for d in data["decisions"]]
+            assert "defer" in kinds and "scheduled" in kinds
+
+            resp = await client.get("/debug/explain/never-seen")
+            assert resp.status == 404
+
+            # Fleet-level ledger on deep health (503: no engine).
+            resp = await client.get("/health/detail")
+            assert resp.status == 503
+            contention = (await resp.json())["contention"]
+            assert contention["enabled"] is True
+            causes = contention["deferred_seconds_by_cause"]
+            assert causes["token_budget"] > 0
+            assert causes["tenant_fairness"] > 0
+            assert "unattributed" not in causes
+            assert contention["decisions"]["scheduled"] == 1
+
+        _run(demo_server.build_app(), scenario)
+        _run(openai_server.build_app(), scenario)
+    finally:
+        get_flight_recorder().reset_for_testing()
+        decisions_mod.reset_for_testing()
+
+
 def test_demo_server_has_debug_routes():
     _seed_recorder()
     try:

@@ -1,0 +1,228 @@
+"""DecisionLog unit tests (obs/decisions.py): the cause-attribution
+clock, pass protocol, event dedup, bounds, and the explain payload —
+driven with a fake monotonic clock, no scheduler."""
+import intellillm_tpu.obs.decisions as decisions_mod
+from intellillm_tpu.obs.decisions import CAUSES, DECISIONS, DecisionLog
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def _log(**kw):
+    clock = FakeClock()
+    return DecisionLog(now_fn=clock, **kw), clock
+
+
+def test_queue_wait_decomposes_by_pass_cause():
+    log, clock = _log()
+    log.note_queued("r1")
+
+    # Pass 1: blocked on the token budget for 0.5s.
+    log.begin_pass()
+    log.pass_blocked("token_budget")
+    clock.tick(0.5)
+    log.end_pass(["r1"])
+
+    # Pass 2: a per-request fairness defer for 0.25s, then admitted.
+    log.begin_pass()
+    log.defer("r1", "tenant_fairness")
+    clock.tick(0.25)
+    log.end_pass(["r1"])
+    log.begin_pass()
+    log.scheduled("r1")
+    log.end_pass([])
+
+    ex = log.explain("r1")
+    by_cause = ex["queue_wait"]["by_cause"]
+    assert abs(by_cause["token_budget"] - 0.5) < 1e-6
+    assert abs(by_cause["tenant_fairness"] - 0.25) < 1e-6
+    assert abs(ex["queue_wait"]["total_s"] - 0.75) < 1e-6
+    assert "tenant_fairness" in ex["verdict"] or "token_budget" in ex["verdict"]
+    # Worst contributor leads the verdict.
+    assert ex["verdict"].startswith("deferred 0.50s by token_budget")
+
+
+def test_per_request_defer_beats_pass_cause():
+    log, clock = _log()
+    log.note_queued("a")
+    log.note_queued("b")
+    log.begin_pass()
+    log.defer("a", "lora_cap")
+    log.pass_blocked("max_seqs")
+    clock.tick(1.0)
+    log.end_pass(["a", "b"])
+    assert log.explain("a")["queue_wait"]["by_cause"] == {"lora_cap": 1.0}
+    assert log.explain("b")["queue_wait"]["by_cause"] == {"max_seqs": 1.0}
+
+
+def test_unattributed_charged_but_not_exported():
+    log, clock = _log()
+    log.note_queued("r")
+    log.begin_pass()
+    clock.tick(0.1)
+    log.end_pass(["r"])  # no verdict site fired
+    ex = log.explain("r")
+    assert abs(ex["queue_wait"]["by_cause"]["unattributed"] - 0.1) < 1e-6
+    assert "unattributed" not in log.summary()["deferred_seconds_by_cause"]
+    assert "no contention observed" in ex["verdict"]
+
+
+def test_stall_phase_sticky_preempted_cause():
+    log, clock = _log()
+    log.note_queued("v")
+    log.begin_pass()
+    log.scheduled("v")
+    log.end_pass([])
+
+    log.preempt_victim("v", 512.0, "newbie", "swap")
+    log.requeued("v", "swap")
+    log.begin_pass()
+    clock.tick(0.4)
+    log.end_pass([], ["v"])  # sits in SWAPPED, no verdict this pass
+    log.begin_pass()
+    log.scheduled("v")
+    log.end_pass([])
+
+    ex = log.explain("v")
+    assert abs(ex["stall"]["by_cause"]["preempted"] - 0.4) < 1e-6
+    assert ex["queue_wait"]["total_s"] == 0.0
+    assert ex["preemptions"] == 1
+    assert "preempted 1x" in ex["verdict"]
+    assert "p90_remaining=512" in ex["verdict"]
+    decisions = [d["decision"] for d in ex["decisions"]]
+    assert decisions == ["scheduled", "preempt_victim", "requeue",
+                         "defer", "scheduled"]
+    # The stall-pass defer event carries the sticky preempted cause.
+    assert ex["decisions"][3]["cause"] == "preempted"
+
+
+def test_defer_events_dedupe_per_cause_change():
+    log, clock = _log()
+    log.note_queued("r")
+    for _ in range(5):
+        log.begin_pass()
+        log.defer("r", "tenant_fairness")
+        clock.tick(0.01)
+        log.end_pass(["r"])
+    ex = log.explain("r")
+    defers = [d for d in ex["decisions"] if d["decision"] == "defer"]
+    assert len(defers) == 1  # 5 passes, same cause: one event
+    # Cause change emits a new event.
+    log.begin_pass()
+    log.defer("r", "kv_watermark")
+    clock.tick(0.01)
+    log.end_pass(["r"])
+    defers = [d for d in log.explain("r")["decisions"]
+              if d["decision"] == "defer"]
+    assert [d["cause"] for d in defers] == ["tenant_fairness",
+                                            "kv_watermark"]
+
+
+def test_promote_and_spec_plan_dedupe():
+    log, _ = _log()
+    log.note_queued("r")
+    log.promoted("r", 5.0)
+    log.promoted("r", 6.0)
+    log.spec_plan("r", True, 4)
+    log.spec_plan("r", True, 4)
+    log.spec_plan("r", True, 2)
+    ex = log.explain("r")
+    assert ex["promoted"] is True
+    kinds = [d["decision"] for d in ex["decisions"]]
+    assert kinds.count("promote") == 1
+    assert kinds.count("spec_plan") == 2  # k change re-records
+
+
+def test_swap_in_closes_stall_clock():
+    log, clock = _log()
+    log.note_queued("r")
+    log.begin_pass()
+    log.scheduled("r")
+    log.end_pass([])
+    log.requeued("r", "swap")
+    clock.tick(0.3)
+    log.swap("r", "in", 7)
+    ex = log.explain("r")
+    assert abs(ex["stall"]["by_cause"]["preempted"] - 0.3) < 1e-6
+    assert ex["state"] == "running"
+    assert any(d["decision"] == "swap_in" and d["detail"] == "blocks=7"
+               for d in ex["decisions"])
+
+
+def test_seal_moves_to_finished_ring_and_bounds_hold():
+    log, clock = _log(max_live_requests=4, max_finished_requests=2)
+    for i in range(6):
+        log.note_queued(f"r{i}")
+    assert log.summary()["live_requests"] == 4  # oldest evicted
+    log.seal("r4")
+    log.seal("r5")
+    log.seal("r3")
+    s = log.summary()
+    assert s["finished_requests"] == 2  # ring capped
+    assert log.explain("r4") is None  # evicted from finished ring
+    assert log.explain("r3")["state"] == "finished"
+    # Sealing an open clock closes it.
+    assert log.explain("r0") is None  # evicted from live table earlier
+
+
+def test_event_deque_bounded():
+    log, _ = _log(max_events_per_request=8)
+    log.note_queued("r")
+    for i in range(50):
+        log.chunk_split("r", i, 16, 100 - i, "token_budget")
+    ex = log.explain("r")
+    assert len(ex["decisions"]) == 8
+    assert log.summary()["decisions"]["chunk_split"] == 50
+
+
+def test_disabled_log_is_inert():
+    log, clock = _log()
+    log.enabled = False
+    log.note_queued("r")
+    log.begin_pass()
+    log.pass_blocked("token_budget")
+    clock.tick(1.0)
+    log.end_pass(["r"])
+    assert log.explain("r") is None
+    assert log.summary()["deferred_seconds_by_cause"] == {}
+
+
+def test_summary_totals_accumulate():
+    log, clock = _log()
+    for rid in ("a", "b"):
+        log.note_queued(rid)
+    log.begin_pass()
+    log.pass_blocked("kv_watermark", "free=1/10,watermark=2")
+    clock.tick(2.0)
+    log.end_pass(["a", "b"])
+    s = log.summary()
+    assert abs(s["deferred_seconds_by_cause"]["kv_watermark"] - 4.0) < 1e-6
+    assert s["decisions"]["defer"] == 2
+    # The pass detail rides the defer events.
+    assert any(d.get("detail") == "free=1/10,watermark=2"
+               for d in log.explain("a")["decisions"])
+
+
+def test_vocabularies_are_closed():
+    assert "unattributed" in CAUSES
+    assert set(DECISIONS) >= {"defer", "scheduled", "preempt_victim",
+                              "requeue", "promote", "chunk_split",
+                              "spec_plan", "swap_in", "swap_out"}
+
+
+def test_module_reset_rebuilds_singleton():
+    decisions_mod.reset_for_testing()
+    first = decisions_mod.get_decision_log()
+    first.note_queued("x")
+    decisions_mod.reset_for_testing()
+    second = decisions_mod.get_decision_log()
+    assert second is not first
+    assert second.explain("x") is None

@@ -56,6 +56,9 @@ def test_constructors_are_found():
     assert "intellillm_kernel_hbm_peak_bytes" in names
     assert "intellillm_kernel_executables" in names
     assert "intellillm_kernel_mfu_costmodel" in names
+    # Scheduler decision-tracing families (PR 17).
+    assert "intellillm_sched_deferred_seconds_total" in names
+    assert "intellillm_sched_decisions_total" in names
 
 
 def test_every_metric_name_is_prefixed():

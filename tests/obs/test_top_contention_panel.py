@@ -1,0 +1,91 @@
+"""intellillm-top CONTENTION panel unit tests: rendering of the
+/health/detail `contention` block (obs/decisions.py) — no HTTP, no
+engine. The panel must degrade, never crash: NaN/None/garbage seconds
+from a half-up replica render as 0, and an idle engine hides the
+panel entirely."""
+from intellillm_tpu.tools.top import _contention_lines, _num, render_frame
+
+
+def _block():
+    return {
+        "enabled": True,
+        "deferred_seconds_by_cause": {
+            "token_budget": 1.25,
+            "tenant_fairness": 4.5,
+            "kv_watermark": 0.002,
+        },
+        "decisions": {"defer": 12, "preempt_victim": 2, "requeue": 2,
+                      "promote": 1, "scheduled": 40, "chunk_split": 0},
+        "live_requests": 3,
+        "finished_requests": 40,
+    }
+
+
+def test_panel_renders_causes_sorted_by_seconds():
+    lines = _contention_lines(_block())
+    text = "\n".join(lines)
+    assert "Contention (deferred seconds by cause):" in text
+    # Sorted descending: fairness (4.5s) before token_budget (1.25s).
+    fairness_idx = next(i for i, ln in enumerate(lines)
+                        if "tenant_fairness" in ln)
+    budget_idx = next(i for i, ln in enumerate(lines)
+                      if "token_budget" in ln)
+    assert fairness_idx < budget_idx
+    assert "4.500s" in lines[fairness_idx]
+    assert "verdicts:" in text
+    assert "preempt_victim=2" in text
+    assert "requeue=2" in text
+    assert "promote=1" in text
+    # Zero-count decisions are omitted from the verdict line.
+    assert "chunk_split" not in text
+
+
+def test_panel_hidden_when_idle_or_disabled():
+    assert _contention_lines(None) == []
+    assert _contention_lines({}) == []
+    assert _contention_lines({"enabled": False,
+                              "deferred_seconds_by_cause": {"x": 1}}) == []
+    # Enabled but nothing observed yet: no panel, not a row of zeros.
+    assert _contention_lines({"enabled": True,
+                              "deferred_seconds_by_cause": {},
+                              "decisions": {}}) == []
+
+
+def test_panel_degrades_on_nan_and_garbage():
+    block = _block()
+    block["deferred_seconds_by_cause"] = {
+        "token_budget": float("nan"),
+        "kv_watermark": None,
+        "preempted": "garbage",
+        "tenant_fairness": float("inf"),
+        "max_seqs": 0.5,
+    }
+    lines = _contention_lines(block)
+    text = "\n".join(lines)
+    # Every bad value renders as 0.000s; the one finite value survives.
+    assert "0.500s" in text
+    assert text.count("0.000s") == 4
+    assert "nan" not in text.lower().replace("tenant", "")
+    assert "inf" not in text
+
+
+def test_num_defensive():
+    assert _num(None) == 0.0
+    assert _num("bogus") == 0.0
+    assert _num(float("nan")) == 0.0
+    assert _num(float("-inf")) == 0.0
+    assert _num("2.5") == 2.5
+    assert _num(3) == 3.0
+
+
+def test_render_frame_carries_contention_panel():
+    health = {
+        "status": "ok",
+        "live_requests": 0,
+        "contention": _block(),
+    }
+    frame = render_frame(health, {}, "http://x:1")
+    assert "Contention (deferred seconds by cause):" in frame
+    # And without the block the frame still renders, panel-free.
+    frame = render_frame({"status": "ok"}, {}, "http://x:1")
+    assert "Contention" not in frame

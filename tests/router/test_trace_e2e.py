@@ -139,6 +139,24 @@ def test_stitched_trace_across_failover(tiny_opt_dir, monkeypatch,
             assert sum(hops_s.values()) == pytest.approx(
                 attribution["e2e_s"], abs=1e-4)
 
+            # --- stitched explain: both attempts' replica-side root
+            # cause + the router's failover verdict -------------------
+            resp = await client.get(f"/debug/explain/{TRACE_ID}")
+            assert resp.status == 200
+            ex = await resp.json()
+            assert ex["trace_id"] == TRACE_ID
+            assert [a["request_id"] for a in ex["attempts"]] == [
+                TRACE_ID, f"{TRACE_ID}#f1"]
+            assert ex["verdict"].startswith("rerouted 1x by the router")
+            # Each hop carries the replica's own explain payload
+            # (in-process replicas share this test's recorder).
+            for att in ex["attempts"]:
+                assert att["explain"]["found"] is True
+                assert "verdict" in att["explain"]
+            assert "hops_s" in ex["attribution"]
+            resp = await client.get("/debug/explain/never-routed")
+            assert resp.status == 404
+
             # --- trace listing + 404 ---------------------------------
             resp = await client.get("/debug/trace")
             listing = await resp.json()
