@@ -502,7 +502,29 @@ def main():
             **warmup,
             "under_30s": warmup.get("seconds", 1e9) < 30.0,
         }
+    wdiff = _wdiff_vs_baseline(rec)
+    if wdiff is not None:
+        rec["wdiff"] = wdiff
     print(json.dumps(rec))
+
+
+def _wdiff_vs_baseline(rec: dict):
+    """Sectioned diff against an explicit baseline snapshot, when the
+    operator points INTELLILLM_WDIFF_BASELINE at one (a --summary-out
+    file or a prior bench record). Complements _regression_vs_prior,
+    which only tracks headline tok/s: this one covers the kernel ledger
+    and any other shared sections via obs/diff.py. Best-effort — a
+    missing or unparsable baseline never fails the bench."""
+    path = os.environ.get("INTELLILLM_WDIFF_BASELINE")
+    if not path:
+        return None
+    try:
+        from intellillm_tpu.obs.diff import diff_summaries, load_summary
+        report = diff_summaries(load_summary(path), rec)
+        return {"baseline": path, "verdict": report["verdict"],
+                "regressed_sections": report["regressed_sections"]}
+    except Exception as e:
+        return {"baseline": path, "error": str(e)}
 
 
 def _regression_vs_prior(tok_s: float):

@@ -41,12 +41,28 @@ class RequestResult:
     n_chunks: int    # streamed SSE chunks received
 
 
-async def _paced_requests(requests, request_rate: float):
+async def _paced_requests(requests, request_rate: float, rng=None):
+    """Poisson pacing. `rng` (np.random.RandomState) makes the arrival
+    stream reproducible — two runs with the same seed issue requests on
+    the same schedule; None falls back to the unseeded global RNG."""
+    sample = (rng.exponential if rng is not None
+              else np.random.exponential)
     for req in requests:
         yield req
         if request_rate == float("inf"):
             continue
-        await asyncio.sleep(np.random.exponential(1.0 / request_rate))
+        await asyncio.sleep(sample(1.0 / request_rate))
+
+
+async def _replayed_requests(requests, gaps):
+    """Recorded pacing: sleep `gaps[i]` seconds before issuing request
+    i (gaps come from a captured IWL1 stream's arrival offsets, already
+    divided by the replay --speed). Deterministic by construction — no
+    RNG anywhere in the schedule."""
+    for req, gap in zip(requests, gaps):
+        if gap > 0:
+            await asyncio.sleep(gap)
+        yield req
 
 
 async def send_request(session: aiohttp.ClientSession, backend: str,
@@ -83,17 +99,27 @@ async def send_request(session: aiohttp.ClientSession, backend: str,
 
 
 async def run_benchmark(backend: str, api_url: str, model: str, requests,
-                        request_rate: float, best_of: int = 1):
-    """Drive one pass over `requests`; returns (elapsed_s, results)."""
+                        request_rate: float, best_of: int = 1,
+                        seed: int = None, gaps=None):
+    """Drive one pass over `requests`; returns (elapsed_s, results).
+
+    `seed` makes the Poisson arrival schedule reproducible (serve_bench
+    threads --seed through here and records it in every summary).
+    `gaps` switches to recorded pacing: per-request pre-issue sleeps
+    from a captured workload (serve_bench --scenario replay)."""
     results: List[RequestResult] = []
     conn = aiohttp.TCPConnector(limit=0)
     timeout = aiohttp.ClientTimeout(total=6 * 3600)
+    if gaps is not None:
+        paced = _replayed_requests(requests, gaps)
+    else:
+        rng = np.random.RandomState(seed) if seed is not None else None
+        paced = _paced_requests(requests, request_rate, rng=rng)
     start = time.perf_counter()
     async with aiohttp.ClientSession(connector=conn,
                                      timeout=timeout) as session:
         tasks = []
-        async for prompt, prompt_len, output_len in _paced_requests(
-                requests, request_rate):
+        async for prompt, prompt_len, output_len in paced:
             tasks.append(asyncio.create_task(
                 send_request(session, backend, api_url, model, prompt,
                              prompt_len, output_len, best_of, results)))
@@ -156,7 +182,7 @@ def main(args):
                f"http://{args.host}:{args.port}/generate")
     elapsed, results = asyncio.run(run_benchmark(
         args.backend, api_url, args.model, requests, args.request_rate,
-        args.best_of))
+        args.best_of, seed=args.seed))
     m = compute_metrics(results, elapsed)
 
     print(f"Completed {m['completed']}/{len(requests)} requests "

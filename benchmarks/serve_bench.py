@@ -16,8 +16,11 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import hashlib
 import json
+import math
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -412,6 +415,7 @@ def run_ttft_under_load(args, api_url: str, model_name: str, tokenizer,
     (pr,) = probe_results
     return {
         "scenario": "ttft-under-load",
+        "seed": args.seed,
         "chunked_prefill": not args.disable_chunked_prefill,
         "max_num_batched_tokens": args.max_num_batched_tokens,
         "probe_input_len": probe[1],
@@ -424,6 +428,257 @@ def run_ttft_under_load(args, api_url: str, model_name: str, tokenizer,
         "background_ttft_p99_ms": bg["ttft_percentiles_ms"]["p99"],
         "background": bg,
     }
+
+
+# ---------------------------------------------------------------------------
+# Workload capture & replay (docs/observability.md).
+#
+# `--scenario replay` re-issues a captured IWL1 stream (obs/workload.py)
+# against a freshly booted server with the original inter-arrival gaps;
+# `--scenario diurnal` synthesizes a seeded day-in-the-life stream (flash
+# crowds, heavy-tailed lengths, adapter churn) in the same format.  Both
+# are deterministic end to end: two replays of the same file issue the
+# identical request sequence, and the server-side re-capture
+# (/debug/workload?format=iwl) matches across repeats.
+# ---------------------------------------------------------------------------
+
+
+def _synth_prompt(tokenizer, prompt_len: int, prompt_hash: str):
+    """Deterministically resynthesize a prompt from its fingerprint.
+
+    Captures default to hashes, not raw text (privacy).  Replay only
+    needs *a* stable prompt of the recorded token length, so we sample
+    token ids from an RNG seeded by the fingerprint: every replay of the
+    same record produces the same prompt string.  Returns
+    (prompt, server_token_count) like build_requests."""
+    rng = random.Random(int(prompt_hash or "0", 16))
+    vocab = len(tokenizer)
+    ids = [rng.randrange(vocab) for _ in range(max(1, prompt_len))]
+    prompt = tokenizer.decode(ids, skip_special_tokens=True)
+    if not prompt.strip():
+        prompt = " ".join(str(rng.randrange(10)) for _ in range(
+            max(1, prompt_len)))
+    # Re-encode: the server budgets by *its* token count (see
+    # build_requests in benchmark_serving.py).
+    return prompt, len(tokenizer.encode(prompt))
+
+
+def build_replay_stream(records, tokenizer, args):
+    """Turn parsed IWL1 records into (requests, gaps, stream_digest).
+
+    `requests` is the (prompt, prompt_len, output_len) list
+    run_benchmark expects; `gaps[i]` is the sleep before issuing request
+    i (recorded offsets divided by --speed); `stream_digest` is a sha256
+    over the exact issue schedule so two replays can be compared without
+    trusting wall clocks."""
+    speed = max(float(args.speed), 1e-6)
+    requests, gaps = [], []
+    h = hashlib.sha256()
+    prev_t = 0.0
+    for rec in records:
+        t = float(rec.get("t", 0.0))
+        gap = max(0.0, (t - prev_t) / speed)
+        prev_t = t
+        plen = int(rec.get("prompt_len") or 1)
+        if rec.get("prompt"):
+            prompt = rec["prompt"]
+            plen = len(tokenizer.encode(prompt))
+        else:
+            prompt, plen = _synth_prompt(tokenizer, plen,
+                                         rec.get("prompt_hash") or "0")
+        sampling = rec.get("sampling") or {}
+        outcome = rec.get("outcome") or {}
+        olen = int(sampling.get("max_tokens") or outcome.get("tokens")
+                   or args.output_len)
+        olen = max(1, min(olen, args.max_model_len - plen - 1))
+        requests.append((prompt, plen, olen))
+        gaps.append(round(gap, 6))
+        h.update(json.dumps(
+            [gaps[-1], rec.get("prompt_hash") or "", plen, olen],
+            sort_keys=True).encode())
+    return requests, gaps, h.hexdigest()[:16]
+
+
+def _recapture_digest(records) -> str:
+    """Order-insensitive digest of a re-captured workload shard.
+
+    Concurrent arrivals can land in the server's log in either order,
+    so the digest covers the sorted multiset of per-request tuples, not
+    the sequence."""
+    tuples = sorted(
+        [rec.get("prompt_hash") or "", int(rec.get("prompt_len") or 0),
+         (rec.get("sampling") or {}).get("max_tokens"),
+         (rec.get("outcome") or {}).get("tokens"),
+         (rec.get("outcome") or {}).get("reason")]
+        for rec in records)
+    return hashlib.sha256(
+        json.dumps(tuples, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _fetch_iwl(base: str) -> str:
+    with urllib.request.urlopen(base + "/debug/workload?format=iwl",
+                                timeout=10.0) as r:
+        return r.read().decode()
+
+
+def run_replay(args, model_dir, tokenizer, extra=None) -> dict:
+    """Replay a captured IWL1 workload against one freshly booted server.
+
+    Boots once, then runs the stream --replay-repeat times.  Each pass
+    records client-side metrics plus a server-side re-capture digest
+    from /debug/workload, so the summary can assert end-to-end
+    determinism (identical issue schedule AND identical server-observed
+    workload) instead of asking the reader to diff logs."""
+    from intellillm_tpu.obs.workload import parse_iwl
+
+    if not args.workload:
+        raise SystemExit("--scenario replay requires --workload FILE")
+    with open(args.workload) as f:
+        header, records = parse_iwl(f.read())
+    requests, gaps, stream_digest = build_replay_stream(
+        records, tokenizer, args)
+
+    proc = launch_server(model_dir, args)
+    base = f"http://127.0.0.1:{args.port}"
+    api_url = base + "/v1/completions"
+    model_name = f"dummy-{args.size}"
+    summary = {"scenario": "replay", "size": args.size,
+               "seed": args.seed, "workload": args.workload,
+               "speed": args.speed, "replay_repeat": args.replay_repeat,
+               "num_requests": len(requests),
+               "workload_header": {k: header.get(k) for k in
+                                   ("iwl", "source", "raw_prompts",
+                                    "requests")},
+               "stream_digest": stream_digest,
+               "max_num_seqs": args.max_num_seqs, "results": []}
+    if extra:
+        summary.update(extra)
+    recaptures = []
+    try:
+        wait_healthy(proc, base, args.init_timeout, args.server_log)
+        # Warm the batch/width ladder the replayed stream will hit (same
+        # rationale as run_single's warm-up): two all-at-once passes over
+        # a prefix so first-compile stalls don't skew repeat 1 vs 2.
+        warm = requests[:max(4, min(args.max_num_seqs, len(requests)))]
+        for _ in range(2):
+            asyncio.run(run_benchmark("openai", api_url, model_name,
+                                      warm, float("inf")))
+        for rep in range(max(1, args.replay_repeat)):
+            mark = time.time()
+            elapsed, results = asyncio.run(run_benchmark(
+                "openai", api_url, model_name, requests, float("inf"),
+                gaps=gaps))
+            m = compute_metrics(results, elapsed)
+            m["repeat"] = rep
+            recap = {"count": None, "digest": None}
+            try:
+                _, caught = parse_iwl(_fetch_iwl(base))
+                shard = [r for r in caught
+                         if float(r.get("ts") or 0.0) >= mark]
+                recap = {"count": len(shard),
+                         "digest": _recapture_digest(shard)}
+                if args.workload_out:
+                    from intellillm_tpu.obs.workload import dump_iwl
+                    with open(args.workload_out, "w") as f:
+                        f.write(dump_iwl(shard, source="replay"))
+            except Exception as e:  # recapture is best-effort
+                recap["error"] = str(e)
+            m["recapture"] = recap
+            recaptures.append(recap.get("digest"))
+            summary["results"].append(m)
+            print(json.dumps({"serve_bench_replay_repeat": rep, **m}),
+                  flush=True)
+        summary["recapture_digests"] = recaptures
+        summary["recapture_match"] = (
+            len(set(d for d in recaptures)) == 1
+            and recaptures[0] is not None)
+        summary["replay_deterministic"] = bool(summary["recapture_match"])
+        summary["observability"] = snapshot_observability(base)
+        detail = snapshot_health_detail(base)
+        summary["slo"] = detail.get("slo") or {}
+        summary["efficiency"] = snapshot_efficiency(base)
+        summary["kernels"] = snapshot_kernels(base)
+        summary["contention"] = distill_contention(detail)
+        summary["alerts"] = distill_alerts(snapshot_alerts(base))
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+    print(json.dumps({"serve_bench_summary": summary}), flush=True)
+    return summary
+
+
+def synth_diurnal(args):
+    """Synthesize a seeded diurnal workload as IWL1 records.
+
+    ~60% of arrivals are spread uniformly over --diurnal-duration; the
+    rest cluster into --diurnal-bursts gaussian flash crowds.  Prompt
+    and output lengths are heavy-tailed (lognormal, clamped to the
+    context window); requests churn across --num-tenants adapters with
+    a Zipf-ish 1/k weighting so adapter-cache behaviour is exercised.
+    Same --seed => byte-identical record list."""
+    rng = random.Random(args.seed)
+    n = args.num_prompts
+    dur = max(0.001, float(args.diurnal_duration))
+    bursts = max(0, int(args.diurnal_bursts))
+    centers = [rng.uniform(0.15, 0.85) * dur for _ in range(bursts)]
+    arrivals = []
+    for i in range(n):
+        if bursts and rng.random() < 0.4:
+            c = centers[rng.randrange(bursts)]
+            arrivals.append(min(dur, max(0.0,
+                                         rng.gauss(c, dur * 0.02))))
+        else:
+            arrivals.append(rng.uniform(0.0, dur))
+    arrivals.sort()
+    tenants = max(1, args.num_tenants)
+    weights = [1.0 / k for k in range(1, tenants + 1)]
+    records = []
+    for i, t in enumerate(arrivals):
+        plen = int(min(args.max_model_len // 2, max(
+            4, rng.lognormvariate(math.log(args.input_len), 0.6))))
+        olen = int(min(args.max_model_len - plen - 1, max(
+            1, rng.lognormvariate(math.log(args.output_len), 0.6))))
+        adapter = rng.choices(range(tenants), weights=weights)[0]
+        phash = hashlib.blake2b(
+            f"{args.seed}:{i}".encode(), digest_size=8).hexdigest()
+        records.append({
+            "ts": round(t, 6), "t": round(t, 6),
+            "id": f"diurnal-{args.seed}-{i}",
+            "prompt_len": plen, "prompt_hash": phash,
+            "sampling": {"max_tokens": olen, "temperature": 0.0,
+                         "ignore_eos": True},
+            "tenant": f"tenant-{adapter}" if adapter else None,
+            "adapter": adapter, "priority": 0,
+            "outcome": {"tokens": olen, "reason": "synthetic"},
+        })
+    return records
+
+
+def run_diurnal(args, model_dir, tokenizer) -> dict:
+    """Emit a synthetic diurnal IWL1 stream, then (unless --emit-only)
+    replay it through run_replay."""
+    from intellillm_tpu.obs.workload import dump_iwl
+
+    records = synth_diurnal(args)
+    out = args.workload_out or "/tmp/serve_bench_diurnal.iwl.jsonl"
+    with open(out, "w") as f:
+        f.write(dump_iwl(records, source="diurnal",
+                         extra_header={"seed": args.seed}))
+    block = {"scenario": "diurnal", "seed": args.seed,
+             "num_requests": len(records), "workload_out": out,
+             "diurnal_duration_s": args.diurnal_duration,
+             "diurnal_bursts": args.diurnal_bursts,
+             "num_tenants": args.num_tenants}
+    print(json.dumps({"serve_bench_diurnal": block}), flush=True)
+    if args.emit_only:
+        summary = dict(block, emit_only=True)
+        print(json.dumps({"serve_bench_summary": summary}), flush=True)
+        return summary
+    args.workload = out
+    args.workload_out = None  # don't clobber the input mid-replay
+    return run_replay(args, model_dir, tokenizer,
+                      extra={"diurnal": block})
 
 
 def launch_generate_replica(model_dir: str, args, port: int,
@@ -475,7 +730,7 @@ def run_fleet(args, model_dir: str, tokenizer) -> dict:
     summary = {"scenario": "fleet", "size": args.size,
                "num_replicas": args.num_replicas,
                "input_len": args.input_len, "output_len": args.output_len,
-               "num_prompts": args.num_prompts,
+               "num_prompts": args.num_prompts, "seed": args.seed,
                "max_num_seqs": args.max_num_seqs,
                "quantization": args.quantization,
                "kv_cache_dtype": args.kv_cache_dtype, "results": []}
@@ -519,7 +774,8 @@ def run_fleet(args, model_dir: str, tokenizer) -> dict:
         for rate_s in args.rates.split(","):
             rate = float(rate_s)
             elapsed, results = asyncio.run(run_benchmark(
-                "generate", api_url, None, requests, rate))
+                "generate", api_url, None, requests, rate,
+                seed=args.seed))
             m = compute_metrics(results, elapsed)
             m["request_rate"] = rate_s
             summary["results"].append(m)
@@ -692,7 +948,7 @@ def run_disagg(args, model_dir, tokenizer) -> dict:
                        or {}).get("cache_hits"),
     }
     summary = {"scenario": "disagg", "size": args.size,
-               "num_decode_replicas": n,
+               "num_decode_replicas": n, "seed": args.seed,
                "input_len": args.input_len, "output_len": args.output_len,
                "num_prompts": args.num_prompts,
                "max_num_seqs": args.max_num_seqs,
@@ -877,6 +1133,7 @@ def run_multi_tenant(args, model_dir, tokenizer) -> dict:
         return proc
 
     summary = {"scenario": "multi-tenant", "size": args.size,
+               "seed": args.seed,
                "num_tenants": n, "max_loras": max_loras,
                "hog": hog, "victims": victims,
                "hog_concurrency": args.hog_concurrency,
@@ -985,6 +1242,7 @@ def _compare_policies(args, model_dir, tokenizer, policies) -> dict:
             "goodput_ratio": slo.get("goodput_ratio"),
         }
     block = {"scenario": args.scenario, "policies": rows,
+             "seed": args.seed,
              "sjf_starvation_s": args.sjf_starvation_s}
     base_row = rows.get("fcfs")
     if base_row is not None:
@@ -1041,6 +1299,7 @@ def _compare_spec(args, model_dir, tokenizer) -> dict:
             row["output_tok_s_ratio_vs_off"] = round(
                 row["output_tok_s"] / base["output_tok_s"], 3)
     block = {
+        "seed": args.seed,
         "num_speculative_tokens": args.num_speculative_tokens,
         "spec_k_min": args.spec_k_min,
         "spec_k_max": args.spec_k_max,
@@ -1073,6 +1332,18 @@ def main(args) -> dict:
         save_dummy_checkpoint(f"dummy:{args.speculative_size}", spec_dir)
         args._spec_model_dir = spec_dir
 
+    summary = _dispatch(args, model_dir, tokenizer)
+    if args.summary_out:
+        # Machine-readable snapshot for `python -m
+        # intellillm_tpu.tools.wdiff` (obs/diff.py) — compare two of
+        # these to flag regressions between runs.
+        with open(args.summary_out, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
+    return summary
+
+
+def _dispatch(args, model_dir, tokenizer) -> dict:
     if args.scenario == "fleet":
         return run_fleet(args, model_dir, tokenizer)
 
@@ -1081,6 +1352,12 @@ def main(args) -> dict:
 
     if args.scenario == "multi-tenant":
         return run_multi_tenant(args, model_dir, tokenizer)
+
+    if args.scenario == "replay":
+        return run_replay(args, model_dir, tokenizer)
+
+    if args.scenario == "diurnal":
+        return run_diurnal(args, model_dir, tokenizer)
 
     if args.compare_spec:
         if not args._spec_model_dir:
@@ -1110,7 +1387,7 @@ def run_single(args, model_dir, tokenizer, scheduling_policy=None) -> dict:
     model_name = f"dummy-{args.size}"
     summary = {"size": args.size, "input_len": args.input_len,
                "output_len": args.output_len,
-               "num_prompts": args.num_prompts,
+               "num_prompts": args.num_prompts, "seed": args.seed,
                "max_num_seqs": args.max_num_seqs,
                "num_decode_steps": args.num_decode_steps,
                "quantization": args.quantization,
@@ -1160,7 +1437,8 @@ def run_single(args, model_dir, tokenizer, scheduling_policy=None) -> dict:
             for rate_s in args.rates.split(","):
                 rate = float(rate_s)
                 elapsed, results = asyncio.run(run_benchmark(
-                    "openai", api_url, model_name, requests, rate))
+                    "openai", api_url, model_name, requests, rate,
+                    seed=args.seed))
                 m = compute_metrics(results, elapsed)
                 m["request_rate"] = rate_s
                 summary["results"].append(m)
@@ -1222,7 +1500,8 @@ def make_arg_parser() -> argparse.ArgumentParser:
                    default="/tmp/serve_bench_server.log")
     p.add_argument("--scenario", type=str, default="rate-sweep",
                    choices=["rate-sweep", "ttft-under-load", "fleet",
-                            "disagg", "multi-tenant"],
+                            "disagg", "multi-tenant", "replay",
+                            "diurnal"],
                    help="rate-sweep: Poisson sweep over --rates (the "
                         "default). ttft-under-load: start --num-prompts "
                         "short-prompt requests at once (steady decode "
@@ -1244,7 +1523,18 @@ def make_arg_parser() -> argparse.ArgumentParser:
                         "one hot tenant flooding; reports victim-tenant "
                         "TPOT p99 solo vs contention with fairness caps "
                         "on and off, per-tenant SLO splits, and adapter "
-                        "churn counters (docs/multitenancy.md).")
+                        "churn counters (docs/multitenancy.md). "
+                        "replay: re-issue a captured IWL1 workload "
+                        "(--workload, from /debug/workload?format=iwl "
+                        "or a rotated workload.jsonl) with the original "
+                        "inter-arrival gaps; --replay-repeat N runs the "
+                        "stream N times against one boot and checks the "
+                        "server-side re-captures match (determinism). "
+                        "diurnal: synthesize a seeded day-in-the-life "
+                        "IWL1 stream (flash crowds, heavy-tailed "
+                        "lengths, adapter churn) and replay it; "
+                        "--emit-only just writes the file "
+                        "(docs/observability.md).")
     p.add_argument("--num-replicas", type=int, default=2,
                    help="fleet scenario: engine replicas to launch; "
                         "disagg scenario: decode replicas per fleet")
@@ -1325,6 +1615,37 @@ def make_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--tenant-hog-share-cap", type=float, default=0.2,
                    help="multi-tenant scenario: token_share_cap "
                         "registered for the hot tenant (0 disables)")
+    p.add_argument("--workload", type=str, default=None,
+                   help="replay scenario: IWL1 workload file to "
+                        "re-issue (capture one from "
+                        "/debug/workload?format=iwl)")
+    p.add_argument("--speed", type=float, default=1.0,
+                   help="replay scenario: time-compression factor for "
+                        "recorded inter-arrival gaps (2.0 = replay "
+                        "twice as fast)")
+    p.add_argument("--replay-repeat", type=int, default=1,
+                   help="replay scenario: run the stream N times "
+                        "against one booted server and report whether "
+                        "the server-side workload re-captures match "
+                        "(the determinism check)")
+    p.add_argument("--workload-out", type=str, default=None,
+                   help="diurnal: where to write the synthesized IWL1 "
+                        "stream (default /tmp/serve_bench_diurnal"
+                        ".iwl.jsonl); replay: also save the last "
+                        "server-side re-capture here")
+    p.add_argument("--emit-only", action="store_true",
+                   help="diurnal scenario: write the synthesized IWL1 "
+                        "file and exit without booting a server")
+    p.add_argument("--summary-out", type=str, default=None,
+                   help="write the final summary dict as JSON to this "
+                        "path (feed two of these to python -m "
+                        "intellillm_tpu.tools.wdiff)")
+    p.add_argument("--diurnal-duration", type=float, default=30.0,
+                   help="diurnal scenario: seconds of simulated wall "
+                        "time the synthesized arrivals span")
+    p.add_argument("--diurnal-bursts", type=int, default=2,
+                   help="diurnal scenario: number of gaussian flash "
+                        "crowds mixed into the baseline arrival stream")
     return p
 
 

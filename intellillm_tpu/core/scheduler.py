@@ -341,13 +341,19 @@ class Scheduler:
             for seq_group in aborted:
                 state_queue.remove(seq_group)
                 if self._flight.record(seq_group.request_id, "aborted"):
-                    get_slo_tracker().record_finish(
-                        seq_group.request_id,
-                        sum(s.get_output_len()
-                            for s in seq_group.get_seqs()))
+                    emitted = sum(s.get_output_len()
+                                  for s in seq_group.get_seqs())
+                    get_slo_tracker().record_finish(seq_group.request_id,
+                                                    emitted)
                     # Aborted decodes must not calibrate the length
                     # predictor (their actual length is censored).
                     get_prediction_service().discard(seq_group.request_id)
+                    # Aborts are workload too: a replayed stream must
+                    # reproduce the cancelled tail, not just the wins.
+                    from intellillm_tpu.obs.workload import get_workload_log
+                    get_workload_log().record_seq_group(
+                        seq_group, emitted_tokens=emitted,
+                        reason="aborted")
                 for seq in seq_group.get_seqs():
                     if seq.is_finished():
                         continue

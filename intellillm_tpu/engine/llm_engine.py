@@ -1090,6 +1090,13 @@ class LLMEngine:
                     self._prediction.observe_finish(
                         seq_group.request_id, actual_len,
                         scheduler=self.scheduler)
+                    # ... and the workload log (obs/workload.py): one
+                    # bounded append per request, replayable via
+                    # serve_bench --scenario replay.
+                    from intellillm_tpu.obs.workload import get_workload_log
+                    get_workload_log().record_seq_group(
+                        seq_group, emitted_tokens=actual_len,
+                        reason=",".join(reasons) or "finished")
             request_outputs.append(RequestOutput.from_seq_group(seq_group))
 
         # Flip freshly computed prefixes once their FINAL chunk ran

@@ -7,10 +7,17 @@
                                         request (404 if unknown/evicted)
     GET  /debug/trace                   live request ids + recently
                                         finished traces (?limit=N,
+                                        ?offset=N pages the ring,
                                         ?event=<name> keeps only traces
                                         containing that event) + per-
                                         terminal-event counts over the
                                         finished ring
+    GET  /debug/workload                captured workload log: per-
+                                        request arrival/shape/sampling/
+                                        tenant/outcome records
+                                        (?limit=/?offset= pages,
+                                        ?format=iwl returns the IWL1
+                                        JSONL replay artifact)
     GET  /debug/explain/{request_id}    per-request root-cause explain:
                                         scheduler decision events, the
                                         queue-wait / stall decomposition
@@ -97,7 +104,7 @@ from intellillm_tpu.obs import (EVENTS, explain_request, get_alert_manager,
                                 get_efficiency_tracker,
                                 get_flight_recorder, get_kernel_ledger,
                                 get_metrics_history, get_slo_tracker,
-                                get_watchdog)
+                                get_watchdog, get_workload_log)
 from intellillm_tpu.prediction import get_prediction_service
 from intellillm_tpu.worker.spec_decode.metrics import get_spec_stats
 
@@ -167,6 +174,36 @@ async def debug_spec(request: web.Request) -> web.Response:
     return web.json_response(stats.summary())
 
 
+def parse_paging(request: web.Request, default_limit: int = 32
+                 ) -> "tuple[int, int]":
+    """?limit=/?offset= for ring-buffer listings. Raises ValueError with
+    a client-facing message."""
+    try:
+        limit = int(request.query.get("limit", str(default_limit)))
+        offset = int(request.query.get("offset", "0"))
+    except ValueError:
+        raise ValueError("limit and offset must be integers")
+    if limit < 0 or offset < 0:
+        raise ValueError("limit and offset must be non-negative")
+    return limit, offset
+
+
+async def debug_workload(request: web.Request) -> web.Response:
+    """The workload log (obs/workload.py) for THIS process. Module-level
+    like `metrics` — no engine dependency — so both API servers share
+    it; the router has its own fleet-merged variant. `?format=iwl`
+    returns the ring as a versioned IWL1 JSONL document ready for
+    `serve_bench --scenario replay`."""
+    log = get_workload_log()
+    if request.query.get("format", "json") == "iwl":
+        return web.Response(text=log.iwl_text(), content_type="text/plain")
+    try:
+        limit, offset = parse_paging(request, default_limit=128)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response(log.snapshot(limit=limit, offset=offset))
+
+
 async def metrics(request: web.Request) -> web.Response:
     """Prometheus scrape endpoint — ONE handler shared by both servers
     (the demo server used to lack it entirely)."""
@@ -199,10 +236,9 @@ def add_debug_routes(app: web.Application,
             return web.json_response({"request_id": request_id,
                                       "events": events})
         try:
-            limit = int(request.query.get("limit", "32"))
-        except ValueError:
-            return web.json_response({"error": "limit must be an integer"},
-                                     status=400)
+            limit, offset = parse_paging(request)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
         event = request.query.get("event")
         if event is not None and event not in EVENTS:
             return web.json_response(
@@ -211,7 +247,8 @@ def add_debug_routes(app: web.Application,
         return web.json_response({
             "live_request_ids": recorder.live_request_ids(),
             "finished_counts": recorder.finished_counts(),
-            "recent_finished": recorder.recent_finished(limit, event=event),
+            "recent_finished": recorder.recent_finished(limit, event=event,
+                                                        offset=offset),
         })
 
     async def debug_explain(request: web.Request) -> web.Response:
@@ -412,6 +449,7 @@ def add_debug_routes(app: web.Application,
 
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/trace", debug_trace)
+    app.router.add_get("/debug/workload", debug_workload)
     app.router.add_get("/debug/explain/{request_id}", debug_explain)
     app.router.add_get("/debug/stall", debug_stall)
     app.router.add_get("/debug/efficiency", debug_efficiency)

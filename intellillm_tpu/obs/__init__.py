@@ -1,8 +1,10 @@
 """Observability subsystem: step-phase tracing, XLA compile tracking,
 the per-request flight recorder, request SLO telemetry, the engine
 stall watchdog, device/HBM telemetry, the compute-efficiency ledger,
-the per-kernel cost ledger, the in-process metrics history, and the
-alert rule engine. See docs/observability.md."""
+the per-kernel cost ledger, the in-process metrics history, the alert
+rule engine, the bounded workload log (capture & replay), and the
+benchmark summary differ behind `tools.wdiff`. See
+docs/observability.md."""
 from intellillm_tpu.obs.alerts import (AlertManager, AlertRule,
                                        built_in_rules, get_alert_manager)
 from intellillm_tpu.obs.boot import BootTimeline, get_boot_timeline
@@ -13,6 +15,8 @@ from intellillm_tpu.obs.decisions import (CAUSES, DECISIONS, DecisionLog,
                                           explain_request, get_decision_log)
 from intellillm_tpu.obs.device_telemetry import (DeviceTelemetry,
                                                  get_device_telemetry)
+from intellillm_tpu.obs.diff import (diff_summaries, format_report,
+                                     load_summary)
 from intellillm_tpu.obs.efficiency import (EfficiencyTracker,
                                            get_efficiency_tracker)
 from intellillm_tpu.obs.flight_recorder import (EVENTS, FlightRecorder,
@@ -31,6 +35,9 @@ from intellillm_tpu.obs.trace_export import (TraceSink, flush_black_box,
 from intellillm_tpu.obs.tracing import (PHASES, StepTracer, get_step_tracer,
                                         request_context)
 from intellillm_tpu.obs.watchdog import EngineWatchdog, get_watchdog
+from intellillm_tpu.obs.workload import (WorkloadLog, dump_iwl,
+                                         get_workload_log, merge_workloads,
+                                         parse_iwl)
 
 __all__ = [
     "AlertManager",
@@ -52,8 +59,13 @@ __all__ = [
     "SLOTracker",
     "StepTracer",
     "TraceSink",
+    "WorkloadLog",
     "built_in_rules",
+    "diff_summaries",
+    "dump_iwl",
     "derive_request_metrics",
+    "format_report",
+    "load_summary",
     "explain_request",
     "flush_black_box",
     "get_alert_manager",
@@ -70,7 +82,10 @@ __all__ = [
     "get_step_tracer",
     "get_trace_sink",
     "get_watchdog",
+    "get_workload_log",
     "install_black_box_handlers",
+    "merge_workloads",
+    "parse_iwl",
     "parse_trace_dir",
     "record_kernel_dispatch",
     "request_context",

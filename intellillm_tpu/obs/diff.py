@@ -1,0 +1,261 @@
+"""Workload-diff: compare two benchmark summary snapshots.
+
+`benchmarks/serve_bench.py --summary-out a.json` (and `bench.py`) emit
+machine-readable summary dicts. This module diffs two of them —
+typically "last known-good run" vs "tonight's run" — section by
+section, applies per-section regression thresholds, and produces a
+one-line verdict plus a per-metric breakdown. The CLI wrapper is
+`python -m intellillm_tpu.tools.wdiff`.
+
+Sections and what they cover:
+
+- ``throughput``  rate-sweep results: request/token throughput,
+  latency / TTFT / TPOT percentiles.
+- ``slo``         the server's SLO block (attainment, goodput).
+- ``contention``  contention cause-seconds (queueing, KV pressure, ...).
+- ``efficiency``  the efficiency ledger (MFU, bandwidth util, ...).
+- ``kernels``     per-kernel cost attribution deltas.
+- ``tenancy``     multi-tenant isolation ratios and victim latency.
+
+Direction (is a bigger number better or worse?) is inferred from the
+metric name: throughput/attainment/hit-rate style names regress when
+they *drop*, latency/seconds/ratio style names regress when they
+*rise*. Metrics whose direction can't be inferred are reported as
+informational only and never fail the diff.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+# Name fragments that are structural identifiers, not magnitudes
+# (bucket ids, window sizes, repeat indexes). Checked first: a path
+# like "top_waste[2].batch_bucket" must not inherit a direction from
+# the "waste" higher up the path.
+_NEUTRAL = (
+    "bucket", "window", "repeat", "seed", "limit", "offset",
+    "request_id",
+)
+# Name fragments that identify a metric where HIGHER is better. Checked
+# before the lower-is-better list: "request_throughput_rps" must match
+# "throughput" (not the "_s"-style latency patterns) and "fill_ratio"
+# must match "fill_ratio" (not the degradation-"ratio" pattern — and
+# not bare "fill", which would swallow "prefill" latencies).
+_HIGHER_BETTER = (
+    "throughput", "tok_s", "rps", "goodput", "attainment", "hit",
+    "accept", "mfu", "efficiency", "util", "completed", "bandwidth",
+    "fill_ratio",
+)
+# Name fragments where LOWER is better (latencies, stalls, contention
+# cause-seconds, padding waste, isolation degradation ratios).
+_LOWER_BETTER = (
+    "latency", "ttft", "tpot", "_ms", "_s", "seconds", "stall", "wait",
+    "waste", "evict", "miss", "ratio", "churn", "drop", "abort",
+    "preempt", "queue", "spill", "pressure", "pad_",
+)
+
+# Default per-section regression thresholds as relative fractions:
+# flag `slo` metrics that moved >10% in the bad direction, but give the
+# noisier contention/kernel timings 25% of slack. The wdiff CLI can
+# override any of these per section.
+DEFAULT_THRESHOLDS: Dict[str, float] = {
+    "throughput": 0.10,
+    "slo": 0.10,
+    "contention": 0.25,
+    "efficiency": 0.10,
+    "kernels": 0.25,
+    "tenancy": 0.25,
+}
+
+# Values this small are treated as "basically zero": relative change on
+# them is noise (a 0.0001s cause-second doubling is not a regression).
+_MIN_BASE = 1e-6
+
+
+def metric_direction(key: str) -> Optional[str]:
+    """'higher' | 'lower' | None (unknown => informational only).
+
+    The neutral check runs on the LEAF segment only — "p99" under
+    "ttft_percentiles_ms" keeps its direction, but a "batch_bucket"
+    leaf is an identifier wherever it sits."""
+    low = key.lower()
+    leaf = low.rsplit(".", 1)[-1]
+    for pat in _NEUTRAL:
+        if pat in leaf:
+            return None
+    for pat in _HIGHER_BETTER:
+        if pat in low:
+            return "higher"
+    for pat in _LOWER_BETTER:
+        if pat in low:
+            return "lower"
+    return None
+
+
+def flatten(node, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves of a nested dict/list as dotted-path -> float."""
+    out: Dict[str, float] = {}
+    if isinstance(node, dict):
+        for k, v in node.items():
+            out.update(flatten(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            out.update(flatten(v, f"{prefix}[{i}]"))
+    elif isinstance(node, bool):
+        pass  # True/False are statuses, not magnitudes
+    elif isinstance(node, (int, float)):
+        out[prefix] = float(node)
+    return out
+
+
+def _section_views(summary: dict) -> Dict[str, object]:
+    slo = summary.get("slo")
+    if isinstance(slo, dict):
+        # `slowest` is per-request debris (arbitrary ids, single
+        # samples) — comparing it pairwise across runs is noise.
+        slo = {k: v for k, v in slo.items() if k != "slowest"}
+    views = {
+        "throughput": summary.get("results"),
+        "slo": slo,
+        "contention": summary.get("contention"),
+        "efficiency": summary.get("efficiency"),
+        "kernels": summary.get("kernels"),
+    }
+    tenancy = {k: summary.get(k) for k in
+               ("isolation", "victim_latency") if summary.get(k)}
+    views["tenancy"] = tenancy or None
+    return views
+
+
+def load_summary(path: str) -> dict:
+    """Load a summary snapshot from `path`.
+
+    Accepts either a plain JSON file (--summary-out output) or raw
+    serve_bench stdout, in which case the last line carrying a
+    ``serve_bench_summary`` / ``bench_summary`` object wins."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+        return _unwrap(obj)
+    except ValueError:
+        pass
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and any(
+                k.endswith("_summary") for k in obj):
+            return _unwrap(obj)
+    raise ValueError(f"{path}: no summary JSON found (expected a "
+                     "--summary-out file or serve_bench stdout)")
+
+
+def _unwrap(obj: dict) -> dict:
+    if not isinstance(obj, dict):
+        raise ValueError("summary snapshot must be a JSON object")
+    for k, v in obj.items():
+        if k.endswith("_summary") and isinstance(v, dict):
+            return v
+    return obj
+
+
+def diff_summaries(baseline: dict, candidate: dict,
+                   thresholds: Optional[Dict[str, float]] = None) -> dict:
+    """Diff two summary dicts; returns the report structure.
+
+    A metric regresses when it moved more than the section threshold in
+    its bad direction; it improves when it moved that much in the good
+    direction. Unknown-direction metrics are counted but never flagged.
+    """
+    thr = dict(DEFAULT_THRESHOLDS)
+    thr.update(thresholds or {})
+    sections: Dict[str, dict] = {}
+    a_views = _section_views(baseline)
+    b_views = _section_views(candidate)
+    for name in DEFAULT_THRESHOLDS:
+        a_node, b_node = a_views.get(name), b_views.get(name)
+        if a_node is None or b_node is None:
+            continue
+        a_flat, b_flat = flatten(a_node), flatten(b_node)
+        shared = sorted(set(a_flat) & set(b_flat))
+        regressions: List[dict] = []
+        improvements: List[dict] = []
+        for key in shared:
+            direction = metric_direction(key)
+            if direction is None:
+                continue
+            a_val, b_val = a_flat[key], b_flat[key]
+            base = max(abs(a_val), abs(b_val))
+            if base < _MIN_BASE:
+                continue
+            rel = (b_val - a_val) / max(abs(a_val), _MIN_BASE)
+            worse = rel < -thr[name] if direction == "higher" \
+                else rel > thr[name]
+            better = rel > thr[name] if direction == "higher" \
+                else rel < -thr[name]
+            row = {"metric": key, "baseline": a_val, "candidate": b_val,
+                   "change_pct": round(rel * 100.0, 1),
+                   "direction": direction,
+                   "threshold_pct": round(thr[name] * 100.0, 1)}
+            if worse:
+                regressions.append(row)
+            elif better:
+                improvements.append(row)
+        regressions.sort(key=lambda r: -abs(r["change_pct"]))
+        improvements.sort(key=lambda r: -abs(r["change_pct"]))
+        sections[name] = {"compared": len(shared),
+                          "threshold_pct": round(thr[name] * 100.0, 1),
+                          "regressions": regressions,
+                          "improvements": improvements}
+    regressed = [n for n, s in sections.items() if s["regressions"]]
+    report = {"sections": sections, "regressed_sections": regressed,
+              "verdict": _verdict(sections, regressed)}
+    return report
+
+
+def _verdict(sections: Dict[str, dict], regressed: List[str]) -> str:
+    compared = sum(s["compared"] for s in sections.values())
+    if not sections:
+        return "NO-DATA: the two snapshots share no comparable sections"
+    if not regressed:
+        return (f"PASS: no regressions across {compared} metrics in "
+                f"{len(sections)} sections")
+    worst: Tuple[float, str, dict] = (0.0, "", {})
+    for name in regressed:
+        for row in sections[name]["regressions"]:
+            if abs(row["change_pct"]) > worst[0]:
+                worst = (abs(row["change_pct"]), name, row)
+    _, wname, wrow = worst
+    sign = "+" if wrow["change_pct"] >= 0 else ""
+    return (f"REGRESSION in {', '.join(regressed)} — worst "
+            f"{wname}:{wrow['metric']} {sign}{wrow['change_pct']}% "
+            f"(threshold {wrow['threshold_pct']}%)")
+
+
+def format_report(report: dict, baseline_path: str = "baseline",
+                  candidate_path: str = "candidate") -> str:
+    """Human-readable multi-line rendering of a diff_summaries report."""
+    lines = [f"wdiff: {baseline_path} -> {candidate_path}",
+             report["verdict"], ""]
+    for name, sec in report["sections"].items():
+        status = ("REGRESSED" if sec["regressions"] else "ok")
+        lines.append(f"[{name}] {status}  "
+                     f"({sec['compared']} metrics compared, "
+                     f"threshold {sec['threshold_pct']}%)")
+        for row in sec["regressions"]:
+            sign = "+" if row["change_pct"] >= 0 else ""
+            lines.append(
+                f"  - {row['metric']}: {row['baseline']:g} -> "
+                f"{row['candidate']:g} ({sign}{row['change_pct']}%, "
+                f"{row['direction']} is better)")
+        for row in sec["improvements"][:3]:
+            sign = "+" if row["change_pct"] >= 0 else ""
+            lines.append(
+                f"  + {row['metric']}: {row['baseline']:g} -> "
+                f"{row['candidate']:g} ({sign}{row['change_pct']}%)")
+    return "\n".join(lines) + "\n"

@@ -113,20 +113,26 @@ class FlightRecorder:
                 for ts, ev, d in items]
 
     def recent_finished(self, limit: int = 32,
-                        event: Optional[str] = None) -> List[Dict[str, Any]]:
+                        event: Optional[str] = None,
+                        offset: int = 0) -> List[Dict[str, Any]]:
         """Most-recently finished requests (newest first), each with its
         full event list — the /debug/trace dump when no id is given.
         `event` keeps only traces containing that event (operators
         hunting preempted/rerouted requests filter instead of dumping
-        the whole ring)."""
+        the whole ring); `offset` skips that many matching traces first,
+        so capture-heavy rings page instead of one oversized response."""
         with self._lock:
             items = [(rid, list(buf))
                      for rid, buf in reversed(self._finished.items())]
         out = []
+        skipped = 0
         for rid, events in items:
             if len(out) >= limit:
                 break
             if event is not None and all(ev != event for _, ev, _ in events):
+                continue
+            if skipped < offset:
+                skipped += 1
                 continue
             out.append({
                 "request_id": rid,

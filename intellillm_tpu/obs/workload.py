@@ -1,0 +1,500 @@
+"""Workload capture: what traffic was this process actually serving?
+
+Aggregate metrics say the SLO burned; the workload log says what the
+traffic *was* when it burned — every admitted request's arrival time,
+prompt shape, sampling parameters, tenant/adapter, and outcome — in a
+form `serve_bench --scenario replay` can re-issue verbatim. The capture
+rides the flight recorder's exactly-once terminal seal (one bounded
+append per finished/aborted request, nothing per token), so it can stay
+on in production; the in-memory ring is served by `GET /debug/workload`
+on both API servers and, fleet-merged, on the router.
+
+The interchange format is IWL1 ("IntelliLLM workload, version 1"):
+JSONL whose first line is a header `{"iwl": 1, ...}` and every further
+line one request record:
+
+    {"ts": <arrival wall-clock s>, "t": <offset s from the stream's
+     first arrival>, "id": "<trace id>", "prompt_len": N,
+     "prompt_hash": "<16-hex blake2b>", "prompt": "<raw, opt-in>",
+     "sampling": {"max_tokens": ..., "temperature": ..., "top_p": ...,
+                  "top_k": ..., "n": ..., "best_of": ...,
+                  "ignore_eos": ..., "use_beam_search": ...},
+     "tenant": "<tenant id or null>", "adapter": <lora_int_id>,
+     "priority": 0, "outcome": {"tokens": N, "reason": "<finished
+     reason | aborted>"}}
+
+`priority` is reserved (the engine has no admission priority classes
+yet; the scheduler's SJF ordering is policy-internal) and is always 0
+today — replay tooling must carry it through. Raw prompt text is only
+recorded with `INTELLILLM_WORKLOAD_RAW` on; otherwise replays
+resynthesize deterministic prompts from (prompt_hash, prompt_len).
+
+Config (environment; documented in docs/observability.md):
+
+    INTELLILLM_WORKLOAD            in-memory capture (default on; "0"
+                                   short-circuits the seal hook)
+    INTELLILLM_WORKLOAD_RAW        include raw prompt text (default
+                                   off — prompts are user data)
+    INTELLILLM_WORKLOAD_EXPORT     durable IWL1 JSONL sink (default
+                                   off; durable IO is opt-in)
+    INTELLILLM_WORKLOAD_DIR        sink directory (default
+                                   /tmp/intellillm-workload)
+    INTELLILLM_WORKLOAD_MAX        in-memory ring size (default 4096)
+    INTELLILLM_WORKLOAD_MAX_BYTES  rotate workload.jsonl past this
+                                   size (default 32 MiB)
+    INTELLILLM_WORKLOAD_MAX_FILES  rotated files kept (default 4)
+
+Exported (when `prometheus_client` is installed — silently skipped
+otherwise; sampled into /debug/history like every intellillm_* family):
+
+    intellillm_workload_requests_total{reason}   counter
+    intellillm_workload_prompt_tokens_total      counter
+    intellillm_workload_output_tokens_total      counter
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from intellillm_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+try:
+    from prometheus_client import Counter
+    _PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    _PROMETHEUS = False
+
+IWL_VERSION = 1
+
+_DEFAULT_DIR = "/tmp/intellillm-workload"
+_DEFAULT_MAX_ENTRIES = 4096
+_DEFAULT_MAX_BYTES = 32 * 1024 * 1024
+_DEFAULT_MAX_FILES = 4
+
+#: sampling-params fields a replay needs to reproduce the request
+SAMPLING_FIELDS = ("max_tokens", "temperature", "top_p", "top_k", "n",
+                   "best_of", "ignore_eos", "use_beam_search")
+
+
+def prompt_fingerprint(prompt: Optional[str],
+                       prompt_token_ids: Optional[Iterable[int]]) -> str:
+    """16-hex blake2b of the prompt content — stable across processes
+    (PYTHONHASHSEED-independent), so a captured stream and its replay
+    agree on request identity without shipping raw prompt text. Falls
+    back to the token ids when the request came in pre-tokenized."""
+    if prompt is not None:
+        payload = prompt.encode("utf-8", errors="replace")
+    else:
+        payload = (",".join(str(t) for t in (prompt_token_ids or ()))
+                   .encode("ascii"))
+    return hashlib.blake2b(payload, digest_size=8).hexdigest()
+
+
+class _WorkloadMetrics:
+    """Prometheus collectors for workload capture (process-global, built
+    once — same singleton pattern as obs/trace_export.py)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init()
+        return cls._instance
+
+    def _init(self) -> None:
+        self.counter_requests = Counter(
+            "intellillm_workload_requests_total",
+            "Requests captured into the workload log, by finish reason.",
+            ["reason"])
+        self.counter_prompt_tokens = Counter(
+            "intellillm_workload_prompt_tokens_total",
+            "Prompt tokens across captured requests.")
+        self.counter_output_tokens = Counter(
+            "intellillm_workload_output_tokens_total",
+            "Emitted output tokens across captured requests.")
+
+    @classmethod
+    def reset_for_testing(cls) -> None:
+        inst = cls._instance
+        if inst is not None and _PROMETHEUS:
+            from prometheus_client import REGISTRY
+            for collector in vars(inst).values():
+                try:
+                    REGISTRY.unregister(collector)
+                except Exception:
+                    pass
+        cls._instance = None
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(float(raw))
+    except ValueError:
+        logger.warning("Ignoring invalid %s=%r", name, raw)
+        return default
+
+
+class WorkloadLog:
+    """Bounded in-memory workload ring + optional rotating IWL1 sink.
+
+    `record_seq_group` is called once per request from the two flight-
+    recorder terminal-seal sites (engine finished-seal, scheduler
+    abort-seal); with capture disabled it returns on one attribute
+    check, and it never raises into the engine path."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 raw: Optional[bool] = None,
+                 export: Optional[bool] = None,
+                 workload_dir: Optional[str] = None,
+                 max_entries: Optional[int] = None,
+                 max_bytes: Optional[int] = None,
+                 max_files: Optional[int] = None,
+                 hop: Optional[str] = None) -> None:
+        from intellillm_tpu.utils import parse_env_flag
+        if enabled is None:
+            flag = parse_env_flag(os.environ.get("INTELLILLM_WORKLOAD"))
+            enabled = True if flag is None else flag  # ring is cheap: on
+        self.enabled = enabled
+        if raw is None:
+            raw = bool(parse_env_flag(
+                os.environ.get("INTELLILLM_WORKLOAD_RAW")))
+        self.raw = raw
+        if export is None:
+            export = bool(parse_env_flag(
+                os.environ.get("INTELLILLM_WORKLOAD_EXPORT")))
+        self.export = export
+        self.workload_dir = workload_dir or os.environ.get(
+            "INTELLILLM_WORKLOAD_DIR", _DEFAULT_DIR)
+        self.max_entries = max(max_entries if max_entries is not None else
+                               _env_int("INTELLILLM_WORKLOAD_MAX",
+                                        _DEFAULT_MAX_ENTRIES), 1)
+        self.max_bytes = (max_bytes if max_bytes is not None else
+                          _env_int("INTELLILLM_WORKLOAD_MAX_BYTES",
+                                   _DEFAULT_MAX_BYTES))
+        self.max_files = max(max_files if max_files is not None else
+                             _env_int("INTELLILLM_WORKLOAD_MAX_FILES",
+                                      _DEFAULT_MAX_FILES), 1)
+        from intellillm_tpu.obs.flight_recorder import _default_hop
+        self.hop = hop if hop is not None else _default_hop()
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.max_entries)
+        self._count = 0
+        self._metrics = _WorkloadMetrics() if _PROMETHEUS else None
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.workload_dir, "workload.jsonl")
+
+    # --- capture ----------------------------------------------------------
+
+    def record_seq_group(self, seq_group, *, emitted_tokens: int,
+                         reason: str) -> None:
+        """Capture one sealed request from a SequenceGroup (duck-typed:
+        request_id / arrival_time / prompt / prompt_token_ids /
+        sampling_params / lora_int_id). Must never raise — this sits on
+        the engine's finish path."""
+        if not self.enabled:
+            return
+        try:
+            # arrival_time is time.monotonic(); pin it to the wall clock
+            # so streams captured on different replicas merge on `ts`.
+            arrival_ts = time.time() - max(
+                0.0, time.monotonic() - seq_group.arrival_time)
+            sp = getattr(seq_group, "sampling_params", None)
+            sampling = {f: getattr(sp, f, None) for f in SAMPLING_FIELDS}
+            prompt = getattr(seq_group, "prompt", None)
+            token_ids = getattr(seq_group, "prompt_token_ids", None) or ()
+            adapter = getattr(seq_group, "lora_int_id", 0)
+            # Tenant attribution, lazily: tenancy singletons shouldn't
+            # initialise for engines that never finish a request.
+            tenant = None
+            if adapter:
+                from intellillm_tpu.tenancy import get_tenant_registry
+                tenant = get_tenant_registry().tenant_for_adapter(adapter)
+            self.record(
+                trace_id=seq_group.request_id, arrival_ts=arrival_ts,
+                prompt_len=len(token_ids), prompt=prompt,
+                prompt_hash=prompt_fingerprint(prompt, token_ids),
+                sampling=sampling, tenant=tenant, adapter=adapter,
+                emitted_tokens=int(emitted_tokens), reason=reason)
+        except Exception as e:  # never fail a request over bookkeeping
+            logger.warning("workload capture failed: %s", e)
+
+    def record(self, *, trace_id: str, arrival_ts: float, prompt_len: int,
+               prompt_hash: str, sampling: Dict[str, Any],
+               emitted_tokens: int, reason: str,
+               prompt: Optional[str] = None,
+               tenant: Optional[str] = None, adapter: int = 0,
+               priority: int = 0) -> None:
+        """Append one already-flattened record (the raw-field API the
+        tests and non-engine callers use)."""
+        if not self.enabled:
+            return
+        rec: Dict[str, Any] = {
+            "ts": arrival_ts,
+            "id": trace_id,
+            "prompt_len": int(prompt_len),
+            "prompt_hash": prompt_hash,
+            "sampling": dict(sampling),
+            "tenant": tenant,
+            "adapter": int(adapter),
+            "priority": int(priority),
+            "outcome": {"tokens": int(emitted_tokens), "reason": reason},
+        }
+        if self.raw and prompt is not None:
+            rec["prompt"] = prompt
+        with self._lock:
+            self._ring.append(rec)
+            self._count += 1
+        if self._metrics is not None:
+            self._metrics.counter_requests.labels(
+                (reason or "unknown").split(",")[0]).inc()
+            self._metrics.counter_prompt_tokens.inc(max(int(prompt_len), 0))
+            self._metrics.counter_output_tokens.inc(
+                max(int(emitted_tokens), 0))
+        if self.export:
+            self._export_line(rec)
+
+    # --- durable sink -----------------------------------------------------
+
+    def _export_line(self, rec: Dict[str, Any]) -> None:
+        line = json.dumps(rec, separators=(",", ":"))
+        try:
+            with self._lock:
+                os.makedirs(self.workload_dir, exist_ok=True)
+                self._rotate_if_needed(len(line) + 1)
+                fresh = (not os.path.exists(self.path)
+                         or os.path.getsize(self.path) == 0)
+                with open(self.path, "a", encoding="utf-8") as f:
+                    if fresh:
+                        # Every sink file is self-describing IWL1 (the
+                        # post-rotation file gets a fresh header).
+                        f.write(json.dumps(iwl_header(
+                            source=self.hop,
+                            raw_prompts=self.raw)) + "\n")
+                    f.write(line + "\n")
+        except OSError as e:  # a full disk must never fail a request
+            logger.warning("workload export failed: %s", e)
+
+    def _rotate_if_needed(self, incoming: int) -> None:
+        """Shift workload.jsonl -> .1 -> .2 ... when the active file
+        would exceed max_bytes; the oldest rotated file past max_files
+        is deleted (caller holds the lock)."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size + incoming <= self.max_bytes:
+            return
+        oldest = f"{self.path}.{self.max_files - 1}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.max_files - 2, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        if self.max_files > 1:
+            os.replace(self.path, f"{self.path}.1")
+        else:
+            os.remove(self.path)
+
+    def files(self) -> List[str]:
+        """Active + rotated sink files that currently exist, newest
+        first."""
+        out = []
+        for name in [self.path] + [f"{self.path}.{i}"
+                                   for i in range(1, self.max_files)]:
+            if os.path.exists(name):
+                out.append(name)
+        return out
+
+    # --- read side --------------------------------------------------------
+
+    def records(self) -> List[Dict[str, Any]]:
+        """Ring contents in arrival order (sorted by `ts` — seals land
+        in finish order, which is not arrival order)."""
+        with self._lock:
+            items = list(self._ring)
+        return sorted(items, key=lambda r: (r.get("ts") or 0.0,
+                                            r.get("id") or ""))
+
+    def snapshot(self, limit: int = 128, offset: int = 0) -> Dict[str, Any]:
+        """The /debug/workload body: capture config + state and a page
+        of records, newest first (same orientation as /debug/trace)."""
+        ordered = self.records()
+        newest_first = list(reversed(ordered))
+        page = newest_first[offset:offset + limit] if limit >= 0 else []
+        with self._lock:
+            count = self._count
+        return {
+            "enabled": self.enabled,
+            "raw_prompts": self.raw,
+            "hop": self.hop,
+            "count": count,
+            "evicted": max(count - len(ordered), 0),
+            "limit": limit,
+            "offset": offset,
+            "export": {
+                "enabled": self.export,
+                "path": self.path if self.export else None,
+                "files": self.files() if self.export else [],
+            },
+            "records": page,
+        }
+
+    def iwl_text(self, source: Optional[str] = None) -> str:
+        """The ring as one IWL1 document (the /debug/workload?format=iwl
+        body): header line + records in arrival order with `t` offsets
+        relative to the first arrival."""
+        return dump_iwl(self.records(), source=source or self.hop,
+                        raw_prompts=self.raw)
+
+    def reset_for_testing(self) -> None:
+        with self._lock:
+            self._ring = deque(maxlen=self.max_entries)
+            self._count = 0
+
+
+# --- IWL1 read/write -------------------------------------------------------
+
+def iwl_header(source: str = "unknown", raw_prompts: bool = False,
+               requests: Optional[int] = None,
+               extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    hdr: Dict[str, Any] = {
+        "iwl": IWL_VERSION,
+        "source": source,
+        "captured_ts": time.time(),
+        "raw_prompts": bool(raw_prompts),
+    }
+    if requests is not None:
+        hdr["requests"] = int(requests)
+    if extra:
+        hdr.update(extra)
+    return hdr
+
+
+def dump_iwl(records: List[Dict[str, Any]], source: str = "unknown",
+             raw_prompts: bool = False,
+             extra_header: Optional[Dict[str, Any]] = None) -> str:
+    """Serialize records (arrival-ordered) as an IWL1 document. Each
+    record gains `t`, the offset from the stream's first arrival —
+    replay pacing needs only the offsets, so documents stay comparable
+    across capture epochs."""
+    ordered = sorted(records, key=lambda r: (r.get("ts") or 0.0,
+                                             r.get("id") or ""))
+    base = ordered[0].get("ts", 0.0) if ordered else 0.0
+    lines = [json.dumps(iwl_header(source=source, raw_prompts=raw_prompts,
+                                   requests=len(ordered),
+                                   extra=extra_header),
+                        separators=(",", ":"))]
+    for rec in ordered:
+        out = dict(rec)
+        out["t"] = round(max((rec.get("ts") or 0.0) - base, 0.0), 6)
+        lines.append(json.dumps(out, separators=(",", ":")))
+    return "\n".join(lines) + "\n"
+
+
+def parse_iwl(text: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Parse an IWL1 document into (header, records). Records come back
+    sorted by `t` (falling back to `ts`), each guaranteed to carry a
+    numeric `t` offset. Raises ValueError on a missing/foreign header
+    or unsupported version."""
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("empty workload file (expected an IWL1 header)")
+    try:
+        header = json.loads(lines[0])
+    except json.JSONDecodeError as e:
+        raise ValueError(f"workload header is not JSON: {e}") from e
+    if not isinstance(header, dict) or "iwl" not in header:
+        raise ValueError("not an IWL workload file (first line lacks "
+                         "the {\"iwl\": 1, ...} header)")
+    if header["iwl"] != IWL_VERSION:
+        raise ValueError(f"unsupported IWL version {header['iwl']!r} "
+                         f"(this build reads IWL{IWL_VERSION})")
+    records = []
+    for i, ln in enumerate(lines[1:], start=2):
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"bad workload record on line {i}: {e}") from e
+        if "t" not in rec:
+            rec["t"] = rec.get("ts", 0.0)
+        records.append(rec)
+    records.sort(key=lambda r: (float(r.get("t") or 0.0),
+                                str(r.get("id") or "")))
+    if records:
+        base = float(records[0].get("t") or 0.0)
+        if base:
+            for rec in records:
+                rec["t"] = round(float(rec.get("t") or 0.0) - base, 6)
+    return header, records
+
+
+def base_trace_id(trace_id: str) -> str:
+    """Strip the attempt suffix the router appends for failover retries
+    (`{id}#f{k}`) and disagg prefill legs (`{id}#p0`) — fleet merges
+    dedup on the base id so one logical request counts once."""
+    return (trace_id or "").split("#", 1)[0]
+
+
+def merge_workloads(record_lists: Iterable[List[Dict[str, Any]]]
+                    ) -> Tuple[List[Dict[str, Any]], int]:
+    """Merge per-replica workload records into one arrival-ordered
+    stream, attempt-deduped by base trace id. Among duplicates the
+    `finished` outcome wins (the failover retry is the request the
+    client saw complete); ties go to the latest arrival. Returns
+    (merged, attempts_deduped)."""
+    best: Dict[str, Dict[str, Any]] = {}
+    dropped = 0
+    for records in record_lists:
+        for rec in records or []:
+            key = base_trace_id(str(rec.get("id") or ""))
+            cur = best.get(key)
+            if cur is None:
+                best[key] = rec
+                continue
+            dropped += 1
+            cur_fin = ((cur.get("outcome") or {}).get("reason")
+                       not in ("aborted", "rerouted"))
+            new_fin = ((rec.get("outcome") or {}).get("reason")
+                       not in ("aborted", "rerouted"))
+            if (new_fin, rec.get("ts") or 0.0) > (cur_fin,
+                                                  cur.get("ts") or 0.0):
+                best[key] = rec
+    merged = sorted(best.values(), key=lambda r: (r.get("ts") or 0.0,
+                                                  r.get("id") or ""))
+    return merged, dropped
+
+
+# Built lazily so tests can flip the env and rebuild (same pattern as
+# obs/trace_export.py's sink singleton).
+_WORKLOAD_LOG: Optional[WorkloadLog] = None
+_LOG_LOCK = threading.Lock()
+
+
+def get_workload_log() -> WorkloadLog:
+    global _WORKLOAD_LOG
+    if _WORKLOAD_LOG is None:
+        with _LOG_LOCK:
+            if _WORKLOAD_LOG is None:
+                _WORKLOAD_LOG = WorkloadLog()
+    return _WORKLOAD_LOG
+
+
+def reset_workload_log_for_testing() -> None:
+    global _WORKLOAD_LOG
+    with _LOG_LOCK:
+        _WORKLOAD_LOG = None
+    _WorkloadMetrics.reset_for_testing()

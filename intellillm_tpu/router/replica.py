@@ -106,6 +106,13 @@ class Replica:
         fetch_trace."""
         return None
 
+    async def fetch_workload(self, limit: int = 1024) -> Optional[list]:
+        """This replica's captured workload records (obs/workload.py),
+        arrival-ordered, or None when unknown/unreachable — the fleet-
+        merge side of the router's /debug/workload. Same must-not-raise
+        contract as fetch_trace."""
+        return None
+
     async def close(self) -> None:
         pass
 
@@ -203,6 +210,12 @@ class InProcessReplica(Replica):
         from intellillm_tpu.obs import explain_request
         payload = explain_request(request_id)
         return payload if payload.get("found") else None
+
+    async def fetch_workload(self, limit: int = 1024) -> Optional[list]:
+        # The process-global log — in-process replicas share it, so the
+        # router's merge dedups the shared records by trace id.
+        from intellillm_tpu.obs import get_workload_log
+        return get_workload_log().records()[-limit:]
 
     async def export_kv(self, prompt: str) -> bytes:
         if self._killed:
@@ -318,6 +331,26 @@ class HTTPReplica(Replica):
         except Exception:
             # Same contract as fetch_trace: a dead replica yields
             # explain=None for the attempt, never a failed stitch.
+            return None
+
+    async def fetch_workload(self, limit: int = 1024) -> Optional[list]:
+        import aiohttp
+        try:
+            async with self._get_session().get(
+                    f"{self.base_url}/debug/workload",
+                    params={"limit": str(limit)},
+                    timeout=aiohttp.ClientTimeout(total=5.0)) as resp:
+                if resp.status != 200:
+                    return None
+                body = await resp.json()
+                # snapshot() pages newest-first; restore arrival order.
+                records = body.get("records") or []
+                return sorted(records,
+                              key=lambda r: (r.get("ts") or 0.0,
+                                             r.get("id") or ""))
+        except Exception:
+            # A dead replica contributes nothing to the fleet merge
+            # instead of failing it (same contract as fetch_trace).
             return None
 
     async def export_kv(self, prompt: str) -> bytes:

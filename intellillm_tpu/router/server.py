@@ -643,6 +643,33 @@ class Router:
             },
         }
 
+    async def fleet_workload(self, limit: int = 1024) -> dict:
+        """Fleet-merged workload: every replica's captured records
+        (Replica.fetch_workload — must-not-raise, so a dead replica
+        contributes nothing), attempt-deduped by base trace id. Failover
+        retries reach replicas as `{id}#f{k}` and disagg prefill legs as
+        `{id}#p0`; the merge keeps one record per logical request, and
+        for duplicates the finished attempt beats the rerouted/aborted
+        one — the stream a replay should re-issue."""
+        from intellillm_tpu.obs.workload import merge_workloads
+        per_replica: Dict[str, Optional[int]] = {}
+        shards = []
+        for rid, replica in self.manager.replicas.items():
+            records = await replica.fetch_workload(limit=limit)
+            per_replica[rid] = len(records) if records is not None else None
+            if records:
+                shards.append(records)
+        merged, deduped = merge_workloads(shards)
+        if limit >= 0:
+            merged = merged[-limit:]
+        return {
+            "fleet_merged": True,
+            "replicas": per_replica,
+            "attempts_deduped": deduped,
+            "count": len(merged),
+            "records": merged,
+        }
+
     def snapshot(self) -> dict:
         healthy = [rid for rid, r in self.manager.replicas.items()
                    if r.healthy]
@@ -727,16 +754,36 @@ def build_router_app(router: Router) -> web.Application:
         return web.json_response(body, status=200 if ok else 503)
 
     async def debug_trace_list(request: web.Request) -> web.Response:
+        from intellillm_tpu.entrypoints.debug_routes import parse_paging
         try:
-            limit = int(request.query.get("limit", "32"))
-        except ValueError:
-            return web.json_response({"error": "limit must be an integer"},
-                                     status=400)
+            limit, offset = parse_paging(request)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
         return web.json_response({
             "live_trace_ids": router.recorder.live_request_ids(),
             "recent_trace_ids": router.tracebook.recent_trace_ids(limit),
-            "recent_finished": router.recorder.recent_finished(limit),
+            "recent_finished": router.recorder.recent_finished(
+                limit, offset=offset),
         })
+
+    async def debug_workload_fleet(request: web.Request) -> web.Response:
+        """Fleet-merged, attempt-deduped workload across every replica
+        (the per-process view lives on each replica's own
+        /debug/workload). ?format=iwl emits the merged stream as one
+        IWL1 document — the capture side of `serve_bench --scenario
+        replay`."""
+        from intellillm_tpu.entrypoints.debug_routes import parse_paging
+        try:
+            limit, _ = parse_paging(request, default_limit=1024)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        body = await router.fleet_workload(limit=limit)
+        if request.query.get("format", "json") == "iwl":
+            from intellillm_tpu.obs.workload import dump_iwl
+            return web.Response(
+                text=dump_iwl(body["records"], source="fleet"),
+                content_type="text/plain")
+        return web.json_response(body)
 
     async def debug_alerts(request: web.Request) -> web.Response:
         """The engine handler's body plus the fleet aggregation."""
@@ -773,6 +820,7 @@ def build_router_app(router: Router) -> web.Application:
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/health/detail", health_detail)
     app.router.add_get("/debug/trace", debug_trace_list)
+    app.router.add_get("/debug/workload", debug_workload_fleet)
     app.router.add_get("/debug/trace/{trace_id}", debug_trace_stitched)
     app.router.add_get("/debug/explain/{trace_id}", debug_explain_stitched)
     app.router.add_get("/debug/history", debug_history)

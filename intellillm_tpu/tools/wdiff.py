@@ -1,0 +1,82 @@
+"""wdiff: did tonight's benchmark run regress against the baseline?
+
+    python -m intellillm_tpu.tools.wdiff baseline.json candidate.json
+
+Both inputs are summary snapshots — either `--summary-out` files from
+`benchmarks/serve_bench.py` / raw serve_bench stdout, or a `bench.py`
+summary JSON. The tool diffs them section by section (SLO percentiles,
+throughput, contention cause-seconds, efficiency ledger, per-kernel
+deltas, tenancy isolation — see `intellillm_tpu/obs/diff.py`), prints a
+per-metric breakdown plus a one-line verdict, and exits non-zero when
+any section regressed past its threshold — so CI can gate on it.
+
+    # loosen the noisy sections for tiny CPU smoke runs
+    python -m intellillm_tpu.tools.wdiff a.json b.json \
+        --threshold throughput=0.5 --threshold slo=0.5
+
+Exit codes: 0 pass, 1 regression, 2 could not load a snapshot.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from intellillm_tpu.obs.diff import (diff_summaries, format_report,
+                                     load_summary)
+
+
+def _parse_thresholds(pairs: List[str]) -> dict:
+    out = {}
+    for pair in pairs:
+        name, _, value = pair.partition("=")
+        try:
+            out[name.strip()] = float(value)
+        except ValueError:
+            raise SystemExit(
+                f"--threshold expects SECTION=FRACTION, got {pair!r}")
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m intellillm_tpu.tools.wdiff",
+        description="diff two benchmark summary snapshots and flag "
+                    "regressions")
+    parser.add_argument("baseline", help="known-good summary snapshot")
+    parser.add_argument("candidate", help="summary snapshot under test")
+    parser.add_argument("--threshold", action="append", default=[],
+                        metavar="SECTION=FRACTION",
+                        help="override a section's regression threshold "
+                             "(e.g. slo=0.2 allows 20%% drift); "
+                             "repeatable")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the full report as JSON instead of "
+                             "the text rendering")
+    parser.add_argument("--out", default=None,
+                        help="also write the report to this file")
+    args = parser.parse_args(argv)
+
+    try:
+        baseline = load_summary(args.baseline)
+        candidate = load_summary(args.candidate)
+    except (OSError, ValueError) as e:
+        print(f"wdiff: {e}", file=sys.stderr)
+        return 2
+
+    report = diff_summaries(baseline, candidate,
+                            thresholds=_parse_thresholds(args.threshold))
+    if args.as_json:
+        rendered = json.dumps(report, indent=2, sort_keys=True) + "\n"
+    else:
+        rendered = format_report(report, args.baseline, args.candidate)
+    sys.stdout.write(rendered)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(rendered)
+    return 1 if report["regressed_sections"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -632,3 +632,92 @@ def test_demo_server_has_debug_routes():
         _run(demo_server.build_app(), scenario)
     finally:
         get_flight_recorder().reset_for_testing()
+
+
+def test_trace_pagination_offset():
+    """/debug/trace pages its ring with ?limit= and ?offset= (newest
+    first, offset skips from the newest end) on both servers."""
+    recorder = get_flight_recorder()
+    recorder.reset_for_testing()
+    for i in range(5):
+        recorder.record(f"page-{i}", "arrived")
+        recorder.record(f"page-{i}", "finished")
+    try:
+        async def scenario(client):
+            resp = await client.get("/debug/trace",
+                                    params={"limit": "2"})
+            data = await resp.json()
+            assert [x["request_id"] for x in data["recent_finished"]] == [
+                "page-4", "page-3"]
+
+            resp = await client.get("/debug/trace",
+                                    params={"limit": "2", "offset": "2"})
+            data = await resp.json()
+            assert [x["request_id"] for x in data["recent_finished"]] == [
+                "page-2", "page-1"]
+
+            resp = await client.get("/debug/trace",
+                                    params={"offset": "99"})
+            data = await resp.json()
+            assert data["recent_finished"] == []
+
+            resp = await client.get("/debug/trace",
+                                    params={"offset": "-1"})
+            assert resp.status == 400
+            resp = await client.get("/debug/trace",
+                                    params={"offset": "bogus"})
+            assert resp.status == 400
+
+        _run(demo_server.build_app(), scenario)
+        _run(openai_server.build_app(), scenario)
+    finally:
+        recorder.reset_for_testing()
+
+
+def test_workload_endpoint_on_both_servers():
+    """/debug/workload serves the capture ring (paged JSON, newest
+    first) and the full stream as an IWL1 document via ?format=iwl on
+    both servers."""
+    from intellillm_tpu.obs.workload import (get_workload_log, parse_iwl,
+                                             reset_workload_log_for_testing)
+
+    reset_workload_log_for_testing()
+    log = get_workload_log()
+    for i in range(3):
+        log.record(trace_id=f"wl-{i}", arrival_ts=100.0 + i,
+                   prompt_len=4, prompt_hash=f"{i:016x}",
+                   sampling={"max_tokens": 8}, emitted_tokens=8,
+                   reason="finished")
+    try:
+        async def scenario(client):
+            resp = await client.get("/debug/workload")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["enabled"] is True
+            assert data["count"] == 3
+            assert data["raw_prompts"] is False
+            assert [r["id"] for r in data["records"]] == [
+                "wl-2", "wl-1", "wl-0"]
+
+            resp = await client.get("/debug/workload",
+                                    params={"limit": "1", "offset": "1"})
+            data = await resp.json()
+            assert [r["id"] for r in data["records"]] == ["wl-1"]
+
+            resp = await client.get("/debug/workload",
+                                    params={"format": "iwl"})
+            assert resp.status == 200
+            header, recs = parse_iwl(await resp.text())
+            assert header["iwl"] == 1 and header["requests"] == 3
+            # IWL order is arrival order with rebased offsets.
+            assert [r["id"] for r in recs] == ["wl-0", "wl-1", "wl-2"]
+            assert [r["t"] for r in recs] == [0.0, 1.0, 2.0]
+
+            resp = await client.get("/debug/workload",
+                                    params={"limit": "bogus"})
+            assert resp.status == 400
+
+        _run(demo_server.build_app(), scenario)
+        _run(openai_server.build_app(), scenario)
+    finally:
+        reset_workload_log_for_testing()

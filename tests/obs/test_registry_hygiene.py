@@ -59,6 +59,10 @@ def test_constructors_are_found():
     # Scheduler decision-tracing families (PR 17).
     assert "intellillm_sched_deferred_seconds_total" in names
     assert "intellillm_sched_decisions_total" in names
+    # Workload-capture families (PR 18).
+    assert "intellillm_workload_requests_total" in names
+    assert "intellillm_workload_prompt_tokens_total" in names
+    assert "intellillm_workload_output_tokens_total" in names
 
 
 def test_every_metric_name_is_prefixed():

@@ -1,0 +1,181 @@
+"""Summary diffing (obs/diff.py + tools/wdiff.py): section extraction,
+direction inference, thresholds, the injected-regression acceptance
+case (tenant caps off => tenancy section flagged), and the CLI's exit
+codes."""
+import json
+import subprocess
+import sys
+
+import pytest
+
+from intellillm_tpu.obs.diff import (DEFAULT_THRESHOLDS, diff_summaries,
+                                     flatten, format_report, load_summary,
+                                     metric_direction)
+
+
+def _summary(**over):
+    base = {
+        "results": [{"request_throughput_rps": 10.0,
+                     "output_tok_s": 1200.0,
+                     "latency_percentiles_s": {"p50": 0.5, "p99": 1.0},
+                     "ttft_percentiles_ms": {"p50": 40.0, "p99": 90.0}}],
+        "slo": {"goodput_ratio": 0.98,
+                "ttft_ms": {"p99": 80.0}, "tpot_ms": {"p99": 30.0}},
+        "contention": {"deferred_seconds_by_cause": {"kv_pressure": 2.0}},
+        "efficiency": {"mfu": 0.42, "pad_fraction": 0.2},
+        "kernels": {"programs": {"mixed": {"compile_seconds_total": 3.0}}},
+        "isolation": {"contention_vs_solo_tpot_p99_ratio": 1.3},
+    }
+    base.update(over)
+    return base
+
+
+def test_metric_direction_inference():
+    assert metric_direction("request_throughput_rps") == "higher"
+    assert metric_direction("goodput_ratio") == "higher"
+    assert metric_direction("ttft_ms.p99") == "lower"
+    assert metric_direction("deferred_seconds_by_cause.kv") == "lower"
+    assert metric_direction("window") is None  # unknown => informational
+    # Structural identifiers stay neutral even when a scored fragment
+    # ("waste") appears higher up the dotted path.
+    assert metric_direction("top_waste[2].batch_bucket") is None
+    assert metric_direction("top_waste[2].pad_flops") == "lower"
+    # fill_ratio is a utilization: higher is better despite "ratio" —
+    # but "prefill" latencies must not catch the same fragment.
+    assert metric_direction("fill_ratio_avg.decode.batch") == "higher"
+    assert metric_direction("hops_ms.prefill.p50") == "lower"
+
+
+def test_structural_fields_never_regress():
+    """Bucket identities under `top_waste` shift between runs as the
+    ranking reorders; they must not be scored as metrics. `slowest`
+    carries per-request samples and is excluded from the slo view."""
+    a = _summary(
+        efficiency={"mfu": 0.42,
+                    "top_waste": [{"batch_bucket": 1, "pad_flops": 5.0}]},
+        slo={"goodput_ratio": 0.98, "ttft_ms": {"p99": 80.0},
+             "slowest": [{"request_id": "r1", "e2e_ms": 100.0}]})
+    b = _summary(
+        efficiency={"mfu": 0.42,
+                    "top_waste": [{"batch_bucket": 7, "pad_flops": 5.0}]},
+        slo={"goodput_ratio": 0.98, "ttft_ms": {"p99": 80.0},
+             "slowest": [{"request_id": "r9", "e2e_ms": 900.0}]})
+    report = diff_summaries(a, b)
+    assert report["regressed_sections"] == []
+    assert report["verdict"].startswith("PASS")
+
+
+def test_flatten_numeric_leaves_only():
+    flat = flatten({"a": {"b": 1, "ok": True}, "c": [2.5, {"d": 3}],
+                    "s": "text"})
+    assert flat == {"a.b": 1.0, "c[0]": 2.5, "c[1].d": 3.0}
+
+
+def test_identical_summaries_pass():
+    report = diff_summaries(_summary(), _summary())
+    assert report["regressed_sections"] == []
+    assert report["verdict"].startswith("PASS")
+    assert set(report["sections"]) <= set(DEFAULT_THRESHOLDS)
+
+
+def test_injected_tenant_caps_off_regression_is_flagged():
+    """The acceptance case: re-running with tenant caps disabled blows
+    up the victim-isolation ratio (and leaks into SLO tail latency);
+    wdiff must name the right sections and a REGRESSION verdict."""
+    degraded = _summary(
+        isolation={"contention_vs_solo_tpot_p99_ratio": 6.0},
+        slo={"goodput_ratio": 0.6, "ttft_ms": {"p99": 80.0},
+             "tpot_ms": {"p99": 240.0}})
+    report = diff_summaries(_summary(), degraded)
+    assert set(report["regressed_sections"]) == {"tenancy", "slo"}
+    assert report["verdict"].startswith("REGRESSION")
+    assert "tenancy" in report["verdict"]
+    rows = report["sections"]["tenancy"]["regressions"]
+    assert rows[0]["metric"].endswith("tpot_p99_ratio")
+    text = format_report(report)
+    assert "REGRESSED" in text and "tpot_p99_ratio" in text
+
+
+def test_improvements_and_thresholds():
+    better = _summary()
+    better["results"][0]["output_tok_s"] = 2400.0  # +100%
+    report = diff_summaries(_summary(), better)
+    assert report["regressed_sections"] == []
+    assert any(r["metric"].endswith("output_tok_s") for r in
+               report["sections"]["throughput"]["improvements"])
+    # A 5% throughput dip passes at the default 10% threshold but fails
+    # when the caller tightens it.
+    worse = _summary()
+    worse["results"][0]["output_tok_s"] = 1140.0
+    assert diff_summaries(_summary(), worse)["regressed_sections"] == []
+    tight = diff_summaries(_summary(), worse,
+                           thresholds={"throughput": 0.02})
+    assert tight["regressed_sections"] == ["throughput"]
+
+
+def test_near_zero_bases_are_not_noise():
+    a = _summary(contention={"deferred_seconds_by_cause":
+                             {"kv_pressure": 1e-9}})
+    b = _summary(contention={"deferred_seconds_by_cause":
+                             {"kv_pressure": 5e-9}})  # "5x" of nothing
+    assert "contention" not in diff_summaries(a, b)["regressed_sections"]
+
+
+def test_missing_sections_degrade_gracefully():
+    report = diff_summaries({"results": _summary()["results"]},
+                            {"slo": _summary()["slo"]})
+    assert report["sections"] == {}
+    assert report["verdict"].startswith("NO-DATA")
+
+
+def test_load_summary_accepts_json_wrappers_and_stdout(tmp_path):
+    plain = tmp_path / "plain.json"
+    plain.write_text(json.dumps(_summary()))
+    assert load_summary(str(plain))["slo"]["goodput_ratio"] == 0.98
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(json.dumps({"serve_bench_summary": _summary()}))
+    assert load_summary(str(wrapped))["efficiency"]["mfu"] == 0.42
+    stdout = tmp_path / "run.log"
+    stdout.write_text("booting...\n" + json.dumps({"x": 1}) + "\n"
+                      + json.dumps({"serve_bench_summary": _summary()})
+                      + "\n")
+    assert load_summary(str(stdout))["efficiency"]["mfu"] == 0.42
+    bad = tmp_path / "bad.log"
+    bad.write_text("no json here\n")
+    with pytest.raises(ValueError):
+        load_summary(str(bad))
+
+
+def _wdiff(args):
+    return subprocess.run(
+        [sys.executable, "-m", "intellillm_tpu.tools.wdiff"] + args,
+        capture_output=True, text=True, timeout=120)
+
+
+def test_wdiff_cli_exit_codes(tmp_path):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"serve_bench_summary": _summary()}))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"serve_bench_summary": _summary(
+        slo={"goodput_ratio": 0.4, "ttft_ms": {"p99": 500.0},
+             "tpot_ms": {"p99": 30.0}})}))
+    report_path = tmp_path / "report.txt"
+
+    same = _wdiff([str(good), str(good)])
+    assert same.returncode == 0, same.stdout + same.stderr
+    assert "PASS" in same.stdout
+
+    diff = _wdiff([str(good), str(bad), "--out", str(report_path)])
+    assert diff.returncode == 1
+    assert "REGRESSION" in diff.stdout and "slo" in diff.stdout
+    assert "REGRESSION" in report_path.read_text()
+
+    # --threshold loosens the gate back to passing
+    loose = _wdiff([str(good), str(bad), "--threshold", "slo=9.9"])
+    assert loose.returncode == 0, loose.stdout
+
+    as_json = _wdiff([str(good), str(bad), "--json"])
+    assert json.loads(as_json.stdout)["regressed_sections"] == ["slo"]
+
+    missing = _wdiff([str(good), str(tmp_path / "nope.json")])
+    assert missing.returncode == 2

@@ -1,0 +1,167 @@
+"""Workload capture (obs/workload.py): the bounded ring, the IWL1
+round trip, attempt dedup across failover/disagg legs, the rotating
+durable sink, and the raw-prompt privacy gate."""
+import json
+import os
+
+import pytest
+
+from intellillm_tpu.obs.workload import (WorkloadLog, base_trace_id,
+                                         dump_iwl, get_workload_log,
+                                         iwl_header, merge_workloads,
+                                         parse_iwl, prompt_fingerprint,
+                                         reset_workload_log_for_testing)
+
+
+def _record(log, i, ts=None, trace_id=None, reason="finished",
+            tokens=8, prompt=None):
+    log.record(trace_id=trace_id or f"req-{i}", arrival_ts=ts or 100.0 + i,
+               prompt_len=4 + i, prompt_hash=f"{i:016x}",
+               sampling={"max_tokens": tokens, "temperature": 0.0},
+               emitted_tokens=tokens, reason=reason, prompt=prompt)
+
+
+def test_ring_is_bounded_and_ordered():
+    log = WorkloadLog(enabled=True, export=False, max_entries=4)
+    # Seal order is finish order, not arrival order: record backwards.
+    for i in reversed(range(6)):
+        _record(log, i)
+    recs = log.records()
+    assert len(recs) == 4  # two oldest arrivals evicted is NOT promised —
+    # the ring drops the two earliest *seals* (arrivals 5 and 4 stay)
+    assert [r["id"] for r in recs] == ["req-0", "req-1", "req-2", "req-3"]
+    snap = log.snapshot(limit=2, offset=1)
+    assert snap["count"] == 6
+    assert snap["evicted"] == 2
+    assert [r["id"] for r in snap["records"]] == ["req-2", "req-1"]
+
+
+def test_disabled_log_records_nothing():
+    log = WorkloadLog(enabled=False, export=False)
+    _record(log, 0)
+    assert log.records() == []
+    assert log.snapshot()["count"] == 0
+
+
+def test_raw_prompts_gated_off_by_default():
+    hashed = WorkloadLog(enabled=True, raw=False, export=False)
+    _record(hashed, 0, prompt="the secret prompt")
+    assert "prompt" not in hashed.records()[0]
+    raw = WorkloadLog(enabled=True, raw=True, export=False)
+    _record(raw, 0, prompt="the secret prompt")
+    assert raw.records()[0]["prompt"] == "the secret prompt"
+
+
+def test_prompt_fingerprint_stable_and_short():
+    a = prompt_fingerprint("hello world", [1, 2, 3])
+    assert a == prompt_fingerprint("hello world", [9, 9])  # text wins
+    assert len(a) == 16 and int(a, 16) >= 0
+    # Token-id fallback when the engine path has no prompt text.
+    b = prompt_fingerprint(None, [1, 2, 3])
+    assert b == prompt_fingerprint(None, [1, 2, 3])
+    assert b != prompt_fingerprint(None, [1, 2, 4])
+
+
+def test_iwl_round_trip_rebases_offsets():
+    log = WorkloadLog(enabled=True, export=False)
+    _record(log, 1, ts=50.5)
+    _record(log, 0, ts=50.0)
+    text = log.iwl_text(source="test")
+    header, recs = parse_iwl(text)
+    assert header["iwl"] == 1
+    assert header["source"] == "test"
+    assert header["requests"] == 2
+    assert [r["id"] for r in recs] == ["req-0", "req-1"]
+    assert [r["t"] for r in recs] == [0.0, 0.5]
+    # Round trip: dump(parse(text)) carries the same records.
+    _, again = parse_iwl(dump_iwl(recs, source="test"))
+    assert [(r["id"], r["t"]) for r in again] == \
+        [(r["id"], r["t"]) for r in recs]
+
+
+def test_parse_iwl_rejects_bad_headers():
+    with pytest.raises(ValueError):
+        parse_iwl("")
+    with pytest.raises(ValueError):
+        parse_iwl(json.dumps({"not": "a header"}) + "\n")
+    with pytest.raises(ValueError):
+        parse_iwl(json.dumps({"iwl": 99}) + "\n")
+    header, recs = parse_iwl(json.dumps(iwl_header(source="x")) + "\n")
+    assert recs == []
+
+
+def test_merge_dedups_attempts_prefers_finished():
+    assert base_trace_id("abc#f1") == "abc"
+    assert base_trace_id("abc#p0") == "abc"
+    assert base_trace_id("abc") == "abc"
+    a = WorkloadLog(enabled=True, export=False)
+    b = WorkloadLog(enabled=True, export=False)
+    # Same request seen on two replicas: the rerouted attempt on A, the
+    # finished retry (#f1 suffix) on B. Merge keeps one record and
+    # prefers the finished outcome.
+    _record(a, 0, ts=10.0, trace_id="req-x", reason="rerouted", tokens=0)
+    _record(b, 0, ts=10.2, trace_id="req-x#f1", reason="finished",
+            tokens=8)
+    _record(b, 1, ts=11.0, trace_id="req-y", reason="finished")
+    merged, deduped = merge_workloads([a.records(), b.records()])
+    assert deduped == 1
+    assert [r["id"] for r in merged] == ["req-x#f1", "req-y"]
+    assert merged[0]["outcome"]["reason"] == "finished"
+
+
+def test_export_sink_writes_headers_and_rotates(tmp_path):
+    log = WorkloadLog(enabled=True, export=True, raw=False,
+                      workload_dir=str(tmp_path), max_bytes=400,
+                      max_files=3, hop="unit")
+    for i in range(12):
+        _record(log, i)
+    files = log.files()
+    assert log.path in files and len(files) > 1  # rotation happened
+    for name in files:
+        lines = open(name).read().splitlines()
+        hdr = json.loads(lines[0])
+        # every sink file is self-describing IWL1
+        assert hdr["iwl"] == 1 and hdr["source"] == "unit"
+    # no file beyond max_files - 1 rotations
+    assert not os.path.exists(f"{log.path}.3")
+
+
+def test_record_seq_group_duck_typed_and_never_raises():
+    class Params:
+        max_tokens, temperature, top_p = 16, 0.0, 1.0
+
+    class Group:
+        request_id = "sg-1"
+        prompt = "hi there"
+        prompt_token_ids = [1, 2, 3]
+        sampling_params = Params()
+        lora_int_id = 0
+
+        def __init__(self):
+            import time
+            self.arrival_time = time.monotonic() - 0.25
+
+    log = WorkloadLog(enabled=True, export=False)
+    log.record_seq_group(Group(), emitted_tokens=16, reason="finished")
+    (rec,) = log.records()
+    assert rec["id"] == "sg-1"
+    assert rec["prompt_len"] == 3
+    assert rec["sampling"]["max_tokens"] == 16
+    assert rec["outcome"] == {"tokens": 16, "reason": "finished"}
+    # A hostile seq_group must not raise into the engine finish path.
+    log.record_seq_group(object(), emitted_tokens=1, reason="finished")
+    assert len(log.records()) == 1
+
+
+def test_singleton_reset(monkeypatch, tmp_path):
+    monkeypatch.setenv("INTELLILLM_WORKLOAD_DIR", str(tmp_path))
+    reset_workload_log_for_testing()
+    try:
+        log = get_workload_log()
+        assert log is get_workload_log()
+        _record(log, 0)
+        assert log.snapshot()["count"] == 1
+        reset_workload_log_for_testing()
+        assert get_workload_log().snapshot()["count"] == 0
+    finally:
+        reset_workload_log_for_testing()

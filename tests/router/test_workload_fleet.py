@@ -1,0 +1,113 @@
+"""Fleet workload merge: the router's /debug/workload unions every
+replica's captured stream, dedups failover/disagg attempt legs by base
+trace id, and serves the result as JSON or one merged IWL1 document —
+no engines, no real HTTP polling."""
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from intellillm_tpu.obs.workload import get_workload_log, parse_iwl
+from intellillm_tpu.obs.workload import reset_workload_log_for_testing
+from intellillm_tpu.router.policy import RouterConfig
+from intellillm_tpu.router.replica import (InProcessReplica, Replica,
+                                           ReplicaManager)
+from intellillm_tpu.router.server import Router, build_router_app
+
+
+def _rec(trace_id, ts, reason="finished", tokens=8):
+    return {"ts": ts, "id": trace_id, "prompt_len": 4,
+            "prompt_hash": "00" * 8,
+            "sampling": {"max_tokens": tokens}, "tenant": None,
+            "adapter": 0, "priority": 0,
+            "outcome": {"tokens": tokens, "reason": reason}}
+
+
+class _FakeReplica(Replica):
+    """A replica whose workload shard is injected by the test."""
+
+    def __init__(self, name, shard):
+        super().__init__(name)
+        self._shard = shard
+
+    async def fetch_workload(self, limit=1024):
+        return self._shard[-limit:]
+
+
+def _router(replicas):
+    mgr = ReplicaManager()
+    for r in replicas:
+        mgr.add(r, healthy=True)
+    return Router(RouterConfig(), mgr)
+
+
+def _run(app, scenario):
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await scenario(client)
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+def test_fleet_workload_merges_and_dedups_attempts():
+    # req-a failed over: the rerouted attempt sealed on r0, the retry
+    # (#f1) finished on r1. The merged stream must carry ONE req-a with
+    # the finished outcome, in arrival order with r1's own request.
+    r0 = _FakeReplica("r0", [_rec("req-a", 10.0, reason="rerouted",
+                                  tokens=0)])
+    r1 = _FakeReplica("r1", [_rec("req-a#f1", 10.5),
+                             _rec("req-b", 11.0)])
+    dead = Replica("r2")  # base class: unreachable, fetch -> None
+    router = _router([r0, r1, dead])
+    body = asyncio.run(router.fleet_workload())
+    assert body["fleet_merged"] is True
+    assert body["attempts_deduped"] == 1
+    assert body["count"] == 2
+    assert [r["id"] for r in body["records"]] == ["req-a#f1", "req-b"]
+    assert body["records"][0]["outcome"]["reason"] == "finished"
+    assert body["replicas"] == {"r0": 1, "r1": 2, "r2": None}
+
+
+def test_router_debug_workload_route_json_and_iwl():
+    r0 = _FakeReplica("r0", [_rec("req-1", 5.0), _rec("req-2", 6.0)])
+    router = _router([r0])
+
+    async def scenario(client):
+        resp = await client.get("/debug/workload")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["fleet_merged"] is True
+        assert [r["id"] for r in body["records"]] == ["req-1", "req-2"]
+
+        resp = await client.get("/debug/workload", params={"limit": "1"})
+        body = await resp.json()
+        assert [r["id"] for r in body["records"]] == ["req-2"]
+
+        resp = await client.get("/debug/workload",
+                                params={"format": "iwl"})
+        assert resp.status == 200
+        header, recs = parse_iwl(await resp.text())
+        assert header["iwl"] == 1 and header["source"] == "fleet"
+        assert [r["t"] for r in recs] == [0.0, 1.0]
+
+        resp = await client.get("/debug/workload",
+                                params={"limit": "bogus"})
+        assert resp.status == 400
+
+    _run(build_router_app(router), scenario)
+
+
+def test_in_process_replica_serves_the_shared_log():
+    reset_workload_log_for_testing()
+    try:
+        log = get_workload_log()
+        log.record(trace_id="local-1", arrival_ts=1.0, prompt_len=3,
+                   prompt_hash="ab" * 8, sampling={"max_tokens": 4},
+                   emitted_tokens=4, reason="finished")
+        replica = InProcessReplica("local", engine=None)
+        shard = asyncio.run(replica.fetch_workload())
+        assert [r["id"] for r in shard] == ["local-1"]
+    finally:
+        reset_workload_log_for_testing()

@@ -211,3 +211,95 @@ def test_serve_bench_multi_tenant_smoke():
     # Per-tenant stats block made it into the snapshot.
     stats = (summary["tenants_caps_on"] or {}).get("stats") or {}
     assert any(t.startswith("tenant-") for t in stats)
+
+
+def test_serve_bench_replay_args_parse():
+    """The replay/diurnal CLI surface stays wired (cheap guard; the
+    full capture->replay roundtrip lives in the slow smoke below)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from benchmarks.serve_bench import make_arg_parser
+    args = make_arg_parser().parse_args(
+        ["--scenario", "replay", "--workload", "/tmp/w.iwl.jsonl",
+         "--speed", "2.0", "--replay-repeat", "2",
+         "--summary-out", "/tmp/s.json"])
+    assert args.scenario == "replay"
+    assert args.workload == "/tmp/w.iwl.jsonl"
+    assert args.speed == 2.0
+    assert args.replay_repeat == 2
+    args = make_arg_parser().parse_args(
+        ["--scenario", "diurnal", "--emit-only", "--seed", "7",
+         "--diurnal-duration", "5", "--diurnal-bursts", "3",
+         "--workload-out", "/tmp/d.iwl.jsonl"])
+    assert args.scenario == "diurnal"
+    assert args.emit_only and args.diurnal_bursts == 3
+
+
+def test_diurnal_synth_is_seed_deterministic():
+    """Same --seed => byte-identical synthesized workload (the property
+    the replay determinism check stands on), different seed => a
+    different stream. In-process: no tokenizer, no server."""
+    import argparse
+    import json as json_mod
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from benchmarks.serve_bench import synth_diurnal
+    from intellillm_tpu.obs.workload import dump_iwl, parse_iwl
+
+    def make_args(seed):
+        return argparse.Namespace(
+            seed=seed, num_prompts=32, diurnal_duration=10.0,
+            diurnal_bursts=2, num_tenants=4, input_len=64,
+            output_len=32, max_model_len=512)
+
+    a = synth_diurnal(make_args(11))
+    b = synth_diurnal(make_args(11))
+    assert json_mod.dumps(a) == json_mod.dumps(b)
+    c = synth_diurnal(make_args(12))
+    assert json_mod.dumps(a) != json_mod.dumps(c)
+    assert len(a) == 32
+    # Arrivals sorted, lengths inside the context window, adapters churn.
+    ts = [r["ts"] for r in a]
+    assert ts == sorted(ts) and ts[-1] <= 10.0
+    assert all(r["prompt_len"] + r["sampling"]["max_tokens"]
+               < 512 for r in a)
+    assert len({r["adapter"] for r in a}) > 1
+    # The emitted document round-trips as IWL1.
+    header, recs = parse_iwl(dump_iwl(a, source="diurnal"))
+    assert header["requests"] == 32
+    assert [r["id"] for r in recs] == [r["id"] for r in a]
+
+
+@pytest.mark.slow
+def test_serve_bench_replay_roundtrip_smoke():
+    """The acceptance path end to end on CPU: synthesize a diurnal
+    workload, replay it twice against one booted server, and require
+    bit-identical server-side re-captures (replay_deterministic), then
+    gate the summary through wdiff against itself (exit 0)."""
+    import json
+    import tempfile
+
+    out_dir = tempfile.mkdtemp(prefix="replay-smoke-")
+    summary_path = os.path.join(out_dir, "summary.json")
+    r = _run(["benchmarks/serve_bench.py", "--size", "tiny",
+              "--scenario", "diurnal", "--num-prompts", "6",
+              "--input-len", "8", "--output-len", "8",
+              "--diurnal-duration", "2", "--diurnal-bursts", "1",
+              "--max-model-len", "64", "--max-num-seqs", "4",
+              "--num-decode-steps", "4", "--num-device-blocks", "64",
+              "--replay-repeat", "2", "--seed", "5", "--port", "8735",
+              "--init-timeout", "240",
+              "--workload-out", os.path.join(out_dir, "d.iwl.jsonl"),
+              "--summary-out", summary_path])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    summary = json.load(open(summary_path))
+    assert summary["scenario"] == "replay"
+    assert summary["num_requests"] == 6
+    assert summary["replay_deterministic"] is True
+    assert len(set(summary["recapture_digests"])) == 1
+    assert all(m["completed"] == 6 for m in summary["results"])
+    assert all(m["recapture"]["count"] == 6 for m in summary["results"])
+    # wdiff gates on the snapshot: identical inputs must pass (exit 0).
+    w = _run(["-m", "intellillm_tpu.tools.wdiff", summary_path,
+              summary_path])
+    assert w.returncode == 0, w.stdout + w.stderr
+    assert "PASS" in w.stdout
