@@ -282,6 +282,30 @@ def snapshot_contention(base: str) -> dict:
     return distill_contention(snapshot_health_detail(base))
 
 
+def distill_numerics(detail: dict) -> dict:
+    """Compact the output-integrity block (the `numerics` block of
+    /health/detail, obs/numerics.py) for the summary: sentinel coverage
+    + anomaly/quarantine counts and the KV-audit checksum/mismatch
+    counters. wdiff diffs these with lower-is-better direction — a run
+    is only comparable to a baseline if neither corrupted outputs."""
+    block = (detail or {}).get("numerics")
+    if not block:
+        return {"error": (detail or {}).get(
+            "error", "no numerics block in /health/detail")}
+    return block
+
+
+def snapshot_numerics(base: str) -> dict:
+    """Scrape /debug/numerics. On a router this is the fleet view: the
+    divergence-canary ledger plus each replica's compact block."""
+    try:
+        with urllib.request.urlopen(base + "/debug/numerics",
+                                    timeout=5) as r:
+            return json.loads(r.read().decode(errors="replace"))
+    except Exception as e:
+        return {"error": f"numerics scrape failed: {e}"}
+
+
 def snapshot_fleet_traces(router_base: str, limit: int = 3) -> dict:
     """Sample stitched fleet traces from the router: recent trace ids
     from /debug/trace, each fetched via /debug/trace/{id} — the per-hop
@@ -599,6 +623,7 @@ def run_replay(args, model_dir, tokenizer, extra=None) -> dict:
         summary["efficiency"] = snapshot_efficiency(base)
         summary["kernels"] = snapshot_kernels(base)
         summary["contention"] = distill_contention(detail)
+        summary["numerics"] = distill_numerics(detail)
         summary["alerts"] = distill_alerts(snapshot_alerts(base))
     finally:
         proc.send_signal(signal.SIGKILL)
@@ -805,10 +830,20 @@ def run_fleet(args, model_dir: str, tokenizer) -> dict:
                 "queue_depths": detail.get("queue_depths"),
                 "kv_cache_usage": detail.get("kv_cache_usage"),
                 "contention": distill_contention(detail),
+                "numerics": distill_numerics(detail),
             }
         summary["per_replica_slo"] = per_replica
         summary["contention"] = {
             name: pr["contention"] for name, pr in per_replica.items()}
+        # Fleet output-integrity verdict: the router's canary ledger
+        # (suspect replicas, reference digest) + each replica's own
+        # sentinel/KV-audit counters.
+        fleet_numerics = snapshot_numerics(router_base)
+        summary["numerics"] = {
+            "canary": fleet_numerics.get("canary"),
+            "replicas": {name: pr["numerics"]
+                         for name, pr in per_replica.items()},
+        }
         print(json.dumps({"serve_bench_fleet": {
             "per_replica_slo": per_replica,
             "router": summary["router"],
@@ -882,6 +917,7 @@ def _run_role_fleet(args, model_dir, tokenizer, roles, label,
         router_detail = (detail.get("router") or {}) if detail else {}
         per_replica_kv = {}
         per_replica_contention = {}
+        per_replica_numerics = {}
         kv_bytes = {"export": 0, "import": 0}
         kv_seconds = {"export": 0.0, "import": 0.0}
         for name, base, proc, log_path in replicas:
@@ -889,6 +925,7 @@ def _run_role_fleet(args, model_dir, tokenizer, roles, label,
             kv = rd.get("kv_transfer")
             per_replica_kv[name] = kv
             per_replica_contention[name] = distill_contention(rd)
+            per_replica_numerics[name] = distill_numerics(rd)
             if kv:
                 for d in ("export", "import"):
                     kv_bytes[d] += (kv.get("bytes_total") or {}).get(d, 0)
@@ -907,6 +944,7 @@ def _run_role_fleet(args, model_dir, tokenizer, roles, label,
             "kv_seconds": {d: round(s, 6) for d, s in kv_seconds.items()},
             "per_replica_kv": per_replica_kv,
             "contention": per_replica_contention,
+            "numerics": per_replica_numerics,
         }
     finally:
         if router_proc is not None:
@@ -955,6 +993,8 @@ def run_disagg(args, model_dir, tokenizer) -> dict:
                "fleets": {"disagg": disagg, "mixed": mixed},
                "contention": {"disagg": disagg.get("contention"),
                               "mixed": mixed.get("contention")},
+               "numerics": {"disagg": disagg.get("numerics"),
+                            "mixed": mixed.get("numerics")},
                "comparison": comparison}
     print(json.dumps({"serve_bench_disagg": comparison}), flush=True)
     print(json.dumps({"serve_bench_summary": summary}), flush=True)
@@ -1157,6 +1197,7 @@ def run_multi_tenant(args, model_dir, tokenizer) -> dict:
         detail = snapshot_health_detail(base)
         summary["tenants_caps_on"] = detail.get("tenants")
         contention = {"caps_on": distill_contention(detail)}
+        summary["numerics"] = distill_numerics(detail)
         summary["alerts_caps_on"] = distill_alerts(snapshot_alerts(base))
     finally:
         proc.send_signal(signal.SIGKILL)
@@ -1464,6 +1505,7 @@ def run_single(args, model_dir, tokenizer, scheduling_policy=None) -> dict:
         summary["efficiency"] = snapshot_efficiency(base)
         summary["kernels"] = snapshot_kernels(base)
         summary["contention"] = distill_contention(detail)
+        summary["numerics"] = distill_numerics(detail)
         summary["alerts"] = distill_alerts(snapshot_alerts(base))
     finally:
         proc.send_signal(signal.SIGKILL)

@@ -84,6 +84,11 @@ class EngineArgs:
     # FLOPs for the MFU gauge. None -> INTELLILLM_PEAK_FLOPS env / the
     # built-in per-chip table (NaN MFU when the chip is unknown).
     peak_flops: Optional[float] = None
+    # Numerics sentinels (obs/numerics.py): per-step in-graph logit
+    # statistics + anomaly quarantine. Opt-in — the enabled dispatch
+    # carries an extra device output, so it is a distinct executable
+    # family (warmed at boot). False also honours INTELLILLM_NUMERICS.
+    enable_numerics: bool = False
 
     def __post_init__(self) -> None:
         if self.tokenizer is None:
@@ -216,6 +221,12 @@ class EngineArgs:
                             "denominator, e.g. 918e12 for v6e (default: "
                             "INTELLILLM_PEAK_FLOPS or a built-in "
                             "per-chip table; unknown chips report NaN)")
+        parser.add_argument("--enable-numerics", action="store_true",
+                            help="turn on the in-graph numerics "
+                            "sentinels: per-step logit NaN/Inf/max-abs "
+                            "statistics with anomaly quarantine "
+                            "(equivalent to INTELLILLM_NUMERICS=1; see "
+                            "docs/observability.md)")
         parser.add_argument("--speculative-model", type=str, default=None)
         parser.add_argument("--num-speculative-tokens", type=int,
                             default=5)
@@ -249,6 +260,11 @@ class EngineArgs:
         if self.peak_flops is not None:
             from intellillm_tpu.obs import get_efficiency_tracker
             get_efficiency_tracker().configure(peak_flops=self.peak_flops)
+        if self.enable_numerics:
+            # env-only enablement (INTELLILLM_NUMERICS) already landed
+            # at tracker construction; the flag only ever turns it ON.
+            from intellillm_tpu.obs import get_numerics_tracker
+            get_numerics_tracker().configure(enabled=True)
         model_config = ModelConfig(
             model=self.model,
             tokenizer=self.tokenizer,

@@ -28,8 +28,9 @@ from intellillm_tpu.obs import (get_alert_manager, get_boot_timeline,
                                 get_device_telemetry,
                                 get_efficiency_tracker,
                                 get_flight_recorder, get_metrics_history,
-                                get_slo_tracker, get_step_tracer,
-                                get_watchdog, request_context)
+                                get_numerics_tracker, get_slo_tracker,
+                                get_step_tracer, get_watchdog,
+                                request_context)
 from intellillm_tpu.outputs import RequestOutput
 from intellillm_tpu.prediction import get_prediction_service
 from intellillm_tpu.sampling_params import SamplingParams
@@ -174,6 +175,7 @@ class LLMEngine:
         # log_stats off.
         self._tracer = get_step_tracer()
         self._flight = get_flight_recorder()
+        self._numerics = get_numerics_tracker()
         # Serializes KV export/import against device stepping: the async
         # engine runs step() on an executor thread while /kv/* handlers
         # call export_kv/import_kv from the event loop (also via executor)
@@ -985,6 +987,26 @@ class LLMEngine:
             return pending + request_outputs
         return request_outputs
 
+    def _quarantine_seq_group(self, seq_group: SequenceGroup,
+                              info: Dict) -> None:
+        """Numerics quarantine (obs/numerics.py): the sentinel tripped
+        on this request's logit row, so its sampled token is garbage —
+        never append or stream it. Every live sequence finishes
+        FINISHED_ABORTED, closing the request with a structured error
+        (finish_reason "abort"); the `numerics_anomaly` flight event
+        lands ahead of the terminal record so the sealed trace explains
+        WHY the request aborted."""
+        detail = ",".join(info.get("kinds", ())) or "anomaly"
+        self._flight.record(seq_group.request_id, "numerics_anomaly",
+                            detail=detail)
+        logger.error("Quarantining request %s: numerics anomaly (%s)",
+                     seq_group.request_id, detail)
+        for seq in seq_group.get_seqs():
+            if seq.is_finished():
+                continue
+            seq.status = SequenceStatus.FINISHED_ABORTED
+            self.scheduler.free_seq(seq)
+
     def _process_model_outputs(
         self,
         outputs_per_substep: List[SamplerOutput],
@@ -996,6 +1018,15 @@ class LLMEngine:
         for idx, seq_group in enumerate(scheduled_seq_groups):
             if seq_group.is_finished():
                 continue  # finished at an earlier (possibly pipelined) step
+            if self._numerics.enabled:
+                info = self._numerics.take_quarantine(seq_group.request_id)
+                if info is not None:
+                    # Sentinel tripped on this request's logit row
+                    # (observed at the step fetch, before any token from
+                    # that row reaches here): quarantine — finish with a
+                    # structured abort, never stream the poisoned token.
+                    self._quarantine_seq_group(seq_group, info)
+                    continue
             sp = seq_group.sampling_params
             running = seq_group.get_seqs(status=SequenceStatus.RUNNING)
             if (len(running) == 1 and not sp.use_beam_search

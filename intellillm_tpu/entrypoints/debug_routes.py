@@ -52,6 +52,12 @@
                                         ratio, lifetime token totals
                                         (404 when the engine runs
                                         without a draft model)
+    GET  /debug/numerics                numerics & output-integrity
+                                        snapshot: sentinel stats
+                                        (rows checked, anomalies by
+                                        kind, recent trips, quarantine
+                                        set) + KV-integrity audit
+                                        counters (obs/numerics.py)
     GET  /debug/kernels                 per-(program, bucket) kernel
                                         cost ledger: cost_analysis
                                         FLOPs / bytes / peak HBM per
@@ -152,6 +158,16 @@ async def debug_history(request: web.Request) -> web.Response:
 
 async def debug_alerts(request: web.Request) -> web.Response:
     return web.json_response(get_alert_manager().snapshot())
+
+
+async def debug_numerics(request: web.Request) -> web.Response:
+    """Numerics sentinels + KV-integrity audit snapshot (module-level
+    like `metrics`: both singletons are process-global). Always
+    registered — with sentinels off the body still reports
+    enabled=false plus the KV-audit counters, so dashboards can
+    distinguish 'numerics off' from 'numerics on and clean'."""
+    from intellillm_tpu.obs import numerics_debug_snapshot
+    return web.json_response(numerics_debug_snapshot())
 
 
 async def debug_predictor(request: web.Request) -> web.Response:
@@ -325,6 +341,12 @@ def add_debug_routes(app: web.Application,
             # from here to correct its own predicted lengths.
             "predictor": get_prediction_service().health_block(),
         }
+        # Output-integrity surface (obs/numerics.py): sentinel +
+        # KV-audit counters. The router's canary verdict rides the
+        # fleet view, not this per-replica block (full snapshot at
+        # /debug/numerics).
+        from intellillm_tpu.obs import numerics_health_block
+        body["numerics"] = numerics_health_block()
         # Spec-decode block only when a draft model is serving; fleet
         # aggregation treats a missing key as "spec off" (full table at
         # /debug/spec).
@@ -457,6 +479,7 @@ def add_debug_routes(app: web.Application,
     app.router.add_get("/debug/alerts", debug_alerts)
     app.router.add_get("/debug/predictor", debug_predictor)
     app.router.add_get("/debug/spec", debug_spec)
+    app.router.add_get("/debug/numerics", debug_numerics)
     app.router.add_get("/debug/kernels", debug_kernels)
     app.router.add_get("/health/detail", health_detail)
     if enable_profiling:

@@ -2,9 +2,10 @@
 the per-request flight recorder, request SLO telemetry, the engine
 stall watchdog, device/HBM telemetry, the compute-efficiency ledger,
 the per-kernel cost ledger, the in-process metrics history, the alert
-rule engine, the bounded workload log (capture & replay), and the
-benchmark summary differ behind `tools.wdiff`. See
-docs/observability.md."""
+rule engine, the bounded workload log (capture & replay), the
+numerics/output-integrity layer (in-graph sentinels, KV integrity
+audit, fleet canary ledger), and the benchmark summary differ behind
+`tools.wdiff`. See docs/observability.md."""
 from intellillm_tpu.obs.alerts import (AlertManager, AlertRule,
                                        built_in_rules, get_alert_manager)
 from intellillm_tpu.obs.boot import BootTimeline, get_boot_timeline
@@ -26,6 +27,11 @@ from intellillm_tpu.obs.kernels import (KernelLedger, get_kernel_ledger,
                                         parse_trace_dir)
 from intellillm_tpu.obs.kv_transfer import (KVTransferStats,
                                             get_kv_transfer_stats)
+from intellillm_tpu.obs.numerics import (CanaryLedger, KVIntegrityAuditor,
+                                         NumericsTracker, get_canary_ledger,
+                                         get_kv_audit, get_numerics_tracker,
+                                         numerics_debug_snapshot,
+                                         numerics_health_block)
 from intellillm_tpu.obs.slo import (SLOTracker, derive_request_metrics,
                                     get_slo_tracker)
 from intellillm_tpu.obs.trace_export import (TraceSink, flush_black_box,
@@ -44,6 +50,7 @@ __all__ = [
     "AlertRule",
     "BootTimeline",
     "CAUSES",
+    "CanaryLedger",
     "CompileTracker",
     "DECISIONS",
     "DecisionLog",
@@ -52,9 +59,11 @@ __all__ = [
     "EfficiencyTracker",
     "EngineWatchdog",
     "FlightRecorder",
+    "KVIntegrityAuditor",
     "KVTransferStats",
     "KernelLedger",
     "MetricsHistory",
+    "NumericsTracker",
     "PHASES",
     "SLOTracker",
     "StepTracer",
@@ -70,14 +79,17 @@ __all__ = [
     "flush_black_box",
     "get_alert_manager",
     "get_boot_timeline",
+    "get_canary_ledger",
     "get_compile_tracker",
     "get_decision_log",
     "get_device_telemetry",
     "get_efficiency_tracker",
     "get_flight_recorder",
     "get_kernel_ledger",
+    "get_kv_audit",
     "get_kv_transfer_stats",
     "get_metrics_history",
+    "get_numerics_tracker",
     "get_slo_tracker",
     "get_step_tracer",
     "get_trace_sink",
@@ -85,6 +97,8 @@ __all__ = [
     "get_workload_log",
     "install_black_box_handlers",
     "merge_workloads",
+    "numerics_debug_snapshot",
+    "numerics_health_block",
     "parse_iwl",
     "parse_trace_dir",
     "record_kernel_dispatch",

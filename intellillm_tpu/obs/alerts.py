@@ -28,6 +28,20 @@ states, Google SRE Workbook style for the burn rate):
                            flight longer than `INTELLILLM_KV_STALL_S`
                            (wedged handoff; inactive until the first
                            transfer)
+    numerics_anomaly page  a numerics sentinel (obs/numerics.py)
+                           tripped on a logit row within the fast
+                           window — a request was quarantined instead
+                           of streaming garbage (inactive unless
+                           --enable-numerics / INTELLILLM_NUMERICS)
+    kv_integrity_mismatch page a sampled KV-block checksum failed to
+                           verify on the swap-in path (host-staged KV
+                           bytes changed between swap-out and swap-in)
+    spec_accept_collapse warn speculative-decode acceptance over the
+                           fast window fell below
+                           `INTELLILLM_SPEC_ACCEPT_MIN` (default 0.1)
+                           with a meaningful draft volume — the
+                           draft model stopped agreeing with the
+                           target (draft drift or numerics trouble)
 
 State machine per rule: inactive -> pending (condition held, waiting
 out `for_s`) -> firing -> resolved (condition cleared; kept visible for
@@ -381,10 +395,122 @@ class TenantNoisyNeighborRule(AlertRule):
             f"({signal['active_tenants']} active tenants)")
 
 
+class NumericsAnomalyRule(AlertRule):
+    """A numerics sentinel tripped within the fast window: some request
+    produced NaN/Inf/exploding logits and was quarantined
+    (obs/numerics.py). Reads the process-global tracker directly (like
+    WatchdogStallRule) — a single tripped row must page even if it never
+    becomes a history trend. Inactive (no data) when sentinels are off:
+    absence of evidence is not evidence of health."""
+
+    def __init__(self, window_s: Optional[float] = None) -> None:
+        self.window_s = (window_s if window_s is not None
+                         else _env_f("INTELLILLM_BURN_FAST_S",
+                                     _DEFAULT_BURN_FAST_S))
+        super().__init__(
+            "numerics_anomaly", severity="page",
+            description="a numerics sentinel tripped (NaN/Inf/max-abs "
+            "logit anomaly; affected request quarantined)")
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        from intellillm_tpu.obs.numerics import get_numerics_tracker
+        tracker = get_numerics_tracker()
+        if not tracker.enabled:
+            return None, None, "numerics sentinels disabled"
+        age = tracker.last_anomaly_age_s()
+        block = tracker.health_block()
+        if age is None:
+            return False, 0.0, (
+                f"no anomalies ({block['rows_checked']} rows checked)")
+        return age <= self.window_s, round(age, 3), (
+            f"last anomaly {age:.1f}s ago; "
+            f"{block['anomalies']} total, "
+            f"{block['quarantined']} quarantined")
+
+
+class KVIntegrityMismatchRule(AlertRule):
+    """A sampled KV-block checksum recorded at swap-out failed to verify
+    at swap-in (obs/numerics.py KVIntegrityAuditor): the host-staged KV
+    bytes changed while parked in CPU memory. Silent KV corruption is
+    the worst observability failure mode — the model keeps emitting
+    confident garbage — so one confirmed mismatch pages."""
+
+    def __init__(self, window_s: Optional[float] = None) -> None:
+        self.window_s = (window_s if window_s is not None
+                         else _env_f("INTELLILLM_BURN_FAST_S",
+                                     _DEFAULT_BURN_FAST_S))
+        super().__init__(
+            "kv_integrity_mismatch", severity="page",
+            description="a sampled KV-block checksum failed to verify "
+            "on swap-in (host-staged KV bytes corrupted)")
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        from intellillm_tpu.obs.numerics import get_kv_audit
+        audit = get_kv_audit()
+        if not audit.enabled:
+            return None, None, "KV integrity audit disabled"
+        age = audit.last_mismatch_age_s()
+        block = audit.health_block()
+        if age is None:
+            return False, 0.0, (
+                f"no mismatches ({block['checksums']} checksums, "
+                f"sample {block['sample']:g})")
+        return age <= self.window_s, round(age, 3), (
+            f"last mismatch {age:.1f}s ago; "
+            f"{block['mismatches']} total")
+
+
+class SpecAcceptCollapseRule(AlertRule):
+    """Speculative decoding acceptance collapsed: over the fast window
+    the target accepted fewer than `INTELLILLM_SPEC_ACCEPT_MIN`
+    (default 0.1) of drafted tokens, across a meaningful draft volume.
+    Pure waste signal (every rejected draft is burnt verify compute) and
+    a numerics canary: a drifting or corrupted draft/target pair shows
+    up here before outputs look visibly wrong. Windowed over the
+    existing `intellillm_spec_*` history series; inactive when no
+    speculative decoding is running (series absent)."""
+
+    def __init__(self, window_s: Optional[float] = None,
+                 min_accept: Optional[float] = None,
+                 min_drafts: float = 64.0) -> None:
+        self.window_s = (window_s if window_s is not None
+                         else _env_f("INTELLILLM_BURN_FAST_S",
+                                     _DEFAULT_BURN_FAST_S))
+        self.min_accept = (min_accept if min_accept is not None
+                           else _env_f("INTELLILLM_SPEC_ACCEPT_MIN", 0.1))
+        self.min_drafts = min_drafts
+        super().__init__(
+            "spec_accept_collapse", severity="warn",
+            description="speculative-decode acceptance fell below "
+            f"{self.min_accept:g} over the fast window (draft model "
+            "no longer agrees with the target)")
+
+    def evaluate(self, history,
+                 now: float) -> Tuple[Optional[bool], Optional[float], str]:
+        drafted = history.delta("intellillm_spec_draft_tokens_total",
+                                self.window_s, now=now)
+        accepted = history.delta("intellillm_spec_accepted_tokens_total",
+                                 self.window_s, now=now)
+        if drafted is None or accepted is None:
+            return None, None, "no speculative-decode series"
+        if drafted < self.min_drafts:
+            return False, None, (
+                f"only {drafted:g} drafts in the last "
+                f"{self.window_s:g}s (need {self.min_drafts:g})")
+        rate = accepted / drafted
+        return rate < self.min_accept, round(rate, 4), (
+            f"acceptance {rate:.1%} over {drafted:g} drafts "
+            f"(threshold {self.min_accept:g})")
+
+
 def built_in_rules() -> List[AlertRule]:
     return [SLOBurnRateRule(), WatchdogStallRule(), HBMHeadroomRule(),
             MFUCollapseRule(), CompileStormRule(), RouterFailoverRule(),
-            KVTransferStallRule(), TenantNoisyNeighborRule()]
+            KVTransferStallRule(), TenantNoisyNeighborRule(),
+            NumericsAnomalyRule(), KVIntegrityMismatchRule(),
+            SpecAcceptCollapseRule()]
 
 
 class _RuleState:

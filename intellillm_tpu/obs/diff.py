@@ -16,6 +16,9 @@ Sections and what they cover:
 - ``efficiency``  the efficiency ledger (MFU, bandwidth util, ...).
 - ``kernels``     per-kernel cost attribution deltas.
 - ``tenancy``     multi-tenant isolation ratios and victim latency.
+- ``numerics``    output-integrity counters (sentinel anomalies,
+  quarantines, KV-checksum mismatches, canary suspects) — any rise is
+  a regression; digests themselves are identifiers, not magnitudes.
 
 Direction (is a bigger number better or worse?) is inferred from the
 metric name: throughput/attainment/hit-rate style names regress when
@@ -34,7 +37,7 @@ from typing import Dict, List, Optional, Tuple
 # the "waste" higher up the path.
 _NEUTRAL = (
     "bucket", "window", "repeat", "seed", "limit", "offset",
-    "request_id",
+    "request_id", "digest",
 )
 # Name fragments that identify a metric where HIGHER is better. Checked
 # before the lower-is-better list: "request_throughput_rps" must match
@@ -47,11 +50,15 @@ _HIGHER_BETTER = (
     "fill_ratio",
 )
 # Name fragments where LOWER is better (latencies, stalls, contention
-# cause-seconds, padding waste, isolation degradation ratios).
+# cause-seconds, padding waste, isolation degradation ratios, and the
+# output-integrity incident counters — note "mismatch" is spelled out
+# because "miss" is not a substring of it, and bare "nan" is absent on
+# purpose: "tenant" contains it).
 _LOWER_BETTER = (
     "latency", "ttft", "tpot", "_ms", "_s", "seconds", "stall", "wait",
     "waste", "evict", "miss", "ratio", "churn", "drop", "abort",
-    "preempt", "queue", "spill", "pressure", "pad_",
+    "preempt", "queue", "spill", "pressure", "pad_", "anomal",
+    "mismatch", "quarantin", "suspect", "divergen",
 )
 
 # Default per-section regression thresholds as relative fractions:
@@ -65,6 +72,10 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     "efficiency": 0.10,
     "kernels": 0.25,
     "tenancy": 0.25,
+    # Integrity counters sit at zero in a healthy run, so the relative
+    # threshold rarely matters (any rise from zero is absolute); keep
+    # it tight for the rate-style fields (e.g. audit sample coverage).
+    "numerics": 0.10,
 }
 
 # Values this small are treated as "basically zero": relative change on
@@ -120,6 +131,7 @@ def _section_views(summary: dict) -> Dict[str, object]:
         "contention": summary.get("contention"),
         "efficiency": summary.get("efficiency"),
         "kernels": summary.get("kernels"),
+        "numerics": summary.get("numerics"),
     }
     tenancy = {k: summary.get(k) for k in
                ("isolation", "victim_latency") if summary.get(k)}

@@ -16,7 +16,14 @@ finished requests move to a separate finished ring (default 256) so
 Event names used by the engine/scheduler wiring:
 
     arrived, queued, scheduled, prefill_start, preempted, swapped_out,
-    swapped_in, first_token, finished, aborted, rerouted
+    swapped_in, first_token, numerics_anomaly, finished, aborted,
+    rerouted
+
+`numerics_anomaly` is recorded by the engine's quarantine path
+(obs/numerics.py) when a sentinel trips on a request's logit row; the
+structured `finished` that follows (reason "abort") seals the trace, so
+the anomaly event and its detail (which sentinel kinds fired) survive
+in the finished ring for postmortems.
 
 `queued` is recorded at scheduler admission (after tokenization), so
 queue-wait derived as `scheduled - queued` (obs/slo.py) measures
@@ -46,8 +53,8 @@ from typing import Any, Dict, List, Optional
 
 # Canonical event names (wiring sites pass these strings).
 EVENTS = ("arrived", "queued", "scheduled", "prefill_start", "preempted",
-          "swapped_out", "swapped_in", "first_token", "finished", "aborted",
-          "rerouted")
+          "swapped_out", "swapped_in", "first_token", "numerics_anomaly",
+          "finished", "aborted", "rerouted")
 
 _TERMINAL = ("finished", "aborted", "rerouted")
 

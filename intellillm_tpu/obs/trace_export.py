@@ -303,8 +303,10 @@ def flush_black_box(reason: str,
     black box must never raise out of a dying process).
 
     Contents: live + recently-finished flight-recorder traces, watchdog
-    state and its ring of stall reports, the SLO summary, and any
-    caller-provided `extra` (bench.py passes its progress dict)."""
+    state and its ring of stall reports, the SLO summary, the numerics
+    snapshot (sentinels + KV-integrity audit + canary ledger — a crash
+    right after an anomaly is exactly when that context matters), and
+    any caller-provided `extra` (bench.py passes its progress dict)."""
     dump: Dict[str, Any] = {
         "reason": str(reason)[:500],
         "ts": time.time(),
@@ -331,6 +333,18 @@ def flush_black_box(reason: str,
         dump["slo"] = get_slo_tracker().summary()
     except Exception as e:
         dump["slo_error"] = repr(e)
+    try:
+        from intellillm_tpu.obs.numerics import numerics_debug_snapshot
+        dump["numerics"] = numerics_debug_snapshot()
+    except Exception as e:
+        dump["numerics_error"] = repr(e)
+    try:
+        from intellillm_tpu.obs.numerics import get_canary_ledger
+        canary = get_canary_ledger().snapshot()
+        if canary.get("runs_total"):  # router process only
+            dump["canary"] = canary
+    except Exception as e:
+        dump["canary_error"] = repr(e)
     if extra:
         dump["extra"] = extra
 

@@ -11,6 +11,9 @@ for a replica, not its URL — URLs change across restarts). Exported
     intellillm_router_inflight_requests{replica}        gauge
     intellillm_router_replica_healthy{replica}          gauge
     intellillm_router_replica_queue_depth{replica,queue} gauge
+    intellillm_router_canary_runs_total                 counter
+    intellillm_router_canary_divergence_total{replica}  counter
+    intellillm_router_canary_suspect{replica}           gauge
 
 Routing decisions: `affinity_hit` (known key, sticky replica taken),
 `affinity_new` (key seeded onto its ring replica), `load_balanced`
@@ -70,6 +73,17 @@ class _RouterMetrics:
             "intellillm_router_replica_queue_depth",
             "Replica scheduler queue depths from its /health/detail "
             "(queue = waiting | running | swapped).", ["replica", "queue"])
+        self.counter_canary_runs = Counter(
+            "intellillm_router_canary_runs_total",
+            "Fleet-wide divergence-canary rounds completed.")
+        self.counter_canary_divergence = Counter(
+            "intellillm_router_canary_divergence_total",
+            "Canary rounds where the replica's deterministic output "
+            "digest disagreed with the fleet majority.", ["replica"])
+        self.gauge_canary_suspect = Gauge(
+            "intellillm_router_canary_suspect",
+            "1 while the replica's latest canary digest disagrees with "
+            "the fleet majority, else 0.", ["replica"])
 
     @classmethod
     def reset_for_testing(cls) -> None:

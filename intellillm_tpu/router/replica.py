@@ -15,11 +15,24 @@ request through and health-probe. Two concrete kinds:
 The `ReplicaManager` owns the fleet: attach/launch, a background
 health-poll loop against each replica's `/health/detail`, per-replica
 predicted-load/in-flight accounting, and the per-replica gauges.
+
+Divergence canaries (obs/numerics.py, docs/observability.md): every
+`INTELLILLM_CANARY_EVERY` poll ticks (0 = off) the manager streams one
+deterministic greedy prompt through each live replica, digests the
+final output, and compares digests fleet-wide. A replica that
+disagrees with the strict majority is marked `suspect` — visible in
+the router's `/health/detail` fleet view and fleet alerts — and, with
+`INTELLILLM_CANARY_DRAIN=1`, drained from routing candidates until its
+canary re-converges. No strict majority (e.g. a 1:1 split) marks
+nobody: the canary detects the odd replica out, not which side is
+right.
 """
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
+import os
 import subprocess
 import sys
 import time
@@ -31,6 +44,17 @@ from intellillm_tpu.sampling_params import SamplingParams
 from intellillm_tpu.utils import random_uuid
 
 logger = init_logger(__name__)
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("Ignoring invalid %s=%r (want an int).", name, raw)
+        return default
 
 
 class ReplicaFailure(Exception):
@@ -55,6 +79,15 @@ class Replica:
         # in-flight request count (decremented on completion OR failure).
         self.predicted_load = 0.0
         self.inflight = 0
+        # Divergence-canary state (ReplicaManager.run_canary): suspect
+        # means this replica's deterministic canary digest disagreed
+        # with the fleet majority on the latest run.
+        self.suspect = False
+        self.last_canary_digest: Optional[str] = None
+        self.last_canary_ts: Optional[float] = None
+        # Testing hook: a non-None value short-circuits canary() so
+        # fleet tests can force divergence without a model in the loop.
+        self.canary_digest_override: Optional[str] = None
 
     @property
     def calibration_factor(self) -> float:
@@ -80,6 +113,31 @@ class Replica:
     async def health_detail(self) -> Tuple[int, dict]:
         """(status_code, body) of the replica's /health/detail."""
         raise NotImplementedError
+
+    async def canary(self, prompt: str, max_tokens: int = 8
+                     ) -> Optional[str]:
+        """Stream the deterministic greedy canary `prompt` through this
+        replica and return a digest of the final cumulative output (None
+        when the stream produced nothing). Greedy + fixed prompt means
+        every healthy replica serving the same weights must produce the
+        same digest — any disagreement is weight corruption, numerics
+        divergence, or version skew. Raises ReplicaFailure like any
+        other request; the manager treats that as digest None."""
+        if self.canary_digest_override is not None:
+            return self.canary_digest_override
+        payload = {"prompt": prompt, "temperature": 0.0,
+                   "max_tokens": max_tokens}
+        final: Optional[str] = None
+        gen = self.generate(
+            payload, request_id=f"canary-{self.replica_id}-{random_uuid()}")
+        async for chunk in gen:
+            texts = chunk.get("text")
+            if texts:
+                final = texts[0]
+        if final is None:
+            return None
+        return hashlib.blake2b(final.encode("utf-8"),
+                               digest_size=16).hexdigest()
 
     async def export_kv(self, prompt: str) -> bytes:
         """Export the KV prefix this replica prefilled for `prompt`
@@ -425,7 +483,11 @@ class ReplicaManager:
     and router-side load accounting (+ per-replica gauges)."""
 
     def __init__(self, health_interval_s: float = 2.0,
-                 unhealthy_after: int = 2) -> None:
+                 unhealthy_after: int = 2,
+                 canary_every: Optional[int] = None,
+                 canary_prompt: Optional[str] = None,
+                 canary_max_tokens: Optional[int] = None,
+                 canary_drain: Optional[bool] = None) -> None:
         self.replicas: Dict[str, Replica] = {}
         self.health_interval_s = health_interval_s
         # Probes that must fail consecutively before a replica is marked
@@ -433,6 +495,24 @@ class ReplicaManager:
         # serving bypass this via mark_failed().
         self.unhealthy_after = unhealthy_after
         self._poll_task: Optional[asyncio.Task] = None
+        # Divergence canary (module docstring): run every N poll ticks;
+        # 0 disables. Args override env so tests and the router CLI can
+        # both configure it.
+        self.canary_every = (canary_every if canary_every is not None
+                             else _env_int("INTELLILLM_CANARY_EVERY", 0))
+        self.canary_prompt = (canary_prompt if canary_prompt is not None
+                              else os.environ.get(
+                                  "INTELLILLM_CANARY_PROMPT",
+                                  "The quick brown fox"))
+        self.canary_max_tokens = (
+            canary_max_tokens if canary_max_tokens is not None
+            else _env_int("INTELLILLM_CANARY_MAX_TOKENS", 8))
+        if canary_drain is None:
+            from intellillm_tpu.utils import parse_env_flag
+            canary_drain = parse_env_flag(
+                os.environ.get("INTELLILLM_CANARY_DRAIN", "")) is True
+        self.canary_drain = canary_drain
+        self._polls_since_canary = 0
 
     # --- fleet membership -------------------------------------------------
 
@@ -523,6 +603,12 @@ class ReplicaManager:
             # slo_burn_rate) would otherwise degrade every replica and
             # turn a goodput dip into a router-wide 503 outage.
             ok = status == 200 and body.get("status") in ("ok", "degraded")
+            # A canary-divergent replica under drain stays out of the
+            # candidate set no matter what its own health says — its
+            # self-report is exactly what the canary distrusts. The
+            # suspect flag clears on a later converging canary run.
+            if r.suspect and self.canary_drain:
+                ok = False
             if ok:
                 if not r.healthy:
                     logger.info("replica %s healthy", r.replica_id)
@@ -533,6 +619,72 @@ class ReplicaManager:
                 if r.consecutive_failures >= self.unhealthy_after:
                     r.healthy = False
             self._export_gauges(r)
+        if self.canary_every > 0:
+            self._polls_since_canary += 1
+            if self._polls_since_canary >= self.canary_every:
+                self._polls_since_canary = 0
+                await self.run_canary()
+
+    # --- divergence canary ------------------------------------------------
+
+    async def run_canary(self) -> Dict[str, Optional[str]]:
+        """One fleet-wide canary round (module docstring): same greedy
+        prompt through every live replica, strict-majority digest vote,
+        off-majority replicas marked suspect. Suspect-but-drained
+        replicas stay in the round so a recovered replica (restart,
+        reload) can re-converge and rejoin. Returns the per-replica
+        digests (None = the canary itself failed, which is a health
+        problem, not a divergence verdict)."""
+        digests: Dict[str, Optional[str]] = {}
+        for rid, r in list(self.replicas.items()):
+            if not (r.healthy or r.suspect):
+                continue
+            try:
+                digests[rid] = await r.canary(self.canary_prompt,
+                                              self.canary_max_tokens)
+            except Exception as e:
+                logger.warning("replica %s canary failed: %s", rid, e)
+                digests[rid] = None
+            r.last_canary_digest = digests[rid]
+            r.last_canary_ts = time.monotonic()
+        counts: Dict[str, int] = {}
+        for digest in digests.values():
+            if digest is not None:
+                counts[digest] = counts.get(digest, 0) + 1
+        reference: Optional[str] = None
+        suspects: List[str] = []
+        if counts:
+            best, best_n = max(counts.items(), key=lambda kv: kv[1])
+            if best_n * 2 > sum(counts.values()):
+                reference = best
+                suspects = sorted(
+                    rid for rid, digest in digests.items()
+                    if digest is not None and digest != reference)
+        for rid in digests:
+            r = self.replicas.get(rid)
+            if r is None:
+                continue
+            was_suspect = r.suspect
+            r.suspect = rid in suspects
+            if r.suspect and not was_suspect:
+                logger.error(
+                    "replica %s canary DIVERGED from fleet majority "
+                    "(digest %s vs reference %s)%s", rid,
+                    r.last_canary_digest, reference,
+                    "; draining" if self.canary_drain else "")
+                if self.canary_drain:
+                    r.healthy = False
+            elif was_suspect and not r.suspect:
+                logger.info("replica %s canary re-converged", rid)
+            self._export_gauges(r)
+        from intellillm_tpu.obs import get_canary_ledger
+        get_canary_ledger().record_run(digests, reference, suspects)
+        m = get_router_metrics()
+        if m is not None:
+            m.counter_canary_runs.inc()
+            for rid in suspects:
+                m.counter_canary_divergence.labels(replica=rid).inc()
+        return digests
 
     async def _poll_loop(self) -> None:
         while True:
@@ -569,6 +721,8 @@ class ReplicaManager:
         m.gauge_inflight.labels(replica=r.replica_id).set(r.inflight)
         m.gauge_healthy.labels(replica=r.replica_id).set(
             1 if r.healthy else 0)
+        m.gauge_canary_suspect.labels(replica=r.replica_id).set(
+            1 if r.suspect else 0)
         depths = (r.last_health or {}).get("queue_depths") or {}
         for queue, depth in depths.items():
             m.gauge_queue_depth.labels(replica=r.replica_id,
@@ -581,6 +735,11 @@ class ReplicaManager:
             out[rid] = {
                 "healthy": r.healthy,
                 "role": r.role,
+                "suspect": r.suspect,
+                "canary_digest": r.last_canary_digest,
+                "canary_age_s": (
+                    round(time.monotonic() - r.last_canary_ts, 3)
+                    if r.last_canary_ts is not None else None),
                 "predicted_load_tokens": r.predicted_load,
                 "inflight": r.inflight,
                 "consecutive_failures": r.consecutive_failures,

@@ -631,9 +631,19 @@ class Router:
             firing.update(summary.get("firing") or [])
             pending.update(summary.get("pending") or [])
             page_firing = page_firing or bool(summary.get("page_firing"))
+        # Divergence-canary verdict (router/replica.py run_canary): a
+        # suspect replica is an output-integrity incident, which is
+        # page-severity by the same logic as numerics_anomaly — the
+        # fleet is serving two different answers to the same prompt.
+        from intellillm_tpu.obs import get_canary_ledger
+        canary = get_canary_ledger().snapshot()
+        if canary.get("suspects"):
+            firing.add("canary_divergence")
+            page_firing = True
         return {
             "router": own,
             "replicas": per_replica,
+            "canary": canary,
             "fleet": {
                 "rules_firing": sorted(firing),
                 "rules_pending": sorted(pending),
@@ -794,6 +804,21 @@ def build_router_app(router: Router) -> web.Application:
         body["replicas"] = fleet["replicas"]
         return web.json_response(body)
 
+    async def debug_numerics_fleet(request: web.Request) -> web.Response:
+        """Fleet numerics view: the router's own (usually idle) sentinel
+        + KV-audit snapshot, the divergence-canary ledger, and each
+        replica's compact numerics block as captured by the health
+        poller (full per-replica detail lives on each replica's own
+        /debug/numerics)."""
+        from intellillm_tpu.obs import (get_canary_ledger,
+                                        numerics_debug_snapshot)
+        body = numerics_debug_snapshot()
+        body["canary"] = get_canary_ledger().snapshot()
+        body["replicas"] = {
+            rid: (r.last_health or {}).get("numerics")
+            for rid, r in router.manager.replicas.items()}
+        return web.json_response(body)
+
     async def debug_trace_stitched(request: web.Request) -> web.Response:
         trace_id = request.match_info["trace_id"]
         stitched = await router.stitched_trace(trace_id)
@@ -825,6 +850,7 @@ def build_router_app(router: Router) -> web.Application:
     app.router.add_get("/debug/explain/{trace_id}", debug_explain_stitched)
     app.router.add_get("/debug/history", debug_history)
     app.router.add_get("/debug/alerts", debug_alerts)
+    app.router.add_get("/debug/numerics", debug_numerics_fleet)
 
     async def _start(app: web.Application) -> None:
         router.manager.start_polling()
@@ -879,6 +905,18 @@ def make_arg_parser() -> argparse.ArgumentParser:
                         "affinity is overridden")
     parser.add_argument("--health-interval", type=float, default=2.0,
                         help="replica /health/detail poll period, seconds")
+    parser.add_argument("--canary-every", type=int, default=None,
+                        help="run the fleet divergence canary every N "
+                        "health polls (0 disables; default: "
+                        "INTELLILLM_CANARY_EVERY, off)")
+    parser.add_argument("--canary-prompt", type=str, default=None,
+                        help="deterministic greedy prompt for the "
+                        "divergence canary (default: "
+                        "INTELLILLM_CANARY_PROMPT)")
+    parser.add_argument("--canary-drain", action="store_true",
+                        help="drain a canary-divergent replica from "
+                        "routing until it re-converges (default: "
+                        "INTELLILLM_CANARY_DRAIN)")
     parser.add_argument("--max-retries", type=int, default=1,
                         help="re-routes after a replica failure")
     parser.add_argument("--replica-roles", type=str, default=None,
@@ -906,7 +944,12 @@ def build_router_from_args(args, engine_argv: List[str]) -> Router:
         max_retries=args.max_retries,
         health_interval_s=args.health_interval,
     )
-    manager = ReplicaManager(health_interval_s=args.health_interval)
+    manager = ReplicaManager(
+        health_interval_s=args.health_interval,
+        canary_every=getattr(args, "canary_every", None),
+        canary_prompt=getattr(args, "canary_prompt", None),
+        canary_drain=(True if getattr(args, "canary_drain", False)
+                      else None))
     router = Router(config, manager, predictor=predictor,
                     tokenizer=tokenizer)
 

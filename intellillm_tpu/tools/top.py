@@ -8,7 +8,9 @@ Polls `GET /health/detail`, `GET /metrics`, `GET /debug/alerts`, and
 `GET /debug/history` and renders per-device HBM bars, the memory
 ledger, swap traffic, queue depths, KV-cache usage, goodput/SLO
 percentiles with a goodput history sparkline, the ALERTS panel
-(pending/firing rules, fleet aggregation when pointed at a router), and
+(pending/firing rules, fleet aggregation when pointed at a router), the
+NUMERICS panel (sentinel rows/anomalies/quarantines + KV-integrity
+audit counters, hidden while both channels are off), and
 the compute-efficiency panel (MFU, pad%, per-axis bucket fill,
 top-waste bucket), and the KERNELS panel (per-program executables,
 dispatches, cost-model FLOPs/bytes/HBM, and the cost-model-vs-analytic
@@ -285,6 +287,8 @@ def render_frame(health: Optional[Dict[str, Any]],
 
     lines.extend(_contention_lines(health.get("contention")))
 
+    lines.extend(_numerics_lines(health.get("numerics")))
+
     lines.extend(_alerts_lines(alerts))
 
     lines.extend(_slowest_lines(slo.get("slowest") or []))
@@ -407,6 +411,39 @@ def _contention_lines(contention: Optional[Dict[str, Any]]) -> List[str]:
             verdict_parts.append(f"{decision}={count}")
     if verdict_parts:
         lines.append("  verdicts: " + "  ".join(verdict_parts))
+    return lines
+
+
+def _numerics_lines(numerics: Optional[Dict[str, Any]]) -> List[str]:
+    """NUMERICS panel from /health/detail's numerics block
+    (obs/numerics.py; full snapshot at /debug/numerics): sentinel
+    coverage + anomaly/quarantine counts and the KV-integrity audit
+    counters. Hidden entirely when both channels are off; anomalies or
+    mismatches get a ** marker — those rows should never be non-zero
+    in a healthy fleet."""
+    if not numerics:
+        return []
+    sent = numerics.get("sentinels") or {}
+    audit = numerics.get("kv_audit") or {}
+    if not sent.get("enabled") and not audit.get("enabled"):
+        return []
+    lines = ["", "Numerics:"]
+    if sent.get("enabled"):
+        anomalies = int(_num(sent.get("anomalies")))
+        flag = "  **" if anomalies else ""
+        lines.append(
+            f"  sentinels  rows {int(_num(sent.get('rows_checked')))}  "
+            f"anomalies {anomalies}  "
+            f"quarantined {int(_num(sent.get('quarantined')))}{flag}")
+    else:
+        lines.append("  sentinels  off (--enable-numerics)")
+    if audit.get("enabled"):
+        mismatches = int(_num(audit.get("mismatches")))
+        flag = "  **" if mismatches else ""
+        lines.append(
+            f"  kv-audit   sample {_pct(audit.get('sample'))}  "
+            f"checksums {int(_num(audit.get('checksums')))}  "
+            f"mismatches {mismatches}{flag}")
     return lines
 
 

@@ -6,7 +6,8 @@ Both inputs are summary snapshots — either `--summary-out` files from
 `benchmarks/serve_bench.py` / raw serve_bench stdout, or a `bench.py`
 summary JSON. The tool diffs them section by section (SLO percentiles,
 throughput, contention cause-seconds, efficiency ledger, per-kernel
-deltas, tenancy isolation — see `intellillm_tpu/obs/diff.py`), prints a
+deltas, tenancy isolation, numerics/output-integrity counters — see
+`intellillm_tpu/obs/diff.py`), prints a
 per-metric breakdown plus a one-line verdict, and exits non-zero when
 any section regressed past its threshold — so CI can gate on it.
 

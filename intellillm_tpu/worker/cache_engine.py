@@ -76,6 +76,12 @@ class CacheEngine:
             self.block_size, cache_config.cache_dtype, model_config)
         from intellillm_tpu.obs.device_telemetry import get_device_telemetry
         self._telemetry = get_device_telemetry()
+        # KV integrity audit (obs/numerics.py): sampled blake2b
+        # checksums over the host-staging paths — recorded at swap-out,
+        # verified at swap-in; export/import staging is counted (the
+        # wire format self-validates transit).
+        from intellillm_tpu.obs.numerics import get_kv_audit
+        self._kv_audit = get_kv_audit()
 
     def _block_shape(self, num_blocks: int) -> Tuple[int, ...]:
         # [num_blocks, kv_heads, block_size, head_size]: (block, head) pairs
@@ -112,9 +118,20 @@ class CacheEngine:
     # --- block-op execution ---------------------------------------------
 
     def swap_in(self, src_to_dst: Dict[int, int]) -> None:
+        audit = self._kv_audit
         for i in range(self.num_layers):
             k_dev, v_dev = self.device_cache[i]
             k_cpu, v_cpu = self.cpu_cache[i]
+            if audit.enabled:
+                # Verify sampled host blocks BEFORE they re-enter the
+                # device pool: a bit that flipped while the block sat
+                # in host memory is caught here (counted + logged via
+                # the kv_integrity_mismatch alert) instead of silently
+                # corrupting every later token of the sequence.
+                for src in src_to_dst:
+                    if audit.should_audit(i, int(src)):
+                        audit.verify("swap_in", i, int(src),
+                                     k_cpu[int(src)], v_cpu[int(src)])
             k_dev = swap_blocks(k_cpu, k_dev, src_to_dst, direction="in")
             v_dev = swap_blocks(v_cpu, v_dev, src_to_dst, direction="in")
             self.device_cache[i] = (k_dev, v_dev)
@@ -122,11 +139,21 @@ class CacheEngine:
                                     self.logical_block_bytes)
 
     def swap_out(self, src_to_dst: Dict[int, int]) -> None:
+        audit = self._kv_audit
         for i in range(self.num_layers):
             k_dev, v_dev = self.device_cache[i]
             k_cpu, v_cpu = self.cpu_cache[i]
             swap_blocks(k_dev, k_cpu, src_to_dst, direction="out")
             swap_blocks(v_dev, v_cpu, src_to_dst, direction="out")
+            if audit.enabled:
+                # swap_blocks(direction="out") is synchronous host
+                # numpy, so the freshly written blocks are safe to hash
+                # immediately. Sampling is deterministic per (layer,
+                # block), so swap-in re-checks the same blocks.
+                for dst in src_to_dst.values():
+                    if audit.should_audit(i, int(dst)):
+                        audit.record("swap_out", i, int(dst),
+                                     k_cpu[int(dst)], v_cpu[int(dst)])
         self._telemetry.record_swap("out", len(src_to_dst),
                                     self.logical_block_bytes)
 
@@ -153,6 +180,14 @@ class CacheEngine:
             swap_blocks(k_dev, k_out, src_to_dst, direction="out")
             swap_blocks(v_dev, v_out, src_to_dst, direction="out")
             layers.append((k_out, v_out))
+        if self._kv_audit.enabled and block_numbers:
+            # Coverage counters only: transit integrity on the handoff
+            # path is the wire format's job (it self-validates).
+            for i, (k_out, v_out) in enumerate(layers):
+                for j in range(len(block_numbers)):
+                    if self._kv_audit.should_audit(i, j):
+                        self._kv_audit.record("export", i, j,
+                                              k_out[j], v_out[j])
         self._telemetry.record_swap("out", len(block_numbers),
                                     self.logical_block_bytes)
         return layers
@@ -165,6 +200,12 @@ class CacheEngine:
             raise ValueError(f"payload has {len(layers)} layers, cache has "
                              f"{self.num_layers}")
         src_to_dst = {j: int(b) for j, b in enumerate(block_numbers)}
+        if self._kv_audit.enabled and block_numbers:
+            for i, (k_host, v_host) in enumerate(layers):
+                for j in range(len(block_numbers)):
+                    if self._kv_audit.should_audit(i, j):
+                        self._kv_audit.record("import", i, j,
+                                              k_host[j], v_host[j])
         for i, (k_host, v_host) in enumerate(layers):
             k_dev, v_dev = self.device_cache[i]
             k_dev = swap_blocks(k_host, k_dev, src_to_dst, direction="in")

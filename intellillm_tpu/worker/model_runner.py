@@ -98,7 +98,8 @@ class InflightStep:
     the engine can overlap it with the next dispatched step."""
 
     def __init__(self, runner, packed, metas, rows, t1, t2, logprob_k,
-                 is_prompt, num_steps, proc=None, mixed_plp=None, emit=None):
+                 is_prompt, num_steps, proc=None, mixed_plp=None, emit=None,
+                 numerics=None):
         self.runner = runner
         self.packed = packed            # device array (also the cont input)
         self.metas = metas
@@ -115,6 +116,9 @@ class InflightStep:
         # (emit_idx, emit_rows): the flat-row subset that emits samples in
         # a mixed step (decode rows + final chunks' last rows).
         self.emit = emit
+        # [B, 5] device panel of per-row logit statistics (numerics
+        # sentinels, obs/numerics.py) — only when --enable-numerics.
+        self.numerics = numerics
         self.cont_state: Optional[DecodeContState] = None
 
     def finalize(self) -> List[SamplerOutput]:
@@ -143,6 +147,14 @@ class InflightStep:
                 # lint: allow(host-sync) reason=processor rows resample on the host by design; fetched was produced by the same dispatch the packed fetch above already waited on
                 proc_rows, np.asarray(fetched), row_params, row_tokens,
                 row_seeds, sampled, sampled_lp, topk_ids, topk_lp, self.t1)
+        if self.numerics is not None:
+            # lint: allow(host-sync) reason=the sentinel panel rides the same dispatch the packed fetch above already waited on; this asarray is a ready-result copy
+            stats = np.asarray(self.numerics)
+            if self.emit is not None:
+                pairs = list(zip(self.emit[0], self.emit[1]))
+            else:
+                pairs = list(enumerate(self.rows))
+            r._numerics.observe_step(stats, pairs)
         rows = self.rows
         if self.emit is not None:
             emit_idx, emit_rows = self.emit
@@ -184,6 +196,8 @@ class ModelRunner:
         self._compile_tracker = get_compile_tracker()
         self._efficiency = get_efficiency_tracker()
         self._kernel_ledger = get_kernel_ledger()
+        from intellillm_tpu.obs import get_numerics_tracker
+        self._numerics = get_numerics_tracker()
 
         self.block_size = cache_config.block_size
         self.sliding_window = model_config.get_sliding_window()
@@ -227,7 +241,7 @@ class ModelRunner:
             self._decode_fn_single,
             static_argnames=("num_samples", "plp_k", "logprob_k", "do_topk",
                              "do_topp", "do_minp", "do_penalties",
-                             "do_random"),
+                             "do_random", "do_numerics"),
             donate_argnames=("kv_caches", ),
         )
         self._jit_decode_teacher = jax.jit(
@@ -322,7 +336,8 @@ class ModelRunner:
                                    logprob_k, do_topk, do_topp, do_minp,
                                    do_penalties, do_random=True,
                                    fetch_indices=None, plp_targets=None,
-                                   plp_k=0):
+                                   plp_k=0, do_numerics=False,
+                                   numerics_inject=None):
         """fetch_indices: optional [M] row indices whose RAW (pre-penalty)
         logits are additionally returned for the host logits_processors
         escape path (reference sampler.py `_apply_logits_processors` runs
@@ -334,7 +349,14 @@ class ModelRunner:
         logits, packed [B, 1 + 2*plp_k] (target logprob bitcast, top ids,
         top logprobs bitcast). Position p's row predicts prompt token
         p+1; the host accumulates rows across chunks into the reference
-        prompt-logprob panel (see _attach_prompt_logprobs)."""
+        prompt-logprob panel (see _attach_prompt_logprobs).
+
+        do_numerics/numerics_inject: the in-graph sentinels
+        (obs/numerics.py). When enabled the call additionally returns a
+        [B, 5] float32 panel (NaN count, +Inf count, finite max-abs,
+        top-1 prob, entropy) of the FINAL sampling logits;
+        numerics_inject is the forced-corruption testing hook — an
+        additive [B] row vector (zeros, or NaN on a poisoned row)."""
         lora_vocab = lora is not None and "vocab" in lora
         if lora_vocab:
             # Extra-vocab LoRA: the model returns EXACTLY vocab+extra
@@ -350,6 +372,11 @@ class ModelRunner:
             # win greedy argmax or receive sampling mass.
             pad = jnp.arange(logits.shape[-1]) >= self.vocab_size
             logits = jnp.where(pad[None, :], -1e30, logits)
+        if do_numerics and numerics_inject is not None:
+            # Forced-corruption hook: NaN rows poison everything
+            # downstream (panel, penalties, sample) exactly like a real
+            # in-graph numerics fault would.
+            logits = logits + numerics_inject[:, None]
         fetched = (logits[fetch_indices]
                    if fetch_indices is not None else None)
         plp_out = None
@@ -372,11 +399,30 @@ class ModelRunner:
                 prompt_tokens, output_tokens, logits.shape[-1])
             logits = apply_penalties(logits, prompt_mask, output_counts,
                                      pres_pen, freq_pen, rep_pen)
+        num_stats = None
+        if do_numerics:
+            # Sentinel panel over the FINAL sampling logits (post
+            # penalties): pad columns sit at -1e30 and excluded tokens
+            # at -inf — both are masking semantics, not anomalies, so
+            # max-abs skips them and only +inf counts as inf.
+            p = jax.nn.softmax(logits, axis=-1)
+            finite = jnp.isfinite(logits)
+            nan_c = jnp.sum(jnp.isnan(logits), axis=-1)
+            inf_c = jnp.sum(jnp.isposinf(logits), axis=-1)
+            mag = jnp.where(finite & (logits > -1e29),
+                            jnp.abs(logits), 0.0)
+            max_abs = jnp.max(mag, axis=-1)
+            top1 = jnp.max(p, axis=-1)
+            entropy = -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0),
+                               axis=-1)
+            num_stats = jnp.stack(
+                [nan_c.astype(jnp.float32), inf_c.astype(jnp.float32),
+                 max_abs, top1, entropy], axis=-1)
         out = sample(logits, temperatures, top_ks, top_ps, min_ps, seeds,
                      logprob_k=logprob_k, num_samples=num_samples,
                      do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
                      do_random=do_random)
-        return out + (fetched, plp_out)
+        return out + (fetched, plp_out, num_stats)
 
     def _decode_cont_fn(self, params, kv_caches, prev_packed, positions,
                         block_tables, context_lens, temperatures, top_ks,
@@ -494,7 +540,7 @@ class ModelRunner:
                 g = (chunk_base + k).astype(jnp.uint32)
                 seeds_k = seeds + g * _SEED_STRIDE
                 (sampled, lp, tk_ids,
-                 tk_lp, _, _) = self._compute_logits_and_sample(
+                 tk_lp, _, _, _) = self._compute_logits_and_sample(
                     params, hidden[:, 0], temperatures, top_ks, top_ps,
                     min_ps, seeds_k, pres_pen, freq_pen, rep_pen,
                     prompt_tokens, output_tokens, lora, num_samples=1,
@@ -558,8 +604,9 @@ class ModelRunner:
                           block_tables, context_lens, temperatures, top_ks,
                           top_ps, min_ps, seeds, pres_pen, freq_pen, rep_pen,
                           prompt_tokens, output_tokens, lora=None,
-                          fetch_indices=None, plp_targets=None, *,
-                          num_samples=1, plp_k=0,
+                          fetch_indices=None, plp_targets=None,
+                          numerics_inject=None, *,
+                          num_samples=1, plp_k=0, do_numerics=False,
                           logprob_k, do_topk, do_topp, do_minp,
                           do_penalties, do_random=True):
         """Unstaged single-step program — THE mixed dispatch: writes KV to
@@ -594,15 +641,16 @@ class ModelRunner:
         hidden, new_caches = self._call_model(params, token_ids,
                                               pos[:, None], kv_caches, meta,
                                               lora)
-        (sampled, lp, tk_ids, tk_lp, fetched,
-         plp_out) = self._compute_logits_and_sample(
+        (sampled, lp, tk_ids, tk_lp, fetched, plp_out,
+         num_stats) = self._compute_logits_and_sample(
             params, hidden[:, 0], temperatures, top_ks, top_ps, min_ps,
             seeds, pres_pen, freq_pen, rep_pen, prompt_tokens, output_tokens,
             lora, num_samples=num_samples, logprob_k=logprob_k,
             do_topk=do_topk, do_topp=do_topp, do_minp=do_minp,
             do_penalties=do_penalties, do_random=do_random,
             fetch_indices=fetch_indices, plp_targets=plp_targets,
-            plp_k=plp_k)
+            plp_k=plp_k, do_numerics=do_numerics,
+            numerics_inject=numerics_inject)
         packed = self._pack(sampled, lp, tk_ids[:, None, :],
                             tk_lp[:, None, :])
         extras = ()
@@ -610,6 +658,8 @@ class ModelRunner:
             extras += (plp_out, )
         if fetched is not None:
             extras += (fetched, )
+        if num_stats is not None:
+            extras += (num_stats, )
         return (packed, ) + extras + (new_caches, )
 
     # --- batch prep -------------------------------------------------------
@@ -800,7 +850,19 @@ class ModelRunner:
             place(arrays["block_tables"]), place(arrays["context_lens"]),
             *sampling_args, lora_state)
         fetched = None
+        num_stats_dev = None
         if num_steps == 1:
+            # Numerics sentinels (obs/numerics.py): opt-in extra device
+            # output. When OFF the call binds exactly as pre-sentinel
+            # code did — no new kwargs, no new jit cache entry, so the
+            # default-off path provably adds zero executables.
+            num_on = self._numerics.enabled
+            numerics_kwargs = {}
+            if num_on:
+                numerics_kwargs = dict(
+                    do_numerics=True,
+                    numerics_inject=place(
+                        self._numerics.inject_vector(rows, padded_n)))
             # Mirror of jit's dispatch-cache key: padded shapes + static
             # args + pytree-structure toggles (see obs/compile_tracker.py).
             # Same key layout as _execute_mixed — a decode-only step IS a
@@ -811,16 +873,19 @@ class ModelRunner:
                       else None,
                       lora_state is not None,
                       tuple(sorted(common.items())))
+            if num_on:
+                bucket = bucket + ("numerics", )
             with self._tracer.span("execute"):
                 result = self._guarded_call(
                     "mixed", bucket, self._jit_decode_single,
                     *decode_args,
                     place(fetch_indices) if fetch_indices is not None
-                    else None, **common)
-            if proc_rows:
-                packed, fetched, new_caches = result
-            else:
-                packed, new_caches = result
+                    else None, **common, **numerics_kwargs)
+            result = list(result)
+            packed = result.pop(0)
+            fetched = result.pop(0) if proc_rows else None
+            num_stats_dev = result.pop(0) if num_on else None
+            new_caches = result.pop(0)
         else:
             assert not proc_rows, (
                 "logits_processors present in a fused K>1 decode batch; "
@@ -851,7 +916,8 @@ class ModelRunner:
             self, packed, seq_group_metadata_list, rows, t1, t2,
             st.logprob_k, False, num_steps,
             proc=((proc_rows, fetched, row_params, row_tokens, row_seeds)
-                  if proc_rows else None))
+                  if proc_rows else None),
+            numerics=num_stats_dev)
         if num_steps > 1:
             step.cont_state = DecodeContState(
                 seq_group_metadata_list, rows,
@@ -1019,11 +1085,24 @@ class ModelRunner:
             )
             sampling_args = self._sampling_args_device(st, padded_n)
 
+        # Numerics sentinels: when OFF the dispatch binds exactly as the
+        # pre-sentinel code did (no extra kwargs → identical jit cache
+        # key → zero new executables); when ON every mixed step carries
+        # the panel output and the (usually all-zero) inject vector.
+        num_on = self._numerics.enabled
+        numerics_kwargs = {}
+        if num_on:
+            numerics_kwargs = dict(
+                do_numerics=True,
+                numerics_inject=place(
+                    self._numerics.inject_vector(rows, padded_n)))
         bucket = (padded_n, w, num_samples, plp_k,
                   fetch_indices.shape[0] if fetch_indices is not None
                   else None,
                   lora_state is not None,
                   tuple(sorted(common.items())))
+        if num_on:
+            bucket = bucket + ("numerics", )
         with self._tracer.span("execute"):
             result = self._guarded_call(
                 "mixed", bucket, self._jit_decode_single,
@@ -1033,11 +1112,13 @@ class ModelRunner:
                 *sampling_args, lora_state,
                 place(fetch_indices) if fetch_indices is not None else None,
                 place(plp_targets) if plp_k else None,
-                num_samples=num_samples, plp_k=plp_k, **common)
+                num_samples=num_samples, plp_k=plp_k, **common,
+                **numerics_kwargs)
         result = list(result)
         packed = result.pop(0)
         plp_dev = result.pop(0) if plp_k else None
         fetched = result.pop(0) if proc_rows else None
+        num_stats_dev = result.pop(0) if num_on else None
         new_caches = result.pop(0)
 
         # Per-phase efficiency attribution: each real token is counted
@@ -1066,7 +1147,8 @@ class ModelRunner:
                   if proc_rows else None),
             mixed_plp=((plp_dev, plp_k, plp_jobs, plp_finals)
                        if (plp_jobs or plp_finals) else None),
-            emit=(emit_idx, emit_rows))
+            emit=(emit_idx, emit_rows),
+            numerics=num_stats_dev)
         if defer_fetch:
             return step, new_caches
         return step.finalize(), new_caches

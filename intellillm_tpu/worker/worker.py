@@ -327,8 +327,17 @@ class Worker:
             names = list(inspect.signature(
                 runner._decode_fn_single).parameters)
             idx = names.index("output_tokens")
-            assert names[idx + 1:idx + 4] == \
-                ["lora", "fetch_indices", "plp_targets"], names
+            assert names[idx + 1:idx + 5] == \
+                ["lora", "fetch_indices", "plp_targets",
+                 "numerics_inject"], names
+            # Numerics sentinels (obs/numerics.py): an enabled engine
+            # dispatches EVERY mixed step with do_numerics=True plus the
+            # inject vector, so warm-up must add the same bindings —
+            # otherwise the warmed executables never match serving and
+            # the first real step compiles mid-serving. Disabled (the
+            # default) warms the exact pre-sentinel call structure.
+            from intellillm_tpu.obs import get_numerics_tracker
+            num_on = get_numerics_tracker().enabled
             widths = buckets[:2] if full else buckets[:1]
             for b in batch_sizes:
                 zeros_i = place(np.zeros((b, 1), np.int32))
@@ -352,10 +361,17 @@ class Worker:
                             place(np.zeros(b, np.float32)),
                             place(np.ones(b, np.float32)), None, None,
                             lora)
+                    numerics_kwargs = (dict(
+                        do_numerics=True,
+                        numerics_inject=place(np.zeros(b, np.float32)))
+                        if num_on else {})
                     for flags in flag_variants:
-                        packed, caches = runner._jit_decode_single(
+                        result = runner._jit_decode_single(
                             self.params, self.cache_engine.device_cache,
-                            *args, **flags)
+                            *args, **flags, **numerics_kwargs)
+                        # (packed, [sentinel panel,] caches) — the panel
+                        # rides along only under --enable-numerics.
+                        packed, caches = result[0], result[-1]
                         self.cache_engine.device_cache = caches
                         n += 1
                         if (full and not flags["do_random"] and b == top
@@ -368,11 +384,11 @@ class Worker:
                             m = pad_to_bucket(1, buckets)
                             fargs = args + (
                                 place(np.zeros(m, np.int32)), )
-                            packed, _fetched, caches = \
-                                runner._jit_decode_single(
-                                    self.params,
-                                    self.cache_engine.device_cache,
-                                    *fargs, **flags)
+                            result = runner._jit_decode_single(
+                                self.params,
+                                self.cache_engine.device_cache,
+                                *fargs, **flags, **numerics_kwargs)
+                            packed, caches = result[0], result[-1]
                             self.cache_engine.device_cache = caches
                             n += 1
                         k = self.scheduler_config.num_decode_steps

@@ -1,0 +1,166 @@
+"""Numerics observability through a real CPU engine — the PR's
+acceptance criteria:
+
+- default-off is invisible: no numerics-marked executables, zero rows
+  checked, and greedy outputs identical to an enabled-mode clean run
+  (the sentinel panel must never perturb sampling);
+- a forced in-graph NaN (`inject_nan` testing hook) quarantines the
+  poisoned request with a structured abort, a `numerics_anomaly`
+  flight event in the sealed trace, and an active page alert — while
+  co-scheduled requests finish normally;
+- a byte flipped in the host swap pool between swap-out and swap-in is
+  caught by the sampled KV-integrity audit.
+"""
+import numpy as np
+import pytest
+
+from intellillm_tpu import LLM, SamplingParams
+from intellillm_tpu.obs import get_compile_tracker, get_flight_recorder
+from intellillm_tpu.obs import numerics as numerics_mod
+from intellillm_tpu.obs.alerts import (KVIntegrityMismatchRule,
+                                       NumericsAnomalyRule)
+from intellillm_tpu.obs.numerics import (get_kv_audit,
+                                         get_numerics_tracker)
+
+PROMPTS = ["hello my name is", "the capital of france is"]
+
+
+@pytest.fixture
+def fresh_numerics():
+    numerics_mod.reset_for_testing()
+    get_compile_tracker().reset_for_testing()
+    get_flight_recorder().reset_for_testing()
+    yield
+    numerics_mod.reset_for_testing()
+    get_flight_recorder().reset_for_testing()
+
+
+def _build(tiny_opt_dir):
+    return LLM(model=tiny_opt_dir, dtype="float32",
+               num_device_blocks_override=128, max_model_len=128,
+               max_num_seqs=8, max_paddings=512, swap_space=0.01)
+
+
+def _greedy_tokens(llm, max_tokens=12):
+    engine = llm.llm_engine
+    params = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                            ignore_eos=True)
+    for i, prompt in enumerate(PROMPTS):
+        engine.add_request(str(i), prompt, params)
+    outs = llm._run_engine(use_tqdm=False)
+    return {o.request_id: list(o.outputs[0].token_ids) for o in outs}
+
+
+def test_default_off_adds_no_executables_and_enabled_matches(tiny_opt_dir,
+                                                             fresh_numerics):
+    # Default-off engine: the dispatch passes no numerics kwargs at all,
+    # so the jit call structure — and therefore every compiled
+    # executable — is bit-identical to the pre-numerics engine.
+    tracker = get_numerics_tracker()
+    assert tracker.enabled is False
+    llm = _build(tiny_opt_dir)
+    baseline = _greedy_tokens(llm)
+    assert all(len(t) == 12 for t in baseline.values())
+    snap_off = get_compile_tracker().snapshot()
+    assert snap_off["compiles"], snap_off
+    # Zero numerics-marked executables: every jit bucket key dispatched
+    # by the default-off run is exactly the pre-sentinel key shape.
+    mixed_keys = get_compile_tracker()._keys.get("mixed", set())
+    assert mixed_keys
+    assert not any("numerics" in key for key in mixed_keys), (
+        f"default-off run compiled numerics variants: {mixed_keys}")
+    assert tracker.snapshot()["rows_checked"] == 0
+    del llm
+
+    # Enabled engine, same prompts: the sentinel panel rides along as an
+    # extra device output under numerics-marked bucket keys, every row
+    # is checked, and the greedy tokens are unchanged — the sentinels
+    # observe the logits, they never modify them.
+    get_compile_tracker().reset_for_testing()
+    tracker.configure(enabled=True)
+    llm = _build(tiny_opt_dir)
+    enabled = _greedy_tokens(llm)
+    assert enabled == baseline, (
+        "enabling numerics sentinels changed greedy outputs")
+    mixed_keys = get_compile_tracker()._keys.get("mixed", set())
+    assert any("numerics" in key for key in mixed_keys), mixed_keys
+    snap = tracker.snapshot()
+    assert snap["rows_checked"] > 0
+    assert sum(snap["anomalies"].values()) == 0
+    assert snap["last_step"]["mean_top1_prob"] is not None
+
+
+def test_forced_nan_quarantines_alerts_and_traces(tiny_opt_dir,
+                                                  fresh_numerics):
+    tracker = get_numerics_tracker()
+    tracker.configure(enabled=True)
+    llm = _build(tiny_opt_dir)
+    engine = llm.llm_engine
+    params = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    # "0" is the victim, "1" the co-scheduled bystander (_run_engine
+    # sorts outputs by integer request id).
+    engine.add_request("0", PROMPTS[0], params)
+    engine.add_request("1", PROMPTS[1], params)
+    # The next dispatched step carrying request "0" gets NaN added to
+    # its logit row IN-GRAPH — the full device→sentinel→quarantine path
+    # runs, nothing is simulated host-side.
+    tracker.inject_nan("0")
+    outs = {o.request_id: o for o in llm._run_engine(use_tqdm=False)}
+
+    victim = outs["0"]
+    assert victim.finished
+    assert victim.outputs[0].finish_reason == "abort"
+    # Quarantined before streaming: the poisoned token never landed.
+    assert len(victim.outputs[0].token_ids) == 0
+    # The co-scheduled request is untouched.
+    assert outs["1"].outputs[0].finish_reason == "length"
+    assert len(outs["1"].outputs[0].token_ids) == 12
+
+    # The sealed trace explains WHY: numerics_anomaly ahead of the
+    # abort terminal.
+    trace = get_flight_recorder().get_trace("0")
+    events = [e["event"] for e in trace]
+    assert "numerics_anomaly" in events
+    assert events.index("numerics_anomaly") < events.index("finished")
+    anomaly = trace[events.index("numerics_anomaly")]
+    assert "nan" in (anomaly.get("detail") or "")
+    assert trace[events.index("finished")]["detail"] == "abort"
+
+    snap = tracker.snapshot()
+    assert snap["anomalies"]["nan"] >= 1
+    assert snap["quarantined"] >= 1
+    assert snap["last_anomaly"]["request_id"] == "0"
+
+    # ...and the page-severity rule is active on the fresh anomaly.
+    active, _, detail = NumericsAnomalyRule(window_s=600.0).evaluate(
+        None, now=0.0)
+    assert active is True, detail
+
+
+def test_host_pool_byte_flip_is_caught_at_swap_in(tiny_opt_dir,
+                                                  fresh_numerics):
+    audit = get_kv_audit()
+    audit.configure(enabled=True, sample=1.0)
+    llm = _build(tiny_opt_dir)
+    # Prefill something so device blocks hold real (nonzero) KV.
+    _greedy_tokens(llm, max_tokens=4)
+    cache_engine = llm.llm_engine.worker.cache_engine
+
+    cache_engine.swap_out({0: 1, 1: 2})
+    snap = audit.snapshot()
+    assert snap["checksums"]["swap_out"] == 2 * cache_engine.num_layers
+
+    # Corruption strikes host block 1 while it sits in CPU memory.
+    k_cpu, _v_cpu = cache_engine.cpu_cache[0]
+    k_cpu[1].view(np.uint8).reshape(-1)[5] ^= 0x01
+
+    cache_engine.swap_in({1: 0, 2: 1})
+    snap = audit.snapshot()
+    assert snap["checksums"]["swap_in"] == 2 * cache_engine.num_layers
+    assert snap["mismatches"]["swap_in"] == 1
+    assert snap["last_mismatch"]["layer"] == 0
+    assert snap["last_mismatch"]["block"] == 1
+
+    active, _, detail = KVIntegrityMismatchRule(window_s=600.0).evaluate(
+        None, now=0.0)
+    assert active is True, detail

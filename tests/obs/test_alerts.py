@@ -221,9 +221,14 @@ def test_built_in_catalogue_names_and_severities():
     assert set(rules) == {"slo_burn_rate", "watchdog_stall",
                           "hbm_headroom", "mfu_collapse",
                           "compile_storm", "router_failover",
-                          "kv_transfer_stall", "tenant_noisy_neighbor"}
+                          "kv_transfer_stall", "tenant_noisy_neighbor",
+                          "numerics_anomaly", "kv_integrity_mismatch",
+                          "spec_accept_collapse"}
     pages = {n for n, r in rules.items() if r.severity == "page"}
-    assert pages == {"slo_burn_rate", "watchdog_stall", "hbm_headroom"}
+    # Output-integrity incidents page: corrupted output is a correctness
+    # failure, not a performance dip.
+    assert pages == {"slo_burn_rate", "watchdog_stall", "hbm_headroom",
+                     "numerics_anomaly", "kv_integrity_mismatch"}
 
 
 def test_kv_transfer_stall_rule_fires_on_wedged_transfer():

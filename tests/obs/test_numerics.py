@@ -1,0 +1,311 @@
+"""Unit tests for obs/numerics.py and its read surfaces: the sentinel
+tracker (panel observation, masking semantics, quarantine hand-off),
+the sampled KV-integrity auditor (a flipped byte between swap-out and
+swap-in must be caught), the canary ledger, the three new alert rules,
+the black-box flush, and wdiff's numerics section directions."""
+import json
+
+import numpy as np
+import pytest
+
+from intellillm_tpu.obs import numerics as numerics_mod
+from intellillm_tpu.obs.alerts import (KVIntegrityMismatchRule,
+                                       NumericsAnomalyRule,
+                                       SpecAcceptCollapseRule,
+                                       built_in_rules)
+from intellillm_tpu.obs.diff import diff_summaries, metric_direction
+from intellillm_tpu.obs.numerics import (CanaryLedger, KVIntegrityAuditor,
+                                         get_canary_ledger, get_kv_audit,
+                                         get_numerics_tracker)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singletons():
+    numerics_mod.reset_for_testing()
+    yield
+    numerics_mod.reset_for_testing()
+
+
+def _panel(rows):
+    """[B, 5] float32 sentinel panel from (nan, inf, max_abs, top1,
+    entropy) tuples — what the mixed dispatch fetches."""
+    return np.asarray(rows, np.float32)
+
+
+class TestNumericsTracker:
+
+    def test_clean_step_counts_rows_only(self):
+        tracker = get_numerics_tracker()
+        tracker.configure(enabled=True)
+        stats = _panel([(0, 0, 12.5, 0.9, 0.4), (0, 0, 8.0, 0.5, 1.2)])
+        tracker.observe_step(stats, [(0, ("req-a", 0)), (1, ("req-b", 0))])
+        snap = tracker.snapshot()
+        assert snap["rows_checked"] == 2
+        assert snap["anomalies"] == {"nan": 0, "inf": 0, "max_abs": 0}
+        assert tracker.take_quarantine("req-a") is None
+        assert tracker.last_anomaly_age_s() is None
+        assert snap["last_step"]["rows"] == 2
+
+    def test_nan_row_quarantines_that_request_only(self):
+        tracker = get_numerics_tracker()
+        tracker.configure(enabled=True)
+        stats = _panel([(3, 0, 12.5, np.nan, np.nan),
+                        (0, 0, 8.0, 0.5, 1.2)])
+        tracker.observe_step(stats, [(0, ("bad", 7)), (1, ("good", 0))])
+        assert tracker.snapshot()["anomalies"]["nan"] == 1
+        assert tracker.take_quarantine("good") is None
+        info = tracker.take_quarantine("bad")
+        assert info is not None
+        assert info["kinds"] == ["nan"]
+        assert info["seq_id"] == 7
+        # Popped exactly once; the engine won't double-abort.
+        assert tracker.take_quarantine("bad") is None
+        assert tracker.snapshot()["quarantined"] == 1
+        assert tracker.last_anomaly_age_s() is not None
+
+    def test_inf_and_max_abs_kinds(self):
+        tracker = get_numerics_tracker()
+        tracker.configure(enabled=True, max_abs_threshold=100.0)
+        stats = _panel([(0, 2, 50.0, 0.9, 0.1),
+                        (0, 0, 5000.0, 0.9, 0.1)])
+        tracker.observe_step(stats, [(0, ("r-inf", 0)), (1, ("r-big", 0))])
+        snap = tracker.snapshot()
+        assert snap["anomalies"]["inf"] == 1
+        assert snap["anomalies"]["max_abs"] == 1
+        assert tracker.take_quarantine("r-inf")["kinds"] == ["inf"]
+        assert tracker.take_quarantine("r-big")["kinds"] == ["max_abs"]
+
+    def test_non_finite_max_abs_counts_as_nan(self):
+        # A NaN that reached the max-abs reduction itself (the panel's
+        # max_abs cell is NaN) still trips the nan sentinel.
+        tracker = get_numerics_tracker()
+        tracker.configure(enabled=True)
+        stats = _panel([(0, 0, np.nan, 0.9, 0.1)])
+        tracker.observe_step(stats, [(0, ("r", 0))])
+        assert tracker.snapshot()["anomalies"]["nan"] == 1
+
+    def test_inject_vector_consumed_once(self):
+        tracker = get_numerics_tracker()
+        tracker.configure(enabled=True)
+        tracker.inject_nan("victim")
+        rows = [("other", 0), ("victim", 0)]
+        vec = tracker.inject_vector(rows, padded_n=4)
+        assert vec.shape == (4,)
+        assert np.isnan(vec[1]) and vec[0] == 0.0 and vec[2] == 0.0
+        # Consumed: the next step's vector is clean again.
+        assert not np.isnan(tracker.inject_vector(rows, padded_n=4)).any()
+
+    def test_health_block_shape(self):
+        block = get_numerics_tracker().health_block()
+        assert set(block) == {"enabled", "rows_checked", "anomalies",
+                              "quarantined"}
+
+
+class TestKVIntegrityAuditor:
+
+    def _arrs(self):
+        rng = np.random.RandomState(7)
+        return (rng.randn(2, 16, 4).astype(np.float32),
+                rng.randn(2, 16, 4).astype(np.float32))
+
+    def test_swap_roundtrip_verifies_clean(self):
+        audit = get_kv_audit()
+        audit.configure(enabled=True, sample=1.0)
+        k, v = self._arrs()
+        audit.record("swap_out", layer=0, block=3, k_arr=k, v_arr=v)
+        assert audit.verify("swap_in", 0, 3, k, v) is True
+        snap = audit.snapshot()
+        assert snap["checksums"]["swap_out"] == 1
+        assert snap["checksums"]["swap_in"] == 1
+        assert sum(snap["mismatches"].values()) == 0
+        assert audit.last_mismatch_age_s() is None
+
+    def test_byte_flip_between_swap_out_and_swap_in_is_caught(self):
+        audit = get_kv_audit()
+        audit.configure(enabled=True, sample=1.0)
+        k, v = self._arrs()
+        audit.record("swap_out", layer=1, block=9, k_arr=k, v_arr=v)
+        # One bit flips while the block sits in the host pool.
+        corrupted = k.copy()
+        corrupted.view(np.uint8).reshape(-1)[13] ^= 0x40
+        assert audit.verify("swap_in", 1, 9, corrupted, v) is False
+        snap = audit.snapshot()
+        assert snap["mismatches"]["swap_in"] == 1
+        assert snap["last_mismatch"]["layer"] == 1
+        assert snap["last_mismatch"]["block"] == 9
+        assert audit.last_mismatch_age_s() is not None
+
+    def test_unsampled_block_verifies_none(self):
+        audit = get_kv_audit()
+        audit.configure(enabled=True, sample=1.0)
+        k, v = self._arrs()
+        # Nothing recorded for this (layer, block): no verdict.
+        assert audit.verify("swap_in", 5, 5, k, v) is None
+
+    def test_should_audit_deterministic_and_gated(self):
+        audit = KVIntegrityAuditor()
+        audit.configure(enabled=True, sample=0.25)
+        picks = [audit.should_audit(layer, block)
+                 for layer in range(4) for block in range(64)]
+        # Deterministic: swap-out and swap-in always agree.
+        assert picks == [audit.should_audit(layer, block)
+                         for layer in range(4) for block in range(64)]
+        assert any(picks) and not all(picks)
+        audit.configure(enabled=False)
+        assert audit.should_audit(0, 0) is False
+        audit.configure(enabled=True, sample=0.0)
+        assert audit.should_audit(0, 0) is False
+        audit.configure(sample=1.0)
+        assert audit.should_audit(0, 0) is True
+
+    def test_export_import_paths_count_only(self):
+        audit = get_kv_audit()
+        audit.configure(enabled=True, sample=1.0)
+        k, v = self._arrs()
+        audit.record("export", 0, 1, k, v)
+        audit.record("import", 0, 1, k, v)
+        snap = audit.snapshot()
+        assert snap["checksums"]["export"] == 1
+        assert snap["checksums"]["import"] == 1
+        # Export staging hashes are never kept for swap-in verification
+        # (transit is the wire format's job).
+        assert audit.verify("swap_in", 0, 1, k, v) is None
+
+
+class TestCanaryLedger:
+
+    def test_record_run_and_snapshot(self):
+        ledger = CanaryLedger(now_fn=lambda: 100.0)
+        ledger.record_run({"r0": "aaaa", "r1": "aaaa", "r2": "bbbb"},
+                          reference="aaaa", suspects=["r2"])
+        ledger.record_run({"r0": "aaaa", "r1": "aaaa", "r2": "bbbb"},
+                          reference="aaaa", suspects=["r2"])
+        snap = ledger.snapshot()
+        assert snap["runs_total"] == 2
+        assert snap["reference_digest"] == "aaaa"
+        assert snap["suspects"] == ["r2"]
+        assert snap["divergence_total"] == {"r2": 2}
+        assert snap["verdicts"]["r0"]["suspect"] is False
+        assert ledger.suspects() == ["r2"]
+
+    def test_reconvergence_clears_suspects(self):
+        ledger = CanaryLedger(now_fn=lambda: 100.0)
+        ledger.record_run({"r0": "a", "r1": "b"}, "a", ["r1"])
+        ledger.record_run({"r0": "a", "r1": "a"}, "a", [])
+        assert ledger.suspects() == []
+        # ...but the per-replica divergence history is kept.
+        assert ledger.snapshot()["divergence_total"] == {"r1": 1}
+
+
+class _FakeHistory:
+    def __init__(self, deltas):
+        self._deltas = deltas
+
+    def delta(self, name, window_s, now=None):
+        return self._deltas.get(name)
+
+
+class TestAlertRules:
+
+    def test_numerics_rule_no_data_while_disabled(self):
+        get_numerics_tracker().configure(enabled=False)
+        rule = NumericsAnomalyRule(window_s=60.0)
+        active, value, detail = rule.evaluate(None, now=0.0)
+        assert active is None
+        assert "disabled" in detail
+
+    def test_numerics_rule_fires_on_fresh_anomaly(self):
+        tracker = get_numerics_tracker()
+        tracker.configure(enabled=True)
+        rule = NumericsAnomalyRule(window_s=60.0)
+        active, value, _ = rule.evaluate(None, now=0.0)
+        assert active is False and value == 0.0
+        tracker.observe_step(_panel([(1, 0, 1.0, np.nan, np.nan)]),
+                             [(0, ("r", 0))])
+        active, value, detail = rule.evaluate(None, now=0.0)
+        assert active is True
+        assert "quarantined" in detail
+
+    def test_kv_rule_fires_on_mismatch(self):
+        audit = get_kv_audit()
+        audit.configure(enabled=True, sample=1.0)
+        rule = KVIntegrityMismatchRule(window_s=60.0)
+        active, _, _ = rule.evaluate(None, now=0.0)
+        assert active is False
+        k = np.ones((2, 4), np.float32)
+        audit.record("swap_out", 0, 0, k, k)
+        audit.verify("swap_in", 0, 0, k + 1, k)
+        active, _, detail = rule.evaluate(None, now=0.0)
+        assert active is True
+        assert "mismatch" in detail
+
+    def test_spec_collapse_rule(self):
+        rule = SpecAcceptCollapseRule(window_s=60.0, min_accept=0.1,
+                                      min_drafts=64.0)
+        # No speculative decoding running: series absent, no verdict.
+        active, _, _ = rule.evaluate(_FakeHistory({}), now=0.0)
+        assert active is None
+        # Too few drafts for a meaningful rate.
+        active, _, _ = rule.evaluate(_FakeHistory({
+            "intellillm_spec_draft_tokens_total": 8.0,
+            "intellillm_spec_accepted_tokens_total": 0.0}), now=0.0)
+        assert active is False
+        # Collapse: 2% acceptance over a real draft volume.
+        active, value, _ = rule.evaluate(_FakeHistory({
+            "intellillm_spec_draft_tokens_total": 1000.0,
+            "intellillm_spec_accepted_tokens_total": 20.0}), now=0.0)
+        assert active is True and value == 0.02
+        # Healthy acceptance stays quiet.
+        active, _, _ = rule.evaluate(_FakeHistory({
+            "intellillm_spec_draft_tokens_total": 1000.0,
+            "intellillm_spec_accepted_tokens_total": 700.0}), now=0.0)
+        assert active is False
+
+    def test_rules_are_registered(self):
+        names = {r.name for r in built_in_rules()}
+        assert {"numerics_anomaly", "kv_integrity_mismatch",
+                "spec_accept_collapse"} <= names
+
+
+class TestBlackBoxAndDiff:
+
+    def test_black_box_dump_includes_numerics_and_canary(self, tmp_path):
+        from intellillm_tpu.obs.trace_export import flush_black_box
+        get_numerics_tracker().configure(enabled=True)
+        get_canary_ledger().record_run({"r0": "a", "r1": "b"}, "a", ["r1"])
+        path = flush_black_box("test", black_box_dir=str(tmp_path))
+        with open(path, encoding="utf-8") as f:
+            dump = json.load(f)
+        assert dump["numerics"]["sentinels"]["enabled"] is True
+        assert "kv_audit" in dump["numerics"]
+        assert dump["canary"]["suspects"] == ["r1"]
+
+    def test_wdiff_numerics_section_directions(self):
+        base = {"numerics": {
+            "sentinels": {"anomalies": {"nan": 0}, "quarantined": 0,
+                          "rows_checked": 1000},
+            "kv_audit": {"mismatches": {"swap_in": 0},
+                         "tracked_digests": 10}}}
+        cand = {"numerics": {
+            "sentinels": {"anomalies": {"nan": 3}, "quarantined": 3,
+                          "rows_checked": 1000},
+            "kv_audit": {"mismatches": {"swap_in": 2},
+                         "tracked_digests": 40}}}
+        report = diff_summaries(base, cand)
+        assert "numerics" in report["regressed_sections"]
+        flagged = {r["metric"] for r in
+                   report["sections"]["numerics"]["regressions"]}
+        assert "sentinels.anomalies.nan" in flagged
+        assert "sentinels.quarantined" in flagged
+        assert "kv_audit.mismatches.swap_in" in flagged
+        # Digest counts are identifiers, not magnitudes: never flagged.
+        assert "kv_audit.tracked_digests" not in flagged
+
+    def test_metric_directions(self):
+        assert metric_direction("sentinels.anomalies.nan") == "lower"
+        assert metric_direction("kv_audit.mismatches.swap_in") == "lower"
+        assert metric_direction("canary.divergence_total.r2") == "lower"
+        assert metric_direction("reference_digest") is None
+        # The guard the _LOWER_BETTER comment documents: a bare "nan"
+        # fragment would swallow every per-tenant metric.
+        assert metric_direction("per_tenant_requests") is None

@@ -63,6 +63,15 @@ def test_constructors_are_found():
     assert "intellillm_workload_requests_total" in names
     assert "intellillm_workload_prompt_tokens_total" in names
     assert "intellillm_workload_output_tokens_total" in names
+    # Numerics / output-integrity families (PR 19).
+    assert "intellillm_numerics_rows_checked_total" in names
+    assert "intellillm_numerics_anomalies_total" in names
+    assert "intellillm_numerics_quarantined_total" in names
+    assert "intellillm_kv_integrity_checksums_total" in names
+    assert "intellillm_kv_integrity_mismatches_total" in names
+    assert "intellillm_router_canary_runs_total" in names
+    assert "intellillm_router_canary_divergence_total" in names
+    assert "intellillm_router_canary_suspect" in names
 
 
 def test_every_metric_name_is_prefixed():

@@ -1,0 +1,197 @@
+"""Fleet divergence canaries: the ReplicaManager periodically runs a
+deterministic greedy prompt through every live replica, majority-votes
+the output digests, flags the odd replica out as `suspect` (optionally
+draining it from routing), and records the verdict in the CanaryLedger
+that backs the router's fleet alerts and /debug/numerics. No engines:
+the `canary_digest_override` testing hook forces digests."""
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from intellillm_tpu.obs import get_alert_manager, get_canary_ledger
+from intellillm_tpu.obs import numerics as numerics_mod
+from intellillm_tpu.router.policy import RouterConfig
+from intellillm_tpu.router.replica import Replica, ReplicaManager
+from intellillm_tpu.router.server import Router, build_router_app
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singletons(monkeypatch):
+    """Each test gets a clean CanaryLedger (it is process-global — the
+    router poller writes it, fleet alerts read it) and a disabled alert
+    manager so engine tests earlier in the run can't pollute the fleet
+    union."""
+    monkeypatch.setenv("INTELLILLM_ALERTS", "0")
+    numerics_mod.reset_for_testing()
+    manager = get_alert_manager()
+    manager.reset_for_testing()
+    yield
+    monkeypatch.undo()
+    numerics_mod.reset_for_testing()
+    manager.reset_for_testing()
+
+
+class _OkReplica(Replica):
+    """Health-pollable base replica (the ABC raises NotImplementedError).
+    `health_extra` merges into the body — the app's startup poller
+    overwrites any stubbed `last_health`, so per-replica blocks must
+    come from the poll itself."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.health_extra: dict = {}
+
+    async def health_detail(self):
+        return 200, {"status": "ok", **self.health_extra}
+
+
+def _fleet(digests, **mgr_kwargs):
+    """A manager with one healthy override-digest replica per entry."""
+    mgr_kwargs.setdefault("canary_every", 1)
+    mgr = ReplicaManager(**mgr_kwargs)
+    for rid, digest in digests.items():
+        r = _OkReplica(rid)
+        r.canary_digest_override = digest
+        mgr.add(r, healthy=True)
+    return mgr
+
+
+def _run(app, scenario):
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await scenario(client)
+        finally:
+            await client.close()
+    asyncio.run(go())
+
+
+def test_divergent_replica_flagged_suspect_in_one_run():
+    mgr = _fleet({"r0": "aaaa", "r1": "aaaa", "r2": "bbbb"})
+    digests = asyncio.run(mgr.run_canary())
+    assert digests == {"r0": "aaaa", "r1": "aaaa", "r2": "bbbb"}
+    assert mgr.replicas["r2"].suspect is True
+    assert mgr.replicas["r0"].suspect is False
+    assert mgr.replicas["r1"].suspect is False
+    # Without drain the suspect keeps serving (alert-only mode).
+    assert mgr.replicas["r2"].healthy is True
+    ledger = get_canary_ledger().snapshot()
+    assert ledger["runs_total"] == 1
+    assert ledger["reference_digest"] == "aaaa"
+    assert ledger["suspects"] == ["r2"]
+    assert ledger["verdicts"]["r2"]["suspect"] is True
+    assert ledger["divergence_total"] == {"r2": 1}
+
+
+def test_no_strict_majority_marks_nobody():
+    """A 1:1 split has no reference digest — the canary detects the odd
+    replica out, not which side is right."""
+    mgr = _fleet({"r0": "aaaa", "r1": "bbbb"})
+    asyncio.run(mgr.run_canary())
+    assert mgr.replicas["r0"].suspect is False
+    assert mgr.replicas["r1"].suspect is False
+    snap = get_canary_ledger().snapshot()
+    assert snap["reference_digest"] is None
+    assert snap["suspects"] == []
+
+
+def test_failed_canary_is_health_problem_not_divergence():
+    """A replica whose canary stream failed (digest None) is not
+    suspect — that is a liveness problem for the health poller."""
+
+    class _Boom(_OkReplica):
+        async def canary(self, prompt, max_tokens=8):
+            raise RuntimeError("stream died")
+
+    mgr = _fleet({"r0": "aaaa", "r1": "aaaa"})
+    boom = _Boom("r2")
+    mgr.add(boom, healthy=True)
+    digests = asyncio.run(mgr.run_canary())
+    assert digests["r2"] is None
+    assert boom.suspect is False
+    assert get_canary_ledger().snapshot()["suspects"] == []
+
+
+def test_poll_once_triggers_canary_on_cadence():
+    """canary_every=2: the first poll tick does not canary, the second
+    does — a forced-divergent replica is suspect within one cycle."""
+    mgr = _fleet({"r0": "aaaa", "r1": "aaaa", "r2": "bbbb"},
+                 canary_every=2)
+    asyncio.run(mgr.poll_once())
+    assert get_canary_ledger().snapshot()["runs_total"] == 0
+    asyncio.run(mgr.poll_once())
+    assert get_canary_ledger().snapshot()["runs_total"] == 1
+    assert mgr.replicas["r2"].suspect is True
+
+
+def test_canary_drain_evicts_and_reconverges():
+    mgr = _fleet({"r0": "aaaa", "r1": "aaaa", "r2": "bbbb"},
+                 canary_drain=True)
+    asyncio.run(mgr.run_canary())
+    r2 = mgr.replicas["r2"]
+    assert r2.suspect is True
+    # Drain: out of the routing candidate set immediately...
+    assert r2.healthy is False
+    assert set(mgr.healthy_loads()) == {"r0", "r1"}
+    # ...and a later 200-ok health poll must NOT resurrect it while the
+    # canary still distrusts it (its self-report is exactly what the
+    # canary doubts). poll_once also re-runs the canary (canary_every=1)
+    # with the digest still divergent, so it stays suspect+drained.
+    asyncio.run(mgr.poll_once())
+    assert r2.suspect is True
+    assert r2.healthy is False
+    assert set(mgr.healthy_loads()) == {"r0", "r1"}
+    # The replica recovers (weights reloaded): its canary re-converges,
+    # the suspect flag clears, and the next poll readmits it.
+    r2.canary_digest_override = "aaaa"
+    asyncio.run(mgr.run_canary())
+    assert r2.suspect is False
+    asyncio.run(mgr.poll_once())
+    assert r2.healthy is True
+    assert set(mgr.healthy_loads()) == {"r0", "r1", "r2"}
+
+
+def test_fleet_alerts_and_snapshot_carry_canary_verdict():
+    mgr = _fleet({"r0": "aaaa", "r1": "aaaa", "r2": "bbbb"})
+    asyncio.run(mgr.run_canary())
+    router = Router(RouterConfig(), mgr)
+    fa = router.fleet_alerts()
+    assert "canary_divergence" in fa["fleet"]["rules_firing"]
+    assert fa["fleet"]["page_firing"] is True
+    assert fa["canary"]["suspects"] == ["r2"]
+    # The per-replica suspect flag rides the router snapshot that backs
+    # the router's aggregated /health/detail.
+    snap = router.snapshot()
+    assert snap["replicas"]["r2"]["suspect"] is True
+    assert snap["replicas"]["r0"]["suspect"] is False
+    assert snap["replicas"]["r2"]["canary_digest"] == "bbbb"
+
+
+def test_router_debug_numerics_serves_fleet_view():
+    mgr = _fleet({"r0": "aaaa", "r1": "aaaa", "r2": "bbbb"})
+    asyncio.run(mgr.run_canary())
+    mgr.replicas["r0"].health_extra = {
+        "numerics": {"sentinels": {"enabled": False}}}
+    asyncio.run(mgr.poll_once())
+    router = Router(RouterConfig(), mgr)
+
+    async def scenario(client):
+        resp = await client.get("/debug/numerics")
+        assert resp.status == 200
+        data = await resp.json()
+        # Router-process sentinel/audit snapshot plus the fleet layers.
+        assert "sentinels" in data and "kv_audit" in data
+        assert data["canary"]["suspects"] == ["r2"]
+        assert data["replicas"]["r0"]["sentinels"]["enabled"] is False
+        assert data["replicas"]["r1"] is None
+
+        resp = await client.get("/health/detail")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["router"]["replicas"]["r2"]["suspect"] is True
+        canary = body["router"]["alerts"]["canary"]
+        assert canary["suspects"] == ["r2"]
+
+    _run(build_router_app(router), scenario)
