@@ -211,13 +211,17 @@ DEFAULT_DOC_FILES: Tuple[str, ...] = (
     "docs/observability.md",
     "docs/routing.md",
     "docs/scheduling.md",
+    "docs/kernels.md",
 )
 DEFAULT_METRICS_DOC = "docs/observability.md"
 
 # Env vars of the observability subsystem are operator-facing and
-# belong in the docs/observability.md env table; packages outside obs/
-# carry developer escape hatches that are deliberately undocumented.
-DEFAULT_ENV_VAR_DIRS: Tuple[str, ...] = ("intellillm_tpu/obs", )
+# belong in the docs/observability.md env table, and the kernel
+# selection flags under ops/ belong in docs/kernels.md; packages
+# outside these carry developer escape hatches that are deliberately
+# undocumented.
+DEFAULT_ENV_VAR_DIRS: Tuple[str, ...] = ("intellillm_tpu/obs",
+                                         "intellillm_tpu/ops")
 
 # Quoted intellillm_ literals that are not metric names (the package
 # prefix itself, the request-id contextvar in logger.py).

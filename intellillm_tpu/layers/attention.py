@@ -8,9 +8,13 @@ Role parity: reference `vllm/model_executor/layers/attention.py`
 (:131-133) supported.
 
 TPU redesign: one functional layer; `is_prompt` is a static (trace-time)
-flag so prefill and decode are separate XLA programs. The decode fast path
-is a Pallas kernel (ops/pallas/paged_attention.py) on TPU and the jnp
-gather reference elsewhere.
+flag so prefill and decode are separate XLA programs. The non-prompt
+(mixed/decode) path goes through the fused cache-write + attend seam
+(ops/ragged_attention.py): one Pallas kernel on TPU writes each row's K/V
+into the pool inside the grid and attends over it, replacing the separate
+reshape_and_cache scatter; the jnp reference composes the same scatter +
+gather pair elsewhere. Prompt phases keep the explicit scatter followed by
+the prefill kernels.
 """
 from __future__ import annotations
 
@@ -26,6 +30,7 @@ from intellillm_tpu.ops.attention import (context_attention_reference,
                                           decode_attention_reference,
                                           prefill_attention_reference)
 from intellillm_tpu.ops.kv_cache import reshape_and_cache
+from intellillm_tpu.ops.ragged_attention import ragged_fused_attention
 
 logger = init_logger(__name__)
 
@@ -108,10 +113,13 @@ class PagedAttention:
         flat_k = key.reshape(b * l, self.num_kv_heads, d)
         flat_v = value.reshape(b * l, self.num_kv_heads, d)
         slots = attn_metadata.slot_mapping.reshape(-1)
-        k_cache, v_cache = reshape_and_cache(flat_k, flat_v, k_cache, v_cache,
-                                             slots)
 
         if attn_metadata.is_prompt:
+            # Prompt phase keeps the separate scatter pass: prompt kernels
+            # read K/V from the live activations (and the pool for prefix
+            # reuse), so there is nothing to fuse the write into.
+            k_cache, v_cache = reshape_and_cache(flat_k, flat_v, k_cache,
+                                                 v_cache, slots)
             if attn_metadata.use_prefix:
                 new_lens = attn_metadata.context_lens - attn_metadata.prefix_lens
                 out = context_attention_reference(
@@ -155,16 +163,17 @@ class PagedAttention:
             # This branch also serves CHUNKED-CONTEXT PREFILL (mixed
             # steps, worker/model_runner._execute_mixed): each prefill
             # chunk arrives as flat rows with per-token context_lens =
-            # position + 1. Because reshape_and_cache above writes every
-            # row's K/V into the pool BEFORE this read, a chunk-k query at
-            # position p attends to chunks 0..k-1 (already paged in from
-            # earlier steps) plus the in-flight chunk's rows <= p — exact
-            # causal attention per sequence, one block table per row, no
-            # separate chunked kernel needed.
-            out = _decode_dispatch(query, k_cache, v_cache,
-                                   attn_metadata.block_tables,
-                                   attn_metadata.context_lens, self.scale,
-                                   self.alibi_slopes)
+            # position + 1. The fused seam writes every row's K/V into
+            # the pool BEFORE its read (in-kernel on TPU, a separate
+            # reshape_and_cache pass on the reference path), so a chunk-k
+            # query at position p attends to chunks 0..k-1 (already paged
+            # in from earlier steps) plus the in-flight chunk's rows <= p
+            # — exact causal attention per sequence, one block table per
+            # row, no separate chunked kernel needed.
+            out, k_cache, v_cache = ragged_fused_attention(
+                query, flat_k, flat_v, k_cache, v_cache, slots,
+                attn_metadata.block_tables, attn_metadata.context_lens,
+                self.scale, self.alibi_slopes)
         return out, (k_cache, v_cache)
 
     def _staged_decode(self, query, key, value, kv_cache, attn_metadata):

@@ -2,17 +2,25 @@
 
 Role parity: reference punica kernels (`csrc/punica/bgmv/bgmv_impl.cuh`,
 `vllm/lora/punica.py:17-40` bgmv/add_lora) and the per-layer LoRA wrappers
-(`vllm/lora/layers.py:32-101` _apply_lora*). TPU redesign: instead of a
-hand-written batched-gather matvec kernel, the per-row adapter slab is
-gathered from the stacked adapter tensors and contracted with two einsums
-— XLA maps the [B, Din, R] x [B, R, Dout] chain onto the MXU directly, and
-the gather is a trivial HBM read (the stacks are a few MB). Rows with
-slot 0 hit the reserved all-zero adapter, so padding rows and no-LoRA rows
-cost nothing semantically.
+(`vllm/lora/layers.py:32-101` _apply_lora*). Two paths behind one seam:
+
+- Pallas BGMV kernel (ops/pallas/bgmv.py) on TPU: the adapter stacks stay
+  VMEM-resident and each row's adapter is picked by a dynamic VMEM index
+  — no gathered [B, Din, R] copy in HBM per step. Gated by
+  `use_pallas_kernel("bgmv")` (INTELLILLM_PALLAS_BGMV) and
+  `bgmv_supported` (128-aligned dims, VMEM budget).
+- jnp reference elsewhere: the per-row adapter slab is gathered from the
+  stacked tensors and contracted with two einsums — XLA maps the
+  [B, Din, R] x [B, R, Dout] chain onto the MXU directly.
+
+Rows with slot 0 hit the reserved all-zero adapter on either path, so
+padding rows and no-LoRA rows get an exact +0.0 delta.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
+
+from intellillm_tpu.ops.dispatch import use_pallas_kernel
 
 
 def lora_delta(
@@ -26,6 +34,9 @@ def lora_delta(
     B is pre-scaled by lora_alpha/r at activation time, so the delta adds
     directly onto the base projection output.
     """
+    from intellillm_tpu.ops.pallas.bgmv import bgmv, bgmv_supported
+    if use_pallas_kernel("bgmv") and bgmv_supported(x, a_stack, b_stack):
+        return bgmv(x, a_stack, b_stack, row_slots)
     a_sel = a_stack[row_slots]                     # [B, Din, R]
     b_sel = b_stack[row_slots]                     # [B, R, Dout]
     h = jnp.einsum("bld,bdr->blr", x, a_sel,

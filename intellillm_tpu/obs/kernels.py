@@ -570,6 +570,14 @@ class KernelLedger:
         body["mfu_analytic"] = (round(mfu, 6)
                                 if mfu is not None and math.isfinite(mfu)
                                 else None)
+        # Which path each kernel seam would take if a program were traced
+        # right now (docs/kernels.md) — lets a /debug/kernels before/after
+        # say WHICH kernels produced the ledger it shows.
+        try:
+            from intellillm_tpu.ops.dispatch import kernel_selection
+            body["selection"] = kernel_selection()
+        except Exception:  # pragma: no cover - ops layer must not break obs
+            body["selection"] = None
         return body
 
     def health_block(self) -> Dict[str, Any]:

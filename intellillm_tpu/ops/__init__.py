@@ -1,11 +1,14 @@
 """Device ops: the TPU-native equivalents of the reference's `csrc/` CUDA
 kernels (`csrc/pybind.cpp` ops/cache_ops), implemented as jnp functions that
 XLA fuses, with Pallas kernels for the ops where hand control of HBM traffic
-pays (paged-attention decode, prefill attention)."""
+pays (paged-attention decode, the fused ragged cache-write + attend on the
+mixed path, prefill attention, LoRA bgmv)."""
 from intellillm_tpu.ops.kv_cache import (copy_blocks, reshape_and_cache,
                                          swap_blocks)
 from intellillm_tpu.ops.attention import (decode_attention_reference,
                                           prefill_attention_reference)
+from intellillm_tpu.ops.ragged_attention import (
+    ragged_fused_attention, ragged_fused_attention_reference)
 
 __all__ = [
     "copy_blocks",
@@ -13,4 +16,6 @@ __all__ = [
     "swap_blocks",
     "decode_attention_reference",
     "prefill_attention_reference",
+    "ragged_fused_attention",
+    "ragged_fused_attention_reference",
 ]

@@ -1,3 +1,14 @@
 """Pallas (Mosaic) TPU kernels — the equivalents of the reference's CUDA
-kernels in `csrc/` (paged attention, prefill attention, quant matmuls,
-MoE grouped matmul, LoRA bgmv)."""
+kernels in `csrc/`:
+
+- paged_attention.py — decode-phase paged attention (the consolidated
+  head-block-vectorized kernel; the old v3/v4 twin modules are one now)
+- ragged_paged_attention.py — fused cache-write + causal paged attention
+  over the flat mixed batch (decode + prefill-chunk rows in one grid)
+- flash_attention.py — blockwise-causal prefill flash attention
+- bgmv.py — batched-LoRA gather-matmul (Punica BGMV equivalent)
+- quant_matmul.py — int4 weight-dequant matmuls
+
+Kernel selection lives in ops/dispatch.py; every kernel keeps a jnp
+reference twin (see docs/kernels.md for the contract and flags).
+"""

@@ -261,6 +261,15 @@ class ModelRunner:
             donate_argnames=("kv_caches", ),
         )
 
+        # Pin the trace-time kernel selection at construction: every
+        # executable this runner compiles bakes these paths in, and a
+        # mid-flight env flip would otherwise be invisible in the logs
+        # (the flags are only consulted while tracing).
+        from intellillm_tpu.ops.dispatch import kernel_selection
+        self.kernel_selection = kernel_selection()
+        logger.info("Kernel selection for this runner's programs: %s",
+                    self.kernel_selection)
+
     def _guarded_call(self, program, key, fn, /, *args, **kwargs):
         """Every jitted dispatch goes through here: compile tracking
         (obs/compile_tracker.py), the kernel cost ledger

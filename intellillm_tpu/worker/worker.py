@@ -414,8 +414,13 @@ class Worker:
                         # lint: allow(host-sync) reason=warm-up runs before serving; blocking here ensures executables are resident and the logged compile wall-time is honest
                         jax.block_until_ready(packed)
             seconds = _time.monotonic() - start
+            from intellillm_tpu.ops.dispatch import kernel_selection
             self.warmup_stats = {"executables": n,
-                                 "seconds": round(seconds, 3)}
+                                 "seconds": round(seconds, 3),
+                                 # Selection is trace-time, so the paths
+                                 # recorded here are the ones baked into
+                                 # the executables just compiled.
+                                 "kernel_selection": kernel_selection()}
             logger.info("Warm-up: compiled %d mixed-family executables "
                         "(token buckets=%s) in %.1fs", n,
                         "/".join(str(x) for x in batch_sizes), seconds)

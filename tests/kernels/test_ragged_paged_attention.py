@@ -1,0 +1,144 @@
+"""Ragged fused cache-write + attend kernel vs the incumbent composition
+(reshape_and_cache then decode_attention_reference) — the golden oracle
+the mixed path is pinned against. On TPU the Mosaic kernel compiles
+natively; on CPU it runs under Pallas TPU interpret mode
+(tests/kernels/conftest.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from intellillm_tpu.ops.ragged_attention import (
+    ragged_fused_attention_reference)
+
+requires_tpu = pytest.mark.kernel
+
+
+def _mixed_batch(rng, *, hq, hkv, d, nb=64, bs=16, w=8,
+                 ctx_lens=(1, 17, 63, 30, 31, 32, 0), dtype=np.float32):
+    """A mixed batch: decode rows plus a chunk run (three consecutive
+    rows of ONE sequence at positions 29/30/31 — rows 3..5 share a block
+    table, each must see its predecessors' just-written K/V) plus a pad
+    row (ctx 0, slot -1)."""
+    b = len(ctx_lens)
+    k_cache = jnp.asarray(rng.normal(size=(nb, hkv, bs, d)).astype(dtype))
+    v_cache = jnp.asarray(rng.normal(size=(nb, hkv, bs, d)).astype(dtype))
+    q = jnp.asarray(rng.normal(size=(b, 1, hq, d)).astype(np.float32))
+    k_new = jnp.asarray(rng.normal(size=(b, hkv, d)).astype(np.float32))
+    v_new = jnp.asarray(rng.normal(size=(b, hkv, d)).astype(np.float32))
+
+    tables = rng.permutation(nb)[:b * w].reshape(b, w).astype(np.int32)
+    # Rows 3..5 are the chunk run: one sequence, one table.
+    tables[4] = tables[3]
+    tables[5] = tables[3]
+    slots = []
+    for i, c in enumerate(ctx_lens):
+        if c == 0:
+            slots.append(-1)
+            continue
+        blk = int(tables[i, (c - 1) // bs])
+        slots.append(blk * bs + (c - 1) % bs)
+    return (q, k_new, v_new, k_cache, v_cache,
+            jnp.asarray(np.asarray(slots, np.int32)), jnp.asarray(tables),
+            jnp.asarray(np.asarray(ctx_lens, np.int32)))
+
+
+def _run_both(args, scale, alibi_slopes=None, cache_cast=None):
+    from intellillm_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention)
+    q, k_new, v_new, k_cache, v_cache, slots, tables, ctx = args
+    if cache_cast is not None:
+        k_cache = k_cache.astype(cache_cast)
+        v_cache = v_cache.astype(cache_cast)
+    out_k, kc_k, vc_k = ragged_paged_attention(
+        q, k_new.astype(k_cache.dtype), v_new.astype(v_cache.dtype),
+        k_cache, v_cache, slots, tables, ctx, scale, alibi_slopes)
+    out_r, kc_r, vc_r = ragged_fused_attention_reference(
+        q, k_new, v_new, k_cache, v_cache, slots, tables, ctx, scale,
+        alibi_slopes)
+    return (out_k, kc_k, vc_k), (out_r, kc_r, vc_r)
+
+
+@requires_tpu
+@pytest.mark.parametrize("hq,hkv", [(8, 8), (8, 2), (4, 1)])
+def test_ragged_matches_incumbent_composition(hq, hkv):
+    rng = np.random.default_rng(0)
+    d = 128
+    args = _mixed_batch(rng, hq=hq, hkv=hkv, d=d)
+    (out_k, kc_k, vc_k), (out_r, kc_r, vc_r) = _run_both(args, d**-0.5)
+    tol = 5e-3 if jax.default_backend() == "tpu" else 2e-3
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                               rtol=tol, atol=tol)
+    # The in-grid write must leave the pool byte-identical to the
+    # separate scatter pass (same dtype, no arithmetic on the way in).
+    np.testing.assert_array_equal(np.asarray(kc_k), np.asarray(kc_r))
+    np.testing.assert_array_equal(np.asarray(vc_k), np.asarray(vc_r))
+
+
+@requires_tpu
+def test_ragged_chunk_rows_see_in_flight_writes():
+    """The chunk-run rows (3..5) attend to positions written by the rows
+    just before them in the SAME kernel launch — the write-before-read
+    ordering the sequential grid guarantees. A kernel that read stale
+    pages for its predecessor's token would diverge from the oracle
+    exactly on rows 4 and 5."""
+    rng = np.random.default_rng(2)
+    d, hq, hkv = 128, 4, 2
+    args = _mixed_batch(rng, hq=hq, hkv=hkv, d=d)
+    (out_k, _, _), (out_r, _, _) = _run_both(args, d**-0.5)
+    tol = 5e-3 if jax.default_backend() == "tpu" else 2e-3
+    np.testing.assert_allclose(np.asarray(out_k)[4:6],
+                               np.asarray(out_r)[4:6],
+                               rtol=tol, atol=tol)
+
+
+@requires_tpu
+def test_ragged_alibi_matches_incumbent():
+    from intellillm_tpu.layers.alibi import get_alibi_slopes
+    rng = np.random.default_rng(3)
+    d, hq, hkv = 128, 8, 2
+    slopes = jnp.asarray(get_alibi_slopes(hq), jnp.float32)
+    args = _mixed_batch(rng, hq=hq, hkv=hkv, d=d)
+    (out_k, _, _), (out_r, _, _) = _run_both(args, d**-0.5,
+                                             alibi_slopes=slopes)
+    tol = 2e-2 if jax.default_backend() == "tpu" else 2e-3
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                               rtol=tol, atol=tol)
+
+
+@requires_tpu
+def test_ragged_bf16_cache_self_token_uses_cast_values():
+    """With a bf16 pool the self-token must contribute its POST-cast
+    value (the reference reads the cache after the write); a kernel that
+    attended over the f32 pre-cast k_new/v_new would drift on exactly
+    the ctx=1 row, where the self token is the whole softmax."""
+    rng = np.random.default_rng(4)
+    d, hq, hkv = 128, 4, 2
+    args = _mixed_batch(rng, hq=hq, hkv=hkv, d=d,
+                        ctx_lens=(1, 1, 5, 40, 1, 2, 0))
+    (out_k, kc_k, vc_k), (out_r, kc_r, vc_r) = _run_both(
+        args, d**-0.5, cache_cast=jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(out_k, np.float32),
+                               np.asarray(out_r, np.float32),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_array_equal(
+        np.asarray(kc_k.astype(jnp.float32)),
+        np.asarray(kc_r.astype(jnp.float32)))
+    np.testing.assert_array_equal(
+        np.asarray(vc_k.astype(jnp.float32)),
+        np.asarray(vc_r.astype(jnp.float32)))
+
+
+@requires_tpu
+def test_ragged_rejects_uncast_kv():
+    from intellillm_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention)
+    rng = np.random.default_rng(5)
+    d, hq, hkv = 128, 4, 2
+    q, k_new, v_new, k_cache, v_cache, slots, tables, ctx = _mixed_batch(
+        rng, hq=hq, hkv=hkv, d=d)
+    with pytest.raises(ValueError, match="pre-cast"):
+        ragged_paged_attention(q, k_new, v_new,
+                               k_cache.astype(jnp.bfloat16),
+                               v_cache.astype(jnp.bfloat16), slots,
+                               tables, ctx, d**-0.5)

@@ -78,6 +78,31 @@ def test_warm_up_default_is_two_mixed_executables(monkeypatch,
     assert "error" not in worker.warmup_stats
 
 
+@pytest.mark.parametrize("num_decode_steps", [1, 4])
+def test_warm_up_count_invariant_under_kernel_flags(monkeypatch,
+                                                    num_decode_steps):
+    """Selecting the Pallas hot-path kernels (INTELLILLM_PALLAS_RAGGED /
+    INTELLILLM_PALLAS_BGMV) must not change the default warm-up: the
+    flags pick a code path at trace time INSIDE the two mixed
+    executables, so the count stays exactly 2 and no extra program
+    appears. (On this tiny model head size is 16, so the attention
+    seam falls back to the reference body — which is precisely the
+    invariance being pinned: flag state must not leak into bucketing.)
+    The stats must also carry the trace-time kernel_selection snapshot
+    that /health/detail and bench read."""
+    monkeypatch.setenv("INTELLILLM_PALLAS_RAGGED", "1")
+    monkeypatch.setenv("INTELLILLM_PALLAS_BGMV", "1")
+    worker = _make_worker(num_decode_steps)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    n = worker.warm_up_model()
+    assert n is not None, "warm-up fell back to lazy compilation"
+    assert n == 2
+    assert worker.warmup_stats["executables"] == 2
+    sel = worker.warmup_stats["kernel_selection"]
+    assert sel["ragged"] is True
+    assert sel["bgmv"] is True
+
+
 def test_warm_up_skipped_on_cpu():
     worker = _make_worker(1)
     assert worker.warm_up_model() is None
